@@ -1,0 +1,51 @@
+"""mxnet_tpu — a TPU-native deep learning framework with the capabilities of
+2016-era MXNet (reference: hschen0712/mxnet).
+
+The public API mirrors ``import mxnet as mx``:
+
+* ``mx.nd`` — imperative NDArray over jax.Array + dependency engine
+* ``mx.sym`` — symbolic graph with autodiff, compiled whole-graph to XLA
+* ``mx.io`` — data iterators (NDArray/MNIST/CSV/ImageRecord) with prefetch
+* ``mx.kv`` — KVStore (local / device / tpu_sync collective all-reduce)
+* ``mx.mod`` / ``mx.model`` — Module and FeedForward training loops
+* ``mx.optimizer`` / ``mx.metric`` / ``mx.init`` — training utilities
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context, num_devices
+from . import engine
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from .ndarray import NDArray
+from .name import NameManager
+from .attribute import AttrScope
+
+__version__ = "0.1.0"
+
+# Submodules below are imported lazily-but-eagerly in dependency order; each
+# maps to a reference frontend module (python/mxnet/*.py).
+from . import symbol          # noqa: E402
+from . import symbol as sym   # noqa: E402
+from .symbol import Symbol    # noqa: E402
+from . import executor        # noqa: E402
+from . import initializer     # noqa: E402
+from . import initializer as init  # noqa: E402
+from . import optimizer       # noqa: E402
+from . import metric          # noqa: E402
+from . import lr_scheduler    # noqa: E402
+from . import io              # noqa: E402
+from . import recordio        # noqa: E402
+from . import kvstore         # noqa: E402
+from . import kvstore as kv   # noqa: E402
+from . import callback        # noqa: E402
+from . import monitor         # noqa: E402
+from .monitor import Monitor  # noqa: E402
+from . import model           # noqa: E402
+from .model import FeedForward  # noqa: E402
+from . import module          # noqa: E402
+from . import module as mod   # noqa: E402
+from . import visualization   # noqa: E402
+from . import visualization as viz  # noqa: E402
+from . import test_utils      # noqa: E402

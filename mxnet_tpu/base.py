@@ -1,0 +1,119 @@
+"""Base types shared across the framework.
+
+TPU-native re-design of the reference's ``include/mxnet/base.h`` +
+``dmlc-core`` basics: error type, dtype table (mshadow ``MSHADOW_TYPE_SWITCH``
+equivalent -> jnp dtypes), environment-variable config access
+(``dmlc::GetEnv`` equivalent), and the string-keyed registry that backs
+operators / io iterators / optimizers / metrics / initializers
+(``DMLC_REGISTRY_*`` equivalent, see reference ``include/mxnet/operator.h:537``).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Generic, List, Optional, TypeVar
+
+import numpy as np
+
+__all__ = [
+    "MXNetError", "mx_real_t", "mx_uint", "DTYPE_NP_TO_ID", "DTYPE_ID_TO_NP",
+    "getenv", "Registry", "string_types",
+]
+
+
+class MXNetError(Exception):
+    """Error raised by the framework (reference: ``MXGetLastError`` convention,
+    ``src/c_api/c_api_error.h``)."""
+
+
+string_types = (str,)
+mx_uint = int
+mx_real_t = np.float32
+
+# dtype id table mirrors mshadow type flags so saved params stay stable
+# (reference: mshadow MSHADOW_TYPE_SWITCH over fp32/fp64/fp16/u8/i32).
+DTYPE_NP_TO_ID: Dict[Any, int] = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.float16): 2,
+    np.dtype(np.uint8): 3,
+    np.dtype(np.int32): 4,
+    np.dtype(np.int8): 5,
+    np.dtype(np.int64): 6,
+    # TPU-native addition: bfloat16 is the MXU-preferred compute dtype
+    np.dtype(np.bool_): 8,
+}
+try:
+    import ml_dtypes  # jax dependency, provides the numpy bfloat16 scalar type
+
+    DTYPE_NP_TO_ID[np.dtype(ml_dtypes.bfloat16)] = 7
+except Exception:  # pragma: no cover
+    pass
+
+DTYPE_ID_TO_NP = {v: k for k, v in DTYPE_NP_TO_ID.items()}
+
+
+def getenv(name: str, default):
+    """``dmlc::GetEnv`` equivalent with type coercion from the default."""
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    if isinstance(default, bool):
+        return val.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(val)
+    if isinstance(default, float):
+        return float(val)
+    return val
+
+
+T = TypeVar("T")
+
+
+class Registry(Generic[T]):
+    """String-keyed registry (``DMLC_REGISTRY_ENABLE`` equivalent).
+
+    Used for operators, io iterators, optimizers, metrics, initializers and
+    ndarray functions, mirroring the reference's dmlc registries.
+    """
+
+    _registries: Dict[str, "Registry"] = {}
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._entries: Dict[str, T] = {}
+        Registry._registries[kind] = self
+
+    @staticmethod
+    def get_registry(kind: str) -> "Registry":
+        if kind not in Registry._registries:
+            Registry(kind)
+        return Registry._registries[kind]
+
+    def register(self, name: Optional[str] = None, override: bool = False) -> Callable[[T], T]:
+        def _do(entry: T) -> T:
+            key = name or getattr(entry, "__name__", None)
+            if key is None:
+                raise MXNetError("registry entry needs a name")
+            lname = key.lower()
+            if lname in self._entries and not override:
+                raise MXNetError(
+                    "%s '%s' already registered" % (self.kind, key))
+            self._entries[lname] = entry
+            return entry
+        return _do
+
+    def find(self, name: str) -> Optional[T]:
+        return self._entries.get(name.lower())
+
+    def get(self, name: str) -> T:
+        entry = self.find(name)
+        if entry is None:
+            raise MXNetError("%s '%s' is not registered; known: %s" % (
+                self.kind, name, sorted(self._entries)))
+        return entry
+
+    def list_names(self) -> List[str]:
+        return sorted(self._entries)
+
+    def items(self):
+        return self._entries.items()

@@ -1,0 +1,354 @@
+"""Graph executor.
+
+TPU-native re-design of the reference's GraphExecutor
+(``src/symbol/graph_executor.h:23-279``): binding a Symbol yields an
+executor whose forward and forward+backward paths are each ONE jitted XLA
+computation over the whole graph. This is the reference's bulk-execution
+design (``InitOpSegs``, ``graph_executor.cc:842-892``) taken to its
+conclusion: instead of pushing per-node engine ops, XLA fuses, schedules and
+plans memory for the entire graph (subsuming the reference's
+GraphStorageAllocator, ``src/symbol/graph_memory_allocator.h``).
+
+Autodiff: the reference builds an explicit backward graph
+(``StaticGraph::MakeBackwardPass``, ``static_graph.cc:395``); here the
+backward computation is ``jax.vjp`` through the same graph-eval function,
+with op-custom gradients (SoftmaxOutput etc.) supplied via
+``jax.custom_vjp`` in each op's ``apply``.
+
+Training-step laziness: ``forward(is_train=True)`` records inputs;
+``backward()`` then runs a single fused fwd+bwd XLA computation that also
+materializes the outputs — so a fit() iteration costs exactly one device
+dispatch. Auxiliary states (BatchNorm moving stats) commit on ``backward()``
+(divergence from the reference: a train-mode forward with no backward does
+not update moving stats).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context
+from .engine import get_engine
+from .ndarray import NDArray
+from .ops.registry import OpContext
+from . import random as _random
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx: Context, args, args_grad=None,
+                 grad_req: Union[str, Dict[str, str], List[str]] = "write",
+                 aux_states=None, group2ctx=None, shared_exec=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self._group2ctx = group2ctx or {}
+        self.arg_names = symbol.list_arguments()
+        self.output_names = symbol.list_outputs()
+        self.aux_names = symbol.list_auxiliary_states()
+
+        self.arg_arrays = self._to_list(args, self.arg_names, "args")
+        self.arg_dict = dict(zip(self.arg_names, self.arg_arrays))
+        if args_grad is None:
+            self.grad_arrays = [None] * len(self.arg_names)
+        else:
+            self.grad_arrays = self._to_list(args_grad, self.arg_names,
+                                             "args_grad", allow_missing=True)
+        self.grad_dict = {n: g for n, g in zip(self.arg_names, self.grad_arrays)
+                          if g is not None}
+
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(self.arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, "null") for n in self.arg_names}
+        for n in self.arg_names:
+            if self.grad_dict.get(n) is None:
+                self._grad_req[n] = "null"
+
+        aux_states = aux_states or []
+        self.aux_arrays = self._to_list(aux_states, self.aux_names, "aux_states")
+        self.aux_dict = dict(zip(self.aux_names, self.aux_arrays))
+
+        self._outputs: Optional[List[NDArray]] = None
+        self._train_pending = False
+        self._monitor_callback = None
+        self._step = 0
+        self._base_key = None
+
+        self._build()
+
+    @staticmethod
+    def _to_list(arrays, names, what, allow_missing=False):
+        if arrays is None:
+            arrays = {}
+        if isinstance(arrays, dict):
+            out = [arrays.get(n) for n in names]
+            if not allow_missing and any(a is None for a in out):
+                missing = [n for n, a in zip(names, out) if a is None]
+                raise MXNetError("%s: missing arrays for %s" % (what, missing))
+            return out
+        arrays = list(arrays)
+        if len(arrays) != len(names):
+            raise MXNetError("%s: expected %d arrays, got %d"
+                             % (what, len(names), len(arrays)))
+        return arrays
+
+    # ------------------------------------------------------------------
+    # graph -> pure function
+    # ------------------------------------------------------------------
+    def _build(self):
+        import jax
+
+        symbol = self._symbol
+        nodes = symbol._topo()
+        arg_index = {}
+        i = 0
+        for n in nodes:
+            if n.is_variable:
+                arg_index[n.uid] = i
+                i += 1
+        # aux slot assignment per node
+        aux_slots = {}
+        slot = 0
+        for n in nodes:
+            if not n.is_variable:
+                k = len(n.op.list_auxiliary_states())
+                if k:
+                    aux_slots[n.uid] = list(range(slot, slot + k))
+                    slot += k
+        self._n_aux = slot
+        out_index = [(n.uid, i) for n, i in symbol._outputs]
+
+        def eval_graph(arg_list, aux_list, key, is_train, want_internals=False):
+            env = {}
+            aux_out = list(aux_list)
+            internals = {}
+            for n in nodes:
+                if n.is_variable:
+                    env[n.uid] = [arg_list[arg_index[n.uid]]]
+                else:
+                    ins = [env[src.uid][i] for src, i in n.inputs]
+                    slots = aux_slots.get(n.uid, [])
+                    aux_in = [aux_out[s] for s in slots]
+                    rng = jax.random.fold_in(key, n.uid) if key is not None else None
+                    octx = OpContext(is_train, rng)
+                    outs, new_aux = n.op.apply(octx, ins, aux_in)
+                    for s, a in zip(slots, new_aux):
+                        aux_out[s] = a
+                    env[n.uid] = list(outs)
+                    if want_internals:
+                        for oi, o in enumerate(outs):
+                            oname = "%s_%s" % (n.name, n.op.list_outputs()[oi])
+                            internals[oname] = o
+            outputs = [env[uid][i] for uid, i in out_index]
+            if want_internals:
+                return outputs, aux_out, internals
+            return outputs, aux_out
+
+        self._eval_graph = eval_graph
+
+        grad_idx = [i for i, n in enumerate(self.arg_names)
+                    if self._grad_req.get(n, "null") != "null"]
+        self._grad_idx = grad_idx
+
+        @jax.jit
+        def fwd_infer(args, aux, key):
+            outs, _ = eval_graph(args, aux, key, False)
+            return outs
+
+        @jax.jit
+        def fwd_train(args, aux, key):
+            return eval_graph(args, aux, key, True)
+
+        @jax.jit
+        def fwd_bwd(args, aux, key, head_grads):
+            garr = [args[i] for i in grad_idx]
+
+            def f(garr):
+                full = list(args)
+                for pos, i in enumerate(grad_idx):
+                    full[i] = garr[pos]
+                outs, aux_out = eval_graph(full, aux, key, True)
+                return outs, aux_out
+
+            (outs, aux_out), vjp = jax.vjp(f, garr, has_aux=False)
+            # vjp of (outs, aux_out): zero cotangent for aux_out
+            zero_aux = [jax.numpy.zeros_like(a) for a in aux_out]
+            grads, = vjp((head_grads, zero_aux))
+            return outs, grads, aux_out
+
+        @jax.jit
+        def fwd_monitor(args, aux, key):
+            return eval_graph(args, aux, key, True, want_internals=True)
+
+        self._fwd_infer = fwd_infer
+        self._fwd_train = fwd_train
+        self._fwd_bwd = fwd_bwd
+        self._fwd_monitor = fwd_monitor
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+    def _key(self):
+        import jax
+
+        if self._base_key is None:
+            self._base_key = _random.next_key()
+        self._step += 1
+        return jax.random.fold_in(self._base_key, self._step)
+
+    def _arg_data(self):
+        return [a._data for a in self.arg_arrays]
+
+    def _aux_data(self):
+        return [a._data for a in self.aux_arrays]
+
+    def forward(self, is_train: bool = False, **kwargs):
+        """Run forward (reference ``GraphExecutor::Forward``,
+        ``graph_executor.cc:990``). kwargs update named input arrays."""
+        for name, arr in kwargs.items():
+            if name not in self.arg_dict:
+                raise MXNetError("forward: unknown argument '%s'" % name)
+            self.arg_dict[name][:] = arr
+        self._last_key = self._key()
+        if is_train:
+            # lazy: the fused fwd+bwd in backward() materializes outputs;
+            # accessing .outputs before backward triggers a fwd-only run.
+            # Returns None here — materializing now would double the forward
+            # work of every fit() iteration.
+            self._train_pending = True
+            self._outputs = None
+            if self._monitor_callback is not None:
+                self._run_monitor()
+            return None
+        self._train_pending = False
+        outs = self._fwd_infer(self._arg_data(), self._aux_data(),
+                               self._last_key)
+        self._set_outputs(outs)
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        """Fused forward+backward in one XLA computation (reference
+        ``GraphExecutor::Backward``, ``graph_executor.cc:1003``)."""
+        import jax.numpy as jnp
+
+        if not self._train_pending:
+            raise MXNetError("backward called without forward(is_train=True)")
+        if out_grads is None:
+            sig = tuple(a.shape for a in self.arg_arrays)
+            if getattr(self, "_head_sig", None) != sig:
+                _, out_shapes, _ = self._symbol.infer_shape(
+                    **{n: a.shape for n, a in self.arg_dict.items()})
+                self._head_ones = [jnp.ones(s, dtype=jnp.float32)
+                                   for s in out_shapes]
+                self._head_sig = sig
+            heads = self._head_ones
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            heads = [g._data for g in out_grads]
+        outs, grads, aux_out = self._fwd_bwd(
+            self._arg_data(), self._aux_data(), self._last_key, heads)
+        self._set_outputs(outs)
+        self._train_pending = False
+        for pos, i in enumerate(self._grad_idx):
+            name = self.arg_names[i]
+            garr = self.grad_arrays[i]
+            g = grads[pos]
+            req = self._grad_req[name]
+
+            def _assign(garr=garr, g=g, req=req):
+                garr._data = (garr._data + g.astype(garr.dtype)
+                              if req == "add" else g.astype(garr.dtype))
+            get_engine().push(_assign, mutable_vars=[garr._var])
+        for arr, new in zip(self.aux_arrays, aux_out):
+            def _assign_aux(arr=arr, new=new):
+                arr._data = new
+
+            get_engine().push(_assign_aux, mutable_vars=[arr._var])
+
+    @property
+    def outputs(self) -> List[NDArray]:
+        if self._outputs is None:
+            if self._train_pending:
+                outs, aux_out = self._fwd_train(
+                    self._arg_data(), self._aux_data(), self._last_key)
+                self._set_outputs(outs)
+            else:
+                raise MXNetError("no forward has been run")
+        return self._outputs
+
+    def _set_outputs(self, outs):
+        self._outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+
+    # ------------------------------------------------------------------
+    # monitor (reference MXExecutorSetMonitorCallback ->
+    # GraphExecutor::RunOps monitor hook, graph_executor.cc:937-951)
+    # ------------------------------------------------------------------
+    def set_monitor_callback(self, callback: Callable[[str, NDArray], None]):
+        self._monitor_callback = callback
+
+    def _run_monitor(self):
+        outs, _, internals = self._fwd_monitor(
+            self._arg_data(), self._aux_data(), self._last_key)
+        for name, value in internals.items():
+            self._monitor_callback(name, NDArray(value, ctx=self._ctx))
+
+    # ------------------------------------------------------------------
+    # utilities
+    # ------------------------------------------------------------------
+    def copy_params_from(self, arg_params: Dict[str, NDArray],
+                         aux_params: Optional[Dict[str, NDArray]] = None,
+                         allow_extra_params: bool = False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name][:] = arr
+            elif not allow_extra_params:
+                raise MXNetError("unknown param '%s'" % name)
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name][:] = arr
+                elif not allow_extra_params:
+                    raise MXNetError("unknown aux '%s'" % name)
+
+    def reshape(self, partial_shaping: bool = False, allow_up_sizing: bool = False,
+                **kwargs) -> "Executor":
+        """Rebind to new input shapes, sharing parameter arrays whose shape
+        is unchanged (reference ``executor.py:270``)."""
+        from . import ndarray as nd
+
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = []
+        new_grads: Dict[str, NDArray] = {}
+        for name, shape, arr, grad in zip(self.arg_names, arg_shapes,
+                                          self.arg_arrays, self.grad_arrays):
+            if shape == arr.shape:
+                new_args.append(arr)
+                if grad is not None:
+                    new_grads[name] = grad
+            else:
+                new_args.append(nd.zeros(shape, ctx=self._ctx, dtype=arr.dtype))
+                if grad is not None:
+                    new_grads[name] = nd.zeros(shape, ctx=self._ctx)
+        new_aux = []
+        for shape, arr in zip(aux_shapes, self.aux_arrays):
+            new_aux.append(arr if shape == arr.shape
+                           else nd.zeros(shape, ctx=self._ctx, dtype=arr.dtype))
+        return Executor(self._symbol, self._ctx, new_args,
+                        new_grads or None, self._grad_req, new_aux,
+                        group2ctx=self._group2ctx)
+
+    def debug_str(self) -> str:
+        """Allocation/graph plan dump (reference GraphExecutor::Print)."""
+        lines = ["Symbol outputs: %s" % self.output_names]
+        for n in self._symbol._topo():
+            kind = "var" if n.is_variable else n.op.op_name
+            lines.append("  %-30s %s <- %s" % (
+                n.name, kind, [src.name for src, _ in n.inputs]))
+        return "\n".join(lines)

@@ -1,0 +1,99 @@
+"""Legacy data-parallel executor manager
+(reference ``python/mxnet/executor_manager.py``): kept for API parity with
+old training scripts; internally delegates to the TPU-native
+DataParallelExecutorGroup (mesh-sharded single executor).
+"""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context
+from .io import DataDesc
+
+__all__ = ["_split_input_slice", "DataParallelExecutorManager"]
+
+
+def _split_input_slice(batch_size: int, work_load_list: List[float]):
+    """Split batch_size into slices proportional to work_load_list
+    (reference executor_manager.py:14-46)."""
+    total = sum(work_load_list)
+    if total <= 0:
+        raise MXNetError("invalid work_load_list")
+    num = len(work_load_list)
+    parts = [int(round(batch_size * w / total)) for w in work_load_list]
+    # fix rounding drift
+    diff = batch_size - sum(parts)
+    parts[-1] += diff
+    slices = []
+    begin = 0
+    for p in parts:
+        end = min(begin + p, batch_size)
+        if begin >= end:
+            raise MXNetError("too many slices; batch size too small")
+        slices.append(slice(begin, end))
+        begin = end
+    return slices
+
+
+class DataParallelExecutorManager:
+    """reference executor_manager.py:264; wraps the mesh-sharded group."""
+
+    def __init__(self, symbol, ctx, train_data, arg_names=None,
+                 param_names=None, aux_names=None, work_load_list=None,
+                 logger=None, sym_gen=None):
+        from .module.executor_group import DataParallelExecutorGroup
+
+        self.symbol = symbol
+        self.ctx = ctx if isinstance(ctx, list) else [ctx]
+        self.arg_names = arg_names or symbol.list_arguments()
+        self.aux_names = aux_names or symbol.list_auxiliary_states()
+        data_names = [d.name for d in train_data.provide_data]
+        label_names = [d.name for d in train_data.provide_label]
+        self.param_names = param_names or [
+            n for n in self.arg_names if n not in data_names + label_names]
+        self.execgrp = DataParallelExecutorGroup(
+            symbol, self.ctx, work_load_list,
+            train_data.provide_data, train_data.provide_label,
+            self.param_names, for_training=True, inputs_need_grad=False)
+
+    @property
+    def param_arrays(self):
+        ex = self.execgrp.executor
+        return [[ex.arg_dict[n]] for n in self.param_names
+                if n in ex.arg_dict]
+
+    @property
+    def grad_arrays(self):
+        ex = self.execgrp.executor
+        return [[ex.grad_dict[n]] for n in self.param_names
+                if n in ex.grad_dict]
+
+    @property
+    def aux_arrays(self):
+        ex = self.execgrp.executor
+        return [[a] for a in ex.aux_arrays]
+
+    def install_monitor(self, monitor):
+        self.execgrp.install_monitor(monitor)
+
+    def set_params(self, arg_params, aux_params):
+        self.execgrp.set_params(arg_params, aux_params)
+
+    def copy_to(self, arg_params, aux_params):
+        self.execgrp.get_params(arg_params, aux_params)
+
+    def load_data_batch(self, data_batch):
+        self.execgrp.load_data_batch(data_batch)
+
+    def forward(self, is_train=False):
+        self.execgrp.executor.forward(is_train=is_train)
+
+    def backward(self):
+        self.execgrp.executor.backward()
+
+    def update_metric(self, metric, labels):
+        self.execgrp.update_metric(metric, labels)

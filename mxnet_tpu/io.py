@@ -1,0 +1,596 @@
+"""Data iterators.
+
+TPU-native re-design of the reference's IO layer (``src/io/`` +
+``python/mxnet/io.py``): the ``DataIter`` protocol
+(``Init/BeforeFirst/Next/Value`` -> ``reset/next``), batching with pad
+semantics, background prefetch, and sharding for distributed data parallel
+via ``num_parts``/``part_index`` (reference ``iter_image_recordio.cc:223-244``
+— this is how distributed workers split data).
+
+Decode/augment runs on host CPU (PIL instead of OpenCV); batches land on
+device as jax arrays via NDArray.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import queue
+import struct
+import threading
+from collections import namedtuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .base import MXNetError, Registry, getenv
+from .context import Context
+from .ndarray import NDArray, array
+
+__all__ = ["DataBatch", "DataIter", "NDArrayIter", "MNISTIter", "CSVIter",
+           "ResizeIter", "PrefetchingIter", "ImageRecordIter", "DataDesc"]
+
+_REG: Registry = Registry.get_registry("data_iter")
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """Name+shape descriptor; ``layout`` declares the batch axis (reference
+    ``LayoutMapper``, io.py:23-80 — 'N' position matters for TNC vs NTC)."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    @staticmethod
+    def get_batch_axis(layout: Optional[str]) -> int:
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    def __init__(self, data, label, pad=0, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Iterator protocol (reference ``include/mxnet/io.h:76-96``)."""
+
+    def __init__(self):
+        self.batch_size = 0
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> DataBatch:
+        return self.next()
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             self.getpad(), self.getindex())
+        raise StopIteration
+
+    def iter_next(self) -> bool:
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+    @property
+    def provide_data(self) -> List[DataDesc]:
+        raise NotImplementedError
+
+    @property
+    def provide_label(self) -> List[DataDesc]:
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize input to list of (name, np.ndarray) (reference io.py)."""
+    if data is None:
+        data = []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if not allow_empty and len(data) == 0:
+            raise MXNetError("empty data")
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {default_name + "_%d" % i: d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise MXNetError("data must be NDArray, numpy array, list or dict")
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, np.asarray(v)))
+    return out
+
+
+@_REG.register("NDArrayIter")
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference io.py:395)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__()
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.cursor = -batch_size
+        self.batch_size = batch_size
+        self.last_batch_handle = last_batch_handle
+        if shuffle:
+            idx = np.random.permutation(self.num_data)
+            self.data = [(k, v[idx]) for k, v in self.data]
+            self.label = [(k, v[idx]) for k, v in self.label]
+        if last_batch_handle == "discard":
+            new_n = self.num_data - self.num_data % batch_size
+            self.num_data = new_n
+        if self.num_data < batch_size:
+            raise MXNetError("batch_size larger than dataset")
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def _getdata(self, data_source):
+        if self.cursor + self.batch_size <= self.num_data:
+            return [array(v[self.cursor:self.cursor + self.batch_size])
+                    for _, v in data_source]
+        # pad with wrap-around (reference roll-over semantics)
+        pad = self.batch_size - (self.num_data - self.cursor)
+        return [array(np.concatenate([v[self.cursor:], v[:pad]], axis=0))
+                for _, v in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+def _read_idx_file(path: str) -> np.ndarray:
+    """Read an idx-format (MNIST) file, gzip-transparent."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+        if zero != 0:
+            raise MXNetError("invalid idx file %s" % path)
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        dtype = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
+                 0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}[dtype_code]
+        data = np.frombuffer(f.read(), dtype=np.dtype(dtype).newbyteorder(">"))
+        return data.reshape(shape).astype(dtype)
+
+
+@_REG.register("MNISTIter")
+class MNISTIter(DataIter):
+    """MNIST idx-format iterator with worker sharding (reference
+    ``src/io/iter_mnist.cc``: ``num_parts``/``part_index``)."""
+
+    def __init__(self, image: str, label: str, batch_size: int = 128,
+                 shuffle: bool = True, flat: bool = False, seed: int = 0,
+                 silent: bool = False, num_parts: int = 1, part_index: int = 0,
+                 input_shape=None, **kwargs):
+        super().__init__()
+        images = _read_idx_file(image).astype(np.float32) / 255.0
+        labels = _read_idx_file(label).astype(np.float32)
+        if flat:
+            images = images.reshape(images.shape[0], -1)
+        else:
+            images = images.reshape(images.shape[0], 1,
+                                    images.shape[1], images.shape[2])
+            if input_shape is not None:
+                images = images.reshape((images.shape[0],) + tuple(input_shape))
+        if num_parts > 1:
+            images = images[part_index::num_parts]
+            labels = labels[part_index::num_parts]
+        if shuffle:
+            rng = np.random.RandomState(seed)
+            idx = rng.permutation(images.shape[0])
+            images, labels = images[idx], labels[idx]
+        self._inner = NDArrayIter(images, labels, batch_size=batch_size,
+                                  last_batch_handle="discard")
+        self.batch_size = batch_size
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+    def getdata(self):
+        return self._inner.getdata()
+
+    def getlabel(self):
+        return self._inner.getlabel()
+
+    def getpad(self):
+        return self._inner.getpad()
+
+
+@_REG.register("CSVIter")
+class CSVIter(DataIter):
+    """CSV file iterator (reference ``src/io/iter_csv.cc``)."""
+
+    def __init__(self, data_csv: str, data_shape, label_csv: Optional[str] = None,
+                 label_shape=(1,), batch_size: int = 1, **kwargs):
+        super().__init__()
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32, ndmin=2)
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label.shape[1:] == (1,):
+                label = label[:, 0]
+        else:
+            label = np.zeros(data.shape[0], dtype=np.float32)
+        self._inner = NDArrayIter(data, label, batch_size=batch_size,
+                                  last_batch_handle="pad")
+        self.batch_size = batch_size
+
+    provide_data = property(lambda self: self._inner.provide_data)
+    provide_label = property(lambda self: self._inner.provide_label)
+
+    def reset(self):
+        self._inner.reset()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+    def getdata(self):
+        return self._inner.getdata()
+
+    def getlabel(self):
+        return self._inner.getlabel()
+
+    def getpad(self):
+        return self._inner.getpad()
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches (reference io.py:181)."""
+
+    def __init__(self, data_iter: DataIter, size: int, reset_internal: bool = True):
+        super().__init__()
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch: Optional[DataBatch] = None
+        self.batch_size = data_iter.batch_size
+
+    @property
+    def provide_data(self):
+        return self.data_iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread pipelining (reference io.py:235 +
+    ``src/io/iter_prefetcher.h``): decouples host-side batch prep from
+    device compute. Uses the host ThreadedEngine-style worker thread with a
+    bounded queue of ready batches."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth: int = 2):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = self.provide_data[0].shape[0]
+        self._depth = prefetch_depth
+        self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch_depth)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.current_batch: Optional[DataBatch] = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            descs = []
+            for it in self.iters:
+                descs.extend(it.provide_data)
+            return descs
+        descs = []
+        for r, it in zip(self.rename_data, self.iters):
+            descs.extend(DataDesc(r.get(d.name, d.name), d.shape, d.dtype)
+                         for d in it.provide_data)
+        return descs
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            descs = []
+            for it in self.iters:
+                descs.extend(it.provide_label)
+            return descs
+        descs = []
+        for r, it in zip(self.rename_label, self.iters):
+            descs.extend(DataDesc(r.get(d.name, d.name), d.shape, d.dtype)
+                         for d in it.provide_label)
+        return descs
+
+    def _start(self):
+        def _run():
+            while not self._stop.is_set():
+                try:
+                    batches = [it.next() for it in self.iters]
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                data, label = [], []
+                for b in batches:
+                    data.extend(b.data)
+                    label.extend(b.label)
+                merged = DataBatch(data, label, batches[0].pad,
+                                   batches[0].index)
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(merged, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        self._thread.join()
+        while not self._queue.empty():
+            self._queue.get_nowait()
+        for it in self.iters:
+            it.reset()
+        self._stop = threading.Event()
+        self._start()
+
+    def iter_next(self):
+        batch = self._queue.get()
+        if batch is None:
+            return False
+        self.current_batch = batch
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getpad(self):
+        return self.current_batch.pad
+
+    def getindex(self):
+        return self.current_batch.index
+
+
+@_REG.register("ImageRecordIter")
+class ImageRecordIter(DataIter):
+    """Image recordio iterator with sharding + augmentation (reference
+    ``src/io/iter_image_recordio.cc:109-455``). Decode via PIL; augmentation
+    covers the defaults of ``image_aug_default.cc``: resize, random/center
+    crop, random mirror, mean subtraction, scale."""
+
+    def __init__(self, path_imgrec: str, data_shape, batch_size: int,
+                 path_imgidx: Optional[str] = None, label_width: int = 1,
+                 shuffle: bool = False, num_parts: int = 1, part_index: int = 0,
+                 mean_img: Optional[str] = None, mean_r: float = 0.0,
+                 mean_g: float = 0.0, mean_b: float = 0.0, scale: float = 1.0,
+                 rand_crop: bool = False, rand_mirror: bool = False,
+                 resize: int = -1, round_batch: bool = True, seed: int = 0,
+                 preprocess_threads: int = 4, prefetch_buffer: int = 2,
+                 **kwargs):
+        super().__init__()
+        from . import recordio as rio
+
+        self.data_shape = tuple(data_shape)
+        self.batch_size = batch_size
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.resize = resize
+        self.scale = scale
+        self.mean = None
+        if mean_img is not None and os.path.isfile(mean_img):
+            from . import ndarray as nd
+            self.mean = list(nd.load(mean_img).values())[0].asnumpy()
+        elif mean_r or mean_g or mean_b:
+            self.mean = np.array([mean_r, mean_g, mean_b],
+                                 dtype=np.float32).reshape(3, 1, 1)
+        self._rng = np.random.RandomState(seed)
+        # load record offsets; shard by record index (InputSplit semantics)
+        self._records: List[bytes] = []
+        reader = rio.MXRecordIO(path_imgrec, "r")
+        i = 0
+        while True:
+            rec = reader.read()
+            if rec is None:
+                break
+            if i % num_parts == part_index:
+                self._records.append(rec)
+            i += 1
+        reader.close()
+        if shuffle:
+            self._rng.shuffle(self._records)
+        self.label_width = label_width
+        self.cursor = -batch_size
+        self.num_data = len(self._records)
+        if self.num_data == 0:
+            raise MXNetError("no records found in %s" % path_imgrec)
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def _decode(self, rec: bytes) -> Tuple[np.ndarray, np.ndarray]:
+        from . import recordio as rio
+
+        header, img = rio.unpack_img(rec, iscolor=1 if self.data_shape[0] == 3 else 0)
+        label = np.asarray(header.label, dtype=np.float32)
+        img = img.astype(np.float32)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        c, h, w = self.data_shape
+        if self.resize > 0:
+            from PIL import Image
+
+            short = min(img.shape[0], img.shape[1])
+            ratio = self.resize / short
+            nh, nw = int(round(img.shape[0] * ratio)), int(round(img.shape[1] * ratio))
+            img = np.asarray(Image.fromarray(img.astype(np.uint8)).resize(
+                (nw, nh))).astype(np.float32)
+            if img.ndim == 2:
+                img = img[:, :, None]
+        # crop to (h, w)
+        ih, iw = img.shape[0], img.shape[1]
+        if ih < h or iw < w:
+            from PIL import Image
+
+            img = np.asarray(Image.fromarray(img.astype(np.uint8)).resize(
+                (w, h))).astype(np.float32)
+            if img.ndim == 2:
+                img = img[:, :, None]
+            ih, iw = h, w
+        if self.rand_crop:
+            top = self._rng.randint(0, ih - h + 1)
+            left = self._rng.randint(0, iw - w + 1)
+        else:
+            top, left = (ih - h) // 2, (iw - w) // 2
+        img = img[top:top + h, left:left + w]
+        if self.rand_mirror and self._rng.rand() < 0.5:
+            img = img[:, ::-1]
+        img = img.transpose(2, 0, 1)  # HWC -> CHW
+        if self.mean is not None:
+            img = img - self.mean
+        if self.scale != 1.0:
+            img = img * self.scale
+        return img, label
+
+    def _decode_batch(self):
+        if getattr(self, "_cache_cursor", None) == self.cursor:
+            return self._cache
+        imgs, labels = [], []
+        for i in range(self.cursor, self.cursor + self.batch_size):
+            img, label = self._decode(self._records[i % self.num_data])
+            imgs.append(img)
+            labels.append(label if self.label_width > 1
+                          else float(label.ravel()[0]))
+        self._cache = (np.stack(imgs), np.asarray(labels, dtype=np.float32))
+        self._cache_cursor = self.cursor
+        return self._cache
+
+    def getdata(self):
+        return [array(self._decode_batch()[0])]
+
+    def getlabel(self):
+        return [array(self._decode_batch()[1])]
+
+    def getpad(self):
+        if self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+def MXDataIter(name: str, **kwargs) -> DataIter:
+    """Create a registered iterator by name (the reference's C++-backed
+    iterators exposed via registry, io.py:506)."""
+    cls = _REG.get(name)
+    return cls(**kwargs)

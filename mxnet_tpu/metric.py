@@ -1,0 +1,248 @@
+"""Evaluation metrics (reference ``python/mxnet/metric.py``)."""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .base import MXNetError, Registry
+from .ndarray import NDArray
+
+__all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MAE", "MSE",
+           "RMSE", "CrossEntropy", "CompositeEvalMetric", "CustomMetric",
+           "np_metric", "create"]
+
+_REG: Registry = Registry.get_registry("metric")
+
+
+def check_label_shapes(labels, preds):
+    if len(labels) != len(preds):
+        raise MXNetError("labels/preds count mismatch: %d vs %d"
+                         % (len(labels), len(preds)))
+
+
+class EvalMetric:
+    def __init__(self, name: str, num: Optional[int] = None):
+        self.name = name
+        self.num = num
+        self.reset()
+
+    def reset(self):
+        if self.num is None:
+            self.num_inst = 0
+            self.sum_metric = 0.0
+        else:
+            self.num_inst = [0] * self.num
+            self.sum_metric = [0.0] * self.num
+
+    def update(self, labels: Sequence[NDArray], preds: Sequence[NDArray]):
+        raise NotImplementedError
+
+    def get(self):
+        if self.num is None:
+            value = self.sum_metric / self.num_inst if self.num_inst else float("nan")
+            return self.name, value
+        names = ["%s_%d" % (self.name, i) for i in range(self.num)]
+        values = [s / n if n else float("nan")
+                  for s, n in zip(self.sum_metric, self.num_inst)]
+        return names, values
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            return [(name, value)]
+        return list(zip(name, value))
+
+
+@_REG.register("acc")
+@_REG.register("accuracy")
+class Accuracy(EvalMetric):
+    def __init__(self):
+        super().__init__("accuracy")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            p = pred.asnumpy()
+            pred_label = np.argmax(p, axis=1) if p.ndim > 1 else p
+            lab = label.asnumpy().astype(np.int32).ravel()
+            self.sum_metric += int((pred_label.astype(np.int32).ravel() == lab).sum())
+            self.num_inst += len(lab)
+
+
+@_REG.register("top_k_accuracy")
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k: int = 1, **kwargs):
+        self.top_k = kwargs.get("top_k", top_k)
+        super().__init__("top_k_accuracy_%d" % self.top_k)
+        if self.top_k <= 1:
+            raise MXNetError("top_k should be >1; use Accuracy otherwise")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            p = pred.asnumpy().astype(np.float32)
+            lab = label.asnumpy().astype(np.int32)
+            topk = np.argsort(p, axis=1)[:, -self.top_k:]
+            for i in range(len(lab)):
+                self.sum_metric += int(lab[i] in topk[i])
+            self.num_inst += len(lab)
+
+
+@_REG.register("f1")
+class F1(EvalMetric):
+    """Binary F1 (reference metric.py F1)."""
+
+    def __init__(self):
+        super().__init__("f1")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            p = np.argmax(pred.asnumpy(), axis=1)
+            lab = label.asnumpy().astype(np.int32).ravel()
+            if len(np.unique(lab)) > 2:
+                raise MXNetError("F1 supports binary classification only")
+            tp = int(((p == 1) & (lab == 1)).sum())
+            fp = int(((p == 1) & (lab == 0)).sum())
+            fn = int(((p == 0) & (lab == 1)).sum())
+            precision = tp / (tp + fp) if tp + fp else 0.0
+            recall = tp / (tp + fn) if tp + fn else 0.0
+            f1 = 2 * precision * recall / (precision + recall) \
+                if precision + recall else 0.0
+            self.sum_metric += f1
+            self.num_inst += 1
+
+
+@_REG.register("mae")
+class MAE(EvalMetric):
+    def __init__(self):
+        super().__init__("mae")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            l_np = label.asnumpy()
+            p_np = pred.asnumpy().reshape(l_np.shape)
+            self.sum_metric += float(np.abs(l_np - p_np).mean())
+            self.num_inst += 1
+
+
+@_REG.register("mse")
+class MSE(EvalMetric):
+    def __init__(self):
+        super().__init__("mse")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            l_np = label.asnumpy()
+            p_np = pred.asnumpy().reshape(l_np.shape)
+            self.sum_metric += float(((l_np - p_np) ** 2).mean())
+            self.num_inst += 1
+
+
+@_REG.register("rmse")
+class RMSE(EvalMetric):
+    def __init__(self):
+        super().__init__("rmse")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            l_np = label.asnumpy()
+            p_np = pred.asnumpy().reshape(l_np.shape)
+            self.sum_metric += float(np.sqrt(((l_np - p_np) ** 2).mean()))
+            self.num_inst += 1
+
+
+@_REG.register("ce")
+@_REG.register("cross-entropy")
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps: float = 1e-8):
+        super().__init__("cross-entropy")
+        self.eps = eps
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            lab = label.asnumpy().astype(np.int32).ravel()
+            p = pred.asnumpy()
+            prob = p[np.arange(lab.shape[0]), lab]
+            self.sum_metric += float((-np.log(prob + self.eps)).sum())
+            self.num_inst += len(lab)
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics: Optional[List[EvalMetric]] = None, **kwargs):
+        super().__init__("composite")
+        self.metrics = metrics or []
+
+    def add(self, metric: "EvalMetric"):
+        self.metrics.append(metric)
+
+    def get_metric(self, index: int) -> EvalMetric:
+        return self.metrics[index]
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.extend(n if isinstance(n, list) else [n])
+            values.extend(v if isinstance(v, list) else [v])
+        return names, values
+
+
+class CustomMetric(EvalMetric):
+    """Wrap ``feval(label, pred) -> float`` (reference CustomMetric)."""
+
+    def __init__(self, feval: Callable, name: Optional[str] = None,
+                 allow_extra_outputs: bool = False):
+        name = name or getattr(feval, "__name__", "custom")
+        super().__init__("custom(%s)" % name)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            reval = self._feval(label.asnumpy(), pred.asnumpy())
+            if isinstance(reval, tuple):
+                sum_metric, num_inst = reval
+                self.sum_metric += sum_metric
+                self.num_inst += num_inst
+            else:
+                self.sum_metric += reval
+                self.num_inst += 1
+
+
+def np_metric(numpy_feval: Callable, name: Optional[str] = None,
+              allow_extra_outputs: bool = False):
+    """Decorator creating a CustomMetric from a numpy function."""
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+    feval.__name__ = name or numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)
+
+
+def create(metric: Union[str, Callable, EvalMetric], **kwargs) -> EvalMetric:
+    if isinstance(metric, EvalMetric):
+        return metric
+    if callable(metric):
+        return CustomMetric(metric)
+    if isinstance(metric, list):
+        composite = CompositeEvalMetric()
+        for m in metric:
+            composite.add(create(m, **kwargs))
+        return composite
+    cls = _REG.get(metric)
+    return cls(**kwargs)

@@ -1,0 +1,199 @@
+"""FeedForward estimator API + checkpointing
+(reference ``python/mxnet/model.py``: FeedForward :375-905,
+save/load_checkpoint :308-374, _train_multi_device :115-305).
+
+The training loop delegates to :class:`mxnet_tpu.module.Module`, whose
+executor group is the TPU-native data-parallel engine; the reference's
+`_train_multi_device` per-device slice/copy/reduce choreography is subsumed
+by the pjit-sharded step.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .initializer import Uniform
+from . import ndarray as nd
+from . import symbol as sym_mod
+from .io import DataIter, NDArrayIter
+from . import metric as _metric
+
+__all__ = ["FeedForward", "save_checkpoint", "load_checkpoint"]
+
+BASE_ESTIMATOR = object
+
+
+def save_checkpoint(prefix: str, epoch: int, symbol, arg_params: Dict,
+                    aux_params: Dict):
+    """``prefix-symbol.json`` + ``prefix-NNNN.params`` (reference
+    model.py:308)."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    logging.info("Saved checkpoint to \"%s\"", param_name)
+
+
+def load_checkpoint(prefix: str, epoch: int):
+    """Returns (symbol, arg_params, aux_params) (reference model.py:342)."""
+    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = {}, {}
+    for k, value in save_dict.items():
+        arg_type, name = k.split(":", 1)
+        if arg_type == "arg":
+            arg_params[name] = value
+        elif arg_type == "aux":
+            aux_params[name] = value
+    return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """Estimator-style model (reference FeedForward, model.py:375)."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=Uniform(0.01), numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        self.symbol = symbol
+        if ctx is None:
+            ctx = [current_context()]
+        elif isinstance(ctx, Context):
+            ctx = [ctx]
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.kwargs = kwargs.copy()
+        self.optimizer = optimizer
+        self.initializer = initializer
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self._pred_exec = None
+
+    # -- data normalization (reference _init_iter) -------------------------
+    def _init_iter(self, X, y, is_train: bool) -> DataIter:
+        if isinstance(X, DataIter):
+            return X
+        if isinstance(X, nd.NDArray):
+            X = X.asnumpy()
+        if not isinstance(X, np.ndarray):
+            raise TypeError("X must be DataIter, NDArray or numpy array")
+        if y is None:
+            if is_train:
+                raise ValueError("y is required for training")
+            y = np.zeros(X.shape[0], dtype=np.float32)
+        if isinstance(y, nd.NDArray):
+            y = y.asnumpy()
+        y = np.asarray(y).ravel()
+        batch_size = min(self.numpy_batch_size, X.shape[0])
+        return NDArrayIter(X, y, batch_size=batch_size,
+                           shuffle=is_train,
+                           last_batch_handle="discard" if is_train else "pad")
+
+    def _make_module(self, data_iter: DataIter):
+        from .module import Module
+
+        label_names = [d.name for d in data_iter.provide_label]
+        data_names = [d.name for d in data_iter.provide_data]
+        mod = Module(self.symbol, data_names=data_names,
+                     label_names=label_names, context=self.ctx)
+        return mod
+
+    # -- training ----------------------------------------------------------
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_batch_end_callback=None):
+        data = self._init_iter(X, y, is_train=True)
+        if eval_data is not None and not isinstance(eval_data, DataIter):
+            if isinstance(eval_data, (tuple, list)) and len(eval_data) == 2:
+                eval_data = self._init_iter(eval_data[0], eval_data[1], False)
+            else:
+                raise TypeError("eval_data must be DataIter or (X, y)")
+        mod = self._make_module(data)
+        optimizer = self.optimizer
+        optimizer_params = dict(self.kwargs)
+        mod.fit(data, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=optimizer, optimizer_params=optimizer_params,
+                eval_batch_end_callback=eval_batch_end_callback,
+                initializer=self.initializer, arg_params=self.arg_params,
+                aux_params=self.aux_params, allow_missing=True,
+                begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
+                monitor=monitor)
+        self.arg_params, self.aux_params = mod.get_params()
+        self._module = mod
+        return self
+
+    # -- prediction --------------------------------------------------------
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        data = self._init_iter(X, None, is_train=False)
+        mod = self._make_module(data)
+        mod.bind(data.provide_data, data.provide_label, for_training=False)
+        mod.init_params(arg_params=self.arg_params, aux_params=self.aux_params,
+                        allow_missing=False, initializer=self.initializer)
+        outputs = mod.predict(data, num_batch=num_batch,
+                              always_output_list=True)
+        if return_data:
+            data.reset()
+            xs, ys = [], []
+            for batch in data:
+                pad = batch.pad
+                xs.append(batch.data[0].asnumpy()[:batch.data[0].shape[0] - pad])
+                ys.append(batch.label[0].asnumpy()[:batch.label[0].shape[0] - pad])
+            return ([o.asnumpy() for o in outputs],
+                    np.concatenate(xs), np.concatenate(ys))
+        outs = [o.asnumpy() for o in outputs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def score(self, X, y=None, eval_metric="acc", num_batch=None,
+              batch_end_callback=None, reset=True):
+        data = self._init_iter(X, y, is_train=False)
+        mod = self._make_module(data)
+        mod.bind(data.provide_data, data.provide_label, for_training=False)
+        mod.init_params(arg_params=self.arg_params, aux_params=self.aux_params,
+                        initializer=self.initializer)
+        res = mod.score(data, eval_metric, num_batch=num_batch,
+                        batch_end_callback=batch_end_callback)
+        return res[0][1]
+
+    # -- persistence (reference FeedForward.save/load, model.py:775-850) ---
+    def save(self, prefix: str, epoch: Optional[int] = None):
+        if epoch is None:
+            epoch = self.num_epoch
+        if epoch is None:
+            raise MXNetError("epoch unknown; pass explicitly")
+        save_checkpoint(prefix, epoch, self.symbol,
+                        self.arg_params or {}, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix: str, epoch: int, ctx=None, **kwargs) -> "FeedForward":
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
+               optimizer="sgd", initializer=Uniform(0.01), eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               work_load_list=None, eval_batch_end_callback=None, **kwargs):
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, work_load_list=work_load_list,
+                  eval_batch_end_callback=eval_batch_end_callback)
+        return model

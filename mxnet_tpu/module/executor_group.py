@@ -1,0 +1,217 @@
+"""Data-parallel executor group.
+
+TPU-native re-design of the reference's ``DataParallelExecutorGroup``
+(``python/mxnet/module/executor_group.py:68-530``): where the reference
+slices the batch across per-device executors and reduces grads via
+KVStore/Comm, here there is ONE executor whose arrays carry
+``jax.sharding`` placements over a device mesh — data batch-sharded along
+the ``dp`` axis, parameters replicated. XLA GSPMD partitions the jitted
+step and inserts the gradient all-reduce over ICI automatically
+(the ``kvstore='tpu_sync'`` north star: grad reduction fused INTO the
+training step instead of a separate push/pull phase).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context
+from ..executor import Executor
+from ..io import DataDesc
+from ..ndarray import NDArray
+
+__all__ = ["DataParallelExecutorGroup"]
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts: Sequence[Context], workload,
+                 data_shapes, label_shapes, param_names: List[str],
+                 for_training: bool, inputs_need_grad: bool,
+                 shared_group: Optional["DataParallelExecutorGroup"] = None,
+                 logger=None, fixed_param_names: Optional[List[str]] = None,
+                 grad_req: str = "write"):
+        self.symbol = symbol
+        self.contexts = list(contexts)
+        self.param_names = param_names
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+
+        self.data_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
+                            for d in data_shapes]
+        self.label_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
+                             for d in (label_shapes or [])]
+        self.data_names = [d.name for d in self.data_shapes]
+        self.label_names = [d.name for d in self.label_shapes]
+        self.batch_size = self.data_shapes[0].shape[
+            DataDesc.get_batch_axis(self.data_shapes[0].layout)]
+
+        self._mesh = None
+        if len(self.contexts) > 1:
+            if self.batch_size % len(self.contexts):
+                raise MXNetError(
+                    "batch size %d not divisible by %d devices"
+                    % (self.batch_size, len(self.contexts)))
+            self._mesh = self._make_mesh()
+
+        # grad requests (reference: data grads only if inputs_need_grad)
+        reqs: Dict[str, str] = {}
+        for name in self.arg_names:
+            if name in self.data_names:
+                reqs[name] = "write" if inputs_need_grad else "null"
+            elif name in self.label_names or not for_training \
+                    or name in self.fixed_param_names:
+                reqs[name] = "null"
+            else:
+                reqs[name] = grad_req
+        self.grad_req = reqs
+
+        self._bind_exec(shared_group)
+
+    # ------------------------------------------------------------------
+    def _make_mesh(self):
+        import jax
+        from jax.sharding import Mesh
+
+        devices = [c.jax_device() for c in self.contexts]
+        return Mesh(np.array(devices), ("dp",))
+
+    def _sharding(self, batch_axis: Optional[int]):
+        """NamedSharding for a batch-sharded (or replicated, axis None)
+        array on the group's mesh."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self._mesh is None:
+            return None
+        if batch_axis is None:
+            return NamedSharding(self._mesh, P())
+        spec = [None] * (batch_axis + 1)
+        spec[batch_axis] = "dp"
+        return NamedSharding(self._mesh, P(*spec))
+
+    def _place(self, np_or_nd, batch_axis: Optional[int], dtype=None) -> NDArray:
+        import jax
+
+        if isinstance(np_or_nd, NDArray):
+            data = np_or_nd._data
+        else:
+            data = np.asarray(np_or_nd, dtype=dtype)
+        sharding = self._sharding(batch_axis)
+        if sharding is None:
+            dev = self.contexts[0].jax_device()
+            return NDArray(jax.device_put(data, dev), ctx=self.contexts[0])
+        return NDArray(jax.device_put(data, sharding), ctx=self.contexts[0])
+
+    def _bind_exec(self, shared_group):
+        shapes = {d.name: d.shape for d in self.data_shapes}
+        shapes.update({d.name: d.shape for d in self.label_shapes})
+        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**shapes)
+
+        shared_args = {}
+        if shared_group is not None:
+            shared_args = dict(zip(shared_group.arg_names,
+                                   shared_group.executor.arg_arrays))
+
+        args, grads = [], {}
+        for name, shape in zip(self.arg_names, arg_shapes):
+            is_data = name in self.data_names or name in self.label_names
+            baxis = self._batch_axis_of(name) if is_data else None
+            if name in shared_args and shared_args[name].shape == shape:
+                arr = shared_args[name]
+            else:
+                arr = self._place(np.zeros(shape, dtype=np.float32), baxis)
+            args.append(arr)
+            if self.grad_req.get(name, "null") != "null":
+                if shared_group is not None and name in shared_group.executor.grad_dict:
+                    g = shared_group.executor.grad_dict[name]
+                    if g.shape == shape:
+                        grads[name] = g
+                        continue
+                grads[name] = self._place(np.zeros(shape, dtype=np.float32), baxis)
+
+        aux = []
+        shared_aux = {}
+        if shared_group is not None:
+            shared_aux = dict(zip(shared_group.aux_names,
+                                  shared_group.executor.aux_arrays))
+        for name, shape in zip(self.aux_names, aux_shapes):
+            if name in shared_aux and shared_aux[name].shape == shape:
+                aux.append(shared_aux[name])
+            else:
+                aux.append(self._place(np.zeros(shape, dtype=np.float32), None))
+
+        self.executor = Executor(self.symbol, self.contexts[0], args,
+                                 grads or None, self.grad_req, aux)
+        self.execs = [self.executor]  # reference exposes per-device list
+
+    def _batch_axis_of(self, name: str) -> int:
+        for d in self.data_shapes + self.label_shapes:
+            if d.name == name:
+                return DataDesc.get_batch_axis(d.layout)
+        return 0
+
+    # ------------------------------------------------------------------
+    # parameter sync (reference set_params/get_params copy per device)
+    # ------------------------------------------------------------------
+    def set_params(self, arg_params: Dict[str, NDArray],
+                   aux_params: Dict[str, NDArray]):
+        for name, arr in arg_params.items():
+            if name in self.executor.arg_dict:
+                dst = self.executor.arg_dict[name]
+                dst._data = self._place(arr, None)._data
+        for name, arr in (aux_params or {}).items():
+            if name in self.executor.aux_dict:
+                self.executor.aux_dict[name]._data = self._place(arr, None)._data
+
+    def get_params(self, arg_params: Dict[str, NDArray],
+                   aux_params: Dict[str, NDArray]):
+        for name in self.param_names:
+            if name in self.executor.arg_dict:
+                arg_params[name][:] = self.executor.arg_dict[name].asnumpy()
+        for name, arr in zip(self.aux_names, self.executor.aux_arrays):
+            if name in aux_params:
+                aux_params[name][:] = arr.asnumpy()
+
+    # ------------------------------------------------------------------
+    # per-batch data loading (reference _load_data slice+copyto per dev;
+    # here: one device_put with batch sharding)
+    # ------------------------------------------------------------------
+    def load_data_batch(self, data_batch):
+        for desc, arr in zip(self.data_shapes, data_batch.data):
+            dst = self.executor.arg_dict[desc.name]
+            baxis = DataDesc.get_batch_axis(desc.layout)
+            dst._data = self._place(arr, baxis)._data
+        if self.label_shapes:
+            for desc, arr in zip(self.label_shapes, data_batch.label):
+                dst = self.executor.arg_dict[desc.name]
+                baxis = DataDesc.get_batch_axis(desc.layout)
+                dst._data = self._place(arr, baxis)._data
+
+    def forward(self, data_batch, is_train: Optional[bool] = None):
+        self.load_data_batch(data_batch)
+        if is_train is None:
+            is_train = self.for_training
+        self.executor.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        if not self.for_training:
+            raise MXNetError("executor group bound for inference only")
+        self.executor.backward(out_grads)
+
+    def get_outputs(self) -> List[NDArray]:
+        return self.executor.outputs
+
+    def get_input_grads(self) -> List[NDArray]:
+        if not self.inputs_need_grad:
+            raise MXNetError("bound with inputs_need_grad=False")
+        return [self.executor.grad_dict[n] for n in self.data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.get_outputs())
+
+    def install_monitor(self, mon):
+        mon.install(self.executor)

@@ -1,0 +1,78 @@
+"""Monitor: per-op output/param statistics during training
+(reference ``python/mxnet/monitor.py:16-115`` — the only per-op
+observability in the reference; kept with the same callback design, backed
+by the executor's monitor hook)."""
+from __future__ import annotations
+
+import logging
+import re
+from typing import Callable, List, Optional, Tuple
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval: int, stat_func: Optional[Callable] = None,
+                 pattern: str = ".*", sort: bool = False):
+        if stat_func is None:
+            def stat_func(x: NDArray):
+                from . import ndarray as nd
+
+                return nd.norm(x) / (x.size ** 0.5)
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue: List[Tuple[int, str, NDArray]] = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def stat_helper(self, name: str, arr: NDArray):
+        if not self.activated or not self.re_prog.match(name):
+            return
+        self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def install(self, exe):
+        exe.set_monitor_callback(self.stat_helper)
+        self.exes.append(exe)
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            for exe in self.exes:
+                for arr in exe.arg_arrays:
+                    arr.wait_to_read()
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self) -> List[Tuple[int, str, str]]:
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for arr in exe.arg_arrays:
+                arr.wait_to_read()
+        for exe in self.exes:
+            for name, arr in zip(exe.arg_names, exe.arg_arrays):
+                self.queue.append((self.step, name, self.stat_func(arr)))
+            for name, arr in zip(exe.arg_names, exe.grad_arrays):
+                if arr is not None:
+                    self.queue.append((self.step, name + "_grad",
+                                       self.stat_func(arr)))
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            s = ",".join("%f" % v.asnumpy().ravel()[0] for v in v_list)
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        for n, k, v in self.toc():
+            logging.info("Batch: %7d %30s %s", n, k, v)

@@ -1,0 +1,14 @@
+"""Operator library: registry + op families.
+
+Importing this package registers all built-in operators (the reference's
+static registration via ``MXNET_REGISTER_OP_PROPERTY`` /
+``MXNET_REGISTER_SIMPLE_OP``).
+"""
+from .registry import (Operator, OpContext, Param, REQUIRED, OP_REGISTRY,
+                       register_op, create_operator)
+from . import nn      # noqa: F401
+from . import tensor  # noqa: F401
+from . import seq     # noqa: F401
+
+__all__ = ["Operator", "OpContext", "Param", "REQUIRED", "OP_REGISTRY",
+           "register_op", "create_operator"]

@@ -1,0 +1,303 @@
+"""Sequence operators + fused RNN.
+
+TPU-native equivalents of the reference's sequence ops
+(``src/operator/sequence_{last,mask,reverse}-inl.h``) and of the cuDNN fused
+RNN (``src/operator/cudnn_rnn-inl.h:127-150``: RNN_RELU/RNN_TANH/LSTM/GRU).
+The recurrence is a ``jax.lax.scan`` over time with one fused cell matmul
+per step — the XLA-idiomatic formulation: weights stay resident in
+registers/VMEM across iterations and the (x,h)->gates matmul hits the MXU.
+
+Layout is time-major TNC like the reference RNN op. Parameters are a single
+flat vector like cuDNN blobs; layout is documented in :func:`rnn_param_size`
+(per layer/direction: W_x, W_h, b_x, b_h, gates in cuDNN order).
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from .registry import Operator, Param, REQUIRED, register_op
+
+
+def _jax():
+    import jax
+    return jax
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# sequence_* ops: per-example lengths along time-major axis
+# ---------------------------------------------------------------------------
+class _SeqBase(Operator):
+    PARAMS = {"use_sequence_length": Param(bool, False)}
+
+    def list_arguments(self):
+        if self.use_sequence_length:
+            return ["data", "sequence_length"]
+        return ["data"]
+
+
+@register_op("SequenceLast")
+class SequenceLast(_SeqBase):
+    name_hint = "sequencelast"
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("SequenceLast: data shape unknown")
+        shapes = [data]
+        if self.use_sequence_length:
+            shapes.append((data[1],))
+        return shapes, [tuple(data[1:])], []
+
+    def apply(self, ctx, inputs, aux):
+        jnp = _jnp()
+        x = inputs[0]
+        if self.use_sequence_length:
+            idx = (inputs[1].astype(jnp.int32) - 1).clip(0, x.shape[0] - 1)
+            return [x[idx, jnp.arange(x.shape[1])]], []
+        return [x[-1]], []
+
+
+@register_op("SequenceMask")
+class SequenceMask(_SeqBase):
+    name_hint = "sequencemask"
+    PARAMS = dict(_SeqBase.PARAMS, value=Param(float, 0.0))
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("SequenceMask: data shape unknown")
+        shapes = [data]
+        if self.use_sequence_length:
+            shapes.append((data[1],))
+        return shapes, [data], []
+
+    def apply(self, ctx, inputs, aux):
+        jnp = _jnp()
+        x = inputs[0]
+        if not self.use_sequence_length:
+            return [x], []
+        lengths = inputs[1].astype(jnp.int32)
+        t = jnp.arange(x.shape[0])[:, None]
+        mask = (t < lengths[None, :]).reshape(
+            (x.shape[0], x.shape[1]) + (1,) * (x.ndim - 2))
+        return [jnp.where(mask, x, jnp.asarray(self.value, x.dtype))], []
+
+
+@register_op("SequenceReverse")
+class SequenceReverse(_SeqBase):
+    name_hint = "sequencereverse"
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("SequenceReverse: data shape unknown")
+        shapes = [data]
+        if self.use_sequence_length:
+            shapes.append((data[1],))
+        return shapes, [data], []
+
+    def apply(self, ctx, inputs, aux):
+        jnp = _jnp()
+        x = inputs[0]
+        if not self.use_sequence_length:
+            return [x[::-1]], []
+        lengths = inputs[1].astype(_jnp().int32)
+        t = jnp.arange(x.shape[0])[:, None]
+        # index of reversed element within each valid prefix
+        src = jnp.where(t < lengths[None, :], lengths[None, :] - 1 - t, t)
+        return [x[src, jnp.arange(x.shape[1])[None, :]]], []
+
+
+# ---------------------------------------------------------------------------
+# fused RNN (reference rnn-inl.h param struct :70-100 + cudnn_rnn-inl.h)
+# ---------------------------------------------------------------------------
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(num_layers: int, input_size: int, state_size: int,
+                   bidirectional: bool, mode: str) -> int:
+    """Total flat parameter count. Layout (contiguous, per layer then per
+    direction): W_x (G*H, in), W_h (G*H, H), b_x (G*H), b_h (G*H)."""
+    gates = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else state_size * dirs
+        size += dirs * gates * state_size * (in_size + state_size + 2)
+    return size
+
+
+@register_op("RNN")
+class RNN(Operator):
+    name_hint = "rnn"
+    PARAMS = {
+        "state_size": Param(int, REQUIRED),
+        "num_layers": Param(int, REQUIRED),
+        "mode": Param(str, REQUIRED, "rnn_relu/rnn_tanh/lstm/gru"),
+        "bidirectional": Param(bool, False),
+        "p": Param(float, 0.0, "dropout between layers"),
+        "state_outputs": Param(bool, False),
+    }
+
+    def list_arguments(self):
+        args = ["data", "parameters", "state"]
+        if self.mode == "lstm":
+            args.append("state_cell")
+        return args
+
+    def list_outputs(self):
+        outs = ["output"]
+        if self.state_outputs:
+            outs.append("state")
+            if self.mode == "lstm":
+                outs.append("state_cell")
+        return outs
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("RNN: data shape unknown")
+        t, n, input_size = data
+        dirs = 2 if self.bidirectional else 1
+        h = self.state_size
+        psize = rnn_param_size(self.num_layers, input_size, h,
+                               self.bidirectional, self.mode)
+        state_shape = (self.num_layers * dirs, n, h)
+        shapes = [data, (psize,), state_shape]
+        if self.mode == "lstm":
+            shapes.append(state_shape)
+        outs = [(t, n, h * dirs)]
+        if self.state_outputs:
+            outs.append(state_shape)
+            if self.mode == "lstm":
+                outs.append(state_shape)
+        return shapes, outs, []
+
+    # -- flat parameter unpacking ------------------------------------------
+    def _slices(self, input_size):
+        gates = _GATES[self.mode]
+        dirs = 2 if self.bidirectional else 1
+        h = self.state_size
+        offset = 0
+        layout = []  # [layer][dir] = dict of (offset, shape)
+        for layer in range(self.num_layers):
+            in_size = input_size if layer == 0 else h * dirs
+            per_dir = []
+            for _ in range(dirs):
+                entry = {}
+                for key, shape in (("wx", (gates * h, in_size)),
+                                   ("wh", (gates * h, h)),
+                                   ("bx", (gates * h,)),
+                                   ("bh", (gates * h,))):
+                    size = int(np.prod(shape))
+                    entry[key] = (offset, shape)
+                    offset += size
+                per_dir.append(entry)
+            layout.append(per_dir)
+        return layout
+
+    def _cell(self, mode):
+        jnp = _jnp()
+        jax = _jax()
+        h_units = self.state_size
+
+        if mode in ("rnn_relu", "rnn_tanh"):
+            act = (lambda v: jnp.maximum(v, 0)) if mode == "rnn_relu" else jnp.tanh
+
+            def cell(carry, xw, wh, bh):
+                h_prev, = carry
+                h = act(xw + jnp.dot(h_prev, wh.T) + bh)
+                return (h,), h
+        elif mode == "lstm":
+            def cell(carry, xw, wh, bh):
+                h_prev, c_prev = carry
+                gates = xw + jnp.dot(h_prev, wh.T) + bh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i = jax.nn.sigmoid(i)
+                f = jax.nn.sigmoid(f)
+                g = jnp.tanh(g)
+                o = jax.nn.sigmoid(o)
+                c = f * c_prev + i * g
+                h = o * jnp.tanh(c)
+                return (h, c), h
+        elif mode == "gru":
+            def cell(carry, xw, wh, bh):
+                h_prev, = carry
+                hw = jnp.dot(h_prev, wh.T) + bh
+                xr, xz, xn = jnp.split(xw, 3, axis=-1)
+                hr, hz, hn = jnp.split(hw, 3, axis=-1)
+                r = jax.nn.sigmoid(xr + hr)
+                z = jax.nn.sigmoid(xz + hz)
+                n = jnp.tanh(xn + r * hn)
+                h = (1 - z) * n + z * h_prev
+                return (h,), h
+        else:
+            raise MXNetError("unknown RNN mode %s" % mode)
+        return cell
+
+    def apply(self, ctx, inputs, aux):
+        jax = _jax()
+        jnp = _jnp()
+        data = inputs[0]
+        params = inputs[1]
+        state0 = inputs[2]
+        cell0 = inputs[3] if self.mode == "lstm" else None
+        t, n, input_size = data.shape
+        dirs = 2 if self.bidirectional else 1
+        layout = self._slices(input_size)
+        cell = self._cell(self.mode)
+
+        def take(off_shape):
+            off, shape = off_shape
+            return jax.lax.dynamic_slice_in_dim(
+                params, off, int(np.prod(shape))).reshape(shape)
+
+        x = data
+        h_finals, c_finals = [], []
+        for layer in range(self.num_layers):
+            outs_dirs = []
+            for d in range(dirs):
+                entry = layout[layer][d]
+                wx, wh = take(entry["wx"]), take(entry["wh"])
+                bx, bh = take(entry["bx"]), take(entry["bh"])
+                sidx = layer * dirs + d
+                h0 = state0[sidx]
+                carry = (h0, cell0[sidx]) if self.mode == "lstm" else (h0,)
+                seq = x if d == 0 else x[::-1]
+                # hoist the input projection out of the scan: one big
+                # (T*N, in) x (in, G*H) matmul for the MXU
+                xw_all = jnp.einsum("tni,gi->tng", seq, wx) + bx
+
+                def step(carry, xw, _wh=wh, _bh=bh):
+                    new_carry, h = cell(carry, xw, _wh, _bh)
+                    return new_carry, h
+
+                final, hs = jax.lax.scan(step, carry, xw_all)
+                if d == 1:
+                    hs = hs[::-1]
+                outs_dirs.append(hs)
+                h_finals.append(final[0])
+                if self.mode == "lstm":
+                    c_finals.append(final[1])
+            x = outs_dirs[0] if dirs == 1 else jnp.concatenate(outs_dirs, axis=-1)
+            if self.p > 0 and ctx.is_train and ctx.rng is not None \
+                    and layer < self.num_layers - 1:
+                keep = 1.0 - self.p
+                key = jax.random.fold_in(ctx.rng, layer)
+                mask = jax.random.bernoulli(key, keep, x.shape)
+                x = jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+        outputs = [x]
+        if self.state_outputs:
+            outputs.append(jnp.stack(h_finals))
+            if self.mode == "lstm":
+                outputs.append(jnp.stack(c_finals))
+        return outputs, []

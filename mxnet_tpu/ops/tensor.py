@@ -1,0 +1,606 @@
+"""Tensor manipulation + elementwise operators.
+
+TPU-native implementations of the reference's elementwise / broadcast /
+reduce / matrix op families (``src/operator/elementwise_*``,
+``broadcast_reduce_op*``, ``matrix_op-inl.h``, ``mshadow_op.h`` functor
+zoo). Internal ``_Plus``-style ops back Symbol operator overloading exactly
+like the reference's registered internal ops.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..base import MXNetError
+from .registry import (Operator, OpContext, Param, REQUIRED, register_op,
+                       same_shape_binary)
+
+
+def _jax():
+    import jax
+    return jax
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary (reference elementwise_binary_op-inl.h)
+# ---------------------------------------------------------------------------
+class _BinaryOp(Operator):
+    fn = None
+
+    def list_arguments(self):
+        return ["lhs", "rhs"]
+
+    def infer_shape(self, in_shapes):
+        return same_shape_binary(in_shapes)
+
+    def apply(self, ctx, inputs, aux):
+        return [type(self).fn(inputs[0], inputs[1])], []
+
+
+def _def_binary(name, hint, fn):
+    cls = type(name.strip("_"), (_BinaryOp,), {"fn": staticmethod(fn),
+                                               "name_hint": hint})
+    register_op(name)(cls)
+    return cls
+
+
+_def_binary("_Plus", "plus", lambda a, b: a + b)
+_def_binary("_Minus", "minus", lambda a, b: a - b)
+_def_binary("_Mul", "mul", lambda a, b: a * b)
+_def_binary("_Div", "div", lambda a, b: a / b)
+_def_binary("_Power", "power", lambda a, b: a ** b)
+_def_binary("_Maximum", "maximum", lambda a, b: _jnp().maximum(a, b))
+_def_binary("_Minimum", "minimum", lambda a, b: _jnp().minimum(a, b))
+
+
+class _ScalarOp(Operator):
+    PARAMS = {"scalar": Param(float, REQUIRED)}
+    fn = None
+
+    def apply(self, ctx, inputs, aux):
+        return [type(self).fn(inputs[0], self.scalar)], []
+
+
+def _def_scalar(name, hint, fn):
+    cls = type(name.strip("_"), (_ScalarOp,), {"fn": staticmethod(fn),
+                                               "name_hint": hint})
+    register_op(name)(cls)
+    return cls
+
+
+_def_scalar("_PlusScalar", "plusscalar", lambda a, s: a + s)
+_def_scalar("_MinusScalar", "minusscalar", lambda a, s: a - s)
+_def_scalar("_RMinusScalar", "rminusscalar", lambda a, s: s - a)
+_def_scalar("_MulScalar", "mulscalar", lambda a, s: a * s)
+_def_scalar("_DivScalar", "divscalar", lambda a, s: a / s)
+_def_scalar("_RDivScalar", "rdivscalar", lambda a, s: s / a)
+_def_scalar("_PowerScalar", "powerscalar", lambda a, s: a ** s)
+_def_scalar("_RPowerScalar", "rpowerscalar", lambda a, s: s ** a)
+_def_scalar("_MaximumScalar", "maximumscalar", lambda a, s: _jnp().maximum(a, s))
+_def_scalar("_MinimumScalar", "minimumscalar", lambda a, s: _jnp().minimum(a, s))
+
+
+# ---------------------------------------------------------------------------
+# elementwise unary (reference elementwise_unary_op + mshadow_op.h)
+# ---------------------------------------------------------------------------
+class _UnaryOp(Operator):
+    fn = None
+
+    def apply(self, ctx, inputs, aux):
+        return [type(self).fn(inputs[0])], []
+
+
+def _def_unary(name, fn, aliases=()):
+    cls = type("U_" + name, (_UnaryOp,), {"fn": staticmethod(fn),
+                                          "name_hint": name})
+    register_op(name, aliases=aliases)(cls)
+    return cls
+
+
+_def_unary("exp", lambda x: _jnp().exp(x))
+_def_unary("log", lambda x: _jnp().log(x))
+_def_unary("sqrt", lambda x: _jnp().sqrt(x))
+_def_unary("rsqrt", lambda x: _jax().lax.rsqrt(x))
+_def_unary("square", lambda x: x * x)
+_def_unary("abs", lambda x: _jnp().abs(x))
+_def_unary("sign", lambda x: _jnp().sign(x))
+_def_unary("round", lambda x: _jnp().round(x))
+_def_unary("ceil", lambda x: _jnp().ceil(x))
+_def_unary("floor", lambda x: _jnp().floor(x))
+_def_unary("cos", lambda x: _jnp().cos(x))
+_def_unary("sin", lambda x: _jnp().sin(x))
+_def_unary("negative", lambda x: -x)
+
+
+@register_op("smooth_l1")
+class SmoothL1(Operator):
+    """reference smooth_l1_unary-inl.h: f(x)=0.5(sx)^2/|x|<1/s^2 else |x|-0.5/s^2."""
+
+    name_hint = "smooth_l1"
+    PARAMS = {"scalar": Param(float, 1.0)}
+
+    def apply(self, ctx, inputs, aux):
+        jnp = _jnp()
+        x = inputs[0]
+        s2 = self.scalar ** 2
+        out = jnp.where(jnp.abs(x) < 1.0 / s2,
+                        0.5 * s2 * x * x,
+                        jnp.abs(x) - 0.5 / s2)
+        return [out], []
+
+
+# ---------------------------------------------------------------------------
+# structural ops
+# ---------------------------------------------------------------------------
+@register_op("Flatten")
+class Flatten(Operator):
+    name_hint = "flatten"
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("Flatten: data shape unknown")
+        return [data], [(data[0], int(np.prod(data[1:])))], []
+
+    def apply(self, ctx, inputs, aux):
+        x = inputs[0]
+        return [x.reshape((x.shape[0], -1))], []
+
+
+@register_op("Reshape")
+class Reshape(Operator):
+    """reference reshape-inl.h; supports 0 (keep) and -1 (infer) entries."""
+
+    name_hint = "reshape"
+    PARAMS = {
+        "shape": Param("shape", None),
+        "target_shape": Param("shape", None),
+    }
+
+    def _target(self, data):
+        shape = self.params["shape"] or self.target_shape
+        if shape is None:
+            raise MXNetError("Reshape: no target shape")
+        out = []
+        for i, s in enumerate(shape):
+            out.append(data[i] if s == 0 and i < len(data) else s)
+        if -1 in out:
+            known = int(np.prod([s for s in out if s != -1]))
+            out[out.index(-1)] = int(np.prod(data)) // max(known, 1)
+        return tuple(out)
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("Reshape: data shape unknown")
+        out = self._target(data)
+        if int(np.prod(out)) != int(np.prod(data)):
+            raise MXNetError("Reshape: size mismatch %s -> %s" % (data, out))
+        return [data], [out], []
+
+    def apply(self, ctx, inputs, aux):
+        return [inputs[0].reshape(self._target(inputs[0].shape))], []
+
+
+@register_op("Cast")
+class Cast(Operator):
+    name_hint = "cast"
+    PARAMS = {"dtype": Param(str, REQUIRED)}
+
+    def infer_type(self, in_types):
+        dtype = np.dtype(self.dtype)
+        return [in_types[0] or np.float32], [dtype], []
+
+    def apply(self, ctx, inputs, aux):
+        import jax.numpy as jnp
+        return [inputs[0].astype(jnp.dtype(self.dtype))], []
+
+
+@register_op("transpose")
+class Transpose(Operator):
+    name_hint = "transpose"
+    PARAMS = {"axes": Param("shape", None)}
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("transpose: data shape unknown")
+        axes = self.axes or tuple(reversed(range(len(data))))
+        return [data], [tuple(data[a] for a in axes)], []
+
+    def apply(self, ctx, inputs, aux):
+        return [_jnp().transpose(inputs[0], self.axes)], []
+
+
+@register_op("SwapAxis")
+class SwapAxis(Operator):
+    name_hint = "swapaxis"
+    PARAMS = {"dim1": Param(int, 0), "dim2": Param(int, 0)}
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("SwapAxis: data shape unknown")
+        s = list(data)
+        s[self.dim1], s[self.dim2] = s[self.dim2], s[self.dim1]
+        return [data], [tuple(s)], []
+
+    def apply(self, ctx, inputs, aux):
+        return [_jnp().swapaxes(inputs[0], self.dim1, self.dim2)], []
+
+
+@register_op("expand_dims")
+class ExpandDims(Operator):
+    name_hint = "expand_dims"
+    PARAMS = {"axis": Param(int, REQUIRED)}
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("expand_dims: data shape unknown")
+        s = list(data)
+        # normalize negative axes the way jnp.expand_dims does
+        axis = self.axis if self.axis >= 0 else len(data) + 1 + self.axis
+        s.insert(axis, 1)
+        return [data], [tuple(s)], []
+
+    def apply(self, ctx, inputs, aux):
+        return [_jnp().expand_dims(inputs[0], self.axis)], []
+
+
+@register_op("Concat")
+class Concat(Operator):
+    name_hint = "concat"
+    PARAMS = {"num_args": Param(int, REQUIRED), "dim": Param(int, 1)}
+
+    def list_arguments(self):
+        return ["arg%d" % i for i in range(self.num_args)]
+
+    def infer_shape(self, in_shapes):
+        known = next((s for s in in_shapes if s is not None), None)
+        if known is None:
+            raise MXNetError("Concat: no input shape known")
+        filled = [s if s is not None else known for s in in_shapes]
+        dim = self.dim
+        out = list(known)
+        out[dim] = sum(s[dim] for s in filled)
+        return filled, [tuple(out)], []
+
+    def apply(self, ctx, inputs, aux):
+        return [_jnp().concatenate(list(inputs), axis=self.dim)], []
+
+
+@register_op("SliceChannel")
+class SliceChannel(Operator):
+    """Split along an axis into num_outputs symbols (reference
+    slice_channel-inl.h)."""
+
+    name_hint = "slicechannel"
+    PARAMS = {
+        "num_outputs": Param(int, REQUIRED),
+        "axis": Param(int, 1),
+        "squeeze_axis": Param(bool, False),
+    }
+
+    def list_outputs(self):
+        # note: self.params, not self.num_outputs — the base-class
+        # num_outputs property derives from list_outputs
+        n = self.params["num_outputs"]
+        return ["output%d" % i for i in range(n)]
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("SliceChannel: data shape unknown")
+        n = self.params["num_outputs"]
+        s = list(data)
+        if s[self.axis] % n:
+            raise MXNetError("SliceChannel: axis not divisible")
+        s[self.axis] //= n
+        if self.squeeze_axis and s[self.axis] == 1:
+            del s[self.axis]
+        return [data], [tuple(s)] * n, []
+
+    def apply(self, ctx, inputs, aux):
+        jnp = _jnp()
+        outs = jnp.split(inputs[0], self.params["num_outputs"], axis=self.axis)
+        if self.squeeze_axis:
+            outs = [o.squeeze(self.axis) for o in outs]
+        return list(outs), []
+
+
+@register_op("ElementWiseSum", aliases=["add_n"])
+class ElementWiseSum(Operator):
+    name_hint = "elementwisesum"
+    PARAMS = {"num_args": Param(int, REQUIRED)}
+
+    def list_arguments(self):
+        return ["arg%d" % i for i in range(self.num_args)]
+
+    def infer_shape(self, in_shapes):
+        known = next((s for s in in_shapes if s is not None), None)
+        if known is None:
+            raise MXNetError("ElementWiseSum: no input shape known")
+        return [known] * len(in_shapes), [known], []
+
+    def apply(self, ctx, inputs, aux):
+        out = inputs[0]
+        for x in inputs[1:]:
+            out = out + x
+        return [out], []
+
+
+@register_op("Crop")
+class Crop(Operator):
+    """reference crop-inl.h: crop spatial dims to match a reference symbol
+    or explicit h_w, with offset."""
+
+    name_hint = "crop"
+    PARAMS = {
+        "num_args": Param(int, 1),
+        "offset": Param("shape", (0, 0)),
+        "h_w": Param("shape", (0, 0)),
+        "center_crop": Param(bool, False),
+    }
+
+    def list_arguments(self):
+        return ["data"] if self.num_args == 1 else ["data", "crop_like"]
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("Crop: data shape unknown")
+        if self.num_args == 2:
+            like = in_shapes[1]
+            if like is None:
+                raise MXNetError("Crop: crop_like shape unknown")
+            out = data[:2] + like[2:4]
+            return [data, like], [out], []
+        h, w = self.h_w
+        return [data], [data[:2] + (h, w)], []
+
+    def apply(self, ctx, inputs, aux):
+        x = inputs[0]
+        if self.num_args == 2:
+            h, w = inputs[1].shape[2:4]
+        else:
+            h, w = self.h_w
+        if self.center_crop:
+            oh = (x.shape[2] - h) // 2
+            ow = (x.shape[3] - w) // 2
+        else:
+            oh, ow = self.offset
+        return [x[:, :, oh:oh + h, ow:ow + w]], []
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference broadcast_reduce_op-inl.h)
+# ---------------------------------------------------------------------------
+class _ReduceOp(Operator):
+    PARAMS = {
+        "axis": Param("shape", None),
+        "keepdims": Param(bool, False),
+    }
+    jname = "sum"
+
+    def _axes(self, ndim):
+        if self.axis is None:
+            return tuple(range(ndim))
+        return tuple(a % ndim for a in self.axis)
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("reduce: data shape unknown")
+        axes = self._axes(len(data))
+        if self.keepdims:
+            out = tuple(1 if i in axes else s for i, s in enumerate(data))
+        else:
+            out = tuple(s for i, s in enumerate(data) if i not in axes)
+            if not out:
+                out = (1,)
+        return [data], [out], []
+
+    def apply(self, ctx, inputs, aux):
+        jnp = _jnp()
+        x = inputs[0]
+        axes = self._axes(x.ndim)
+        r = getattr(jnp, self.jname)(x, axis=axes, keepdims=self.keepdims)
+        if r.ndim == 0:
+            r = r.reshape((1,))
+        return [r], []
+
+
+for _name, _jname in [("sum", "sum"), ("max", "max"), ("min", "min")]:
+    _cls = type("Reduce_" + _name, (_ReduceOp,), {"jname": _jname,
+                                                  "name_hint": _name})
+    register_op(_name, aliases=["%s_axis" % _name])(_cls)
+
+
+@register_op("broadcast_axis")
+class BroadcastAxis(Operator):
+    name_hint = "broadcast_axis"
+    PARAMS = {"axis": Param("shape", ()), "size": Param("shape", ())}
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("broadcast_axis: data shape unknown")
+        out = list(data)
+        for a, s in zip(self.axis, self.size):
+            out[a] = s
+        return [data], [tuple(out)], []
+
+    def apply(self, ctx, inputs, aux):
+        x = inputs[0]
+        out = list(x.shape)
+        for a, s in zip(self.axis, self.size):
+            out[a] = s
+        return [_jnp().broadcast_to(x, tuple(out))], []
+
+
+# ---------------------------------------------------------------------------
+# matrix ops
+# ---------------------------------------------------------------------------
+@register_op("dot")
+class Dot(Operator):
+    name_hint = "dot"
+    PARAMS = {
+        "transpose_a": Param(bool, False),
+        "transpose_b": Param(bool, False),
+    }
+
+    def list_arguments(self):
+        return ["lhs", "rhs"]
+
+    def infer_shape(self, in_shapes):
+        a, b = in_shapes
+        if a is None or b is None:
+            raise MXNetError("dot: input shapes unknown")
+        ar = tuple(reversed(a)) if self.transpose_a else a
+        br = tuple(reversed(b)) if self.transpose_b else b
+        if len(ar) == 1 and len(br) == 1:
+            out = (1,)
+        elif len(br) == 1:
+            out = ar[:-1]
+        elif len(ar) == 1:
+            out = br[1:]
+        else:
+            out = ar[:-1] + br[1:]
+        return [a, b], [out], []
+
+    def apply(self, ctx, inputs, aux):
+        jnp = _jnp()
+        a, b = inputs
+        if self.transpose_a:
+            a = a.T
+        if self.transpose_b:
+            b = b.T
+        r = jnp.dot(a, b)
+        if r.ndim == 0:
+            r = r.reshape((1,))
+        return [r], []
+
+
+@register_op("batch_dot")
+class BatchDot(Operator):
+    name_hint = "batch_dot"
+    PARAMS = {
+        "transpose_a": Param(bool, False),
+        "transpose_b": Param(bool, False),
+    }
+
+    def list_arguments(self):
+        return ["lhs", "rhs"]
+
+    def infer_shape(self, in_shapes):
+        a, b = in_shapes
+        if a is None or b is None:
+            raise MXNetError("batch_dot: input shapes unknown")
+        m = a[2] if self.transpose_a else a[1]
+        k = b[1] if self.transpose_b else b[2]
+        return [a, b], [(a[0], m, k)], []
+
+    def apply(self, ctx, inputs, aux):
+        jnp = _jnp()
+        a, b = inputs
+        if self.transpose_a:
+            a = jnp.swapaxes(a, 1, 2)
+        if self.transpose_b:
+            b = jnp.swapaxes(b, 1, 2)
+        return [jnp.einsum("bij,bjk->bik", a, b)], []
+
+
+# ---------------------------------------------------------------------------
+# gradient-control ops
+# ---------------------------------------------------------------------------
+@register_op("BlockGrad")
+class BlockGrad(Operator):
+    """Identity forward, zero gradient (reference block_grad-inl.h)."""
+
+    name_hint = "blockgrad"
+
+    def apply(self, ctx, inputs, aux):
+        return [_jax().lax.stop_gradient(inputs[0])], []
+
+
+@register_op("MakeLoss")
+class MakeLoss(Operator):
+    """Forward identity; gradient is grad_scale regardless of head grad
+    (reference make_loss-inl.h) — turns any symbol into a loss."""
+
+    name_hint = "makeloss"
+    PARAMS = {"grad_scale": Param(float, 1.0)}
+
+    def apply(self, ctx, inputs, aux):
+        jax = _jax()
+        scale = self.grad_scale
+
+        @jax.custom_vjp
+        def f(x):
+            return x
+
+        def f_fwd(x):
+            return x, None
+
+        def f_bwd(_, g):
+            return (_jnp().full_like(g, scale),)
+
+        f.defvjp(f_fwd, f_bwd)
+        return [f(inputs[0])], []
+
+
+@register_op("IdentityAttachKLSparseReg")
+class IdentityAttachKLSparseReg(Operator):
+    """Identity with KL sparsity regularization gradient added
+    (reference identity_attach_KL_sparse_reg-inl.h)."""
+
+    name_hint = "identityattachklsparsereg"
+    PARAMS = {
+        "sparseness_target": Param(float, 0.1),
+        "penalty": Param(float, 0.001),
+        "momentum": Param(float, 0.9),
+    }
+
+    def list_auxiliary_states(self):
+        return ["moving_avg"]
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("IdentityAttachKLSparseReg: data shape unknown")
+        return [data], [data], [(data[1],)]
+
+    def apply(self, ctx, inputs, aux):
+        jax = _jax()
+        jnp = _jnp()
+        x = inputs[0]
+        moving = aux[0]
+        rho_hat = jnp.mean(x, axis=tuple(i for i in range(x.ndim) if i != 1))
+        if ctx.is_train:
+            new_aux = [moving * self.momentum + rho_hat * (1 - self.momentum)]
+        else:
+            new_aux = [moving]
+        rho = self.sparseness_target
+        penalty = self.penalty
+        bshape = (1, -1) + (1,) * (x.ndim - 2)
+
+        @jax.custom_vjp
+        def f(x, rho_hat):
+            return x
+
+        def f_fwd(x, rho_hat):
+            return x, rho_hat
+
+        def f_bwd(rho_hat_res, g):
+            kl_grad = penalty * (-rho / rho_hat_res + (1 - rho) / (1 - rho_hat_res))
+            return g + kl_grad.reshape(bshape), jnp.zeros_like(rho_hat_res)
+
+        f.defvjp(f_fwd, f_bwd)
+        return [f(x, jax.lax.stop_gradient(rho_hat))], new_aux

@@ -1,0 +1,404 @@
+"""Optimizers (reference ``python/mxnet/optimizer.py``).
+
+The update math runs as jitted jax functions over the underlying arrays —
+one fused XLA kernel per (optimizer, shape) — while keeping the reference's
+imperative ``update(index, weight, grad, state)`` interface, per-parameter
+lr/wd multipliers (symbol attrs ``__lr_mult__``/``__wd_mult__``),
+``rescale_grad`` and clipping semantics.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .base import MXNetError, Registry
+from .ndarray import NDArray, zeros
+from .lr_scheduler import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "NAG", "SGLD", "Adam", "AdaGrad", "RMSProp",
+           "AdaDelta", "Test", "create", "get_updater", "Updater"]
+
+_REG: Registry = Registry.get_registry("optimizer")
+register = _REG.register
+
+
+
+def _zeros_like_state(weight: NDArray) -> NDArray:
+    """Optimizer state matching the weight's dtype AND device sharding —
+    params may be replicated over a device mesh (executor_group), and the
+    update math must stay colocated."""
+    import jax
+    import jax.numpy as jnp
+
+    data = jax.device_put(jnp.zeros(weight.shape, dtype=weight.dtype),
+                          weight._data.sharding)
+    return NDArray(data, ctx=weight.context)
+
+
+class Optimizer:
+    """Base optimizer (reference ``optimizer.py`` ``Optimizer``)."""
+
+    def __init__(self, rescale_grad: float = 1.0, param_idx2name=None,
+                 wd: float = 0.0, clip_gradient: Optional[float] = None,
+                 learning_rate: float = 0.01,
+                 lr_scheduler: Optional[LRScheduler] = None,
+                 sym=None, begin_num_update: int = 0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count: Dict[int, int] = {}
+        self.idx2name = dict(param_idx2name or {})
+        self.sym = sym
+        self.lr_mult: Dict[str, float] = {}
+        self.wd_mult: Dict[str, float] = {}
+        if sym is not None:
+            attrs = sym.attr_dict()
+            for name in sym.list_arguments():
+                if name in attrs:
+                    if "__lr_mult__" in attrs[name]:
+                        self.lr_mult[name] = float(attrs[name]["__lr_mult__"])
+                    if "__wd_mult__" in attrs[name]:
+                        self.wd_mult[name] = float(attrs[name]["__wd_mult__"])
+
+    @staticmethod
+    def create_optimizer(name: str, **kwargs) -> "Optimizer":
+        cls = _REG.get(name)
+        return cls(**kwargs)
+
+    def create_state(self, index: int, weight: NDArray):
+        return None
+
+    def update(self, index: int, weight: NDArray, grad: NDArray, state):
+        raise NotImplementedError
+
+    def set_lr_mult(self, args_lr_mult: Dict[str, float]):
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult: Dict[str, float]):
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index: int):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index: int) -> float:
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        name = self.idx2name.get(index, str(index))
+        return lr * self.lr_mult.get(name, 1.0)
+
+    def _get_wd(self, index: int) -> float:
+        name = self.idx2name.get(index, str(index))
+        wd = self.wd * self.wd_mult.get(name, 1.0)
+        # bias/gamma/beta conventionally get no weight decay unless overridden
+        return wd
+
+    def _preprocess(self, grad):
+        import jax.numpy as jnp
+
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+
+@register("sgd")
+class SGD(Optimizer):
+    """SGD with momentum (reference optimizer.py:234)."""
+
+    def __init__(self, momentum: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _zeros_like_state(weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        mom = self.momentum
+        opt = self
+
+        def _do():
+            g = opt._preprocess(grad._data) + wd * weight._data
+            if state is None:
+                weight._data = weight._data - lr * g
+            else:
+                state._data = mom * state._data - lr * g
+                weight._data = weight._data + state._data
+        from .engine import get_engine
+        muts = [weight._var] if state is None else [weight._var, state._var]
+        get_engine().push(_do, const_vars=[grad._var], mutable_vars=muts)
+
+
+@register("nag")
+class NAG(SGD):
+    """Nesterov accelerated gradient (reference optimizer.py:313)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        mom = self.momentum
+        opt = self
+
+        def _do():
+            g = opt._preprocess(grad._data) + wd * weight._data
+            if state is None:
+                weight._data = weight._data - lr * g
+            else:
+                state._data = mom * state._data + g
+                weight._data = weight._data - lr * (g + mom * state._data)
+        from .engine import get_engine
+        muts = [weight._var] if state is None else [weight._var, state._var]
+        get_engine().push(_do, const_vars=[grad._var], mutable_vars=muts)
+
+
+@register("sgld")
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference optimizer.py:361)."""
+
+    def update(self, index, weight, grad, state):
+        import jax
+
+        from . import random as _random
+
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        opt = self
+
+        def _do():
+            g = opt._preprocess(grad._data) + wd * weight._data
+            noise = jax.random.normal(_random.next_key(), weight.shape,
+                                      dtype=weight._data.dtype)
+            weight._data = weight._data - lr / 2 * g \
+                + math.sqrt(lr) * noise
+        from .engine import get_engine
+        get_engine().push(_do, const_vars=[grad._var], mutable_vars=[weight._var])
+
+
+@register("adam")
+class Adam(Optimizer):
+    """Adam (reference optimizer.py:504)."""
+
+    def __init__(self, learning_rate: float = 0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like_state(weight), _zeros_like_state(weight))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        mean, var = state
+        opt = self
+
+        def _do():
+            g = opt._preprocess(grad._data) + wd * weight._data
+            mean._data = opt.beta1 * mean._data + (1 - opt.beta1) * g
+            var._data = opt.beta2 * var._data + (1 - opt.beta2) * g * g
+            coef1 = 1.0 - opt.beta1 ** t
+            coef2 = 1.0 - opt.beta2 ** t
+            step_lr = lr * math.sqrt(coef2) / coef1
+            weight._data = weight._data - step_lr * mean._data / \
+                (jnp.sqrt(var._data) + opt.epsilon)
+        from .engine import get_engine
+        get_engine().push(_do, const_vars=[grad._var],
+                          mutable_vars=[weight._var, mean._var, var._var])
+
+
+@register("adagrad")
+class AdaGrad(Optimizer):
+    """AdaGrad (reference optimizer.py:605)."""
+
+    def __init__(self, eps: float = 1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return _zeros_like_state(weight)
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        opt = self
+
+        def _do():
+            g = opt._preprocess(grad._data)
+            state._data = state._data + g * g
+            weight._data = weight._data - lr * (
+                g / jnp.sqrt(state._data + opt.float_stable_eps)
+                + wd * weight._data)
+        from .engine import get_engine
+        get_engine().push(_do, const_vars=[grad._var],
+                          mutable_vars=[weight._var, state._var])
+
+
+@register("rmsprop")
+class RMSProp(Optimizer):
+    """RMSProp (Tieleman & Hinton variant used by the reference,
+    optimizer.py:654: running E[g^2], E[g], and momentum delta)."""
+
+    def __init__(self, learning_rate: float = 0.002, gamma1: float = 0.95,
+                 gamma2: float = 0.9, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+
+    def create_state(self, index, weight):
+        return (_zeros_like_state(weight),   # n
+                _zeros_like_state(weight),   # g
+                _zeros_like_state(weight))   # delta
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        n, g_state, delta = state
+        opt = self
+
+        def _do():
+            g = opt._preprocess(grad._data) + wd * weight._data
+            n._data = (1 - opt.gamma1) * g * g + opt.gamma1 * n._data
+            g_state._data = (1 - opt.gamma1) * g + opt.gamma1 * g_state._data
+            delta._data = opt.gamma2 * delta._data - lr * g / jnp.sqrt(
+                n._data - g_state._data * g_state._data + 1e-4)
+            weight._data = weight._data + delta._data
+        from .engine import get_engine
+        get_engine().push(_do, const_vars=[grad._var],
+                          mutable_vars=[weight._var, n._var, g_state._var,
+                                        delta._var])
+
+
+@register("adadelta")
+class AdaDelta(Optimizer):
+    """AdaDelta (reference optimizer.py:728)."""
+
+    def __init__(self, rho: float = 0.90, epsilon: float = 1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like_state(weight), _zeros_like_state(weight))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_delta = state
+        opt = self
+
+        def _do():
+            g = opt._preprocess(grad._data)
+            acc_g._data = opt.rho * acc_g._data + (1 - opt.rho) * g * g
+            cur_delta = jnp.sqrt(acc_delta._data + opt.epsilon) / \
+                jnp.sqrt(acc_g._data + opt.epsilon) * g
+            acc_delta._data = opt.rho * acc_delta._data + \
+                (1 - opt.rho) * cur_delta * cur_delta
+            weight._data = weight._data - cur_delta - wd * weight._data
+        from .engine import get_engine
+        get_engine().push(_do, const_vars=[grad._var],
+                          mutable_vars=[weight._var, acc_g._var, acc_delta._var])
+
+
+@register("test")
+class Test(Optimizer):
+    """Trivial optimizer for tests (reference optimizer.py:782)."""
+
+    def create_state(self, index, weight):
+        return _zeros_like_state(weight)
+
+    def update(self, index, weight, grad, state):
+        weight += grad * self.rescale_grad
+        state[:] = weight
+
+
+def create(name: str, **kwargs) -> Optimizer:
+    return Optimizer.create_optimizer(name, **kwargs)
+
+
+def _states_to_numpy(obj):
+    """NDArray states -> numpy for pickling (NDArray holds engine vars with
+    thread locks and device buffers, neither of which pickles)."""
+    if isinstance(obj, NDArray):
+        return obj.asnumpy()
+    if isinstance(obj, tuple):
+        return tuple(_states_to_numpy(o) for o in obj)
+    if isinstance(obj, list):
+        return [_states_to_numpy(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _states_to_numpy(v) for k, v in obj.items()}
+    return obj
+
+
+def _states_from_numpy(obj):
+    import numpy as _np
+
+    from .ndarray import array as _array
+
+    if isinstance(obj, _np.ndarray):
+        return _array(obj, dtype=obj.dtype)
+    if isinstance(obj, tuple):
+        return tuple(_states_from_numpy(o) for o in obj)
+    if isinstance(obj, list):
+        return [_states_from_numpy(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _states_from_numpy(v) for k, v in obj.items()}
+    return obj
+
+
+class Updater:
+    """Closure bundling an optimizer with per-index states (reference
+    ``get_updater``, optimizer.py:816). States serialize via
+    get_states/set_states (numpy form) for checkpointing."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[int, Any] = {}
+
+    def __call__(self, index: int, grad: NDArray, weight: NDArray):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def get_states(self):
+        import pickle
+
+        return pickle.dumps(_states_to_numpy(self.states))
+
+    def set_states(self, states_bytes):
+        import pickle
+
+        self.states = _states_from_numpy(pickle.loads(states_bytes))
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

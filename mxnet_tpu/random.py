@@ -1,0 +1,100 @@
+"""Random sampling.
+
+TPU-native equivalent of the reference's ``mshadow::Random`` +
+``python/mxnet/random.py``: a process-global PRNG seeded with
+:func:`seed` (reference ``MXRandomSeed``), implemented over jax's
+counter-based PRNG. Each draw folds a monotonically increasing counter into
+the base key, so imperative sampling is reproducible given a seed while
+staying functional underneath.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Optional
+
+import numpy as np
+
+from .base import mx_real_t
+from .context import Context
+from .ndarray import NDArray
+
+__all__ = ["seed", "uniform", "normal", "randint", "next_key"]
+
+_lock = threading.Lock()
+_seed = 0
+_counter = itertools.count()
+
+
+def seed(seed_state: int) -> None:
+    """Seed all random number generators (reference ``mx.random.seed``)."""
+    global _seed, _counter
+    with _lock:
+        _seed = int(seed_state)
+        _counter = itertools.count()
+
+
+def next_key():
+    """A fresh jax PRNG key derived from the global seed (internal use:
+    Dropout/initializers/executors)."""
+    import jax
+
+    with _lock:
+        n = next(_counter)
+        s = _seed
+    return jax.random.fold_in(jax.random.PRNGKey(s), n)
+
+
+def uniform(low: float = 0.0, high: float = 1.0, shape=None,
+            ctx: Optional[Context] = None, out: Optional[NDArray] = None,
+            dtype=mx_real_t) -> NDArray:
+    import jax
+
+    if out is not None:
+        shape = out.shape
+        dtype = out.dtype
+    if shape is None:
+        shape = (1,)
+    if isinstance(shape, int):
+        shape = (shape,)
+    data = jax.random.uniform(next_key(), shape, dtype=np.dtype(dtype),
+                              minval=low, maxval=high)
+    res = NDArray(data, ctx=ctx)
+    if out is not None:
+        return res.copyto(out)
+    return res
+
+
+def normal(loc: float = 0.0, scale: float = 1.0, shape=None,
+           ctx: Optional[Context] = None, out: Optional[NDArray] = None,
+           dtype=mx_real_t) -> NDArray:
+    import jax
+
+    if out is not None:
+        shape = out.shape
+        dtype = out.dtype
+    if shape is None:
+        shape = (1,)
+    if isinstance(shape, int):
+        shape = (shape,)
+    data = loc + scale * jax.random.normal(next_key(), shape, dtype=np.dtype(dtype))
+    res = NDArray(data, ctx=ctx)
+    if out is not None:
+        return res.copyto(out)
+    return res
+
+
+# reference names
+gaussian = normal
+
+
+def randint(low: int, high: int, shape=None, ctx: Optional[Context] = None,
+            dtype=np.int32) -> NDArray:
+    import jax
+
+    if shape is None:
+        shape = (1,)
+    if isinstance(shape, int):
+        shape = (shape,)
+    data = jax.random.randint(next_key(), shape, low, high, dtype=np.dtype(dtype))
+    return NDArray(data, ctx=ctx)

@@ -1,0 +1,180 @@
+"""RecordIO file format.
+
+TPU-native equivalent of dmlc-core recordio + ``python/mxnet/recordio.py``:
+a stream of length-prefixed records with a magic marker, plus an indexed
+variant for random access, and the image-record header used by
+``ImageRecordIter``/``im2rec`` (label + id packed ahead of the payload).
+Binary layout (little-endian): ``magic(u32) lrecord(u32) data pad-to-4``,
+with the upper 3 bits of ``lrecord`` reserved for the continuation flag like
+the reference.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from collections import namedtuple
+from typing import Optional
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+_LFLAG_BITS = 29
+_LENGTH_MASK = (1 << _LFLAG_BITS) - 1
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (reference dmlc::RecordIOWriter)."""
+
+    def __init__(self, uri: str, flag: str):
+        self.uri = uri
+        self.flag = flag
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fp = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fp = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError("invalid flag %s" % self.flag)
+
+    def close(self):
+        self.fp.close()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf: bytes):
+        if not self.writable:
+            raise MXNetError("not opened for writing")
+        self.fp.write(struct.pack("<II", _MAGIC, len(buf) & _LENGTH_MASK))
+        self.fp.write(buf)
+        pad = (4 - len(buf) % 4) % 4
+        if pad:
+            self.fp.write(b"\x00" * pad)
+
+    def tell(self) -> int:
+        return self.fp.tell()
+
+    def read(self) -> Optional[bytes]:
+        if self.writable:
+            raise MXNetError("not opened for reading")
+        header = self.fp.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise MXNetError("invalid record magic at %d" % (self.fp.tell() - 8))
+        length = lrec & _LENGTH_MASK
+        buf = self.fp.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.fp.read(pad)
+        return buf
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *args):
+        self.close()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Indexed record IO: ``.idx`` text file of ``key\\toffset`` lines
+    (reference ``python/mxnet/recordio.py`` indexed variant)."""
+
+    def __init__(self, idx_path: str, uri: str, flag: str, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if not self.writable and os.path.isfile(idx_path):
+            with open(idx_path) as fin:
+                for line in fin:
+                    key, off = line.strip().split("\t")
+                    key = key_type(key)
+                    self.idx[key] = int(off)
+                    self.keys.append(key)
+
+    def close(self):
+        if self.writable and self.idx:
+            with open(self.idx_path, "w") as fout:
+                for key in self.keys:
+                    fout.write("%s\t%d\n" % (key, self.idx[key]))
+        super().close()
+
+    def seek(self, idx):
+        self.fp.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf: bytes):
+        key = self.key_type(idx)
+        self.idx[key] = self.tell()
+        self.keys.append(key)
+        self.write(buf)
+
+
+IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Pack an image-record header + payload (reference pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (list, tuple, np.ndarray)):
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0.0)
+        s = label.tobytes() + s
+    return struct.pack(_IR_FORMAT, header.flag, header.label,
+                       header.id, header.id2) + s
+
+
+def unpack(s: bytes):
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    payload = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(payload[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        payload = payload[header.flag * 4:]
+    return header, payload
+
+
+def pack_img(header: IRHeader, img: np.ndarray, quality: int = 95,
+             img_fmt: str = ".jpg") -> bytes:
+    """Encode an image array and pack (requires PIL)."""
+    import io as _io
+
+    from PIL import Image
+
+    buf = _io.BytesIO()
+    fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+    Image.fromarray(img.astype(np.uint8)).save(buf, format=fmt, quality=quality)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s: bytes, iscolor: int = -1):
+    """Unpack + decode an image record -> (header, HWC uint8 array)."""
+    import io as _io
+
+    from PIL import Image
+
+    header, payload = unpack(s)
+    img = Image.open(_io.BytesIO(payload))
+    if iscolor == 0:
+        img = img.convert("L")
+    elif iscolor == 1:
+        img = img.convert("RGB")
+    return header, np.asarray(img)

@@ -1,0 +1,510 @@
+"""Symbolic graph layer.
+
+TPU-native re-design of the reference's Symbol
+(``include/mxnet/symbolic.h:40-317``, ``src/symbol/symbol.cc``): a Symbol is
+a list of (node, output_index) heads over a DAG of op nodes; composition,
+grouping, slicing, attributes and JSON save/load match the reference API.
+Where the reference lowers Symbol -> StaticGraph -> GraphExecutor with its
+own autodiff (``static_graph.cc:395`` MakeBackwardPass), here the executor
+compiles the whole graph into ONE jitted XLA computation and gets gradients
+from ``jax.vjp`` — the reference's bulk-execution segments
+(``graph_executor.cc:842-892`` InitOpSegs) generalized to the full graph.
+
+Symbol creation functions for every registered operator are generated at
+import, mirroring ``python/mxnet/symbol.py`` ``_init_symbol_module``.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .base import MXNetError
+from .name import NameManager
+from .attribute import AttrScope
+from .ops import OP_REGISTRY, Operator, create_operator
+
+__all__ = ["Symbol", "Variable", "Group", "load", "load_json"]
+
+_node_uid = itertools.count()
+
+
+class _Node:
+    """One graph node: an operator application or (op is None) a variable."""
+
+    __slots__ = ("op", "name", "inputs", "attrs", "uid")
+
+    def __init__(self, op: Optional[Operator], name: str,
+                 inputs: List[Tuple["_Node", int]], attrs: Dict[str, str]):
+        self.op = op
+        self.name = name
+        self.inputs = inputs
+        self.attrs = attrs
+        self.uid = next(_node_uid)
+
+    @property
+    def is_variable(self) -> bool:
+        return self.op is None
+
+    def num_outputs(self) -> int:
+        return 1 if self.op is None else self.op.num_outputs
+
+
+def topo_order(head_nodes: Sequence[_Node]) -> List[_Node]:
+    """DFS post-order (reference ``Symbol::DFSVisit``, ``symbol.cc:119``)."""
+    seen = set()
+    order: List[_Node] = []
+
+    def visit(node: _Node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for src, _ in node.inputs:
+            visit(src)
+        order.append(node)
+
+    for node in head_nodes:
+        visit(node)
+    return order
+
+
+class Symbol:
+    """Immutable symbolic expression; composes via op creation functions and
+    python operators exactly like ``mx.sym``."""
+
+    def __init__(self, outputs: List[Tuple[_Node, int]]):
+        self._outputs = outputs
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def name(self) -> Optional[str]:
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def _head_nodes(self) -> List[_Node]:
+        seen, heads = set(), []
+        for node, _ in self._outputs:
+            if id(node) not in seen:
+                seen.add(id(node))
+                heads.append(node)
+        return heads
+
+    def _topo(self) -> List[_Node]:
+        return topo_order(self._head_nodes())
+
+    def list_arguments(self) -> List[str]:
+        return [n.name for n in self._topo() if n.is_variable]
+
+    def list_outputs(self) -> List[str]:
+        names = []
+        for node, idx in self._outputs:
+            if node.is_variable:
+                names.append(node.name)
+            else:
+                onames = node.op.list_outputs()
+                suffix = onames[idx]
+                names.append("%s_%s" % (node.name, suffix))
+        return names
+
+    def list_auxiliary_states(self) -> List[str]:
+        names = []
+        for node in self._topo():
+            if not node.is_variable:
+                for aux in node.op.list_auxiliary_states():
+                    names.append("%s_%s" % (node.name, aux))
+        return names
+
+    # -- attributes (reference symbol attributes / ListAttr) ---------------
+    def attr(self, key: str) -> Optional[str]:
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].attrs.get(key)
+        return None
+
+    def list_attr(self) -> Dict[str, str]:
+        if len(self._outputs) == 1:
+            return dict(self._outputs[0][0].attrs)
+        return {}
+
+    def attr_dict(self) -> Dict[str, Dict[str, str]]:
+        ret = {}
+        for node in self._topo():
+            if node.attrs:
+                ret[node.name] = dict(node.attrs)
+        return ret
+
+    def _set_attr(self, **kwargs):
+        for node, _ in self._outputs:
+            for k, v in kwargs.items():
+                node.attrs[k] = v
+
+    # -- composition -------------------------------------------------------
+    def __getitem__(self, index) -> "Symbol":
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise MXNetError("output '%s' not found in %s" % (index, names))
+            index = names.index(index)
+        return Symbol([self._outputs[index]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self._outputs)))
+
+    def get_internals(self) -> "Symbol":
+        """Symbol exposing every internal node output, names ``<n>_output``
+        (reference ``Symbol::GetInternals``)."""
+        outputs = []
+        for node in self._topo():
+            for i in range(node.num_outputs()):
+                outputs.append((node, i))
+        return Symbol(outputs)
+
+    def get_children(self) -> Optional["Symbol"]:
+        if len(self._outputs) != 1 or self._outputs[0][0].is_variable:
+            return None
+        return Symbol(list(self._outputs[0][0].inputs))
+
+    # -- operator overloading (reference registered _Plus etc.) ------------
+    def __add__(self, other):
+        return _binary_create("_Plus", "_PlusScalar", self, other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return _binary_create("_Minus", "_MinusScalar", self, other)
+
+    def __rsub__(self, other):
+        return _scalar_create("_RMinusScalar", self, other)
+
+    def __mul__(self, other):
+        return _binary_create("_Mul", "_MulScalar", self, other)
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return _binary_create("_Div", "_DivScalar", self, other)
+
+    def __rtruediv__(self, other):
+        return _scalar_create("_RDivScalar", self, other)
+
+    __div__ = __truediv__
+    __rdiv__ = __rtruediv__
+
+    def __pow__(self, other):
+        return _binary_create("_Power", "_PowerScalar", self, other)
+
+    def __rpow__(self, other):
+        return _scalar_create("_RPowerScalar", self, other)
+
+    def __neg__(self):
+        return _scalar_create("_MulScalar", self, -1.0)
+
+    def __copy__(self):
+        return Symbol(list(self._outputs))
+
+    def __repr__(self):
+        return "<Symbol %s>" % (self.name or "group[%d]" % len(self._outputs))
+
+    # -- shape/type inference ----------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        arg_shapes, out_shapes, aux_shapes = self._infer_shape_impl(
+            False, *args, **kwargs)
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known: Dict[str, Optional[tuple]] = {}
+        if args:
+            if len(args) > len(arg_names):
+                raise MXNetError("too many positional shapes")
+            for name, shape in zip(arg_names, args):
+                if shape is not None:
+                    known[name] = tuple(shape)
+        for name, shape in kwargs.items():
+            if name not in arg_names:
+                raise MXNetError("infer_shape: unknown argument '%s' (args: %s)"
+                                 % (name, arg_names))
+            known[name] = tuple(shape)
+
+        nodes = self._topo()
+        # node -> list of output shapes
+        shapes: Dict[int, List[Optional[tuple]]] = {}
+        aux_shapes: Dict[int, List[tuple]] = {}
+        for node in nodes:
+            shapes[node.uid] = [None] * node.num_outputs()
+            if node.is_variable and node.name in known:
+                shapes[node.uid][0] = known[node.name]
+
+        # fixpoint forward propagation with write-back into variables
+        # (reference StaticGraph::InferNodeShapes iterates to fixpoint,
+        # static_graph.cc:59)
+        for _ in range(3):
+            changed = False
+            for node in nodes:
+                if node.is_variable:
+                    continue
+                in_shapes = [shapes[src.uid][i] for src, i in node.inputs]
+                try:
+                    in_filled, out_filled, aux = node.op.infer_shape(in_shapes)
+                except MXNetError:
+                    continue
+                for (src, i), s in zip(node.inputs, in_filled):
+                    if s is not None and shapes[src.uid][i] != tuple(s):
+                        shapes[src.uid][i] = tuple(s)
+                        changed = True
+                for i, s in enumerate(out_filled):
+                    if shapes[node.uid][i] != tuple(s):
+                        shapes[node.uid][i] = tuple(s)
+                        changed = True
+                aux_shapes[node.uid] = [tuple(s) for s in aux]
+            if not changed:
+                break
+
+        arg_shapes = [shapes[n.uid][0] for n in nodes if n.is_variable]
+        out_shapes = [shapes[n.uid][i] for n, i in self._outputs]
+        aux_list: List[tuple] = []
+        for node in nodes:
+            if not node.is_variable and node.op.list_auxiliary_states():
+                if node.uid not in aux_shapes:
+                    if partial:
+                        aux_list.extend([None] * len(node.op.list_auxiliary_states()))
+                        continue
+                    raise MXNetError("cannot infer aux shapes of %s" % node.name)
+                aux_list.extend(aux_shapes[node.uid])
+        if not partial:
+            if any(s is None for s in arg_shapes):
+                missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
+                raise MXNetError("infer_shape incomplete; unknown args: %s"
+                                 % missing)
+            if any(s is None for s in out_shapes):
+                raise MXNetError("infer_shape could not infer outputs")
+        return arg_shapes, out_shapes, aux_list
+
+    def infer_type(self, *args, **kwargs):
+        import numpy as np
+
+        arg_names = self.list_arguments()
+        known: Dict[str, Any] = {}
+        for name, t in zip(arg_names, args):
+            if t is not None:
+                known[name] = np.dtype(t)
+        for name, t in kwargs.items():
+            known[name] = np.dtype(t)
+        arg_types = [known.get(n, np.dtype("float32")) for n in arg_names]
+        out_types = [np.dtype("float32")] * len(self._outputs)
+        aux_types = [np.dtype("float32")] * len(self.list_auxiliary_states())
+        return arg_types, out_types, aux_types
+
+    # -- serialization (reference static_graph.cc:551-615 JSON) ------------
+    def tojson(self) -> str:
+        nodes = self._topo()
+        nid = {n.uid: i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jnodes.append({
+                "op": "null" if n.is_variable else n.op.op_name,
+                "name": n.name,
+                "param": {} if n.is_variable else n.op.param_str_dict(),
+                "inputs": [[nid[src.uid], i] for src, i in n.inputs],
+                "attr": dict(n.attrs),
+            })
+        heads = [[nid[n.uid], i] for n, i in self._outputs]
+        return json.dumps({"nodes": jnodes,
+                           "arg_nodes": [i for i, n in enumerate(nodes)
+                                         if n.is_variable],
+                           "heads": heads}, indent=2)
+
+    def save(self, fname: str):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- binding -----------------------------------------------------------
+    def simple_bind(self, ctx, grad_req="write", type_dict=None, **kwargs):
+        """Infer shapes, allocate arrays, bind (reference symbol.py:635)."""
+        from . import ndarray as nd
+        from .executor import Executor
+
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        arg_names = self.list_arguments()
+        arg_types = dict(type_dict or {})
+        args = [nd.zeros(s, ctx=ctx, dtype=arg_types.get(n, "float32"))
+                for n, s in zip(arg_names, arg_shapes)]
+        if grad_req == "null":
+            args_grad = None
+        else:
+            args_grad = {}
+            reqs = grad_req if isinstance(grad_req, dict) else \
+                {n: grad_req for n in arg_names}
+            for n, s in zip(arg_names, arg_shapes):
+                if reqs.get(n, "null") != "null":
+                    args_grad[n] = nd.zeros(s, ctx=ctx)
+        aux_states = [nd.zeros(s, ctx=ctx) for s in aux_shapes]
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from .executor import Executor
+
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states,
+                        group2ctx=group2ctx, shared_exec=shared_exec)
+
+    # evaluation convenience (not in reference; handy for tests)
+    def eval(self, ctx=None, **kwargs):
+        from .context import current_context
+
+        ctx = ctx or current_context()
+        args = {k: v for k, v in kwargs.items()}
+        executor = self.bind(ctx, args, grad_req="null")
+        return executor.forward(is_train=False)
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+def Variable(name: str, attr: Optional[Dict[str, str]] = None,
+             shape=None, lr_mult=None, wd_mult=None, dtype=None,
+             init=None) -> Symbol:
+    """Create a symbolic variable (reference ``mx.sym.Variable``)."""
+    if not isinstance(name, str):
+        raise TypeError("Variable name must be a string")
+    attr = AttrScope.current().get(attr)
+    if shape is not None:
+        attr["__shape__"] = str(tuple(shape))
+    if lr_mult is not None:
+        attr["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        attr["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        attr["__dtype__"] = str(dtype)
+    node = _Node(None, name, [], attr)
+    return Symbol([(node, 0)])
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    """Group symbols into one multi-output symbol (reference CreateGroup)."""
+    outputs: List[Tuple[_Node, int]] = []
+    for s in symbols:
+        if not isinstance(s, Symbol):
+            raise TypeError("Group expects Symbols")
+        outputs.extend(s._outputs)
+    return Symbol(outputs)
+
+
+def load_json(json_str: str) -> Symbol:
+    data = json.loads(json_str)
+    nodes: List[_Node] = []
+    for jn in data["nodes"]:
+        inputs = [(nodes[i], idx) for i, idx in jn["inputs"]]
+        if jn["op"] == "null":
+            node = _Node(None, jn["name"], inputs, dict(jn.get("attr", {})))
+        else:
+            op = create_operator(jn["op"], **jn.get("param", {}))
+            node = _Node(op, jn["name"], inputs, dict(jn.get("attr", {})))
+        nodes.append(node)
+    outputs = [(nodes[i], idx) for i, idx in data["heads"]]
+    return Symbol(outputs)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def _create(op_name: str, *args, **kwargs) -> Symbol:
+    """Create a symbol by applying a registered operator — the generated
+    creation functions call this (reference ``Symbol::Create`` +
+    ``Compose``, ``symbol.cc:335-403``)."""
+    name = kwargs.pop("name", None)
+    attr = kwargs.pop("attr", None)
+    sym_kwargs = {}
+    param_kwargs = {}
+    for k, v in kwargs.items():
+        if isinstance(v, Symbol):
+            sym_kwargs[k] = v
+        else:
+            param_kwargs[k] = v
+    op = create_operator(op_name, **param_kwargs)
+    arg_names = op.list_arguments()
+    name = NameManager.current().get(name, op.name_hint)
+    attrs = AttrScope.current().get(attr)
+
+    # positional then keyword matching (Compose semantics)
+    if args and sym_kwargs:
+        raise MXNetError(
+            "%s: cannot mix positional and keyword symbol inputs" % op_name)
+    inputs_by_name: Dict[str, Symbol] = dict(sym_kwargs)
+    for argn, s in zip(arg_names, args):
+        if not isinstance(s, Symbol):
+            raise TypeError("%s: positional inputs must be Symbols" % op_name)
+        inputs_by_name[argn] = s
+    for k in inputs_by_name:
+        if k not in arg_names:
+            raise MXNetError("%s: unknown input '%s' (expects %s)"
+                             % (op_name, k, arg_names))
+
+    inputs: List[Tuple[_Node, int]] = []
+    for argn in arg_names:
+        if argn in inputs_by_name:
+            s = inputs_by_name[argn]
+            if len(s._outputs) != 1:
+                raise MXNetError("%s: input '%s' must be single-output"
+                                 % (op_name, argn))
+            inputs.append(s._outputs[0])
+        else:
+            # auto-create missing inputs as variables (reference behavior:
+            # weights/bias become arguments named <op>_<arg>)
+            var = _Node(None, "%s_%s" % (name, argn), [],
+                        AttrScope.current().get(None))
+            inputs.append((var, 0))
+    node = _Node(op, name, inputs, attrs)
+    return Symbol([(node, i) for i in range(op.num_outputs)])
+
+
+def _binary_create(op_name, scalar_op_name, lhs, rhs) -> Symbol:
+    if isinstance(rhs, Symbol):
+        return _create(op_name, lhs=lhs, rhs=rhs)
+    return _scalar_create(scalar_op_name, lhs, rhs)
+
+
+def _scalar_create(op_name, data, scalar) -> Symbol:
+    return _create(op_name, data=data, scalar=float(scalar))
+
+
+# ---------------------------------------------------------------------------
+# auto-generate creation functions from the registry (reference
+# _init_symbol_module, python/mxnet/symbol.py:1187)
+# ---------------------------------------------------------------------------
+
+def _make_creator(op_name: str):
+    def creator(*args, **kwargs):
+        return _create(op_name, *args, **kwargs)
+    creator.__name__ = op_name
+    cls = OP_REGISTRY.get(op_name)
+    creator.__doc__ = cls.__doc__ or "Apply operator %s." % op_name
+    return creator
+
+
+def _init_symbol_module():
+    done = set()
+    for lname, cls in list(OP_REGISTRY.items()):
+        for op_name in (cls.op_name,) + getattr(cls, "op_aliases", ()):
+            if op_name in done:
+                continue
+            done.add(op_name)
+            fn = _make_creator(cls.op_name)
+            fn.__name__ = op_name
+            globals()[op_name] = fn
+            if not op_name.startswith("_"):
+                __all__.append(op_name)
+
+
+_init_symbol_module()

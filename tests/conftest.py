@@ -1,0 +1,21 @@
+"""Test configuration: force an 8-device CPU platform so multi-device
+sharding paths run without TPU hardware (the reference's analogue: CPU-only
+multi-device tests like tests/python/unittest/test_multi_device_exec.py)."""
+import os
+
+# force CPU: the session may default to a TPU platform (axon), but tests run
+# on the virtual 8-device CPU mesh
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# full-precision matmuls/convs so finite-difference gradient checks are tight
+os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
+
+# the axon TPU site hook overrides JAX_PLATFORMS at import; force cpu via
+# config too
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,110 @@
+"""Executor tests (reference tests/python/unittest/test_executor.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _net():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data=data, num_hidden=3, name="fc")
+    return sym.SoftmaxOutput(data=fc, name="sm")
+
+
+def test_simple_bind_and_forward():
+    net = _net()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 6))
+    assert set(ex.arg_dict) == {"data", "fc_weight", "fc_bias", "sm_label"}
+    ex.arg_dict["data"][:] = np.random.randn(4, 6)
+    ex.arg_dict["fc_weight"][:] = np.random.randn(3, 6)
+    outs = ex.forward(is_train=False)
+    assert outs[0].shape == (4, 3)
+    np.testing.assert_allclose(outs[0].asnumpy().sum(axis=1), np.ones(4),
+                               rtol=1e-5)
+
+
+def test_grad_req_add():
+    a = sym.Variable("a")
+    out = a * 3.0
+    arr = mx.nd.array(np.ones((2, 2), dtype=np.float32))
+    grad = mx.nd.zeros((2, 2))
+    ex = out.bind(mx.cpu(), {"a": arr}, args_grad={"a": grad}, grad_req="add")
+    for i in range(3):
+        ex.forward(is_train=True)
+        ex.backward()
+    np.testing.assert_allclose(grad.asnumpy(), np.full((2, 2), 9.0))
+
+
+def test_grad_req_null():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    out = a * b
+    ones = np.ones((2, 2), dtype=np.float32)
+    ga = mx.nd.zeros((2, 2))
+    ex = out.bind(mx.cpu(), {"a": mx.nd.array(ones), "b": mx.nd.array(2 * ones)},
+                  args_grad={"a": ga},
+                  grad_req={"a": "write", "b": "null"})
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ga.asnumpy(), 2 * ones)
+    assert ex.grad_dict.get("b") is None
+
+
+def test_backward_head_grads():
+    a = sym.Variable("a")
+    out = a * a
+    x = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+    ga = mx.nd.zeros((3,))
+    ex = out.bind(mx.cpu(), {"a": mx.nd.array(x)}, args_grad={"a": ga})
+    ex.forward(is_train=True)
+    head = mx.nd.array(np.array([1.0, 0.5, 2.0], dtype=np.float32))
+    ex.backward([head])
+    np.testing.assert_allclose(ga.asnumpy(), 2 * x * head.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_executor_reshape():
+    net = _net()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 6))
+    w = np.random.randn(3, 6).astype(np.float32)
+    ex.arg_dict["fc_weight"][:] = w
+    ex2 = ex.reshape(data=(8, 6))
+    assert ex2.arg_dict["data"].shape == (8, 6)
+    # params shared
+    np.testing.assert_allclose(ex2.arg_dict["fc_weight"].asnumpy(), w)
+    assert ex2.arg_dict["fc_weight"] is ex.arg_dict["fc_weight"]
+    ex2.arg_dict["data"][:] = np.random.randn(8, 6)
+    outs = ex2.forward(is_train=False)
+    assert outs[0].shape == (8, 3)
+
+
+def test_monitor_callback():
+    net = _net()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 4))
+    ex.arg_dict["data"][:] = np.random.randn(2, 4)
+    seen = []
+    ex.set_monitor_callback(lambda name, arr: seen.append(name))
+    ex.forward(is_train=True)
+    assert any("fc_output" in n for n in seen)
+    assert any("sm_output" in n for n in seen)
+
+
+def test_copy_params_from():
+    net = _net()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 4))
+    w = np.random.randn(3, 4).astype(np.float32)
+    ex.copy_params_from({"fc_weight": mx.nd.array(w)},
+                        allow_extra_params=True)
+    np.testing.assert_allclose(ex.arg_dict["fc_weight"].asnumpy(), w)
+
+
+def test_outputs_lazy_train():
+    """Train-mode forward defers compute to backward (one fused XLA call)."""
+    net = _net()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 4))
+    ex.arg_dict["data"][:] = np.random.randn(2, 4)
+    ex.forward(is_train=True)
+    ex.backward()
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (2, 3)

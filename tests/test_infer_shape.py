@@ -1,0 +1,67 @@
+"""Shape inference tests (reference tests/python/unittest/test_infer_shape.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.base import MXNetError
+
+
+def test_mlp_infer_shape():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data=data, name="fc1", num_hidden=1000)
+    out = sym.SoftmaxOutput(data=fc1, name="softmax")
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(100, 100))
+    names = out.list_arguments()
+    d = dict(zip(names, arg_shapes))
+    assert d["fc1_weight"] == (1000, 100)
+    assert d["fc1_bias"] == (1000,)
+    assert d["softmax_label"] == (100,)
+    assert out_shapes == [(100, 1000)]
+
+
+def test_conv_chain_infer_shape():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data=data, kernel=(3, 3), num_filter=8,
+                           pad=(1, 1), name="conv")
+    pool = sym.Pooling(data=conv, kernel=(2, 2), stride=(2, 2),
+                       pool_type="max")
+    flat = sym.Flatten(data=pool)
+    fc = sym.FullyConnected(data=flat, num_hidden=10, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape(data=(2, 3, 28, 28))
+    d = dict(zip(fc.list_arguments(), arg_shapes))
+    assert d["conv_weight"] == (8, 3, 3, 3)
+    assert d["fc_weight"] == (10, 8 * 14 * 14)
+    assert out_shapes == [(2, 10)]
+
+
+def test_incomplete_shape_raises():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data=data, num_hidden=10)
+    with pytest.raises(MXNetError):
+        fc.infer_shape()
+
+
+def test_infer_shape_partial():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data=data, num_hidden=10, name="fc")
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial()
+    assert arg_shapes[0] is None
+
+
+def test_batchnorm_aux_shapes():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data=data, name="bn")
+    arg_shapes, out_shapes, aux_shapes = bn.infer_shape(data=(4, 7, 5, 5))
+    assert aux_shapes == [(7,), (7,)]
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+
+
+def test_deconv_infer_shape():
+    data = sym.Variable("data")
+    deconv = sym.Deconvolution(data=data, kernel=(4, 4), stride=(2, 2),
+                               pad=(1, 1), num_filter=8, name="dc")
+    arg_shapes, out_shapes, _ = deconv.infer_shape(data=(1, 3, 16, 16))
+    assert out_shapes == [(1, 8, 32, 32)]
+    d = dict(zip(deconv.list_arguments(), arg_shapes))
+    assert d["dc_weight"] == (3, 8, 4, 4)

@@ -1,0 +1,168 @@
+"""IO tests (reference tests/python/unittest/test_io.py + recordio tests)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+from mxnet_tpu import recordio as rio
+
+
+def test_ndarray_iter():
+    data = np.arange(100).reshape(25, 4).astype(np.float32)
+    label = np.arange(25).astype(np.float32)
+    it = mio.NDArrayIter(data, label, batch_size=10, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (10, 4)
+    assert batches[2].pad == 5
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:10])
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(), label[:10])
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_ndarray_iter_discard():
+    data = np.zeros((25, 4), dtype=np.float32)
+    it = mio.NDArrayIter(data, np.zeros(25), batch_size=10,
+                         last_batch_handle="discard")
+    assert len(list(it)) == 2
+
+
+def test_ndarray_iter_dict_input():
+    it = mio.NDArrayIter({"a": np.zeros((10, 2)), "b": np.zeros((10, 3))},
+                         np.zeros(10), batch_size=5)
+    assert sorted(d.name for d in it.provide_data) == ["a", "b"]
+
+
+def test_resize_iter():
+    data = np.zeros((20, 2), dtype=np.float32)
+    it = mio.NDArrayIter(data, np.zeros(20), batch_size=5)
+    rit = mio.ResizeIter(it, size=7)
+    assert len(list(rit)) == 7
+    rit.reset()
+    assert len(list(rit)) == 7
+
+
+def test_prefetching_iter():
+    data = np.random.rand(40, 3).astype(np.float32)
+    label = np.arange(40).astype(np.float32)
+    base = mio.NDArrayIter(data, label, batch_size=10)
+    pre = mio.PrefetchingIter(base)
+    batches = list(pre)
+    assert len(batches) == 4
+    got = np.concatenate([b.label[0].asnumpy() for b in batches])
+    np.testing.assert_allclose(np.sort(got), label)
+    pre.reset()
+    assert len(list(pre)) == 4
+
+
+def test_csv_iter(tmp_path):
+    data_path = str(tmp_path / "data.csv")
+    label_path = str(tmp_path / "label.csv")
+    data = np.random.rand(12, 3)
+    label = np.arange(12)
+    np.savetxt(data_path, data, delimiter=",")
+    np.savetxt(label_path, label, delimiter=",")
+    it = mio.CSVIter(data_csv=data_path, data_shape=(3,),
+                     label_csv=label_path, batch_size=4)
+    batches = list(it)
+    assert len(batches) == 3
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:4],
+                               rtol=1e-5)
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    writer = rio.MXRecordIO(path, "w")
+    payloads = [b"hello", b"x" * 1000, b"", b"abc123"]
+    for p in payloads:
+        writer.write(p)
+    writer.close()
+    reader = rio.MXRecordIO(path, "r")
+    got = []
+    while True:
+        rec = reader.read()
+        if rec is None:
+            break
+        got.append(rec)
+    reader.close()
+    assert got == payloads
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "test.rec")
+    idx_path = str(tmp_path / "test.idx")
+    writer = rio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(10):
+        writer.write_idx(i, b"record%d" % i)
+    writer.close()
+    reader = rio.MXIndexedRecordIO(idx_path, path, "r")
+    assert reader.read_idx(7) == b"record7"
+    assert reader.read_idx(2) == b"record2"
+    reader.close()
+
+
+def test_pack_unpack():
+    header = rio.IRHeader(0, 3.0, 42, 0)
+    packed = rio.pack(header, b"payload")
+    h, payload = rio.unpack(packed)
+    assert h.label == 3.0
+    assert h.id == 42
+    assert payload == b"payload"
+    # multi-label
+    header = rio.IRHeader(0, [1.0, 2.0, 3.0], 7, 0)
+    packed = rio.pack(header, b"xyz")
+    h, payload = rio.unpack(packed)
+    np.testing.assert_allclose(h.label, [1.0, 2.0, 3.0])
+    assert payload == b"xyz"
+
+
+def test_image_record_iter(tmp_path):
+    pytest.importorskip("PIL")
+    path = str(tmp_path / "img.rec")
+    writer = rio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        img = (rng.rand(10, 12, 3) * 255).astype(np.uint8)
+        writer.write(rio.pack_img(rio.IRHeader(0, float(i % 3), i, 0), img))
+    writer.close()
+    it = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                             batch_size=4, rand_crop=True, rand_mirror=True)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (4, 3, 8, 8)
+    assert batches[0].label[0].shape == (4,)
+    # sharding
+    it2 = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                              batch_size=2, num_parts=2, part_index=0)
+    assert it2.num_data == 4
+
+
+def test_mnist_iter_synthetic(tmp_path):
+    """MNISTIter against synthetic idx files (no dataset download)."""
+    import struct
+
+    img_path = str(tmp_path / "images-idx3-ubyte")
+    lbl_path = str(tmp_path / "labels-idx1-ubyte")
+    n = 32
+    rng = np.random.RandomState(0)
+    images = (rng.rand(n, 28, 28) * 255).astype(np.uint8)
+    labels = (rng.randint(0, 10, n)).astype(np.uint8)
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, 3))
+        f.write(struct.pack(">III", n, 28, 28))
+        f.write(images.tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, 1))
+        f.write(struct.pack(">I", n))
+        f.write(labels.tobytes())
+    it = mio.MNISTIter(image=img_path, label=lbl_path, batch_size=8,
+                       shuffle=False)
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (8, 1, 28, 28)
+    flat_it = mio.MNISTIter(image=img_path, label=lbl_path, batch_size=8,
+                            flat=True, shuffle=False)
+    assert next(iter(flat_it)).data[0].shape == (8, 784)

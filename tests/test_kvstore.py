@@ -1,0 +1,88 @@
+"""KVStore tests (reference tests/python/unittest/test_kvstore.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def _check(kv_type):
+    kv = kvstore.create(kv_type)
+    kv.init(3, mx.nd.ones(SHAPE))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones(SHAPE))
+
+    # push single
+    kv.push(3, mx.nd.ones(SHAPE) * 8)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(SHAPE, 8.0))
+
+    # aggregation across "devices" (reference: 4 GPUs -> sum)
+    num_devs = 4
+    vals = [mx.nd.ones(SHAPE, ctx=mx.cpu(i % 4)) for i in range(num_devs)]
+    kv.push(3, vals)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(SHAPE, 4.0))
+
+
+@pytest.mark.parametrize("kv_type", ["local", "device", "tpu_sync"])
+def test_kvstore_single_key(kv_type):
+    _check(kv_type)
+
+
+def test_kvstore_list_keys():
+    kv = kvstore.create("local")
+    kv.init(KEYS, [mx.nd.ones(SHAPE)] * len(KEYS))
+    vals = [[mx.nd.ones(SHAPE) * 2] * 3] * len(KEYS)
+    kv.push(KEYS, vals)
+    outs = [mx.nd.zeros(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), np.full(SHAPE, 6.0))
+
+
+def test_kvstore_updater():
+    kv = kvstore.create("local")
+    kv.init(3, mx.nd.ones(SHAPE))
+
+    def updater(key, recv, stored):
+        stored += recv * 2
+    kv.set_updater(updater)
+    kv.push(3, [mx.nd.ones(SHAPE)] * 4)
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(SHAPE, 9.0))
+
+
+def test_kvstore_optimizer():
+    from mxnet_tpu import optimizer as opt
+
+    kv = kvstore.create("local")
+    kv.init(0, mx.nd.ones(SHAPE))
+    kv.set_optimizer(opt.SGD(learning_rate=0.1, rescale_grad=1.0))
+    kv.push(0, mx.nd.ones(SHAPE))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(SHAPE, 0.9), rtol=1e-6)
+
+
+def test_kvstore_rank():
+    kv = kvstore.create("dist_sync")
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+
+
+def test_kvstore_aggregation_exact():
+    """Exact arithmetic of push/pull (reference
+    tests/nightly/dist_sync_kvstore.py:14-40 single-process analogue)."""
+    kv = kvstore.create("tpu_sync")
+    kv.init(9, mx.nd.zeros((2, 3)))
+    for i in range(1, 5):
+        kv.push(9, [mx.nd.ones((2, 3)) * i])
+    out = mx.nd.zeros((2, 3))
+    kv.pull(9, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 3), 4.0))

@@ -1,0 +1,163 @@
+"""Module tests (reference tests/python/unittest/test_module.py +
+train/test_mlp.py convergence gate on synthetic data)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.module import Module, BucketingModule
+
+
+def _mlp_sym(num_hidden=32, num_classes=3):
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=num_hidden, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _synthetic(n=600, dim=10, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, dim).astype(np.float32)
+    w = rng.randn(dim, classes)
+    y = X.dot(w).argmax(axis=1).astype(np.float32)
+    return X, y
+
+
+def test_module_fit_convergence():
+    X, y = _synthetic()
+    data = mx.io.NDArrayIter(X, y, batch_size=50, shuffle=True)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(data, num_epoch=15, optimizer="adam",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.05})
+    score = mod.score(data, "acc")
+    assert score[0][1] > 0.95, "did not converge: %s" % score
+
+
+def test_module_predict():
+    X, y = _synthetic(n=100)
+    data = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data.provide_data, data.provide_label, for_training=False)
+    mod.init_params()
+    out = mod.predict(data)
+    assert out.shape == (100, 3)
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    X, y = _synthetic(n=100)
+    data = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(data, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 2)
+    arg0, aux0 = mod.get_params()
+
+    mod2 = Module.load(prefix, 2)
+    mod2.bind(data.provide_data, data.provide_label, for_training=False)
+    arg1, _ = mod2.get_params()
+    for name in arg0:
+        np.testing.assert_allclose(arg0[name].asnumpy(),
+                                   arg1[name].asnumpy(), rtol=1e-6)
+    # predictions match
+    p1 = mod.predict(data).asnumpy()
+    p2 = mod2.predict(data).asnumpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)
+
+
+def test_module_get_set_params():
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.bind([("data", (10, 10))], [("softmax_label", (10,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    arg, aux = mod.get_params()
+    assert "fc1_weight" in arg
+    w = arg["fc1_weight"].asnumpy()
+    assert np.abs(w).max() > 0
+    new_w = np.ones_like(w)
+    mod.set_params({**{k: v for k, v in arg.items()},
+                    "fc1_weight": mx.nd.array(new_w)}, aux)
+    arg2, _ = mod.get_params()
+    np.testing.assert_allclose(arg2["fc1_weight"].asnumpy(), new_w)
+
+
+def test_module_input_grads():
+    net = _mlp_sym()
+    mod = Module(net, context=mx.cpu())
+    mod.bind([("data", (4, 10))], [("softmax_label", (4,))],
+             for_training=True, inputs_need_grad=True)
+    mod.init_params()
+    batch = mx.io.DataBatch([mx.nd.array(np.random.randn(4, 10))],
+                            [mx.nd.array(np.array([0, 1, 2, 0]))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    grads = mod.get_input_grads()
+    assert grads[0].shape == (4, 10)
+    assert np.abs(grads[0].asnumpy()).sum() > 0
+
+
+def test_module_kvstore_fit():
+    X, y = _synthetic(n=200)
+    data = mx.io.NDArrayIter(X, y, batch_size=50, shuffle=True)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(data, num_epoch=5, kvstore="tpu_sync", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+    score = mod.score(data, "acc")
+    assert score[0][1] > 0.9
+
+
+def test_bucketing_module():
+    """Variable-length training via bucketing (reference
+    test_module bucketing + lstm_bucketing example pattern)."""
+    buckets = [4, 8]
+
+    def sym_gen(seq_len):
+        # weights shared across buckets: FC input dim is seq-independent
+        data = sym.Variable("data")
+        pooled = sym.sum(data, axis=(1,))
+        fc = sym.FullyConnected(pooled, num_hidden=8, name="fc_shared")
+        out = sym.SoftmaxOutput(fc, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = BucketingModule(sym_gen, default_bucket_key=8, context=mx.cpu())
+    mod.bind([("data", (10, 8, 5))], [("softmax_label", (10,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(0)
+    for bucket in [8, 4, 8, 4]:
+        batch = mx.io.DataBatch(
+            [mx.nd.array(rng.randn(10, bucket, 5).astype(np.float32))],
+            [mx.nd.array(rng.randint(0, 8, 10).astype(np.float32))],
+            bucket_key=bucket,
+            provide_data=[mx.io.DataDesc("data", (10, bucket, 5))],
+            provide_label=[mx.io.DataDesc("softmax_label", (10,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    assert set(mod._buckets.keys()) == {4, 8}
+
+
+def test_sequential_module():
+    from mxnet_tpu.module import SequentialModule
+
+    net1 = sym.Variable("data")
+    net1 = sym.FullyConnected(net1, num_hidden=8, name="fc1")
+    net1 = sym.Activation(net1, act_type="relu")
+
+    net2 = sym.Variable("data")
+    net2 = sym.FullyConnected(net2, num_hidden=3, name="fc2")
+    net2 = sym.SoftmaxOutput(net2, name="softmax")
+
+    smod = SequentialModule()
+    smod.add(Module(net1, label_names=[], context=mx.cpu()))
+    smod.add(Module(net2, context=mx.cpu()), take_labels=True,
+             auto_wiring=True)
+
+    X, y = _synthetic(n=100, classes=3)
+    data = mx.io.NDArrayIter(X, y, batch_size=20)
+    smod.fit(data, num_epoch=3, optimizer="sgd",
+             optimizer_params={"learning_rate": 0.3})
+    score_metric = mx.metric.create("acc")
+    res = smod.score(data, score_metric)
+    assert res[0][1] > 0.4

@@ -1,0 +1,98 @@
+"""Multi-device data parallelism tests on the 8-device CPU platform
+(reference tests/python/unittest/test_multi_device_exec.py +
+multi_lenet.py: multi-device training must match single-device)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.module import Module
+
+
+def _mlp_sym():
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=3, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _synthetic(n=400, dim=6, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, dim).astype(np.float32)
+    w = rng.randn(dim, classes)
+    y = X.dot(w).argmax(axis=1).astype(np.float32)
+    return X, y
+
+
+def test_multi_device_fit():
+    import jax
+
+    n_dev = min(4, len(jax.devices()))
+    if n_dev < 2:
+        pytest.skip("needs >=2 devices")
+    X, y = _synthetic()
+    data = mx.io.NDArrayIter(X, y, batch_size=40, shuffle=True)
+    ctxs = [mx.cpu(i) for i in range(n_dev)]
+    mod = Module(_mlp_sym(), context=ctxs)
+    mod.fit(data, num_epoch=8, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+    score = mod.score(data, "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_multi_vs_single_device_identical():
+    """Same seed, same data => multi-device run must match single device
+    closely (reference multi_lenet.py check)."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices")
+    X, y = _synthetic(n=160)
+
+    def run(ctxs, seed=7):
+        mx.random.seed(seed)
+        data = mx.io.NDArrayIter(X, y, batch_size=40, shuffle=False)
+        mod = Module(_mlp_sym(), context=ctxs)
+        mod.fit(data, num_epoch=3, optimizer="sgd",
+                initializer=mx.init.Xavier(),
+                optimizer_params={"learning_rate": 0.2})
+        arg, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in arg.items()}
+
+    single = run([mx.cpu(0)])
+    multi = run([mx.cpu(0), mx.cpu(1)])
+    for name in single:
+        np.testing.assert_allclose(single[name], multi[name], rtol=1e-3,
+                                   atol=1e-4)
+
+
+def test_batch_not_divisible_raises():
+    import jax
+
+    if len(jax.devices()) < 3:
+        pytest.skip("needs >=3 devices")
+    mod = Module(_mlp_sym(), context=[mx.cpu(i) for i in range(3)])
+    with pytest.raises(Exception):
+        mod.bind([("data", (10, 6))], [("softmax_label", (10,))])
+
+
+def test_sharded_batch_placement():
+    """The executor group shards the batch over the mesh dp axis."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices")
+    from mxnet_tpu.module.executor_group import DataParallelExecutorGroup
+    from mxnet_tpu.io import DataDesc
+
+    group = DataParallelExecutorGroup(
+        _mlp_sym(), [mx.cpu(0), mx.cpu(1)], None,
+        [DataDesc("data", (8, 6))], [DataDesc("softmax_label", (8,))],
+        ["fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"],
+        for_training=True, inputs_need_grad=False)
+    data_arr = group.executor.arg_dict["data"]
+    assert len(data_arr._data.sharding.device_set) == 2
+    # params replicated
+    w_arr = group.executor.arg_dict["fc1_weight"]
+    assert w_arr._data.sharding.is_fully_replicated

@@ -1,0 +1,153 @@
+"""NDArray tests (reference tests/python/unittest/test_ndarray.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+
+def test_ndarray_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    assert np.all(a.asnumpy() == 0)
+    b = nd.ones((2,), dtype=np.int32)
+    assert b.dtype == np.int32
+    c = nd.full((2, 2), 7.5)
+    assert np.all(c.asnumpy() == 7.5)
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.dtype == np.float32
+    assert d.asnumpy().tolist() == [[1, 2], [3, 4]]
+
+
+def test_ndarray_elementwise():
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        a_np = rng.randn(4, 5).astype(np.float32)
+        b_np = rng.rand(4, 5).astype(np.float32) + 0.5
+        a, b = nd.array(a_np), nd.array(b_np)
+        np.testing.assert_allclose((a + b).asnumpy(), a_np + b_np, rtol=1e-5)
+        np.testing.assert_allclose((a - b).asnumpy(), a_np - b_np, rtol=1e-5)
+        np.testing.assert_allclose((a * b).asnumpy(), a_np * b_np, rtol=1e-5)
+        np.testing.assert_allclose((a / b).asnumpy(), a_np / b_np, rtol=1e-5)
+        np.testing.assert_allclose((a + 3).asnumpy(), a_np + 3, rtol=1e-5)
+        np.testing.assert_allclose((2 - a).asnumpy(), 2 - a_np, rtol=1e-5)
+        np.testing.assert_allclose((-a).asnumpy(), -a_np, rtol=1e-5)
+
+
+def test_ndarray_inplace():
+    a = nd.ones((2, 3))
+    a += 2
+    np.testing.assert_allclose(a.asnumpy(), np.full((2, 3), 3.0))
+    a *= 2
+    np.testing.assert_allclose(a.asnumpy(), np.full((2, 3), 6.0))
+    b = nd.ones((2, 3))
+    a -= b
+    np.testing.assert_allclose(a.asnumpy(), np.full((2, 3), 5.0))
+
+
+def test_ndarray_setitem_getitem():
+    a = nd.zeros((4, 4))
+    a[:] = 5
+    assert np.all(a.asnumpy() == 5)
+    a[1:3] = 1
+    expected = np.full((4, 4), 5.0)
+    expected[1:3] = 1
+    np.testing.assert_allclose(a.asnumpy(), expected)
+    sl = a[1:3]
+    assert sl.shape == (2, 4)
+    assert np.all(sl.asnumpy() == 1)
+    np_b = np.arange(16).reshape(4, 4).astype(np.float32)
+    b = nd.array(np_b)
+    np.testing.assert_allclose(b[2].asnumpy(), np_b[2])
+
+
+def test_ndarray_reshape_transpose():
+    a_np = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+    a = nd.array(a_np)
+    np.testing.assert_allclose(a.reshape((6, 4)).asnumpy(),
+                               a_np.reshape(6, 4))
+    np.testing.assert_allclose(a.reshape((-1, 4)).asnumpy(),
+                               a_np.reshape(-1, 4))
+    np.testing.assert_allclose(nd.transpose(a).asnumpy(), a_np.T)
+    np.testing.assert_allclose(a.T.asnumpy(), a_np.T)
+
+
+def test_ndarray_functions():
+    a_np = np.random.rand(3, 4).astype(np.float32) + 0.1
+    a = nd.array(a_np)
+    np.testing.assert_allclose(nd.exp(a).asnumpy(), np.exp(a_np), rtol=1e-5)
+    np.testing.assert_allclose(nd.log(a).asnumpy(), np.log(a_np), rtol=1e-5)
+    np.testing.assert_allclose(nd.sqrt(a).asnumpy(), np.sqrt(a_np), rtol=1e-5)
+    np.testing.assert_allclose(nd.square(a).asnumpy(), a_np ** 2, rtol=1e-5)
+    np.testing.assert_allclose(nd.sum(a).asnumpy(), [a_np.sum()], rtol=1e-5)
+    np.testing.assert_allclose(nd.max(a).asnumpy(), [a_np.max()], rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.norm(a).asnumpy(), [np.sqrt((a_np ** 2).sum())], rtol=1e-5)
+    b_np = np.random.rand(4, 5).astype(np.float32)
+    b = nd.array(b_np)
+    np.testing.assert_allclose(nd.dot(a, b).asnumpy(), a_np.dot(b_np),
+                               rtol=1e-4)
+    np.testing.assert_allclose(nd.clip(a, 0.2, 0.8).asnumpy(),
+                               np.clip(a_np, 0.2, 0.8), rtol=1e-6)
+    np.testing.assert_allclose(nd.maximum(a, 0.5).asnumpy(),
+                               np.maximum(a_np, 0.5), rtol=1e-6)
+
+
+def test_ndarray_onehot():
+    idx = nd.array([0, 2, 1])
+    out = nd.zeros((3, 3))
+    nd.onehot_encode(idx, out)
+    np.testing.assert_allclose(out.asnumpy(), np.eye(3)[[0, 2, 1]])
+    picked = nd.choose_element_0index(out, idx)
+    np.testing.assert_allclose(picked.asnumpy(), [1, 1, 1])
+
+
+def test_ndarray_copy():
+    a = nd.array(np.random.rand(3, 3).astype(np.float32))
+    b = a.copy()
+    b += 1
+    assert not np.allclose(a.asnumpy(), b.asnumpy())
+    c = nd.zeros((3, 3))
+    a.copyto(c)
+    np.testing.assert_allclose(a.asnumpy(), c.asnumpy())
+    d = a.as_in_context(mx.cpu(1))
+    assert d.context == mx.cpu(1)
+    np.testing.assert_allclose(a.asnumpy(), d.asnumpy())
+
+
+def test_ndarray_saveload(tmp_path):
+    fname = str(tmp_path / "arrays.bin")
+    arrays = [nd.array(np.random.rand(3, 4).astype(np.float32)),
+              nd.array(np.arange(5).astype(np.int32))]
+    nd.save(fname, arrays)
+    loaded = nd.load(fname)
+    assert len(loaded) == 2
+    for orig, back in zip(arrays, loaded):
+        np.testing.assert_allclose(orig.asnumpy(), back.asnumpy())
+        assert orig.dtype == back.dtype
+    d = {"weight": arrays[0], "idx": arrays[1]}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded.keys()) == {"weight", "idx"}
+    np.testing.assert_allclose(loaded["weight"].asnumpy(),
+                               arrays[0].asnumpy())
+
+
+def test_ndarray_concatenate():
+    a = nd.array(np.ones((2, 3), dtype=np.float32))
+    b = nd.array(np.zeros((3, 3), dtype=np.float32))
+    c = nd.concatenate([a, b], axis=0)
+    assert c.shape == (5, 3)
+    np.testing.assert_allclose(c.asnumpy()[:2], 1)
+    np.testing.assert_allclose(c.asnumpy()[2:], 0)
+
+
+def test_ndarray_waitall():
+    a = nd.ones((100, 100))
+    for _ in range(10):
+        a = a * 1.0001
+    nd.waitall()
+    assert a.asnumpy().shape == (100, 100)

@@ -1,0 +1,322 @@
+"""Operator tests (reference tests/python/unittest/test_operator.py):
+forward values against numpy closed forms, gradients against finite
+differences via the test_utils harness."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  check_symbolic_forward)
+
+
+def _bind_forward(s, args_np, is_train=False, aux=None, grad_req="null"):
+    args = {k: mx.nd.array(v) for k, v in args_np.items()}
+    ex = s.bind(mx.cpu(), args, grad_req=grad_req)
+    if aux:
+        for k, v in aux.items():
+            ex.aux_dict[k][:] = v
+    return ex, ex.forward(is_train=is_train)
+
+
+def test_elementwise_sum():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = sym.Variable("c")
+    s = sym.ElementWiseSum(a, b, c, num_args=3, name="esum")
+    rng = np.random.RandomState(0)
+    arrs = {k: rng.randn(3, 4).astype(np.float32) for k in "abc"}
+    _, outs = _bind_forward(s, arrs)
+    np.testing.assert_allclose(outs[0].asnumpy(),
+                               arrs["a"] + arrs["b"] + arrs["c"], rtol=1e-5)
+
+
+def test_fullyconnected_grad():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    rng = np.random.RandomState(0)
+    check_numeric_gradient(fc, {
+        "data": rng.randn(3, 5).astype(np.float32),
+        "fc_weight": rng.randn(4, 5).astype(np.float32),
+        "fc_bias": rng.randn(4).astype(np.float32)})
+
+
+def test_activation():
+    x_np = np.array([[-1.0, 0.0, 2.0]], dtype=np.float32)
+    for act, fn in [("relu", lambda x: np.maximum(x, 0)),
+                    ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+                    ("tanh", np.tanh),
+                    ("softrelu", lambda x: np.log1p(np.exp(x)))]:
+        s = sym.Activation(data=sym.Variable("data"), act_type=act)
+        _, outs = _bind_forward(s, {"data": x_np})
+        np.testing.assert_allclose(outs[0].asnumpy(), fn(x_np), rtol=1e-5)
+
+
+def test_leaky_relu():
+    x_np = np.array([[-2.0, 3.0]], dtype=np.float32)
+    s = sym.LeakyReLU(data=sym.Variable("data"), act_type="leaky", slope=0.1)
+    _, outs = _bind_forward(s, {"data": x_np})
+    np.testing.assert_allclose(outs[0].asnumpy(), [[-0.2, 3.0]], rtol=1e-5)
+
+
+def test_softmax_output_semantics():
+    """Backward must be softmax - onehot regardless of head grads
+    (the reference's fused loss-layer contract)."""
+    data = sym.Variable("data")
+    s = sym.SoftmaxOutput(data=data, name="softmax")
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 3).astype(np.float32)
+    label = np.array([0, 1, 2, 1], dtype=np.float32)
+    args = {"data": mx.nd.array(x), "softmax_label": mx.nd.array(label)}
+    grads = {"data": mx.nd.zeros((4, 3)),
+             "softmax_label": mx.nd.zeros((4,))}
+    ex = s.bind(mx.cpu(), args, args_grad=grads,
+                grad_req={"data": "write", "softmax_label": "null"})
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    expected = np.exp(x) / np.exp(x).sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+    ex.backward()
+    onehot = np.eye(3)[label.astype(int)]
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               out - onehot, rtol=1e-4, atol=1e-6)
+
+
+def test_softmax_ignore_label():
+    data = sym.Variable("data")
+    s = sym.SoftmaxOutput(data=data, name="softmax", use_ignore=True,
+                          ignore_label=-1)
+    x = np.random.randn(3, 4).astype(np.float32)
+    label = np.array([1, -1, 2], dtype=np.float32)
+    args = {"data": mx.nd.array(x), "softmax_label": mx.nd.array(label)}
+    grads = {"data": mx.nd.zeros((3, 4))}
+    ex = s.bind(mx.cpu(), args, args_grad=grads,
+                grad_req={"data": "write", "softmax_label": "null"})
+    ex.forward(is_train=True)
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    np.testing.assert_allclose(g[1], np.zeros(4), atol=1e-7)
+    assert np.abs(g[0]).sum() > 0
+
+
+def test_convolution_forward():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data=data, kernel=(3, 3), num_filter=2, pad=(1, 1),
+                           name="conv")
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 1, 5, 5).astype(np.float32)
+    w = rng.randn(2, 1, 3, 3).astype(np.float32)
+    b = np.zeros(2, dtype=np.float32)
+    _, outs = _bind_forward(conv, {"data": x, "conv_weight": w, "conv_bias": b})
+    out = outs[0].asnumpy()
+    assert out.shape == (1, 2, 5, 5)
+    # center value check vs direct correlation
+    ref = sum(x[0, 0, 1 + di, 1 + dj] * w[0, 0, 1 + di, 1 + dj]
+              for di in (-1, 0, 1) for dj in (-1, 0, 1))
+    np.testing.assert_allclose(out[0, 0, 1, 1], ref, rtol=1e-4)
+
+
+def test_convolution_grad():
+    data = sym.Variable("data")
+    conv = sym.Convolution(data=data, kernel=(2, 2), num_filter=2,
+                           name="conv", no_bias=True)
+    rng = np.random.RandomState(0)
+    check_numeric_gradient(conv, {
+        "data": rng.randn(2, 2, 4, 4).astype(np.float32),
+        "conv_weight": rng.randn(2, 2, 2, 2).astype(np.float32)},
+        numeric_eps=1e-2, check_eps=0.05)
+
+
+def test_pooling():
+    data = sym.Variable("data")
+    x = np.arange(16).reshape(1, 1, 4, 4).astype(np.float32)
+    pmax = sym.Pooling(data=data, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    _, outs = _bind_forward(pmax, {"data": x})
+    np.testing.assert_allclose(outs[0].asnumpy()[0, 0],
+                               [[5, 7], [13, 15]])
+    pavg = sym.Pooling(data=data, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    _, outs = _bind_forward(pavg, {"data": x})
+    np.testing.assert_allclose(outs[0].asnumpy()[0, 0],
+                               [[2.5, 4.5], [10.5, 12.5]])
+    pglobal = sym.Pooling(data=data, kernel=(1, 1), global_pool=True,
+                          pool_type="max")
+    _, outs = _bind_forward(pglobal, {"data": x})
+    assert outs[0].shape == (1, 1, 1, 1)
+    assert outs[0].asnumpy().ravel()[0] == 15
+
+
+def test_batchnorm_train_and_aux():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data=data, name="bn", fix_gamma=False, momentum=0.9)
+    rng = np.random.RandomState(0)
+    x = (rng.randn(8, 3, 2, 2) * 2 + 1).astype(np.float32)
+    args = {"data": mx.nd.array(x), "bn_gamma": mx.nd.ones((3,)),
+            "bn_beta": mx.nd.zeros((3,))}
+    grads = {k: mx.nd.zeros(v.shape) for k, v in args.items()}
+    ex = bn.bind(mx.cpu(), args, args_grad=grads, grad_req="write",
+                 aux_states=[mx.nd.zeros((3,)), mx.nd.ones((3,))])
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    # normalized output: per-channel mean ~0 var ~1
+    np.testing.assert_allclose(out.mean(axis=(0, 2, 3)), np.zeros(3),
+                               atol=1e-5)
+    np.testing.assert_allclose(out.var(axis=(0, 2, 3)), np.ones(3), atol=1e-2)
+    ex.backward()
+    # moving stats committed on backward
+    mean = x.mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(),
+                               0.1 * mean, rtol=1e-4)
+    # inference path uses moving stats
+    ex.forward(is_train=False)
+    out_inf = ex.outputs[0].asnumpy()
+    assert not np.allclose(out, out_inf)
+
+
+def test_dropout():
+    data = sym.Variable("data")
+    do = sym.Dropout(data=data, p=0.5)
+    x = np.ones((100, 100), dtype=np.float32)
+    ex, outs = _bind_forward(do, {"data": x}, is_train=True)
+    out = ex.outputs[0].asnumpy()
+    frac_zero = (out == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+    kept = out[out != 0]
+    np.testing.assert_allclose(kept, 2.0, rtol=1e-5)
+    _, outs = _bind_forward(do, {"data": x}, is_train=False)
+    np.testing.assert_allclose(outs[0].asnumpy(), x)
+
+
+def test_concat_and_slice():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    cat = sym.Concat(a, b, num_args=2, dim=1)
+    an = np.ones((2, 2), dtype=np.float32)
+    bn = np.zeros((2, 3), dtype=np.float32)
+    _, outs = _bind_forward(cat, {"a": an, "b": bn})
+    assert outs[0].shape == (2, 5)
+    np.testing.assert_allclose(outs[0].asnumpy(),
+                               np.concatenate([an, bn], axis=1))
+
+
+def test_reshape_flatten_transpose():
+    data = sym.Variable("data")
+    x = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+    r = sym.Reshape(data=data, shape=(2, 12))
+    _, outs = _bind_forward(r, {"data": x})
+    assert outs[0].shape == (2, 12)
+    r2 = sym.Reshape(data=data, target_shape=(0, -1))
+    _, outs = _bind_forward(r2, {"data": x})
+    assert outs[0].shape == (2, 12)
+    f = sym.Flatten(data=data)
+    _, outs = _bind_forward(f, {"data": x})
+    assert outs[0].shape == (2, 12)
+    t = sym.transpose(data=data, axes=(1, 0, 2))
+    _, outs = _bind_forward(t, {"data": x})
+    np.testing.assert_allclose(outs[0].asnumpy(), x.transpose(1, 0, 2))
+    s = sym.SwapAxis(data=data, dim1=0, dim2=2)
+    _, outs = _bind_forward(s, {"data": x})
+    np.testing.assert_allclose(outs[0].asnumpy(), x.swapaxes(0, 2))
+
+
+def test_embedding():
+    data = sym.Variable("data")
+    emb = sym.Embedding(data=data, input_dim=5, output_dim=3, name="emb")
+    w = np.random.randn(5, 3).astype(np.float32)
+    idx = np.array([0, 4, 2], dtype=np.float32)
+    _, outs = _bind_forward(emb, {"data": idx, "emb_weight": w})
+    np.testing.assert_allclose(outs[0].asnumpy(), w[[0, 4, 2]])
+
+
+def test_block_grad():
+    data = sym.Variable("data")
+    blocked = sym.BlockGrad(data=data)
+    out = blocked * 2
+    x = np.ones((2, 2), dtype=np.float32)
+    args = {"data": mx.nd.array(x)}
+    grads = {"data": mx.nd.zeros((2, 2))}
+    ex = out.bind(mx.cpu(), args, args_grad=grads, grad_req="write")
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               np.zeros((2, 2)))
+
+
+def test_make_loss():
+    data = sym.Variable("data")
+    loss = sym.MakeLoss(data=data, grad_scale=0.5)
+    x = np.random.rand(3, 3).astype(np.float32)
+    args = {"data": mx.nd.array(x)}
+    grads = {"data": mx.nd.zeros((3, 3))}
+    ex = loss.bind(mx.cpu(), args, args_grad=grads, grad_req="write")
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               np.full((3, 3), 0.5))
+
+
+def test_regression_outputs():
+    data = sym.Variable("data")
+    lro = sym.LinearRegressionOutput(data=data, name="lro")
+    x = np.array([[1.0], [2.0]], dtype=np.float32)
+    label = np.array([[1.5], [1.0]], dtype=np.float32)
+    args = {"data": mx.nd.array(x), "lro_label": mx.nd.array(label)}
+    grads = {"data": mx.nd.zeros((2, 1))}
+    ex = lro.bind(mx.cpu(), args, args_grad=grads,
+                  grad_req={"data": "write", "lro_label": "null"})
+    ex.forward(is_train=True)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), x)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), x - label,
+                               rtol=1e-5)
+
+
+def test_reductions():
+    data = sym.Variable("data")
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    s = sym.sum(data=data, axis=(1,))
+    _, outs = _bind_forward(s, {"data": x})
+    np.testing.assert_allclose(outs[0].asnumpy(), x.sum(axis=1), rtol=1e-5)
+    m = sym.max(data=data)
+    _, outs = _bind_forward(m, {"data": x})
+    np.testing.assert_allclose(outs[0].asnumpy(), [x.max()], rtol=1e-6)
+
+
+def test_lrn():
+    data = sym.Variable("data")
+    lrn = sym.LRN(data=data, nsize=3, alpha=1e-4, beta=0.75, knorm=2.0)
+    x = np.random.rand(1, 5, 2, 2).astype(np.float32)
+    _, outs = _bind_forward(lrn, {"data": x})
+    # manual reference for channel 2
+    sq = x ** 2
+    ssum = sq[:, 1:4].sum(axis=1)
+    denom = (2.0 + (1e-4 / 3) * ssum) ** 0.75
+    np.testing.assert_allclose(outs[0].asnumpy()[0, 2], (x[0, 2] / denom[0]),
+                               rtol=1e-5)
+
+
+def test_upsampling():
+    data = sym.Variable("data")
+    up = sym.UpSampling(data, scale=2, sample_type="nearest", num_args=1)
+    x = np.arange(4).reshape(1, 1, 2, 2).astype(np.float32)
+    _, outs = _bind_forward(up, {"data": x})
+    expected = x.repeat(2, axis=2).repeat(2, axis=3)
+    np.testing.assert_allclose(outs[0].asnumpy(), expected)
+
+
+def test_numeric_gradient_various():
+    rng = np.random.RandomState(0)
+    data = sym.Variable("data")
+    for s in [sym.Activation(data=data, act_type="tanh"),
+              sym.L2Normalization(data=data),
+              sym.Flatten(data=data) * 2.0]:
+        check_numeric_gradient(s, {"data": rng.randn(3, 4).astype(np.float32)},
+                               check_eps=0.05)
+
+
+def test_smooth_l1():
+    data = sym.Variable("data")
+    s = sym.smooth_l1(data=data, scalar=1.0)
+    x = np.array([-2.0, -0.5, 0.5, 2.0], dtype=np.float32)
+    _, outs = _bind_forward(s, {"data": x})
+    expected = np.where(np.abs(x) < 1, 0.5 * x ** 2, np.abs(x) - 0.5)
+    np.testing.assert_allclose(outs[0].asnumpy(), expected, rtol=1e-5)

@@ -1,0 +1,113 @@
+"""Optimizer tests: update rules against numpy references
+(reference tests validated via Test optimizer + training convergence)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+
+
+def _run_updates(optimizer, w0, grads):
+    weight = mx.nd.array(w0.copy())
+    state = optimizer.create_state(0, weight)
+    for g in grads:
+        optimizer.update(0, weight, mx.nd.array(g), state)
+    return weight.asnumpy()
+
+
+def test_sgd_no_momentum():
+    w0 = np.ones(4, dtype=np.float32)
+    g = np.full(4, 0.5, dtype=np.float32)
+    sgd = opt.SGD(learning_rate=0.1, rescale_grad=1.0, wd=0.0)
+    w = _run_updates(sgd, w0, [g, g])
+    np.testing.assert_allclose(w, w0 - 0.1 * g * 2, rtol=1e-6)
+
+
+def test_sgd_momentum_matches_numpy():
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(5).astype(np.float32)
+    grads = [rng.randn(5).astype(np.float32) for _ in range(4)]
+    lr, mom, wd = 0.05, 0.9, 0.01
+    sgd = opt.SGD(learning_rate=lr, momentum=mom, wd=wd, rescale_grad=1.0)
+    w = _run_updates(sgd, w0, grads)
+    # numpy reference
+    wn = w0.copy().astype(np.float64)
+    m = np.zeros(5)
+    for g in grads:
+        gg = g + wd * wn
+        m = mom * m - lr * gg
+        wn = wn + m
+    np.testing.assert_allclose(w, wn, rtol=1e-5)
+
+
+def test_adam_matches_numpy():
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(6).astype(np.float32)
+    grads = [rng.randn(6).astype(np.float32) for _ in range(5)]
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    adam = opt.Adam(learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps,
+                    rescale_grad=1.0)
+    w = _run_updates(adam, w0, grads)
+    wn = w0.astype(np.float64).copy()
+    m = np.zeros(6)
+    v = np.zeros(6)
+    for t, g in enumerate(grads, 1):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        step = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        wn -= step * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(w, wn, rtol=1e-4)
+
+
+def test_adagrad():
+    w0 = np.ones(3, dtype=np.float32)
+    g = np.full(3, 2.0, dtype=np.float32)
+    ada = opt.AdaGrad(learning_rate=0.1, rescale_grad=1.0, eps=1e-7)
+    w = _run_updates(ada, w0, [g])
+    np.testing.assert_allclose(w, w0 - 0.1 * g / np.sqrt(g * g + 1e-7),
+                               rtol=1e-5)
+
+
+def test_rescale_and_clip():
+    w0 = np.zeros(3, dtype=np.float32)
+    g = np.array([10.0, -10.0, 1.0], dtype=np.float32)
+    sgd = opt.SGD(learning_rate=1.0, rescale_grad=0.1, clip_gradient=0.5)
+    w = _run_updates(sgd, w0, [g])
+    np.testing.assert_allclose(w, [-0.5, 0.5, -0.1], rtol=1e-6)
+
+
+def test_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import FactorScheduler, MultiFactorScheduler
+
+    sched = FactorScheduler(step=10, factor=0.5)
+    sched.base_lr = 1.0
+    assert sched(5) == 1.0
+    assert sched(11) == 0.5
+    assert sched(21) == 0.25
+    msched = MultiFactorScheduler(step=[5, 15], factor=0.1)
+    msched.base_lr = 1.0
+    assert msched(3) == 1.0
+    assert abs(msched(7) - 0.1) < 1e-9
+    assert abs(msched(20) - 0.01) < 1e-9
+
+
+def test_lr_wd_mult_from_symbol():
+    from mxnet_tpu import symbol as sym
+
+    data = sym.Variable("data")
+    w = sym.Variable("fc_weight", lr_mult=2.0)
+    fc = sym.FullyConnected(data=data, weight=w, num_hidden=2, name="fc")
+    sgd = opt.SGD(learning_rate=0.1, sym=fc,
+                  param_idx2name={0: "fc_weight", 1: "fc_bias"})
+    assert sgd.lr_mult.get("fc_weight") == 2.0
+    assert sgd._get_lr(0) == pytest.approx(0.2)
+    assert sgd._get_lr(1) == pytest.approx(0.1)
+
+
+def test_updater_state():
+    sgd = opt.SGD(learning_rate=0.1, momentum=0.9)
+    updater = opt.get_updater(sgd)
+    w = mx.nd.ones((3,))
+    updater(0, mx.nd.ones((3,)), w)
+    updater(0, mx.nd.ones((3,)), w)
+    assert 0 in updater.states

@@ -1,0 +1,102 @@
+"""Random seeding + metric tests (reference test_random.py, metric usage)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_random_seed_reproducible():
+    mx.random.seed(42)
+    a = mx.random.uniform(0, 1, shape=(10,)).asnumpy()
+    b = mx.random.uniform(0, 1, shape=(10,)).asnumpy()
+    assert not np.allclose(a, b)
+    mx.random.seed(42)
+    a2 = mx.random.uniform(0, 1, shape=(10,)).asnumpy()
+    b2 = mx.random.uniform(0, 1, shape=(10,)).asnumpy()
+    np.testing.assert_allclose(a, a2)
+    np.testing.assert_allclose(b, b2)
+
+
+def test_random_distributions():
+    mx.random.seed(0)
+    u = mx.random.uniform(-2, 3, shape=(10000,)).asnumpy()
+    assert u.min() >= -2 and u.max() <= 3
+    assert abs(u.mean() - 0.5) < 0.1
+    n = mx.random.normal(1.0, 2.0, shape=(10000,)).asnumpy()
+    assert abs(n.mean() - 1.0) < 0.1
+    assert abs(n.std() - 2.0) < 0.1
+
+
+def test_metric_accuracy():
+    metric = mx.metric.create("acc")
+    preds = [mx.nd.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]])]
+    labels = [mx.nd.array([0, 1, 1])]
+    metric.update(labels, preds)
+    name, value = metric.get()
+    assert name == "accuracy"
+    assert value == pytest.approx(2.0 / 3.0)
+
+
+def test_metric_topk():
+    metric = mx.metric.create("top_k_accuracy", top_k=2)
+    preds = [mx.nd.array([[0.1, 0.2, 0.7], [0.5, 0.4, 0.1]])]
+    labels = [mx.nd.array([1, 2])]
+    metric.update(labels, preds)
+    _, value = metric.get()
+    assert value == pytest.approx(0.5)
+
+
+def test_metric_regression():
+    mse = mx.metric.create("mse")
+    mse.update([mx.nd.array([1.0, 2.0])], [mx.nd.array([1.5, 2.5])])
+    assert mse.get()[1] == pytest.approx(0.25)
+    mae = mx.metric.create("mae")
+    mae.update([mx.nd.array([1.0, 2.0])], [mx.nd.array([1.5, 2.5])])
+    assert mae.get()[1] == pytest.approx(0.5)
+
+
+def test_composite_metric():
+    comp = mx.metric.create(["acc", "ce"])
+    preds = [mx.nd.array([[0.9, 0.1], [0.2, 0.8]])]
+    labels = [mx.nd.array([0, 1])]
+    comp.update(labels, preds)
+    names, values = comp.get()
+    assert "accuracy" in names
+    assert "cross-entropy" in names
+
+
+def test_custom_metric():
+    def my_metric(label, pred):
+        return float(np.abs(label - pred.argmax(axis=1)).sum())
+    metric = mx.metric.CustomMetric(my_metric)
+    metric.update([mx.nd.array([0, 1])],
+                  [mx.nd.array([[0.9, 0.1], [0.9, 0.1]])])
+    assert metric.get()[1] == 1.0
+
+
+def test_initializers():
+    for init, check in [
+            (mx.init.Uniform(0.1), lambda w: np.abs(w).max() <= 0.1),
+            (mx.init.Normal(0.01), lambda w: np.abs(w).mean() < 0.05),
+            (mx.init.Xavier(), lambda w: np.abs(w).max() > 0),
+            (mx.init.One(), lambda w: np.all(w == 1)),
+            (mx.init.Zero(), lambda w: np.all(w == 0))]:
+        arr = mx.nd.zeros((8, 8)) if not isinstance(init, mx.init.Zero) \
+            else mx.nd.ones((8, 8))
+        init("fc_weight", arr)
+        assert check(arr.asnumpy()), type(init).__name__
+    # name-based dispatch
+    arr = mx.nd.ones((4,))
+    mx.init.Uniform()("bn_beta", arr)
+    np.testing.assert_allclose(arr.asnumpy(), np.zeros(4))
+    arr = mx.nd.zeros((4,))
+    mx.init.Uniform()("bn_gamma", arr)
+    np.testing.assert_allclose(arr.asnumpy(), np.ones(4))
+
+
+def test_mixed_initializer():
+    init = mx.init.Mixed([".*bias", ".*"],
+                         [mx.init.Zero(), mx.init.Uniform(0.1)])
+    bias = mx.nd.ones((3,))
+    init("fc_bias", bias)
+    np.testing.assert_allclose(bias.asnumpy(), np.zeros(3))

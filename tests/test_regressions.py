@@ -1,0 +1,137 @@
+"""Regression tests for review findings."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def test_deconvolution_forward_shape_and_value():
+    data = sym.Variable("data")
+    deconv = sym.Deconvolution(data=data, kernel=(3, 3), stride=(2, 2),
+                               num_filter=1, name="dc", no_bias=True)
+    _, out_shapes, _ = deconv.infer_shape(data=(1, 1, 4, 4))
+    assert out_shapes == [(1, 1, 9, 9)]
+    x = np.zeros((1, 1, 4, 4), dtype=np.float32)
+    x[0, 0, 0, 0] = 1.0
+    w = np.arange(9).reshape(1, 1, 3, 3).astype(np.float32)
+    ex = deconv.bind(mx.cpu(), {"data": mx.nd.array(x),
+                                "dc_weight": mx.nd.array(w)}, grad_req="null")
+    out = ex.forward()[0].asnumpy()
+    assert out.shape == (1, 1, 9, 9)
+    # single impulse at (0,0): output top-left 3x3 == kernel
+    np.testing.assert_allclose(out[0, 0, :3, :3], w[0, 0])
+
+
+def test_deconvolution_is_conv_transpose():
+    """Deconv must be the transpose of conv: forward deconv == grad of conv
+    wrt its input (the defining property)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 5, 5).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)  # deconv layout (Cin,Cout,k,k)
+
+    data = sym.Variable("data")
+    deconv = sym.Deconvolution(data=data, kernel=(3, 3), stride=(1, 1),
+                               pad=(1, 1), num_filter=2, name="dc",
+                               no_bias=True)
+    ex = deconv.bind(mx.cpu(), {"data": mx.nd.array(x),
+                                "dc_weight": mx.nd.array(w)}, grad_req="null")
+    out = ex.forward()[0].asnumpy()
+
+    # conv with weight (Cin=2 out-chan view) computing grad wrt input:
+    import jax
+    import jax.numpy as jnp
+
+    def conv(inp):
+        return jax.lax.conv_general_dilated(
+            inp, jnp.asarray(w).transpose(0, 1, 2, 3),
+            window_strides=(1, 1), padding=[(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    # conv maps (N,2,5,5)->(N,3,5,5) with weight (3,2,3,3) OIHW;
+    # its vjp applied to x gives deconv of x
+    primal = jnp.zeros((2, 2, 5, 5), dtype=jnp.float32)
+    _, vjp = jax.vjp(conv, primal)
+    expected = np.asarray(vjp(jnp.asarray(x))[0])
+    np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_deconvolution_grad():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    rng = np.random.RandomState(0)
+    data = sym.Variable("data")
+    deconv = sym.Deconvolution(data=data, kernel=(2, 2), stride=(2, 2),
+                               num_filter=2, name="dc", no_bias=True)
+    check_numeric_gradient(deconv, {
+        "data": rng.randn(1, 2, 3, 3).astype(np.float32),
+        "dc_weight": rng.randn(2, 2, 2, 2).astype(np.float32)},
+        numeric_eps=1e-2, check_eps=0.06)
+
+
+def test_expand_dims_negative_axis():
+    data = sym.Variable("data")
+    s = sym.expand_dims(data=data, axis=-1)
+    arg_shapes, out_shapes, _ = s.infer_shape(data=(2, 3))
+    assert out_shapes == [(2, 3, 1)]
+    ex = s.bind(mx.cpu(), {"data": mx.nd.ones((2, 3))}, grad_req="null")
+    assert ex.forward()[0].shape == (2, 3, 1)
+
+
+def test_optimizer_states_pickle_roundtrip(tmp_path):
+    from mxnet_tpu import optimizer as opt
+
+    sgd = opt.SGD(learning_rate=0.1, momentum=0.9)
+    updater = opt.get_updater(sgd)
+    w = mx.nd.ones((3, 3))
+    updater(0, mx.nd.ones((3, 3)), w)
+    blob = updater.get_states()
+    updater2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
+    updater2.set_states(blob)
+    np.testing.assert_allclose(updater2.states[0].asnumpy(),
+                               updater.states[0].asnumpy())
+
+
+def test_module_checkpoint_with_optimizer_states(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.randn(40, 5).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    data = mx.io.NDArrayIter(X, y, batch_size=10)
+    net = sym.SoftmaxOutput(sym.FullyConnected(
+        sym.Variable("data"), num_hidden=2, name="fc"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(data, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+    import os
+
+    assert os.path.exists(prefix + "-0001.states")
+    mod.load_optimizer_states(prefix + "-0001.states")
+
+
+def test_init_params_allow_missing_enforced():
+    net = sym.SoftmaxOutput(sym.FullyConnected(
+        sym.Variable("data"), num_hidden=2, name="fc"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind([("data", (4, 5))], [("softmax_label", (4,))])
+    with pytest.raises(Exception, match="missing arg_param"):
+        mod.init_params(arg_params={"fc_weight": mx.nd.ones((2, 5))},
+                        allow_missing=False)
+    mod.init_params(arg_params={"fc_weight": mx.nd.ones((2, 5))},
+                    allow_missing=True)
+    arg, _ = mod.get_params()
+    np.testing.assert_allclose(arg["fc_weight"].asnumpy(), np.ones((2, 5)))
+
+
+def test_train_forward_is_lazy():
+    """forward(is_train=True) must not dispatch the forward computation —
+    the fused fwd+bwd in backward() does it once."""
+    net = sym.SoftmaxOutput(sym.FullyConnected(
+        sym.Variable("data"), num_hidden=2, name="fc"), name="softmax")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 5))
+    ret = ex.forward(is_train=True)
+    assert ret is None
+    assert ex._outputs is None
+    ex.backward()
+    assert ex._outputs is not None

@@ -1,0 +1,152 @@
+"""Sequence op + fused RNN tests (reference test_operator.py sequence
+tests; RNN validated against a manual numpy recurrence the way the
+reference validated cuDNN RNN against CPU)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.ops.seq import rnn_param_size
+
+
+def _bind_forward(s, args_np, is_train=False):
+    args = {k: mx.nd.array(v) for k, v in args_np.items()}
+    ex = s.bind(mx.cpu(), args, grad_req="null")
+    return ex, ex.forward(is_train=is_train)
+
+
+def test_sequence_last():
+    data = sym.Variable("data")
+    s = sym.SequenceLast(data=data, use_sequence_length=True,
+                         name="seqlast")
+    x = np.arange(24).reshape(4, 3, 2).astype(np.float32)
+    lengths = np.array([2, 4, 1], dtype=np.float32)
+    _, outs = _bind_forward(s, {"data": x, "seqlast_sequence_length": lengths})
+    expected = np.stack([x[1, 0], x[3, 1], x[0, 2]])
+    np.testing.assert_allclose(outs[0].asnumpy(), expected)
+
+
+def test_sequence_mask():
+    data = sym.Variable("data")
+    s = sym.SequenceMask(data=data, use_sequence_length=True, value=-1.0,
+                         name="seqmask")
+    x = np.ones((3, 2, 2), dtype=np.float32)
+    lengths = np.array([1, 3], dtype=np.float32)
+    _, outs = _bind_forward(s, {"data": x, "seqmask_sequence_length": lengths})
+    out = outs[0].asnumpy()
+    np.testing.assert_allclose(out[0, 0], 1)
+    np.testing.assert_allclose(out[1, 0], -1)
+    np.testing.assert_allclose(out[2, 1], 1)
+
+
+def test_sequence_reverse():
+    data = sym.Variable("data")
+    s = sym.SequenceReverse(data=data, use_sequence_length=True,
+                            name="seqrev")
+    x = np.arange(12).reshape(3, 2, 2).astype(np.float32)
+    lengths = np.array([2, 3], dtype=np.float32)
+    _, outs = _bind_forward(s, {"data": x, "seqrev_sequence_length": lengths})
+    out = outs[0].asnumpy()
+    np.testing.assert_allclose(out[0, 0], x[1, 0])
+    np.testing.assert_allclose(out[1, 0], x[0, 0])
+    np.testing.assert_allclose(out[2, 0], x[2, 0])
+    np.testing.assert_allclose(out[0, 1], x[2, 1])
+
+
+def _np_lstm(x, params, h0, c0, hidden):
+    """Manual LSTM recurrence matching the documented flat layout."""
+    t_len, n, input_size = x.shape
+    off = 0
+
+    def take(shape):
+        nonlocal off
+        size = int(np.prod(shape))
+        out = params[off:off + size].reshape(shape)
+        off += size
+        return out
+
+    wx = take((4 * hidden, input_size))
+    wh = take((4 * hidden, hidden))
+    bx = take((4 * hidden,))
+    bh = take((4 * hidden,))
+    h, c = h0.copy(), c0.copy()
+    outs = []
+
+    def sigmoid(v):
+        return 1 / (1 + np.exp(-v))
+
+    for t in range(t_len):
+        gates = x[t].dot(wx.T) + bx + h.dot(wh.T) + bh
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        i, f, o = sigmoid(i), sigmoid(f), sigmoid(o)
+        g = np.tanh(g)
+        c = f * c + i * g
+        h = o * np.tanh(c)
+        outs.append(h)
+    return np.stack(outs), h, c
+
+
+def test_rnn_lstm_matches_manual():
+    t_len, n, input_size, hidden = 5, 2, 3, 4
+    psize = rnn_param_size(1, input_size, hidden, False, "lstm")
+    rng = np.random.RandomState(0)
+    x = rng.randn(t_len, n, input_size).astype(np.float32)
+    params = (rng.randn(psize) * 0.1).astype(np.float32)
+    h0 = np.zeros((1, n, hidden), dtype=np.float32)
+    c0 = np.zeros((1, n, hidden), dtype=np.float32)
+
+    data = sym.Variable("data")
+    rnn = sym.RNN(data=data, state_size=hidden, num_layers=1, mode="lstm",
+                  state_outputs=True, name="rnn")
+    _, outs = _bind_forward(rnn, {
+        "data": x, "rnn_parameters": params, "rnn_state": h0,
+        "rnn_state_cell": c0})
+    expected_out, expected_h, expected_c = _np_lstm(x, params, h0[0], c0[0],
+                                                    hidden)
+    np.testing.assert_allclose(outs[0].asnumpy(), expected_out, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(outs[1].asnumpy()[0], expected_h, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(outs[2].asnumpy()[0], expected_c, rtol=1e-4,
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["rnn_relu", "rnn_tanh", "gru", "lstm"])
+@pytest.mark.parametrize("bidirectional", [False, True])
+def test_rnn_modes_shapes(mode, bidirectional):
+    t_len, n, input_size, hidden, layers = 4, 3, 5, 6, 2
+    dirs = 2 if bidirectional else 1
+    psize = rnn_param_size(layers, input_size, hidden, bidirectional, mode)
+    rng = np.random.RandomState(1)
+    args = {
+        "data": rng.randn(t_len, n, input_size).astype(np.float32),
+        "r_parameters": (rng.randn(psize) * 0.1).astype(np.float32),
+        "r_state": np.zeros((layers * dirs, n, hidden), dtype=np.float32),
+    }
+    if mode == "lstm":
+        args["r_state_cell"] = np.zeros((layers * dirs, n, hidden),
+                                        dtype=np.float32)
+    data = sym.Variable("data")
+    rnn = sym.RNN(data=data, state_size=hidden, num_layers=layers, mode=mode,
+                  bidirectional=bidirectional, name="r")
+    s_args, s_outs, _ = rnn.infer_shape(data=(t_len, n, input_size))
+    assert s_outs[0] == (t_len, n, hidden * dirs)
+    _, outs = _bind_forward(rnn, args)
+    assert outs[0].shape == (t_len, n, hidden * dirs)
+
+
+def test_rnn_gradient():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    t_len, n, input_size, hidden = 3, 2, 2, 3
+    psize = rnn_param_size(1, input_size, hidden, False, "lstm")
+    rng = np.random.RandomState(0)
+    data = sym.Variable("data")
+    rnn = sym.RNN(data=data, state_size=hidden, num_layers=1, mode="lstm",
+                  name="r")
+    check_numeric_gradient(rnn, {
+        "data": rng.randn(t_len, n, input_size).astype(np.float32),
+        "r_parameters": (rng.randn(psize) * 0.2).astype(np.float32),
+        "r_state": np.zeros((1, n, hidden), dtype=np.float32),
+        "r_state_cell": np.zeros((1, n, hidden), dtype=np.float32)},
+        check_eps=0.08, numeric_eps=1e-2)

@@ -1,0 +1,103 @@
+"""Symbol tests (reference tests/python/unittest/test_symbol.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc1", num_hidden=10)
+    net = sym.Activation(data=net, act_type="relu", name="relu1")
+    net = sym.FullyConnected(data=net, name="fc2", num_hidden=4)
+    net = sym.SoftmaxOutput(data=net, name="softmax")
+    return net
+
+
+def test_symbol_compose():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_symbol_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    outs = internals.list_outputs()
+    assert "fc1_output" in outs
+    assert "relu1_output" in outs
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_symbol_group():
+    data = sym.Variable("data")
+    a = sym.FullyConnected(data=data, name="fc1", num_hidden=3)
+    b = sym.FullyConnected(data=data, name="fc2", num_hidden=5)
+    g = sym.Group([a, b])
+    assert g.list_outputs() == ["fc1_output", "fc2_output"]
+    assert g[0].name == "fc1"
+    assert g[1].name == "fc2"
+
+
+def test_symbol_operator_overload():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b * 2 - 1
+    args = c.list_arguments()
+    assert set(args) == {"a", "b"}
+    ex = c.bind(mx.cpu(), {"a": mx.nd.array([1.0, 2.0]),
+                           "b": mx.nd.array([3.0, 4.0])}, grad_req="null")
+    out = ex.forward()
+    np.testing.assert_allclose(out[0].asnumpy(), [6.0, 9.0])
+
+
+def test_symbol_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    assert net2.tojson() == js
+
+
+def test_symbol_attr():
+    data = sym.Variable("data", attr={"ctx_group": "dev1"})
+    assert data.attr("ctx_group") == "dev1"
+    with mx.AttrScope(ctx_group="dev2"):
+        fc = sym.FullyConnected(data=data, num_hidden=3, name="fc")
+    assert fc.attr("ctx_group") == "dev2"
+    lrd = sym.Variable("w", lr_mult=2.0, wd_mult=0.5)
+    assert lrd.attr("__lr_mult__") == "2.0"
+    assert lrd.attr("__wd_mult__") == "0.5"
+
+
+def test_symbol_auto_naming():
+    with mx.NameManager():
+        data = sym.Variable("data")
+        fc_a = sym.FullyConnected(data=data, num_hidden=3)
+        fc_b = sym.FullyConnected(data=data, num_hidden=3)
+    assert fc_a.name != fc_b.name
+    assert fc_a.name.startswith("fullyconnected")
+
+
+def test_symbol_save_load(tmp_path):
+    net = _mlp()
+    fname = str(tmp_path / "sym.json")
+    net.save(fname)
+    net2 = sym.load(fname)
+    assert net2.list_arguments() == net.list_arguments()
+
+
+def test_slice_channel_multi_output():
+    data = sym.Variable("data")
+    s = sym.SliceChannel(data=data, num_outputs=3, name="slice")
+    assert len(s.list_outputs()) == 3
+    ex = s.bind(mx.cpu(), {"data": mx.nd.array(
+        np.arange(12).reshape(2, 6).astype(np.float32))}, grad_req="null")
+    outs = ex.forward()
+    assert len(outs) == 3
+    np.testing.assert_allclose(outs[0].asnumpy(), [[0, 1], [6, 7]])
