@@ -1,0 +1,92 @@
+"""Benchmark: ResNet-50 training throughput (images/sec) on one chip.
+
+Prints ONE JSON line:
+  {"metric": "resnet50_train_imgs_per_sec", "value": N, "unit": "img/s",
+   "vs_baseline": N}
+
+Baseline: the reference publishes no in-tree ResNet-50 number
+(BASELINE.md); the closest per-GPU proxy is ImageNet Inception-BN on
+Titan X, batch 128: 1,281,167 img / 10,666 s ~= 120 img/s/GPU
+(example/image-classification/README.md:245-253). vs_baseline =
+ours / 120.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMGS_PER_SEC = 120.0  # reference TitanX per-GPU Inception-BN proxy
+
+
+def main():
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import build_sgd_train_step
+
+    devices = jax.devices()
+    on_accel = devices[0].platform != "cpu"
+    batch = 128 if on_accel else 8
+    image = 224 if on_accel else 32
+    num_classes = 1000 if on_accel else 16
+    steps = 10 if on_accel else 2
+
+    net = models.get_resnet50(num_classes=num_classes,
+                              small_input=not on_accel)
+    shapes = {"data": (batch, 3, image, image)}
+    arg_shapes, _, aux_shapes = net.infer_shape(**shapes)
+    arg_names = net.list_arguments()
+    rng = np.random.RandomState(0)
+
+    params = {}
+    data = {}
+    for name, shape in zip(arg_names, arg_shapes):
+        if name == "data":
+            data[name] = jax.device_put(
+                rng.rand(*shape).astype(np.float32), devices[0])
+        elif name == "softmax_label":
+            data[name] = jax.device_put(
+                rng.randint(0, num_classes, shape).astype(np.float32),
+                devices[0])
+        elif name.endswith("gamma"):
+            params[name] = jax.device_put(np.ones(shape, dtype=np.float32),
+                                          devices[0])
+        else:
+            params[name] = jax.device_put(
+                (rng.randn(*shape) * 0.05).astype(np.float32), devices[0])
+    aux = [jax.device_put(np.ones(s, dtype=np.float32) if "var" in n
+                          else np.zeros(s, dtype=np.float32), devices[0])
+           for n, s in zip(net.list_auxiliary_states(), aux_shapes)]
+
+    step, _ = build_sgd_train_step(net, ["data"], ["softmax_label"], lr=0.01)
+    # donate params/aux so XLA reuses their HBM buffers across steps
+    jit_step = jax.jit(step, donate_argnums=(0, 2))
+    key = jax.random.PRNGKey(0)
+
+    # warmup / compile
+    outputs, params, aux = jit_step(params, data, aux, key)
+    jax.block_until_ready(params)
+
+    tic = time.time()
+    for i in range(steps):
+        outputs, params, aux = jit_step(params, data, aux,
+                                        jax.random.fold_in(key, i))
+    jax.block_until_ready(params)
+    elapsed = time.time() - tic
+
+    imgs_per_sec = batch * steps / elapsed
+    result = {
+        "metric": "resnet50_train_imgs_per_sec",
+        "value": round(imgs_per_sec, 2),
+        "unit": "img/s",
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,8 @@ __version__ = "0.1.0"
 
 # Submodules below are imported lazily-but-eagerly in dependency order; each
 # maps to a reference frontend module (python/mxnet/*.py).
+from . import operator        # noqa: E402  (registers the Custom op before
+#                                            symbol generates creators)
 from . import symbol          # noqa: E402
 from . import symbol as sym   # noqa: E402
 from .symbol import Symbol    # noqa: E402

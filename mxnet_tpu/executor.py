@@ -36,7 +36,64 @@ from .ndarray import NDArray
 from .ops.registry import OpContext
 from . import random as _random
 
-__all__ = ["Executor"]
+__all__ = ["Executor", "make_graph_eval"]
+
+
+def make_graph_eval(symbol):
+    """Build the pure graph-eval function for a symbol.
+
+    Returns ``(eval_graph, n_aux)`` where
+    ``eval_graph(arg_list, aux_list, key, is_train, want_internals=False)``
+    evaluates the whole DAG over jnp arrays. Shared by :class:`Executor`
+    and the sharded training-step builders in :mod:`mxnet_tpu.parallel`.
+    """
+    import jax
+
+    nodes = symbol._topo()
+    arg_index = {}
+    i = 0
+    for n in nodes:
+        if n.is_variable:
+            arg_index[n.uid] = i
+            i += 1
+    aux_slots = {}
+    slot = 0
+    for n in nodes:
+        if not n.is_variable:
+            k = len(n.op.list_auxiliary_states())
+            if k:
+                aux_slots[n.uid] = list(range(slot, slot + k))
+                slot += k
+    n_aux = slot
+    out_index = [(n.uid, i) for n, i in symbol._outputs]
+
+    def eval_graph(arg_list, aux_list, key, is_train, want_internals=False):
+        env = {}
+        aux_out = list(aux_list)
+        internals = {}
+        for n in nodes:
+            if n.is_variable:
+                env[n.uid] = [arg_list[arg_index[n.uid]]]
+            else:
+                ins = [env[src.uid][i] for src, i in n.inputs]
+                slots = aux_slots.get(n.uid, [])
+                aux_in = [aux_out[s] for s in slots]
+                rng = jax.random.fold_in(key, n.uid) if key is not None else None
+                octx = OpContext(is_train, rng)
+                outs, new_aux = n.op.apply(octx, ins, aux_in)
+                for s, a in zip(slots, new_aux):
+                    aux_out[s] = a
+                env[n.uid] = list(outs)
+                if want_internals:
+                    for oi, o in enumerate(outs):
+                        oname = "%s_%s" % (n.name, n.op.list_outputs()[oi])
+                        internals[oname] = o
+        outputs = [env[uid][i] for uid, i in out_index]
+        if want_internals:
+            return outputs, aux_out, internals
+        return outputs, aux_out
+
+    return eval_graph, n_aux
 
 
 class Executor:
@@ -104,52 +161,7 @@ class Executor:
     def _build(self):
         import jax
 
-        symbol = self._symbol
-        nodes = symbol._topo()
-        arg_index = {}
-        i = 0
-        for n in nodes:
-            if n.is_variable:
-                arg_index[n.uid] = i
-                i += 1
-        # aux slot assignment per node
-        aux_slots = {}
-        slot = 0
-        for n in nodes:
-            if not n.is_variable:
-                k = len(n.op.list_auxiliary_states())
-                if k:
-                    aux_slots[n.uid] = list(range(slot, slot + k))
-                    slot += k
-        self._n_aux = slot
-        out_index = [(n.uid, i) for n, i in symbol._outputs]
-
-        def eval_graph(arg_list, aux_list, key, is_train, want_internals=False):
-            env = {}
-            aux_out = list(aux_list)
-            internals = {}
-            for n in nodes:
-                if n.is_variable:
-                    env[n.uid] = [arg_list[arg_index[n.uid]]]
-                else:
-                    ins = [env[src.uid][i] for src, i in n.inputs]
-                    slots = aux_slots.get(n.uid, [])
-                    aux_in = [aux_out[s] for s in slots]
-                    rng = jax.random.fold_in(key, n.uid) if key is not None else None
-                    octx = OpContext(is_train, rng)
-                    outs, new_aux = n.op.apply(octx, ins, aux_in)
-                    for s, a in zip(slots, new_aux):
-                        aux_out[s] = a
-                    env[n.uid] = list(outs)
-                    if want_internals:
-                        for oi, o in enumerate(outs):
-                            oname = "%s_%s" % (n.name, n.op.list_outputs()[oi])
-                            internals[oname] = o
-            outputs = [env[uid][i] for uid, i in out_index]
-            if want_internals:
-                return outputs, aux_out, internals
-            return outputs, aux_out
-
+        eval_graph, self._n_aux = make_graph_eval(self._symbol)
         self._eval_graph = eval_graph
 
         grad_idx = [i for i, n in enumerate(self.arg_names)

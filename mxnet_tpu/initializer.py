@@ -30,7 +30,14 @@ class Initializer:
             self._init_beta(name, arr)
         elif name.endswith("weight"):
             self._init_weight(name, arr)
+        elif name.endswith("parameters"):
+            # fused-RNN flat parameter blob (cuDNN-style)
+            self._init_weight(name, arr)
         elif name.endswith("moving_mean") or name.endswith("moving_avg"):
+            self._init_zero(name, arr)
+        elif name.endswith("state") or name.endswith("state_cell") \
+                or name.endswith("init_h") or name.endswith("init_c"):
+            # RNN initial states default to zero
             self._init_zero(name, arr)
         elif name.endswith("moving_var"):
             self._init_one(name, arr)

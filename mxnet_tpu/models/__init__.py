@@ -1,0 +1,11 @@
+"""Model zoo: symbol builders for the reference's example networks
+(reference ``example/image-classification/symbol_*.py``, ``example/rnn``)."""
+from .mlp import get_mlp
+from .lenet import get_lenet
+from .resnet import get_resnet, get_resnet50
+from .inception_bn import get_inception_bn, get_inception_bn_28_small
+from .lstm import lstm_unroll, lstm_fused
+
+__all__ = ["get_mlp", "get_lenet", "get_resnet", "get_resnet50",
+           "get_inception_bn", "get_inception_bn_28_small",
+           "lstm_unroll", "lstm_fused"]

@@ -1,0 +1,300 @@
+"""Frontend-defined operators.
+
+TPU-native re-design of the reference's custom-op frontends
+(``python/mxnet/operator.py``: PythonOp/NumpyOp :17-223, CustomOp/
+CustomOpProp + register :394-604, backed by ``src/operator/custom-inl.h``
+ctypes callbacks): here the host-side Python code runs inside the jitted
+XLA computation via ``jax.pure_callback`` — forward and backward each
+become a host callback with declared result shapes, wired into autodiff
+with ``jax.custom_vjp``. The CustomOp API (forward/backward with
+``req``/``assign``) is kept verbatim so reference custom ops (e.g. the
+Faster R-CNN Proposal layer) port unchanged.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .base import MXNetError, Registry
+from .ops.registry import Operator, Param, REQUIRED, register_op
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "NumpyOp", "NDArrayOp",
+           "PythonOp"]
+
+_CUSTOM_REG: Registry = Registry.get_registry("custom_op")
+
+
+class CustomOp:
+    """Base for user ops (reference CustomOp, operator.py:394)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst[:] + src if hasattr(dst, "__getitem__") else dst + src
+
+
+class CustomOpProp:
+    """Op declaration (reference CustomOpProp, operator.py:512)."""
+
+    def __init__(self, need_top_grad: bool = True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+
+def register(reg_name: str):
+    """Register a CustomOpProp subclass (reference mx.operator.register)."""
+    def _do(prop_cls):
+        _CUSTOM_REG.register(reg_name, override=True)(prop_cls)
+        return prop_cls
+    return _do
+
+
+class _HostArray:
+    """Minimal NDArray-like host wrapper handed to CustomOp code: supports
+    asnumpy(), .shape, .dtype, slicing assignment — what reference custom
+    ops actually use."""
+
+    def __init__(self, arr: np.ndarray):
+        self._arr = np.asarray(arr)
+
+    def asnumpy(self):
+        return self._arr
+
+    @property
+    def shape(self):
+        return self._arr.shape
+
+    @property
+    def dtype(self):
+        return self._arr.dtype
+
+    def __getitem__(self, key):
+        return self._arr[key]
+
+    def __setitem__(self, key, value):
+        self._arr[key] = np.asarray(value.asnumpy() if hasattr(value, "asnumpy")
+                                    else value)
+
+    def copyto(self, other):
+        other[:] = self._arr
+
+
+@register_op("Custom")
+class Custom(Operator):
+    """The Custom symbol op: runs a registered CustomOpProp's operator via
+    host callbacks inside the jitted graph."""
+
+    name_hint = "custom"
+    PARAMS = {"op_type": Param(str, REQUIRED)}
+
+    def __init__(self, **kwargs):
+        op_type = kwargs.pop("op_type", None)
+        if op_type is None:
+            raise MXNetError("Custom: op_type required")
+        prop_cls = _CUSTOM_REG.find(op_type)
+        if prop_cls is None:
+            raise MXNetError("Custom: op '%s' not registered" % op_type)
+        self.params = {"op_type": op_type}
+        # remaining kwargs go to the prop (stringly-typed like the reference)
+        self._prop = prop_cls(**kwargs)
+        self._prop_kwargs = kwargs
+        self._op_instance = None
+
+    def param_str_dict(self):
+        d = {"op_type": self.params["op_type"]}
+        d.update({k: str(v) for k, v in self._prop_kwargs.items()})
+        return d
+
+    def list_arguments(self):
+        return list(self._prop.list_arguments())
+
+    def list_outputs(self):
+        return list(self._prop.list_outputs())
+
+    def list_auxiliary_states(self):
+        return list(self._prop.list_auxiliary_states())
+
+    def infer_shape(self, in_shapes):
+        if any(s is None for s in in_shapes):
+            raise MXNetError("Custom: all input shapes must be known")
+        in_s, out_s, aux_s = self._prop.infer_shape([list(s) for s in in_shapes])
+        return ([tuple(s) for s in in_s], [tuple(s) for s in out_s],
+                [tuple(s) for s in aux_s])
+
+    def _get_op(self, in_shapes, in_dtypes) -> CustomOp:
+        if self._op_instance is None:
+            self._op_instance = self._prop.create_operator(
+                None, [list(s) for s in in_shapes], in_dtypes)
+        return self._op_instance
+
+    def apply(self, ctx, inputs, aux):
+        import jax
+        import jax.numpy as jnp
+
+        in_shapes = [tuple(x.shape) for x in inputs]
+        in_dtypes = [np.dtype(x.dtype) for x in inputs]
+        _, out_shapes, _ = self.infer_shape(in_shapes)
+        out_dtypes = [in_dtypes[0] if in_dtypes else np.float32] * len(out_shapes)
+        result_shapes = tuple(jax.ShapeDtypeStruct(s, d)
+                              for s, d in zip(out_shapes, out_dtypes))
+        is_train = ctx.is_train
+        op_self = self
+        n_out = len(out_shapes)
+
+        def fwd_host(*arrs):
+            op = op_self._get_op(in_shapes, in_dtypes)
+            in_data = [_HostArray(np.asarray(a)) for a in arrs]
+            out_data = [_HostArray(np.zeros(s, d))
+                        for s, d in zip(out_shapes, out_dtypes)]
+            op.forward(is_train, ["write"] * n_out, in_data, out_data, [])
+            return tuple(o.asnumpy() for o in out_data)
+
+        def bwd_host(*arrs):
+            n_in = len(in_shapes)
+            ins = arrs[:n_in]
+            outs = arrs[n_in:n_in + n_out]
+            ograds = arrs[n_in + n_out:]
+            op = op_self._get_op(in_shapes, in_dtypes)
+            in_data = [_HostArray(np.asarray(a)) for a in ins]
+            out_data = [_HostArray(np.asarray(a)) for a in outs]
+            out_grad = [_HostArray(np.asarray(g)) for g in ograds]
+            in_grad = [_HostArray(np.zeros(s, d))
+                       for s, d in zip(in_shapes, in_dtypes)]
+            op.backward(["write"] * n_in, out_grad, in_data, out_data,
+                        in_grad, [])
+            return tuple(g.asnumpy() for g in in_grad)
+
+        @jax.custom_vjp
+        def f(*xs):
+            return jax.pure_callback(fwd_host, result_shapes, *xs,
+                                     vmap_method="sequential")
+
+        def f_fwd(*xs):
+            ys = f(*xs)
+            return ys, (xs, ys)
+
+        def f_bwd(res, gs):
+            xs, ys = res
+            in_grad_shapes = tuple(jax.ShapeDtypeStruct(s, d)
+                                   for s, d in zip(in_shapes, in_dtypes))
+            grads = jax.pure_callback(bwd_host, in_grad_shapes,
+                                      *(tuple(xs) + tuple(ys) + tuple(gs)),
+                                      vmap_method="sequential")
+            return tuple(grads)
+
+        f.defvjp(f_fwd, f_bwd)
+        outs = f(*inputs)
+        return list(outs), []
+
+
+# ---------------------------------------------------------------------------
+# legacy NumpyOp / NDArrayOp / PythonOp (reference operator.py:17-223)
+# ---------------------------------------------------------------------------
+class PythonOp:
+    """Base of the legacy frontend-op API; get_symbol() wires it into the
+    graph via the Custom machinery."""
+
+    def __init__(self, need_top_grad: bool = True):
+        self.need_top_grad_ = need_top_grad
+
+    def forward(self, in_data, out_data):
+        raise NotImplementedError
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def get_symbol(self, *args, **kwargs):
+        from . import symbol as sym_mod
+
+        pyop = self
+
+        class _Prop(CustomOpProp):
+            def __init__(self, **_kw):
+                super().__init__(pyop.need_top_grad_)
+
+            def list_arguments(self):
+                return pyop.list_arguments()
+
+            def list_outputs(self):
+                return pyop.list_outputs()
+
+            def infer_shape(self, in_shape):
+                in_s, out_s = pyop.infer_shape(in_shape)
+                return in_s, out_s, []
+
+            def create_operator(self, ctx, in_shapes, in_dtypes):
+                class _Op(CustomOp):
+                    def forward(self, is_train, req, in_data, out_data, aux):
+                        pyop.forward([x.asnumpy() for x in in_data], out_data)
+
+                    def backward(self, req, out_grad, in_data, out_data,
+                                 in_grad, aux):
+                        pyop.backward([g.asnumpy() for g in out_grad],
+                                      [x.asnumpy() for x in in_data],
+                                      [y.asnumpy() for y in out_data],
+                                      in_grad)
+                return _Op()
+
+        reg_name = "_pyop_%s_%d" % (type(self).__name__, id(self))
+        register(reg_name)(_Prop)
+        kwargs["op_type"] = reg_name
+        return getattr(sym_mod, "Custom")(*args, **kwargs)
+
+
+class NumpyOp(PythonOp):
+    """Numpy-convention op (reference NumpyOp): forward/backward write into
+    numpy-like out slots via plain assignment."""
+
+    def forward(self, in_data, out_data):
+        raise NotImplementedError
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise NotImplementedError
+
+
+class NDArrayOp(PythonOp):
+    """Device-array flavor (reference NDArrayOp); here identical plumbing —
+    the callback boundary is the host either way."""

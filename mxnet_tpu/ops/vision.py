@@ -1,0 +1,290 @@
+"""Vision-specific operators.
+
+TPU-native implementations of the reference's detection/vision ops:
+ROIPooling (``src/operator/roi_pooling-inl.h``, Faster R-CNN),
+SpatialTransformer (``spatial_transformer-inl.h``), Correlation
+(``correlation-inl.h``). All are formulated as dense masked/gather
+computations with static shapes so XLA can fuse and tile them; a Pallas
+kernel can later replace the ROIPooling inner loop.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .registry import Operator, Param, REQUIRED, register_op
+
+
+def _jax():
+    import jax
+    return jax
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+@register_op("ROIPooling")
+class ROIPooling(Operator):
+    """Max-pool features inside scaled ROIs to a fixed grid (reference
+    roi_pooling-inl.h). rois: (num_rois, 5) = [batch_idx, x1, y1, x2, y2]."""
+
+    name_hint = "roipooling"
+    PARAMS = {
+        "pooled_size": Param("shape", REQUIRED, "(h, w)"),
+        "spatial_scale": Param(float, REQUIRED),
+    }
+
+    def list_arguments(self):
+        return ["data", "rois"]
+
+    def infer_shape(self, in_shapes):
+        data, rois = in_shapes
+        if data is None or rois is None:
+            raise MXNetError("ROIPooling: shapes unknown")
+        ph, pw = self.pooled_size
+        return [data, rois], [(rois[0], data[1], ph, pw)], []
+
+    def apply(self, ctx, inputs, aux):
+        jnp = _jnp()
+        jax = _jax()
+        data, rois = inputs
+        n, c, h, w = data.shape
+        ph, pw = self.pooled_size
+        scale = self.spatial_scale
+
+        def one_roi(roi):
+            batch_idx = roi[0].astype(jnp.int32)
+            # reference: round(coord * scale); end is inclusive
+            x1 = jnp.round(roi[1] * scale)
+            y1 = jnp.round(roi[2] * scale)
+            x2 = jnp.round(roi[3] * scale)
+            y2 = jnp.round(roi[4] * scale)
+            roi_h = jnp.maximum(y2 - y1 + 1.0, 1.0)
+            roi_w = jnp.maximum(x2 - x1 + 1.0, 1.0)
+            bin_h = roi_h / ph
+            bin_w = roi_w / pw
+            img = data[batch_idx]  # (c, h, w)
+
+            iy = jnp.arange(ph, dtype=data.dtype)
+            ix = jnp.arange(pw, dtype=data.dtype)
+            # bin [start, end) with floor/ceil like the reference
+            ys = jnp.clip(jnp.floor(y1 + iy * bin_h), 0, h)        # (ph,)
+            ye = jnp.clip(jnp.ceil(y1 + (iy + 1) * bin_h), 0, h)
+            xs = jnp.clip(jnp.floor(x1 + ix * bin_w), 0, w)
+            xe = jnp.clip(jnp.ceil(x1 + (ix + 1) * bin_w), 0, w)
+            rows = jnp.arange(h, dtype=data.dtype)
+            cols = jnp.arange(w, dtype=data.dtype)
+            row_mask = (rows[None, :] >= ys[:, None]) & (rows[None, :] < ye[:, None])  # (ph, h)
+            col_mask = (cols[None, :] >= xs[:, None]) & (cols[None, :] < xe[:, None])  # (pw, w)
+            mask = row_mask[:, None, :, None] & col_mask[None, :, None, :]  # (ph,pw,h,w)
+            neg = jnp.asarray(-jnp.inf, data.dtype)
+            masked = jnp.where(mask[None], img[:, None, None, :, :], neg)
+            pooled = masked.max(axis=(3, 4))  # (c, ph, pw)
+            # empty bins yield 0 like the reference
+            return jnp.where(jnp.isfinite(pooled), pooled, 0.0)
+
+        out = jax.vmap(one_roi)(rois)
+        return [out.astype(data.dtype)], []
+
+
+@register_op("SpatialTransformer")
+class SpatialTransformer(Operator):
+    """Affine spatial transformer with bilinear sampling (reference
+    spatial_transformer-inl.h; transform_type=affine, sampler=bilinear)."""
+
+    name_hint = "spatialtransformer"
+    PARAMS = {
+        "target_shape": Param("shape", REQUIRED, "(h, w)"),
+        "transform_type": Param(str, "affine"),
+        "sampler_type": Param(str, "bilinear"),
+    }
+
+    def list_arguments(self):
+        return ["data", "loc"]
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("SpatialTransformer: data shape unknown")
+        th, tw = self.target_shape
+        return ([data, (data[0], 6)],
+                [(data[0], data[1], th, tw)], [])
+
+    def apply(self, ctx, inputs, aux):
+        jnp = _jnp()
+        jax = _jax()
+        data, loc = inputs
+        n, c, h, w = data.shape
+        th, tw = self.target_shape
+
+        # normalized target grid in [-1, 1]
+        yt, xt = jnp.meshgrid(jnp.linspace(-1, 1, th),
+                              jnp.linspace(-1, 1, tw), indexing="ij")
+        ones = jnp.ones_like(xt)
+        grid = jnp.stack([xt.ravel(), yt.ravel(), ones.ravel()])  # (3, th*tw)
+
+        def one(img, theta):
+            theta = theta.reshape(2, 3)
+            src = theta @ grid                       # (2, th*tw) in [-1,1]
+            xs = (src[0] + 1.0) * (w - 1) / 2.0
+            ys = (src[1] + 1.0) * (h - 1) / 2.0
+            x0 = jnp.floor(xs)
+            y0 = jnp.floor(ys)
+            wx = xs - x0
+            wy = ys - y0
+
+            def sample(yi, xi):
+                inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+                yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+                xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+                vals = img[:, yc, xc]                # (c, th*tw)
+                return jnp.where(inb[None], vals, 0.0)
+
+            out = (sample(y0, x0) * (1 - wy) * (1 - wx)
+                   + sample(y0, x0 + 1) * (1 - wy) * wx
+                   + sample(y0 + 1, x0) * wy * (1 - wx)
+                   + sample(y0 + 1, x0 + 1) * wy * wx)
+            return out.reshape(c, th, tw)
+
+        out = jax.vmap(one)(data, loc)
+        return [out.astype(data.dtype)], []
+
+
+@register_op("Correlation")
+class Correlation(Operator):
+    """Cross-correlation of two feature maps over a displacement window
+    (reference correlation-inl.h, FlowNet-style)."""
+
+    name_hint = "correlation"
+    PARAMS = {
+        "kernel_size": Param(int, 1),
+        "max_displacement": Param(int, 1),
+        "stride1": Param(int, 1),
+        "stride2": Param(int, 1),
+        "pad_size": Param(int, 0),
+        "is_multiply": Param(bool, True),
+    }
+
+    def list_arguments(self):
+        return ["data1", "data2"]
+
+    def _out_geom(self, data):
+        n, c, h, w = data
+        pad = self.pad_size
+        bor = self.max_displacement + (self.kernel_size - 1) // 2
+        ph, pw = h + 2 * pad, w + 2 * pad
+        out_h = int(np.ceil((ph - bor * 2) / self.stride1))
+        out_w = int(np.ceil((pw - bor * 2) / self.stride1))
+        d = 2 * (self.max_displacement // self.stride2) + 1
+        return out_h, out_w, d * d
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("Correlation: shapes unknown")
+        out_h, out_w, top_c = self._out_geom(data)
+        return [data, data], [(data[0], top_c, out_h, out_w)], []
+
+    def apply(self, ctx, inputs, aux):
+        jnp = _jnp()
+        d1, d2 = inputs
+        n, c, h, w = d1.shape
+        pad = self.pad_size
+        k = self.kernel_size
+        md = self.max_displacement
+        s2 = self.stride2
+        out_h, out_w, _ = self._out_geom(d1.shape)
+        bor = md + (k - 1) // 2
+
+        p1 = jnp.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        p2 = jnp.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        disps = range(-md, md + 1, s2)
+        maps = []
+        for dy in disps:
+            for dx in disps:
+                shifted = jnp.roll(p2, (-dy, -dx), axis=(2, 3))
+                if self.is_multiply:
+                    prod = (p1 * shifted).sum(axis=1) / c   # (n, ph, pw)
+                else:
+                    prod = -jnp.abs(p1 - shifted).sum(axis=1) / c
+                window = prod[:, bor:bor + out_h * self.stride1:self.stride1,
+                              bor:bor + out_w * self.stride1:self.stride1]
+                maps.append(window)
+        out = jnp.stack(maps, axis=1)
+        return [out.astype(d1.dtype)], []
+
+
+@register_op("uniform", aliases=["_sample_uniform"])
+class SampleUniform(Operator):
+    """Symbolic random source (reference sample_op: uniform)."""
+
+    name_hint = "uniform"
+    PARAMS = {
+        "low": Param(float, 0.0),
+        "high": Param(float, 1.0),
+        "shape": Param("shape", REQUIRED),
+    }
+
+    def list_arguments(self):
+        return []
+
+    def infer_shape(self, in_shapes):
+        return [], [tuple(self.params["shape"])], []
+
+    def apply(self, ctx, inputs, aux):
+        jax = _jax()
+        if ctx.rng is None:
+            raise MXNetError("uniform op needs an rng (bind via executor)")
+        return [jax.random.uniform(ctx.rng, tuple(self.params["shape"]),
+                                   minval=self.low, maxval=self.high)], []
+
+
+@register_op("normal", aliases=["_sample_normal"])
+class SampleNormal(Operator):
+    name_hint = "normal"
+    PARAMS = {
+        "loc": Param(float, 0.0),
+        "scale": Param(float, 1.0),
+        "shape": Param("shape", REQUIRED),
+    }
+
+    def list_arguments(self):
+        return []
+
+    def infer_shape(self, in_shapes):
+        return [], [tuple(self.params["shape"])], []
+
+    def apply(self, ctx, inputs, aux):
+        jax = _jax()
+        if ctx.rng is None:
+            raise MXNetError("normal op needs an rng (bind via executor)")
+        return [self.loc + self.scale *
+                jax.random.normal(ctx.rng, tuple(self.params["shape"]))], []
+
+
+@register_op("softmax_cross_entropy")
+class SoftmaxCrossEntropy(Operator):
+    """Per-example softmax cross-entropy loss value (reference
+    loss_binary_op-inl.h)."""
+
+    name_hint = "softmax_cross_entropy"
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("softmax_cross_entropy: data shape unknown")
+        return [data, (data[0],)], [(1,)], []
+
+    def apply(self, ctx, inputs, aux):
+        jax = _jax()
+        jnp = _jnp()
+        data, label = inputs
+        logp = jax.nn.log_softmax(data, axis=-1)
+        lab = label.astype(jnp.int32)
+        nll = -logp[jnp.arange(data.shape[0]), lab]
+        return [jnp.sum(nll).reshape((1,))], []

@@ -1,0 +1,14 @@
+"""Parallelism toolkit: device meshes, sharding rules, sharded train steps.
+
+This is the TPU-native replacement for the reference's distributed tier
+(ps-lite parameter server + Comm device reduce, SURVEY §2.5): instead of
+push/pull RPC, a training step is pjit-compiled over a
+``jax.sharding.Mesh`` and XLA inserts the collectives (psum over ICI for
+data-parallel grads, all-gather/reduce-scatter for tensor-parallel
+matmuls).
+"""
+from .sharding import (make_mesh, make_param_shardings, shard_args,
+                       build_sgd_train_step, ShardingRule)
+
+__all__ = ["make_mesh", "make_param_shardings", "shard_args",
+           "build_sgd_train_step", "ShardingRule"]

@@ -1,0 +1,172 @@
+"""Custom-op frontend + vision op tests (reference test_operator.py custom
+op tests + roi_pooling/spatial_transformer coverage)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import operator as mop
+from mxnet_tpu import symbol as sym
+
+
+def test_custom_op_forward_backward():
+    @mop.register("sqr")
+    class SqrProp(mop.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class Sqr(mop.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    x = in_data[0].asnumpy()
+                    self.assign(out_data[0], req[0], x * x)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    x = in_data[0].asnumpy()
+                    g = out_grad[0].asnumpy()
+                    self.assign(in_grad[0], req[0], 2 * x * g)
+            return Sqr()
+
+    data = sym.Variable("data")
+    s = sym.Custom(data=data, op_type="sqr", name="sqr0")
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], dtype=np.float32)
+    ga = mx.nd.zeros((2, 2))
+    ex = s.bind(mx.cpu(), {"data": mx.nd.array(x)}, args_grad={"data": ga})
+    ex.forward(is_train=True)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), x * x)
+    head = mx.nd.array(np.full((2, 2), 0.5, dtype=np.float32))
+    ex.backward([head])
+    np.testing.assert_allclose(ga.asnumpy(), 2 * x * 0.5)
+
+
+def test_numpy_op():
+    class MySoftmax(mop.NumpyOp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+
+        def list_arguments(self):
+            return ["data"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]]
+
+        def forward(self, in_data, out_data):
+            x = in_data[0]
+            y = np.exp(x - x.max(axis=1, keepdims=True))
+            out_data[0][:] = y / y.sum(axis=1, keepdims=True)
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = out_data[0]  # dummy
+
+    op = MySoftmax()
+    s = op.get_symbol(data=sym.Variable("data"), name="mysoftmax")
+    x = np.random.randn(3, 4).astype(np.float32)
+    ex = s.bind(mx.cpu(), {"data": mx.nd.array(x)}, grad_req="null")
+    out = ex.forward()[0].asnumpy()
+    expected = np.exp(x - x.max(1, keepdims=True))
+    expected /= expected.sum(1, keepdims=True)
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+def test_roi_pooling():
+    data = sym.Variable("data")
+    rois = sym.Variable("rois")
+    roi = sym.ROIPooling(data=data, rois=rois, pooled_size=(2, 2),
+                         spatial_scale=1.0, name="roi")
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    r = np.array([[0, 0, 0, 3, 3],
+                  [0, 1, 1, 2, 2]], dtype=np.float32)
+    ex = roi.bind(mx.cpu(), {"data": mx.nd.array(x), "rois": mx.nd.array(r)},
+                  grad_req="null")
+    out = ex.forward()[0].asnumpy()
+    assert out.shape == (2, 1, 2, 2)
+    # full-image roi: max of each quadrant
+    np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+    # inner 2x2 roi [1..2]x[1..2]: values 5,6,9,10 -> bins
+    np.testing.assert_allclose(out[1, 0], [[5, 6], [9, 10]])
+
+
+def test_roi_pooling_grad_flows():
+    data = sym.Variable("data")
+    rois = sym.Variable("rois")
+    roi = sym.ROIPooling(data=data, rois=rois, pooled_size=(2, 2),
+                         spatial_scale=1.0, name="roi")
+    x = np.random.rand(1, 2, 4, 4).astype(np.float32)
+    r = np.array([[0, 0, 0, 3, 3]], dtype=np.float32)
+    g = mx.nd.zeros((1, 2, 4, 4))
+    ex = roi.bind(mx.cpu(), {"data": mx.nd.array(x), "rois": mx.nd.array(r)},
+                  args_grad={"data": g},
+                  grad_req={"data": "write", "rois": "null"})
+    ex.forward(is_train=True)
+    ex.backward()
+    gn = g.asnumpy()
+    # max-pool grad: exactly one 1 per (channel, bin)
+    assert gn.sum() == pytest.approx(8.0)
+
+
+def test_spatial_transformer_identity():
+    data = sym.Variable("data")
+    loc = sym.Variable("loc")
+    st = sym.SpatialTransformer(data=data, loc=loc, target_shape=(4, 4),
+                                transform_type="affine",
+                                sampler_type="bilinear", name="st")
+    x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+    identity = np.tile(np.array([1, 0, 0, 0, 1, 0], dtype=np.float32), (2, 1))
+    ex = st.bind(mx.cpu(), {"data": mx.nd.array(x),
+                            "loc": mx.nd.array(identity)}, grad_req="null")
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, x, atol=1e-5)
+
+
+def test_correlation_self():
+    data1 = sym.Variable("data1")
+    data2 = sym.Variable("data2")
+    corr = sym.Correlation(data1=data1, data2=data2, kernel_size=1,
+                           max_displacement=1, stride1=1, stride2=1,
+                           pad_size=1, name="corr")
+    x = np.random.rand(1, 4, 5, 5).astype(np.float32)
+    ex = corr.bind(mx.cpu(), {"data1": mx.nd.array(x),
+                              "data2": mx.nd.array(x)}, grad_req="null")
+    out = ex.forward()[0].asnumpy()
+    assert out.shape == (1, 9, 5, 5)
+    # zero displacement channel (index 4) == mean of squares over channels;
+    # out (i,j) maps to padded (bor+i, bor+j) = original (i, j) with pad=1
+    np.testing.assert_allclose(out[0, 4, 2, 2], (x[0, :, 2, 2] ** 2).mean(),
+                               rtol=1e-5)
+
+
+def test_symbolic_sampling():
+    u = sym.uniform(low=0.0, high=1.0, shape=(100,), name="u")
+    ex = u.bind(mx.cpu(), {}, grad_req="null")
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    assert out.shape == (100,)
+    assert 0 <= out.min() and out.max() <= 1
+    # different forward -> different draw
+    ex.forward(is_train=True)
+    out2 = ex.outputs[0].asnumpy()
+    assert not np.allclose(out, out2)
+
+
+def test_softmax_cross_entropy():
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    s = sym.softmax_cross_entropy(data=data, label=label)
+    x = np.random.randn(4, 5).astype(np.float32)
+    lab = np.array([0, 1, 2, 3], dtype=np.float32)
+    ex = s.bind(mx.cpu(), {"data": mx.nd.array(x), "label": mx.nd.array(lab)},
+                grad_req="null")
+    out = ex.forward()[0].asnumpy()
+    p = np.exp(x - x.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    expected = -np.log(p[np.arange(4), lab.astype(int)]).sum()
+    np.testing.assert_allclose(out, [expected], rtol=1e-5)
