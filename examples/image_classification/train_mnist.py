@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Train MNIST (reference example/image-classification/train_mnist.py).
+
+Uses idx-format MNIST files if --data-dir has them (the reference's layout:
+train-images-idx3-ubyte etc.), otherwise generates a synthetic separable
+digit task so the script runs in offline environments.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def get_iters(args):
+    train_img = os.path.join(args.data_dir, "train-images-idx3-ubyte")
+    train_lbl = os.path.join(args.data_dir, "train-labels-idx1-ubyte")
+    val_img = os.path.join(args.data_dir, "t10k-images-idx3-ubyte")
+    val_lbl = os.path.join(args.data_dir, "t10k-labels-idx1-ubyte")
+    flat = args.network == "mlp"
+    if os.path.exists(train_img):
+        train = mx.io.MNISTIter(image=train_img, label=train_lbl,
+                                batch_size=args.batch_size, shuffle=True,
+                                flat=flat, num_parts=args.num_parts,
+                                part_index=args.part_index)
+        val = mx.io.MNISTIter(image=val_img, label=val_lbl,
+                              batch_size=args.batch_size, flat=flat,
+                              shuffle=False)
+        return train, val
+    logging.warning("MNIST not found in %s; using synthetic digits",
+                    args.data_dir)
+    rng = np.random.RandomState(0)
+    n = 4096
+    y = rng.randint(0, 10, n).astype(np.float32)
+    X = np.zeros((n, 1, 28, 28), dtype=np.float32)
+    for i in range(n):
+        c = int(y[i])
+        X[i, 0, 2 * c:2 * c + 4, :] = 1.0
+    X += rng.randn(*X.shape).astype(np.float32) * 0.1
+    if flat:
+        X = X.reshape(n, 784)
+    cut = n * 7 // 8
+    train = mx.io.NDArrayIter(X[:cut], y[:cut], batch_size=args.batch_size,
+                              shuffle=True, last_batch_handle="discard")
+    val = mx.io.NDArrayIter(X[cut:], y[cut:], batch_size=args.batch_size)
+    return train, val
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train mnist")
+    parser.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
+    parser.add_argument("--data-dir", default="mnist/")
+    parser.add_argument("--gpus", default=None,
+                        help="accelerator ids, e.g. '0' or '0,1'")
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--kv-store", default="local")
+    parser.add_argument("--model-prefix", default=None)
+    parser.add_argument("--load-epoch", type=int, default=None)
+    parser.add_argument("--num-parts", type=int, default=1)
+    parser.add_argument("--part-index", type=int, default=0)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = models.get_mlp() if args.network == "mlp" else models.get_lenet()
+    train, val = get_iters(args)
+    if args.gpus:
+        ctx = [mx.gpu(int(i)) for i in args.gpus.split(",")]
+    else:
+        ctx = mx.cpu()
+
+    mod = mx.mod.Module(net, context=ctx)
+    arg_params = aux_params = None
+    begin_epoch = 0
+    if args.model_prefix and args.load_epoch is not None:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+        begin_epoch = args.load_epoch
+    epoch_cb = (mx.callback.do_checkpoint(args.model_prefix)
+                if args.model_prefix else None)
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            kvstore=args.kv_store, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 1e-5},
+            initializer=mx.init.Xavier(),
+            arg_params=arg_params, aux_params=aux_params,
+            allow_missing=True, begin_epoch=begin_epoch,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50),
+            epoch_end_callback=epoch_cb)
+    acc = mod.score(val, "acc")[0][1]
+    print("Final validation accuracy: %.4f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Bucketed LSTM language model (reference example/rnn/lstm_bucketing.py):
+variable-length sequences grouped into buckets, one executor per bucket
+sharing parameters via BucketingModule."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+class BucketSentenceIter(mx.io.DataIter):
+    """Group token sequences into buckets (reference BucketSentenceIter)."""
+
+    def __init__(self, sentences, buckets, batch_size, vocab_size):
+        super().__init__()
+        self.buckets = sorted(buckets)
+        self.batch_size = batch_size
+        self.vocab_size = vocab_size
+        self.data = {b: [] for b in self.buckets}
+        for s in sentences:
+            for b in self.buckets:
+                if len(s) <= b:
+                    padded = np.zeros(b, dtype=np.float32)
+                    padded[:len(s)] = s
+                    self.data[b].append(padded)
+                    break
+        self.plan = []
+        for b in self.buckets:
+            arr = np.array(self.data[b], dtype=np.float32)
+            for i in range(len(arr) // batch_size):
+                self.plan.append((b, arr[i * batch_size:(i + 1) * batch_size]))
+        self.cur = 0
+        self.default_bucket_key = self.buckets[-1]
+
+    @property
+    def provide_data(self):
+        return [mx.io.DataDesc("data",
+                               (self.batch_size, self.default_bucket_key))]
+
+    @property
+    def provide_label(self):
+        return [mx.io.DataDesc("softmax_label",
+                               (self.batch_size, self.default_bucket_key))]
+
+    def reset(self):
+        self.cur = 0
+
+    def __next__(self):
+        if self.cur >= len(self.plan):
+            raise StopIteration
+        bucket, batch = self.plan[self.cur]
+        self.cur += 1
+        # next-token labels (shifted by one)
+        label = np.zeros_like(batch)
+        label[:, :-1] = batch[:, 1:]
+        return mx.io.DataBatch(
+            [mx.nd.array(batch)], [mx.nd.array(label)],
+            bucket_key=bucket,
+            provide_data=[mx.io.DataDesc("data", (self.batch_size, bucket))],
+            provide_label=[mx.io.DataDesc("softmax_label",
+                                          (self.batch_size, bucket))])
+
+    next = __next__
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-hidden", type=int, default=64)
+    parser.add_argument("--num-embed", type=int, default=32)
+    parser.add_argument("--num-layers", type=int, default=1)
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--num-epochs", type=int, default=3)
+    parser.add_argument("--vocab", type=int, default=64)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    buckets = [8, 16, 24]
+    rng = np.random.RandomState(0)
+    sentences = [rng.randint(1, args.vocab, rng.randint(4, 24))
+                 for _ in range(512)]
+    data = BucketSentenceIter(sentences, buckets, args.batch_size, args.vocab)
+
+    def sym_gen(seq_len):
+        net = models.lstm_fused(args.num_layers, seq_len, args.vocab,
+                                args.num_hidden, args.num_embed, args.vocab)
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=data.default_bucket_key,
+                                 context=mx.cpu())
+    mod.bind(data.provide_data, data.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+    for epoch in range(args.num_epochs):
+        data.reset()
+        n = 0
+        for batch in data:
+            mod.forward_backward(batch)
+            mod.update()
+            n += 1
+        logging.info("Epoch[%d] processed %d bucketed batches "
+                     "(buckets bound: %s)", epoch, n,
+                     sorted(mod._buckets.keys()))
+    print("buckets bound:", sorted(mod._buckets.keys()))
+
+
+if __name__ == "__main__":
+    main()

@@ -39,13 +39,20 @@ from . import random as _random
 __all__ = ["Executor", "make_graph_eval"]
 
 
-def make_graph_eval(symbol):
+def make_graph_eval(symbol, node_device=None):
     """Build the pure graph-eval function for a symbol.
 
     Returns ``(eval_graph, n_aux)`` where
     ``eval_graph(arg_list, aux_list, key, is_train, want_internals=False)``
     evaluates the whole DAG over jnp arrays. Shared by :class:`Executor`
     and the sharded training-step builders in :mod:`mxnet_tpu.parallel`.
+
+    ``node_device(node) -> jax.Device | None`` implements model-parallel
+    placement (the reference's ``ctx_group``/``AssignContext`` +
+    ``_CrossDeviceCopy`` insertion, ``graph_executor.cc:391-508``): inputs
+    of a placed node are ``device_put`` to its device inside the single
+    jitted program, so XLA emits the cross-device transfers — and their
+    reverse transfers in the backward pass — in one compiled computation.
     """
     import jax
 
@@ -76,6 +83,10 @@ def make_graph_eval(symbol):
                 env[n.uid] = [arg_list[arg_index[n.uid]]]
             else:
                 ins = [env[src.uid][i] for src, i in n.inputs]
+                if node_device is not None:
+                    dev = node_device(n)
+                    if dev is not None:
+                        ins = [jax.device_put(x, dev) for x in ins]
                 slots = aux_slots.get(n.uid, [])
                 aux_in = [aux_out[s] for s in slots]
                 rng = jax.random.fold_in(key, n.uid) if key is not None else None
@@ -161,7 +172,15 @@ class Executor:
     def _build(self):
         import jax
 
-        eval_graph, self._n_aux = make_graph_eval(self._symbol)
+        node_device = None
+        if self._group2ctx:
+            group2dev = {g: c.jax_device() for g, c in self._group2ctx.items()}
+
+            def node_device(n):  # noqa: F811
+                group = n.attrs.get("ctx_group")
+                return group2dev.get(group)
+
+        eval_graph, self._n_aux = make_graph_eval(self._symbol, node_device)
         self._eval_graph = eval_graph
 
         grad_idx = [i for i, n in enumerate(self.arg_names)

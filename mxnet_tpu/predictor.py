@@ -1,0 +1,88 @@
+"""Deployment predict API.
+
+TPU-native equivalent of the reference's C predict API
+(``include/mxnet/c_predict_api.h:60-170``, ``src/c_api/c_predict_api.cc``):
+create a predictor from a symbol JSON + param blob, set inputs, forward,
+fetch outputs — the minimal surface used by the reference's
+amalgamation/mobile deployments.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu
+
+__all__ = ["Predictor"]
+
+
+class Predictor:
+    def __init__(self, symbol_json: str, param_bytes_or_file,
+                 input_shapes: Dict[str, tuple],
+                 ctx: Optional[Context] = None,
+                 input_names: Optional[Sequence[str]] = None):
+        from . import ndarray as nd
+        from . import symbol as sym_mod
+
+        self._ctx = ctx or cpu()
+        symbol = sym_mod.load_json(symbol_json)
+        if isinstance(param_bytes_or_file, (bytes, bytearray)):
+            import tempfile
+
+            with tempfile.NamedTemporaryFile(delete=False) as f:
+                f.write(param_bytes_or_file)
+                path = f.name
+            params = nd.load(path)
+        else:
+            params = nd.load(param_bytes_or_file)
+        arg_params, aux_params = {}, {}
+        for k, v in params.items():
+            if k.startswith("arg:"):
+                arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux_params[k[4:]] = v
+            else:
+                arg_params[k] = v
+
+        self._input_names = list(input_names or input_shapes.keys())
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**input_shapes)
+        args = {}
+        for name, shape in zip(symbol.list_arguments(), arg_shapes):
+            if name in input_shapes:
+                args[name] = nd.zeros(shape, ctx=self._ctx)
+            elif name in arg_params:
+                if tuple(arg_params[name].shape) != tuple(shape):
+                    raise MXNetError("param '%s' shape mismatch" % name)
+                args[name] = arg_params[name].as_in_context(self._ctx)
+            else:
+                raise MXNetError("missing parameter '%s'" % name)
+        aux = []
+        for name, shape in zip(symbol.list_auxiliary_states(), aux_shapes):
+            if name in aux_params:
+                aux.append(aux_params[name].as_in_context(self._ctx))
+            else:
+                aux.append(nd.zeros(shape, ctx=self._ctx))
+        self._executor = symbol.bind(self._ctx, args, grad_req="null",
+                                     aux_states=aux)
+        self._outputs = None
+
+    def set_input(self, name: str, value):
+        if name not in self._executor.arg_dict:
+            raise MXNetError("unknown input '%s'" % name)
+        self._executor.arg_dict[name][:] = np.asarray(value, dtype=np.float32)
+
+    def forward(self, **inputs):
+        for name, value in inputs.items():
+            self.set_input(name, value)
+        self._outputs = self._executor.forward(is_train=False)
+
+    def get_output(self, index: int) -> np.ndarray:
+        if self._outputs is None:
+            raise MXNetError("call forward first")
+        return self._outputs[index].asnumpy()
+
+    def reshape(self, input_shapes: Dict[str, tuple]) -> "Predictor":
+        self._executor = self._executor.reshape(**input_shapes)
+        return self

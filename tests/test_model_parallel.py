@@ -1,0 +1,87 @@
+"""Model-parallel tests (reference
+tests/python/unittest/test_model_parallel.py:14-50: same net bound on 1 vs
+2 contexts via ctx_group/group2ctx must produce identical results)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def _net():
+    with mx.AttrScope(ctx_group="dev1"):
+        data = sym.Variable("data")
+        fc1 = sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+        act1 = sym.Activation(fc1, act_type="relu")
+    with mx.AttrScope(ctx_group="dev2"):
+        fc2 = sym.FullyConnected(act1, num_hidden=4, name="fc2")
+        out = sym.SoftmaxOutput(fc2, name="softmax")
+    return out
+
+
+def _run(group2ctx):
+    net = _net()
+    rng = np.random.RandomState(0)
+    shapes = {"data": (6, 10), "softmax_label": (6,)}
+    arg_shapes, _, _ = net.infer_shape(**shapes)
+    args = {}
+    grads = {}
+    for name, shape in zip(net.list_arguments(), arg_shapes):
+        args[name] = mx.nd.array(rng.randn(*shape).astype(np.float32) * 0.3)
+        grads[name] = mx.nd.zeros(shape)
+    args["softmax_label"][:] = np.array([0, 1, 2, 3, 0, 1], dtype=np.float32)
+    ex = net.bind(mx.cpu(), args, args_grad=grads,
+                  grad_req={n: ("null" if n == "softmax_label" else "write")
+                            for n in args},
+                  group2ctx=group2ctx)
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    ex.backward()
+    g = {n: a.asnumpy() for n, a in ex.grad_dict.items()}
+    return out, g
+
+
+def test_model_parallel_matches_single_device():
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices")
+    out1, g1 = _run(None)
+    out2, g2 = _run({"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    np.testing.assert_allclose(out1, out2, rtol=1e-5)
+    for name in g1:
+        np.testing.assert_allclose(g1[name], g2[name], rtol=1e-4, atol=1e-6,
+                                   err_msg=name)
+
+
+def test_model_parallel_lstm_style_placement():
+    """Layer-per-device placement as in example/model-parallel-lstm."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices")
+    from mxnet_tpu import models
+
+    group2ctx = {"layer0": mx.cpu(0), "layer1": mx.cpu(1)}
+    data = sym.Variable("data")
+    with mx.AttrScope(ctx_group="layer0"):
+        fc0 = sym.FullyConnected(data, num_hidden=16, name="l0")
+        a0 = sym.Activation(fc0, act_type="tanh")
+    with mx.AttrScope(ctx_group="layer1"):
+        fc1 = sym.FullyConnected(a0, num_hidden=16, name="l1")
+        out = sym.LinearRegressionOutput(fc1, name="lro")
+    shapes = {"data": (4, 8), "lro_label": (4, 16)}
+    ex = out.simple_bind(ctx=mx.cpu(), grad_req="write",
+                         **{k: v for k, v in shapes.items()})
+    # rebind with group2ctx through bind()
+    ex2 = out.bind(mx.cpu(), ex.arg_arrays,
+                   args_grad={n: mx.nd.zeros(a.shape)
+                              for n, a in ex.arg_dict.items()},
+                   group2ctx=group2ctx)
+    rng = np.random.RandomState(0)
+    for name, arr in ex2.arg_dict.items():
+        arr[:] = rng.randn(*arr.shape).astype(np.float32) * 0.2
+    ex2.forward(is_train=True)
+    ex2.backward()
+    assert ex2.outputs[0].shape == (4, 16)
+    assert np.abs(ex2.grad_dict["l0_weight"].asnumpy()).sum() > 0

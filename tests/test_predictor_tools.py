@@ -1,0 +1,98 @@
+"""Predictor (c_predict_api equivalent) + tools tests."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+from mxnet_tpu.predictor import Predictor
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+def test_predictor_roundtrip(tmp_path):
+    net = models.get_mlp(num_classes=5)
+    prefix = str(tmp_path / "m")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind([("data", (4, 20))], [("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+    mod.save_checkpoint(prefix, 0)
+
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read()
+    pred = Predictor(sym_json, prefix + "-0000.params",
+                     {"data": (4, 20), "softmax_label": (4,)})
+    x = np.random.randn(4, 20).astype(np.float32)
+    pred.forward(data=x)
+    out = pred.get_output(0)
+    assert out.shape == (4, 5)
+
+    # must match the module's own prediction
+    batch = mx.io.DataBatch([mx.nd.array(x)], [mx.nd.zeros((4,))])
+    mod.forward(batch, is_train=False)
+    np.testing.assert_allclose(out, mod.get_outputs()[0].asnumpy(),
+                               rtol=1e-5)
+
+
+def test_parse_log(tmp_path):
+    log = tmp_path / "train.log"
+    log.write_text(
+        "INFO:root:Epoch[0] Train-accuracy=0.5\n"
+        "INFO:root:Epoch[0] Time cost=1.25\n"
+        "INFO:root:Epoch[0] Validation-accuracy=0.6\n"
+        "INFO:root:Epoch[1] Train-accuracy=0.9\n"
+        "INFO:root:Epoch[1] Validation-accuracy=0.92\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "parse_log.py"),
+         str(log), "--metric", "val-accuracy"],
+        capture_output=True, text=True)
+    assert out.returncode == 0
+    lines = out.stdout.strip().splitlines()
+    assert lines == ["0\t0.6", "1\t0.92"]
+
+
+def test_im2rec_and_iter(tmp_path):
+    pytest.importorskip("PIL")
+    from PIL import Image
+
+    img_dir = tmp_path / "imgs"
+    img_dir.mkdir()
+    rng = np.random.RandomState(0)
+    lst = []
+    for i in range(6):
+        arr = (rng.rand(20, 24, 3) * 255).astype(np.uint8)
+        Image.fromarray(arr).save(str(img_dir / ("%d.jpg" % i)))
+        lst.append("%d\t%d\t%d.jpg" % (i, i % 3, i))
+    lst_file = tmp_path / "imgs.lst"
+    lst_file.write_text("\n".join(lst) + "\n")
+    prefix = str(tmp_path / "packed")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "im2rec.py"),
+         prefix, str(img_dir), "--list", str(lst_file), "--resize", "16"],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert os.path.exists(prefix + ".rec")
+    assert os.path.exists(prefix + ".idx")
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 14, 14), batch_size=3)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (3, 3, 14, 14)
+
+
+def test_launch_local(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os\n"
+        "print('rank', os.environ['MXTPU_WORKER_RANK'],\n"
+        "      'of', os.environ['MXTPU_NUM_WORKERS'])\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "3", sys.executable, str(script)],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    for r in range(3):
+        assert "rank %d of 3" % r in out.stdout

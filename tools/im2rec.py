@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Pack an image list into recordio (reference tools/im2rec.{cc,py}).
+
+List format (same as the reference): ``index\tlabel[\tlabel...]\tpath``.
+"""
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            yield idx, labels[0] if len(labels) == 1 else labels, parts[-1]
+
+
+def main():
+    from PIL import Image
+
+    from mxnet_tpu import recordio as rio
+
+    parser = argparse.ArgumentParser(description="image list -> recordio")
+    parser.add_argument("prefix", help="output prefix (writes prefix.rec/.idx)")
+    parser.add_argument("root", help="image root dir")
+    parser.add_argument("--list", required=True, help="image list file")
+    parser.add_argument("--resize", type=int, default=0,
+                        help="resize shorter edge")
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--shuffle", action="store_true")
+    parser.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
+    args = parser.parse_args()
+
+    items = list(read_list(args.list))
+    if args.shuffle:
+        random.shuffle(items)
+    record = rio.MXIndexedRecordIO(args.prefix + ".idx", args.prefix + ".rec",
+                                   "w")
+    count = 0
+    for idx, label, fname in items:
+        path = os.path.join(args.root, fname)
+        img = Image.open(path).convert("RGB")
+        if args.resize > 0:
+            w, h = img.size
+            scale = args.resize / min(w, h)
+            img = img.resize((int(round(w * scale)), int(round(h * scale))))
+        header = rio.IRHeader(0, label, idx, 0)
+        packed = rio.pack_img(header, np.asarray(img),
+                              quality=args.quality, img_fmt=args.encoding)
+        record.write_idx(idx, packed)
+        count += 1
+        if count % 1000 == 0:
+            print("packed %d images" % count)
+    record.close()
+    print("wrote %d records to %s.rec" % (count, args.prefix))
+
+
+if __name__ == "__main__":
+    main()

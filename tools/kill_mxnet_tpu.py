@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""Kill stray training processes on this machine (reference
+tools/kill-mxnet.py)."""
+import argparse
+import os
+import signal
+import subprocess
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--pattern", default="mxnet_tpu",
+                        help="process cmdline substring to kill")
+    args = parser.parse_args()
+    out = subprocess.run(["pgrep", "-f", args.pattern],
+                         capture_output=True, text=True)
+    me = os.getpid()
+    for pid in out.stdout.split():
+        pid = int(pid)
+        if pid == me:
+            continue
+        try:
+            os.kill(pid, signal.SIGTERM)
+            print("killed", pid)
+        except ProcessLookupError:
+            pass
+
+
+if __name__ == "__main__":
+    main()

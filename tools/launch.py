@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Launch a distributed job (reference tools/launch.py over dmlc_tracker).
+
+TPU-native re-design: there is no parameter-server tier — every process is
+a worker participating in jax.distributed collectives. The local launcher
+forks N worker processes on this machine with the coordinator env set
+(reference ``launch.py -n N --launcher local``); for real TPU pods, each
+host runs the same command and jax.distributed picks up the topology from
+the TPU runtime.
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed training job")
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="accepted for reference-CLI parity; the TPU "
+                             "backend has no server tier (collectives "
+                             "replace push/pull)")
+    parser.add_argument("--launcher", default="local",
+                        choices=["local", "ssh"],
+                        help="local: fork processes on this machine")
+    parser.add_argument("-H", "--hostfile", default=None,
+                        help="hostfile for ssh launcher")
+    parser.add_argument("--coordinator", default="127.0.0.1:12421")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    if not args.command:
+        parser.error("no command given")
+
+    if args.launcher == "local":
+        procs = []
+        for rank in range(args.num_workers):
+            env = dict(os.environ)
+            env.update({
+                "MXTPU_COORDINATOR": args.coordinator,
+                "MXTPU_NUM_WORKERS": str(args.num_workers),
+                "MXTPU_WORKER_RANK": str(rank),
+                # reference env names kept for script compat
+                "DMLC_ROLE": "worker",
+                "DMLC_NUM_WORKER": str(args.num_workers),
+                "DMLC_WORKER_ID": str(rank),
+            })
+            procs.append(subprocess.Popen(args.command, env=env))
+
+        def _kill(*_):
+            for p in procs:
+                p.terminate()
+        signal.signal(signal.SIGINT, _kill)
+        signal.signal(signal.SIGTERM, _kill)
+        rc = 0
+        for p in procs:
+            rc = p.wait() or rc
+        sys.exit(rc)
+    else:
+        if not args.hostfile:
+            parser.error("ssh launcher needs --hostfile")
+        hosts = [h.strip() for h in open(args.hostfile) if h.strip()]
+        procs = []
+        for rank, host in enumerate(hosts[:args.num_workers]):
+            remote_env = ("MXTPU_COORDINATOR=%s MXTPU_NUM_WORKERS=%d "
+                          "MXTPU_WORKER_RANK=%d" %
+                          (args.coordinator, args.num_workers, rank))
+            cmd = ["ssh", host, remote_env + " " + " ".join(args.command)]
+            procs.append(subprocess.Popen(cmd))
+        rc = 0
+        for p in procs:
+            rc = p.wait() or rc
+        sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

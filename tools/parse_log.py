@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Parse training logs into a table (reference tools/parse_log.py):
+extracts per-epoch train/validation accuracy and throughput from the log
+format emitted by Module.fit/Speedometer."""
+import argparse
+import re
+import sys
+
+
+def parse(fname):
+    train_re = re.compile(r"Epoch\[(\d+)\] Train-([\w-]+)=([\d.eE+-]+)")
+    val_re = re.compile(r"Epoch\[(\d+)\] Validation-([\w-]+)=([\d.eE+-]+)")
+    time_re = re.compile(r"Epoch\[(\d+)\] Time cost=([\d.]+)")
+    speed_re = re.compile(r"Epoch\[(\d+)\].*Speed: ([\d.]+) samples/sec")
+    rows = {}
+    speeds = {}
+    with open(fname) as fin:
+        for line in fin:
+            for regex, key in [(train_re, "train"), (val_re, "val")]:
+                m = regex.search(line)
+                if m:
+                    epoch = int(m.group(1))
+                    rows.setdefault(epoch, {})["%s-%s" % (key, m.group(2))] = \
+                        float(m.group(3))
+            m = time_re.search(line)
+            if m:
+                rows.setdefault(int(m.group(1)), {})["time"] = float(m.group(2))
+            m = speed_re.search(line)
+            if m:
+                speeds.setdefault(int(m.group(1)), []).append(float(m.group(2)))
+    for epoch, sp in speeds.items():
+        rows.setdefault(epoch, {})["speed"] = sum(sp) / len(sp)
+    return rows
+
+
+def main():
+    parser = argparse.ArgumentParser(description="parse training log")
+    parser.add_argument("logfile")
+    parser.add_argument("--metric", default=None,
+                        help="print only this column (e.g. val-accuracy)")
+    args = parser.parse_args()
+    rows = parse(args.logfile)
+    if not rows:
+        print("no epochs found", file=sys.stderr)
+        sys.exit(1)
+    cols = sorted({c for r in rows.values() for c in r})
+    if args.metric:
+        for epoch in sorted(rows):
+            if args.metric in rows[epoch]:
+                print("%d\t%g" % (epoch, rows[epoch][args.metric]))
+        return
+    print("epoch\t" + "\t".join(cols))
+    for epoch in sorted(rows):
+        print("%d\t" % epoch + "\t".join(
+            "%g" % rows[epoch].get(c, float("nan")) for c in cols))
+
+
+if __name__ == "__main__":
+    main()
