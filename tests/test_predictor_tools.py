@@ -84,15 +84,18 @@ def test_im2rec_and_iter(tmp_path):
 
 
 def test_launch_local(tmp_path):
+    # workers write per-rank files (stdout interleaves across processes)
     script = tmp_path / "worker.py"
     script.write_text(
         "import os\n"
-        "print('rank', os.environ['MXTPU_WORKER_RANK'],\n"
-        "      'of', os.environ['MXTPU_NUM_WORKERS'])\n")
+        "rank = os.environ['MXTPU_WORKER_RANK']\n"
+        "n = os.environ['MXTPU_NUM_WORKERS']\n"
+        "open(os.path.join(%r, 'out_' + rank), 'w').write(rank + '/' + n)\n"
+        % str(tmp_path))
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "launch.py"),
          "-n", "3", sys.executable, str(script)],
         capture_output=True, text=True)
     assert out.returncode == 0, out.stderr
     for r in range(3):
-        assert "rank %d of 3" % r in out.stdout
+        assert (tmp_path / ("out_%d" % r)).read_text() == "%d/3" % r
