@@ -1,0 +1,19 @@
+# Build the native runtime library (C++ engine + recordio).
+CXX ?= g++
+CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -pthread
+LIB := mxnet_tpu/_native/libmxtpu.so
+SRCS := $(wildcard src/native/*.cc)
+
+all: $(LIB)
+
+$(LIB): $(SRCS)
+	@mkdir -p mxnet_tpu/_native
+	$(CXX) $(CXXFLAGS) -shared -o $@ $(SRCS)
+
+test: $(LIB)
+	python -m pytest tests/ -q
+
+clean:
+	rm -rf mxnet_tpu/_native
+
+.PHONY: all test clean

@@ -1,0 +1,87 @@
+"""Loader for the native runtime library (C++ engine + recordio codec).
+
+Builds ``mxnet_tpu/_native/libmxtpu.so`` from ``src/native/*.cc`` on first
+use when a compiler is available (``make`` at repo root does the same);
+everything degrades gracefully to the pure-Python implementations if the
+library is missing. Set ``MXNET_TPU_NO_NATIVE=1`` to force pure Python.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from .base import getenv
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LIB_PATH = os.path.join(_REPO, "mxnet_tpu", "_native", "libmxtpu.so")
+_SRC_DIR = os.path.join(_REPO, "src", "native")
+
+
+def _build() -> bool:
+    srcs = [os.path.join(_SRC_DIR, f) for f in sorted(os.listdir(_SRC_DIR))
+            if f.endswith(".cc")] if os.path.isdir(_SRC_DIR) else []
+    if not srcs:
+        return False
+    os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
+    cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+           "-o", _LIB_PATH] + srcs
+    try:
+        res = subprocess.run(cmd, capture_output=True, timeout=120)
+        return res.returncode == 0
+    except Exception:
+        return False
+
+
+def _configure(lib):
+    i8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.mxtpu_recio_writer_open.restype = ctypes.c_void_p
+    lib.mxtpu_recio_writer_open.argtypes = [ctypes.c_char_p]
+    lib.mxtpu_recio_write.restype = ctypes.c_longlong
+    lib.mxtpu_recio_write.argtypes = [ctypes.c_void_p, i8p, ctypes.c_uint64]
+    lib.mxtpu_recio_writer_close.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_recio_reader_open.restype = ctypes.c_void_p
+    lib.mxtpu_recio_reader_open.argtypes = [ctypes.c_char_p]
+    lib.mxtpu_recio_reader_seek.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.mxtpu_recio_read.restype = ctypes.c_longlong
+    lib.mxtpu_recio_read.argtypes = [ctypes.c_void_p, ctypes.POINTER(i8p)]
+    lib.mxtpu_recio_reader_close.argtypes = [ctypes.c_void_p]
+
+    lib.mxtpu_engine_create.restype = ctypes.c_void_p
+    lib.mxtpu_engine_create.argtypes = [ctypes.c_int]
+    lib.mxtpu_engine_destroy.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_engine_new_var.restype = ctypes.c_void_p
+    lib.mxtpu_engine_new_var.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_engine_push.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int, ctypes.c_int]
+    lib.mxtpu_engine_wait_all.argtypes = [ctypes.c_void_p]
+    lib.mxtpu_engine_var_version.restype = ctypes.c_uint64
+    lib.mxtpu_engine_var_version.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    return lib
+
+
+def get_lib():
+    """The loaded native library, or None when unavailable/disabled."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if getenv("MXNET_TPU_NO_NATIVE", False):
+            return None
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            _lib = _configure(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            _lib = None
+        return _lib

@@ -312,6 +312,98 @@ class ThreadedEngine(Engine):
             self._heap_lock.notify_all()
 
 
+class NativeThreadedEngine(Engine):
+    """Host dependency engine backed by the C++ scheduler
+    (``src/native/engine.cc`` — the native re-design of the reference's
+    ``src/engine/threaded_engine.cc``). Python closures run on C++ worker
+    threads via a ctypes trampoline; exceptions are captured and re-raised
+    at the next wait."""
+
+    def __init__(self, num_workers: Optional[int] = None):
+        import ctypes
+        import itertools as _it
+
+        from ._native_lib import get_lib
+
+        lib = get_lib()
+        if lib is None:
+            raise MXNetError("native engine library unavailable "
+                             "(build with `make` or install g++)")
+        self._lib = lib
+        self._handle = lib.mxtpu_engine_create(
+            num_workers or getenv("MXNET_CPU_WORKER_NTHREADS", 4))
+        self._pending = {}
+        self._pending_lock = threading.Lock()
+        self._token = _it.count(1)
+        self._errors: List[BaseException] = []
+        self._ctypes = ctypes
+
+        CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+        def _run(token):
+            with self._pending_lock:
+                fn = self._pending.pop(token)
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001
+                self._errors.append(e)
+        self._trampoline = CB(_run)  # must outlive the engine
+
+    def new_variable(self) -> Var:
+        v = Var()
+        v_native = self._lib.mxtpu_engine_new_var(self._handle)
+        object.__setattr__(v, "version", 0)
+        self._native_of(v, v_native)
+        return v
+
+    @staticmethod
+    def _native_of(var, ptr=None):
+        # Var has __slots__; keep the native ptr in a side table
+        if ptr is not None:
+            NativeThreadedEngine._ptr_table[id(var)] = (var, ptr)
+        return NativeThreadedEngine._ptr_table[id(var)][1]
+
+    _ptr_table: dict = {}
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        ctypes = self._ctypes
+
+        _check_duplicates(const_vars, mutable_vars)
+        token = next(self._token)
+        with self._pending_lock:
+            self._pending[token] = fn
+
+        def _wrap(vars_):
+            arr = (ctypes.c_void_p * max(len(vars_), 1))()
+            for i, v in enumerate(vars_):
+                arr[i] = self._native_of(v)
+            return arr
+        cv = _wrap(const_vars)
+        mv = _wrap(mutable_vars)
+        self._lib.mxtpu_engine_push(
+            self._handle, ctypes.cast(self._trampoline, ctypes.c_void_p),
+            ctypes.c_void_p(token), cv, len(const_vars), mv,
+            len(mutable_vars), priority)
+        for v in mutable_vars:
+            v.version += 1  # logical version; native tracks its own
+
+    def wait_for_var(self, var: Var):
+        done = threading.Event()
+        self.push(done.set, const_vars=[var])
+        done.wait()
+        self._raise_errors()
+
+    def wait_for_all(self):
+        self._lib.mxtpu_engine_wait_all(self._handle)
+        self._raise_errors()
+
+    def _raise_errors(self):
+        if self._errors:
+            err = self._errors[0]
+            self._errors = []
+            raise err
+
+
 _engine: Optional[Engine] = None
 _engine_lock = threading.Lock()
 
@@ -322,6 +414,8 @@ def _create_engine() -> Engine:
         return NaiveEngine()
     if kind in ("ThreadedEngine", "ThreadedEnginePooled"):
         return ThreadedEngine()
+    if kind in ("NativeEngine", "NativeThreadedEngine"):
+        return NativeThreadedEngine()
     # ThreadedEnginePerDevice (the reference default) == XLA async dispatch
     return XLAEngine()
 

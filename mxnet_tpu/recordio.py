@@ -28,45 +28,100 @@ _LENGTH_MASK = (1 << _LFLAG_BITS) - 1
 
 
 class MXRecordIO:
-    """Sequential record reader/writer (reference dmlc::RecordIOWriter)."""
+    """Sequential record reader/writer (reference dmlc::RecordIOWriter).
+
+    Uses the native C++ codec (``src/native/recordio.cc``) when available,
+    falling back to pure Python; the on-disk format is identical.
+    ``write`` returns the record's byte offset (used by the indexed
+    variant)."""
 
     def __init__(self, uri: str, flag: str):
         self.uri = uri
         self.flag = flag
+        from ._native_lib import get_lib
+
+        self._lib = get_lib()
         self.open()
 
     def open(self):
-        if self.flag == "w":
-            self.fp = open(self.uri, "wb")
-            self.writable = True
-        elif self.flag == "r":
-            self.fp = open(self.uri, "rb")
-            self.writable = False
-        else:
+        self.writable = self.flag == "w"
+        if self.flag not in ("r", "w"):
             raise MXNetError("invalid flag %s" % self.flag)
+        if self._lib is not None:
+            if self.writable:
+                self._h = self._lib.mxtpu_recio_writer_open(
+                    self.uri.encode())
+            else:
+                self._h = self._lib.mxtpu_recio_reader_open(
+                    self.uri.encode())
+            if not self._h:
+                raise MXNetError("cannot open %s" % self.uri)
+            self.fp = None
+            self._offset = 0
+        else:
+            self.fp = open(self.uri, "wb" if self.writable else "rb")
+            self._h = None
 
     def close(self):
-        self.fp.close()
+        if self._h is not None:
+            if self.writable:
+                self._lib.mxtpu_recio_writer_close(self._h)
+            else:
+                self._lib.mxtpu_recio_reader_close(self._h)
+            self._h = None
+        elif self.fp is not None:
+            self.fp.close()
+            self.fp = None
 
     def reset(self):
         self.close()
         self.open()
 
-    def write(self, buf: bytes):
+    def write(self, buf: bytes) -> int:
         if not self.writable:
             raise MXNetError("not opened for writing")
+        if self._h is not None:
+            import ctypes
+
+            data = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf) \
+                if buf else (ctypes.c_uint8 * 1)()
+            off = self._lib.mxtpu_recio_write(self._h, data, len(buf))
+            if off < 0:
+                raise MXNetError("write failed on %s" % self.uri)
+            return off
+        off = self.fp.tell()
         self.fp.write(struct.pack("<II", _MAGIC, len(buf) & _LENGTH_MASK))
         self.fp.write(buf)
         pad = (4 - len(buf) % 4) % 4
         if pad:
             self.fp.write(b"\x00" * pad)
+        return off
 
     def tell(self) -> int:
-        return self.fp.tell()
+        if self.fp is not None:
+            return self.fp.tell()
+        raise MXNetError("tell() unsupported on the native handle; "
+                         "use the offset returned by write()")
+
+    def seek(self, offset: int):
+        if self._h is not None:
+            self._lib.mxtpu_recio_reader_seek(self._h, offset)
+        else:
+            self.fp.seek(offset)
 
     def read(self) -> Optional[bytes]:
         if self.writable:
             raise MXNetError("not opened for reading")
+        if self._h is not None:
+            import ctypes
+
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            n = self._lib.mxtpu_recio_read(self._h, ctypes.byref(out))
+            if n == -1:
+                return None
+            if n == -2:
+                raise MXNetError("invalid record magic in %s" % self.uri)
+            return ctypes.string_at(out, n)
         header = self.fp.read(8)
         if len(header) < 8:
             return None
@@ -113,7 +168,7 @@ class MXIndexedRecordIO(MXRecordIO):
         super().close()
 
     def seek(self, idx):
-        self.fp.seek(self.idx[idx])
+        MXRecordIO.seek(self, self.idx[idx])
 
     def read_idx(self, idx):
         self.seek(idx)
@@ -121,9 +176,9 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def write_idx(self, idx, buf: bytes):
         key = self.key_type(idx)
-        self.idx[key] = self.tell()
+        offset = self.write(buf)
+        self.idx[key] = offset
         self.keys.append(key)
-        self.write(buf)
 
 
 IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])

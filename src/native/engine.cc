@@ -1,0 +1,248 @@
+// Native threaded dependency engine.
+//
+// C++ re-design of the reference scheduler (src/engine/threaded_engine.cc:
+// ThreadedVar read/write queues + OprBlock wait counters;
+// threaded_engine_perdevice.cc worker pools). Device-side compute on TPU is
+// scheduled by XLA's async dispatch; this engine schedules HOST work —
+// data loading, decode, callbacks — with the same dependency semantics, and
+// is the arbiter the Python ThreadedEngine delegates to when the native
+// library is present.
+//
+// C ABI for ctypes; callbacks are plain function pointers taking an opaque
+// context.
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Callback = void (*)(void*);
+
+struct OprBlock;
+
+struct Var {
+  std::mutex mu;
+  // queue of (is_write, opr)
+  std::deque<std::pair<bool, OprBlock*>> queue;
+  int num_pending_reads = 0;
+  OprBlock* pending_write = nullptr;
+  std::atomic<uint64_t> version{0};
+};
+
+struct OprBlock {
+  Callback fn;
+  void* ctx;
+  std::vector<Var*> const_vars;
+  std::vector<Var*> mutable_vars;
+  int priority;
+  uint64_t seq;
+  std::atomic<int> wait{0};
+};
+
+struct OprCompare {
+  bool operator()(OprBlock* a, OprBlock* b) const {
+    if (a->priority != b->priority) return a->priority < b->priority;
+    return a->seq > b->seq;  // FIFO within priority
+  }
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_workers) : num_workers_(num_workers) {
+    for (int i = 0; i < num_workers_; ++i) {
+      workers_.emplace_back([this] { WorkerLoop(); });
+    }
+  }
+
+  ~Engine() {
+    WaitForAll();
+    {
+      std::lock_guard<std::mutex> lk(heap_mu_);
+      shutdown_ = true;
+    }
+    heap_cv_.notify_all();
+    for (auto& t : workers_) t.join();
+    for (Var* v : vars_) delete v;
+  }
+
+  Var* NewVar() {
+    Var* v = new Var();
+    std::lock_guard<std::mutex> lk(vars_mu_);
+    vars_.push_back(v);
+    return v;
+  }
+
+  void Push(Callback fn, void* ctx, Var** cvars, int n_const, Var** mvars,
+            int n_mut, int priority) {
+    OprBlock* opr = new OprBlock();
+    opr->fn = fn;
+    opr->ctx = ctx;
+    opr->const_vars.assign(cvars, cvars + n_const);
+    opr->mutable_vars.assign(mvars, mvars + n_mut);
+    opr->priority = priority;
+    opr->seq = seq_.fetch_add(1);
+    pending_.fetch_add(1);
+    // guard unit + assume all deps unready (reference OprBlock.wait)
+    int n_deps = n_const + n_mut;
+    opr->wait.store(1 + n_deps);
+    int n_ready = 0;
+    for (Var* v : opr->const_vars) {
+      if (AppendRead(v, opr)) ++n_ready;
+    }
+    for (Var* v : opr->mutable_vars) {
+      if (AppendWrite(v, opr)) ++n_ready;
+    }
+    if (opr->wait.fetch_sub(n_ready + 1) == n_ready + 1) Dispatch(opr);
+  }
+
+  void WaitForAll() {
+    std::unique_lock<std::mutex> lk(pending_mu_);
+    pending_cv_.wait(lk, [this] { return pending_.load() == 0; });
+  }
+
+  uint64_t VarVersion(Var* v) { return v->version.load(); }
+
+ private:
+  static bool AppendRead(Var* v, OprBlock* opr) {
+    std::lock_guard<std::mutex> lk(v->mu);
+    if (v->pending_write == nullptr && v->queue.empty()) {
+      ++v->num_pending_reads;
+      return true;
+    }
+    v->queue.emplace_back(false, opr);
+    return false;
+  }
+
+  static bool AppendWrite(Var* v, OprBlock* opr) {
+    std::lock_guard<std::mutex> lk(v->mu);
+    if (v->pending_write == nullptr && v->num_pending_reads == 0 &&
+        v->queue.empty()) {
+      v->pending_write = opr;
+      return true;
+    }
+    v->queue.emplace_back(true, opr);
+    return false;
+  }
+
+  void CompleteRead(Var* v) {
+    std::vector<OprBlock*> ready;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      if (--v->num_pending_reads == 0 && !v->queue.empty() &&
+          v->queue.front().first) {
+        OprBlock* opr = v->queue.front().second;
+        v->queue.pop_front();
+        v->pending_write = opr;
+        ready.push_back(opr);
+      }
+    }
+    OnDepsResolved(ready);
+  }
+
+  void CompleteWrite(Var* v) {
+    std::vector<OprBlock*> ready;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      v->pending_write = nullptr;
+      v->version.fetch_add(1);
+      while (!v->queue.empty()) {
+        auto [is_write, opr] = v->queue.front();
+        if (is_write) {
+          if (v->num_pending_reads == 0 && v->pending_write == nullptr) {
+            v->queue.pop_front();
+            v->pending_write = opr;
+            ready.push_back(opr);
+          }
+          break;
+        }
+        v->queue.pop_front();
+        ++v->num_pending_reads;
+        ready.push_back(opr);
+      }
+    }
+    OnDepsResolved(ready);
+  }
+
+  void OnDepsResolved(const std::vector<OprBlock*>& oprs) {
+    for (OprBlock* opr : oprs) {
+      if (opr->wait.fetch_sub(1) == 1) Dispatch(opr);
+    }
+  }
+
+  void Dispatch(OprBlock* opr) {
+    {
+      std::lock_guard<std::mutex> lk(heap_mu_);
+      heap_.push(opr);
+    }
+    heap_cv_.notify_one();
+  }
+
+  void WorkerLoop() {
+    while (true) {
+      OprBlock* opr = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(heap_mu_);
+        heap_cv_.wait(lk, [this] { return shutdown_ || !heap_.empty(); });
+        if (shutdown_ && heap_.empty()) return;
+        opr = heap_.top();
+        heap_.pop();
+      }
+      opr->fn(opr->ctx);
+      for (Var* v : opr->const_vars) CompleteRead(v);
+      for (Var* v : opr->mutable_vars) CompleteWrite(v);
+      delete opr;
+      if (pending_.fetch_sub(1) == 1) {
+        std::lock_guard<std::mutex> lk(pending_mu_);
+        pending_cv_.notify_all();
+      }
+    }
+  }
+
+  int num_workers_;
+  std::vector<std::thread> workers_;
+  std::priority_queue<OprBlock*, std::vector<OprBlock*>, OprCompare> heap_;
+  std::mutex heap_mu_;
+  std::condition_variable heap_cv_;
+  bool shutdown_ = false;
+  std::atomic<uint64_t> seq_{0};
+  std::atomic<int> pending_{0};
+  std::mutex pending_mu_;
+  std::condition_variable pending_cv_;
+  std::mutex vars_mu_;
+  std::vector<Var*> vars_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* mxtpu_engine_create(int num_workers) { return new Engine(num_workers); }
+
+void mxtpu_engine_destroy(void* e) { delete static_cast<Engine*>(e); }
+
+void* mxtpu_engine_new_var(void* e) {
+  return static_cast<Engine*>(e)->NewVar();
+}
+
+void mxtpu_engine_push(void* e, void (*fn)(void*), void* ctx, void** cvars,
+                       int n_const, void** mvars, int n_mut, int priority) {
+  static_cast<Engine*>(e)->Push(fn, ctx, reinterpret_cast<Var**>(cvars),
+                                n_const, reinterpret_cast<Var**>(mvars),
+                                n_mut, priority);
+}
+
+void mxtpu_engine_wait_all(void* e) {
+  static_cast<Engine*>(e)->WaitForAll();
+}
+
+uint64_t mxtpu_engine_var_version(void* e, void* v) {
+  return static_cast<Engine*>(e)->VarVersion(static_cast<Var*>(v));
+}
+
+}  // extern "C"

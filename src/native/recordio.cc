@@ -1,0 +1,91 @@
+// Native recordio codec (re-design of dmlc-core recordio as used by the
+// reference's src/io — SURVEY §2.10). Binary layout matches
+// mxnet_tpu/recordio.py: magic(u32) len(u32) payload pad4.
+//
+// Exposed as a C ABI for ctypes (the reference exposed recordio through
+// the MX C API, c_api.cc recordio section).
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+struct Writer {
+  FILE* fp;
+};
+
+struct Reader {
+  FILE* fp;
+  std::vector<uint8_t> buf;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* mxtpu_recio_writer_open(const char* path) {
+  FILE* fp = std::fopen(path, "wb");
+  if (!fp) return nullptr;
+  return new Writer{fp};
+}
+
+// Returns byte offset of the record, or -1 on error.
+long long mxtpu_recio_write(void* handle, const uint8_t* data, uint64_t len) {
+  Writer* w = static_cast<Writer*>(handle);
+  long long off = std::ftell(w->fp);
+  uint32_t header[2] = {kMagic, static_cast<uint32_t>(len & kLenMask)};
+  if (std::fwrite(header, sizeof(header), 1, w->fp) != 1) return -1;
+  if (len && std::fwrite(data, 1, len, w->fp) != len) return -1;
+  uint64_t pad = (4 - len % 4) % 4;
+  if (pad) {
+    const char zeros[4] = {0, 0, 0, 0};
+    if (std::fwrite(zeros, 1, pad, w->fp) != pad) return -1;
+  }
+  return off;
+}
+
+void mxtpu_recio_writer_close(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  std::fclose(w->fp);
+  delete w;
+}
+
+void* mxtpu_recio_reader_open(const char* path) {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) return nullptr;
+  return new Reader{fp, {}};
+}
+
+void mxtpu_recio_reader_seek(void* handle, uint64_t offset) {
+  Reader* r = static_cast<Reader*>(handle);
+  std::fseek(r->fp, static_cast<long>(offset), SEEK_SET);
+}
+
+// Reads the next record. Returns length (>=0) and sets *out to an internal
+// buffer valid until the next call; returns -1 at EOF, -2 on corruption.
+long long mxtpu_recio_read(void* handle, const uint8_t** out) {
+  Reader* r = static_cast<Reader*>(handle);
+  uint32_t header[2];
+  if (std::fread(header, sizeof(header), 1, r->fp) != 1) return -1;
+  if (header[0] != kMagic) return -2;
+  uint64_t len = header[1] & kLenMask;
+  r->buf.resize(len);
+  if (len && std::fread(r->buf.data(), 1, len, r->fp) != len) return -2;
+  uint64_t pad = (4 - len % 4) % 4;
+  if (pad) std::fseek(r->fp, static_cast<long>(pad), SEEK_CUR);
+  *out = r->buf.data();
+  return static_cast<long long>(len);
+}
+
+void mxtpu_recio_reader_close(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  std::fclose(r->fp);
+  delete r;
+}
+
+}  // extern "C"

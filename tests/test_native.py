@@ -1,0 +1,130 @@
+"""Native C++ runtime tests: dependency engine (vs serial oracle, like the
+reference's tests/cpp/threaded_engine_test.cc) and recordio codec
+cross-compatibility with the Python implementation."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import engine as eng
+from mxnet_tpu._native_lib import get_lib
+
+pytestmark = pytest.mark.skipif(get_lib() is None,
+                                reason="native library unavailable")
+
+
+def test_native_engine_vs_serial_oracle():
+    from tests.test_engine import _random_workload, _run_workload
+
+    ops = _random_workload(seed=7, num_ops=300)
+    oracle_state, oracle_logs = _run_workload(eng.NaiveEngine(), ops, 10)
+    native = eng.NativeThreadedEngine(num_workers=4)
+    state, logs = _run_workload(native, ops, 10)
+    assert state == oracle_state
+    assert logs == oracle_logs
+
+
+def test_native_engine_write_serialization():
+    engine = eng.NativeThreadedEngine(num_workers=8)
+    v = engine.new_variable()
+    counter = {"x": 0, "max_in_flight": 0}
+    lock = threading.Lock()
+
+    def writer():
+        with lock:
+            counter["x"] += 1
+            counter["max_in_flight"] = max(counter["max_in_flight"],
+                                           counter["x"])
+        with lock:
+            counter["x"] -= 1
+
+    for _ in range(200):
+        engine.push(writer, mutable_vars=[v])
+    engine.wait_for_all()
+    assert counter["max_in_flight"] == 1
+
+
+def test_native_engine_error_propagation():
+    engine = eng.NativeThreadedEngine(num_workers=2)
+
+    def boom():
+        raise ValueError("boom")
+    engine.push(boom)
+    with pytest.raises(ValueError, match="boom"):
+        engine.wait_for_all()
+    # engine still usable after the error
+    out = []
+    engine.push(lambda: out.append(1))
+    engine.wait_for_all()
+    assert out == [1]
+
+
+def test_native_recordio_python_interop(tmp_path):
+    """Files written natively must read back through pure Python and vice
+    versa (same on-disk format)."""
+    from mxnet_tpu import recordio as rio
+
+    payloads = [b"alpha", b"", b"x" * 1001, b"tail"]
+
+    native_path = str(tmp_path / "native.rec")
+    w = rio.MXRecordIO(native_path, "w")
+    assert w._h is not None, "native path not active"
+    offs = [w.write(p) for p in payloads]
+    w.close()
+    assert offs[0] == 0 and offs[1] > offs[0]
+
+    # read with pure python
+    os.environ["MXNET_TPU_NO_NATIVE"] = "1"
+    try:
+        import mxnet_tpu._native_lib as nl
+
+        saved = (nl._lib, nl._tried)
+        nl._lib, nl._tried = None, True
+        r = rio.MXRecordIO(native_path, "r")
+        assert r._h is None
+        got = []
+        while True:
+            rec = r.read()
+            if rec is None:
+                break
+            got.append(rec)
+        r.close()
+        assert got == payloads
+
+        # write with pure python, read natively
+        py_path = str(tmp_path / "py.rec")
+        w2 = rio.MXRecordIO(py_path, "w")
+        for p in payloads:
+            w2.write(p)
+        w2.close()
+    finally:
+        nl._lib, nl._tried = saved
+        del os.environ["MXNET_TPU_NO_NATIVE"]
+
+    r2 = rio.MXRecordIO(py_path, "r")
+    assert r2._h is not None
+    got2 = []
+    while True:
+        rec = r2.read()
+        if rec is None:
+            break
+        got2.append(rec)
+    r2.close()
+    assert got2 == payloads
+
+
+def test_native_indexed_recordio(tmp_path):
+    from mxnet_tpu import recordio as rio
+
+    path = str(tmp_path / "x.rec")
+    idx_path = str(tmp_path / "x.idx")
+    w = rio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(20):
+        w.write_idx(i, ("payload-%d" % i).encode())
+    w.close()
+    r = rio.MXIndexedRecordIO(idx_path, path, "r")
+    assert r.read_idx(13) == b"payload-13"
+    assert r.read_idx(0) == b"payload-0"
+    assert r.read_idx(19) == b"payload-19"
+    r.close()
