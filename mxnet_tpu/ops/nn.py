@@ -58,6 +58,14 @@ class FullyConnected(Operator):
         data = inputs[0]
         w = inputs[1]
         x = data.reshape((data.shape[0], -1))
+        from ..base import getenv
+
+        if getenv("MXNET_TPU_PALLAS", False):
+            from .pallas_kernels import fused_linear
+
+            out = fused_linear(x, w, None if self.no_bias else inputs[2])
+            if out is not None:
+                return [out], []
         out = jnp.dot(x, w.T)
         if not self.no_bias:
             out = out + inputs[2]

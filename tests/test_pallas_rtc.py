@@ -1,0 +1,116 @@
+"""Pallas kernel + RTC tests (interpret mode on CPU; the same code paths
+compile natively on TPU)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.ops.pallas_kernels import fused_linear, pallas_available
+
+pytestmark = pytest.mark.skipif(not pallas_available(),
+                                reason="pallas unavailable")
+
+
+def test_fused_linear_matches_xla():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(128, 256).astype(np.float32))
+    w = jnp.asarray(rng.randn(128, 256).astype(np.float32))
+    b = jnp.asarray(rng.randn(128).astype(np.float32))
+    out = fused_linear(x, w, b)
+    assert out is not None
+    expected = np.asarray(x) @ np.asarray(w).T + np.asarray(b)
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4,
+                               atol=1e-4)
+    # fused relu epilogue
+    out_relu = fused_linear(x, w, b, act="relu")
+    np.testing.assert_allclose(np.asarray(out_relu),
+                               np.maximum(expected, 0), rtol=1e-4, atol=1e-4)
+
+
+def test_fused_linear_gradients():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(128, 128).astype(np.float32))
+    w = jnp.asarray(rng.randn(128, 128).astype(np.float32))
+    b = jnp.asarray(rng.randn(128).astype(np.float32))
+
+    def loss_pallas(x, w, b):
+        return jnp.sum(fused_linear(x, w, b, act="relu") ** 2)
+
+    def loss_ref(x, w, b):
+        return jnp.sum(jnp.maximum(x @ w.T + b, 0) ** 2)
+
+    g1 = jax.grad(loss_pallas, argnums=(0, 1, 2))(x, w, b)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(x, w, b)
+    for a, e in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e), rtol=1e-3,
+                                   atol=1e-3)
+
+
+def test_fused_linear_misaligned_falls_back():
+    import jax.numpy as jnp
+
+    x = jnp.zeros((5, 7), jnp.float32)
+    w = jnp.zeros((3, 7), jnp.float32)
+    assert fused_linear(x, w) is None
+
+
+def test_fc_op_pallas_path():
+    os.environ["MXNET_TPU_PALLAS"] = "1"
+    try:
+        data = sym.Variable("data")
+        fc = sym.FullyConnected(data=data, num_hidden=128, name="fc")
+        rng = np.random.RandomState(0)
+        x = rng.randn(128, 256).astype(np.float32)
+        w = rng.randn(128, 256).astype(np.float32)
+        b = rng.randn(128).astype(np.float32)
+        ex = fc.bind(mx.cpu(), {"data": mx.nd.array(x),
+                                "fc_weight": mx.nd.array(w),
+                                "fc_bias": mx.nd.array(b)}, grad_req="null")
+        out = ex.forward()[0].asnumpy()
+        np.testing.assert_allclose(out, x @ w.T + b, rtol=1e-4, atol=1e-4)
+    finally:
+        del os.environ["MXNET_TPU_PALLAS"]
+
+
+def test_rtc_kernel():
+    from mxnet_tpu.rtc import Rtc
+
+    x = mx.nd.array(np.arange(64, dtype=np.float32).reshape(8, 8))
+    y = mx.nd.ones((8, 8))
+    out = mx.nd.zeros((8, 8))
+    rtc = Rtc("axpy", [("x", x), ("y", y)], [("out", out)],
+              "out_ref[:] = 2.0 * x_ref[:] + y_ref[:]")
+    rtc.push([x, y], [out])
+    np.testing.assert_allclose(out.asnumpy(),
+                               2 * x.asnumpy() + 1, rtol=1e-6)
+
+
+def test_rtc_multiline_kernel():
+    from mxnet_tpu.rtc import Rtc
+
+    x = mx.nd.array(np.random.randn(16, 16).astype(np.float32))
+    out = mx.nd.zeros((16, 16))
+    rtc = Rtc("gelu_ish",
+              [("x", x)], [("out", out)],
+              "v = x_ref[:]\n"
+              "out_ref[:] = v * jax.nn.sigmoid(1.702 * v)")
+    rtc.push([x], [out])
+    v = x.asnumpy()
+    np.testing.assert_allclose(out.asnumpy(),
+                               v / (1 + np.exp(-1.702 * v)), rtol=1e-4)
+
+
+def test_rtc_bad_source():
+    from mxnet_tpu.rtc import Rtc
+
+    x = mx.nd.ones((4, 4))
+    out = mx.nd.zeros((4, 4))
+    with pytest.raises(Exception):
+        Rtc("bad", [("x", x)], [("out", out)], "this is not python !!!")
