@@ -54,21 +54,24 @@ class Context:
 
     # -- jax resolution ----------------------------------------------------
     def jax_device(self):
-        """Resolve to a concrete jax.Device."""
+        """Resolve to a concrete jax.Device. Only THIS process's
+        (addressable) devices are eligible — under jax.distributed,
+        ``jax.devices()`` is global and would hand other hosts' devices
+        out (reference analogue: a worker only drives its own GPUs)."""
         import jax
 
         if self.device_type in ("cpu", "cpu_pinned"):
             try:
-                devs = jax.devices("cpu")
+                devs = [d for d in jax.local_devices(backend="cpu")]
             except RuntimeError:
                 # cpu backend unavailable under some plugins: fall back to
                 # default platform devices (functionally equivalent for tests)
-                devs = jax.devices()
+                devs = jax.local_devices()
         else:
             devs = _accelerator_devices()
             if not devs:
                 # graceful degradation like the reference's CPU fallback
-                devs = jax.devices()
+                devs = jax.local_devices()
         if self.device_id >= len(devs):
             raise MXNetError(
                 "%s: device_id out of range (%d devices visible)" % (self, len(devs)))
@@ -87,8 +90,7 @@ class Context:
 def _accelerator_devices() -> List:
     import jax
 
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
-    return devs
+    return [d for d in jax.local_devices() if d.platform != "cpu"]
 
 
 def cpu(device_id: int = 0) -> Context:

@@ -178,6 +178,49 @@ class KVStore:
             self._updater.set_states(f.read())
 
 
+class KVStoreDist(KVStore):
+    """Multi-process synchronous store (reference ``kvstore_dist.h`` +
+    server tier): push reduces locally then all-reduces across worker
+    processes via jax collectives; every worker runs the updater on the
+    identical reduced gradient, so weights stay consistent without a
+    server (the reference's server-side optimizer becomes a replicated
+    worker-side update). init broadcasts rank-0 values (reference
+    ``kvstore_dist.h:58-76``). ``dist_async`` is accepted but behaves
+    synchronously — documented divergence (no TPU analogue of ps-lite
+    async push)."""
+
+    def __init__(self, kv_type: str = "dist_sync"):
+        super().__init__(kv_type)
+        from .parallel import distributed as dist
+
+        dist.init_distributed()
+        self._dist = dist
+
+    def init(self, key, value):
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            if k in self._store:
+                raise MXNetError("key %s already initialized" % k)
+            v = vlist[0]
+            synced = self._dist.broadcast_np(v.asnumpy())
+            arr = v.copyto(v.context)
+            arr[:] = synced
+            self._store[k] = arr
+
+    def _reduce(self, vlist):
+        from .ndarray import array as nd_array
+
+        local = super()._reduce(vlist)
+        if self.num_workers <= 1:
+            return local
+        reduced = self._dist.all_reduce_np(local.asnumpy())
+        return nd_array(reduced, ctx=local.context)
+
+    def barrier(self):
+        self._dist.barrier()
+
+
 class TPUSyncKVStore(KVStore):
     """``tpu_sync`` / ``device``: reduce across device-resident shards with
     a single fused computation; the transfer rides ICI on real hardware."""
@@ -202,8 +245,7 @@ def create(name: str = "local") -> KVStore:
     if "tpu" in lname or "device" in lname:
         return TPUSyncKVStore(lname)
     if "dist" in lname:
-        kv = KVStore(lname)
-        return kv
+        return KVStoreDist(lname)
     if lname in ("local", "local_update_cpu", "local_allreduce_cpu"):
         return KVStore(lname)
     raise MXNetError("unknown kvstore type %s" % name)

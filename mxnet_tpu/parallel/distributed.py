@@ -1,0 +1,98 @@
+"""Multi-host distributed backend.
+
+TPU-native replacement for the reference's ps-lite tier (SURVEY §2.5:
+``ps::KVWorker/KVServer/Postoffice`` + dmlc_tracker): every process is a
+worker in a ``jax.distributed`` job; gradients synchronize with XLA
+collectives over ICI (intra-slice) / DCN (cross-slice) instead of
+parameter-server RPC.
+
+Bootstrapping matches ``tools/launch.py``: the launcher exports
+``MXTPU_COORDINATOR`` / ``MXTPU_NUM_WORKERS`` / ``MXTPU_WORKER_RANK``
+(reference ``DMLC_PS_ROOT_*`` / ``DMLC_ROLE`` / worker id) and each
+process calls :func:`init_distributed` (or it happens automatically on
+``kvstore.create('dist_sync')``).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..base import MXNetError, getenv
+
+__all__ = ["init_distributed", "is_initialized", "rank", "num_workers",
+           "barrier", "all_reduce_np", "broadcast_np"]
+
+_initialized = False
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> bool:
+    """Initialize jax.distributed from args or launcher env. Returns True
+    if a multi-process job was joined, False for single-process."""
+    global _initialized
+    if _initialized:
+        return True
+    import jax
+
+    coordinator = coordinator or os.environ.get("MXTPU_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("MXTPU_NUM_WORKERS", "0") or 0)
+    if process_id is None:
+        process_id = int(os.environ.get("MXTPU_WORKER_RANK", "0") or 0)
+    if not coordinator or num_processes <= 1:
+        return False
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+    return True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def rank() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def num_workers() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def barrier(name: str = "mxtpu_barrier"):
+    if num_workers() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def all_reduce_np(arr: np.ndarray) -> np.ndarray:
+    """Sum a host numpy array across all processes (the dist kvstore
+    reduce). Uses a psum over one device per process."""
+    if num_workers() <= 1:
+        return arr
+    import jax
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(np.asarray(arr))
+    return np.asarray(gathered).sum(axis=0)
+
+
+def broadcast_np(arr: np.ndarray, root: int = 0) -> np.ndarray:
+    """Broadcast rank-root's array to all processes (reference kvstore
+    init broadcast, kvstore_dist.h:58-76)."""
+    if num_workers() <= 1:
+        return arr
+    from jax.experimental import multihost_utils
+
+    return np.asarray(
+        multihost_utils.broadcast_one_to_all(np.asarray(arr)))
