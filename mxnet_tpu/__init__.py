@@ -29,6 +29,9 @@ __version__ = "0.1.0"
 from . import operator        # noqa: E402  (registers the Custom op before
 #                                            symbol generates creators)
 from . import symbol          # noqa: E402
+from .ndarray_ops import init_ndarray_ops  # noqa: E402
+
+init_ndarray_ops(ndarray)  # SimpleOp unification: ops usable imperatively
 from . import symbol as sym   # noqa: E402
 from .symbol import Symbol    # noqa: E402
 from . import executor        # noqa: E402

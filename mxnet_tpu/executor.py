@@ -29,7 +29,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
-from .base import MXNetError
+from .base import MXNetError, getenv
 from .context import Context
 from .engine import get_engine
 from .ndarray import NDArray
@@ -196,6 +196,12 @@ class Executor:
         def fwd_train(args, aux, key):
             return eval_graph(args, aux, key, True)
 
+        # MXNET_BACKWARD_DO_MIRROR (reference static_graph.cc:395-439
+        # memonger mirroring): trade FLOPs for memory by rematerializing
+        # the forward during backward — jax.checkpoint is the XLA-native
+        # form of the same trick.
+        do_mirror = getenv("MXNET_BACKWARD_DO_MIRROR", False)
+
         @jax.jit
         def fwd_bwd(args, aux, key, head_grads):
             garr = [args[i] for i in grad_idx]
@@ -207,6 +213,8 @@ class Executor:
                 outs, aux_out = eval_graph(full, aux, key, True)
                 return outs, aux_out
 
+            if do_mirror:
+                f = jax.checkpoint(f)
             (outs, aux_out), vjp = jax.vjp(f, garr, has_aux=False)
             # vjp of (outs, aux_out): zero cotangent for aux_out
             zero_aux = [jax.numpy.zeros_like(a) for a in aux_out]

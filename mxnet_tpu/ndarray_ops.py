@@ -1,0 +1,78 @@
+"""Imperative invocation of registered operators.
+
+The reference's SimpleOp registry (``include/mxnet/operator_util.h:243-481``)
+registers an op once and exposes it BOTH as an NDArray function and a
+symbolic op. Here the same unification: every operator in the registry is
+materialized as ``mx.nd.<OpName>(*ndarrays, **params)`` which applies it
+eagerly (one jit-cached XLA call), mirroring the auto-generation in
+``python/mxnet/ndarray.py:1127-1306``.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .base import MXNetError
+from .ndarray import NDArray, _new_from
+from .ops import OP_REGISTRY
+from .ops.registry import OpContext
+
+__all__ = ["init_ndarray_ops"]
+
+
+def _make_imperative(op_name: str):
+    cls = OP_REGISTRY.get(op_name)
+
+    def fn(*args, **params):
+        is_train = params.pop("is_train", False)
+        op = cls(**params)
+        arg_names = op.list_arguments()
+        if len(args) != len(arg_names):
+            raise MXNetError("%s expects inputs %s, got %d arrays"
+                             % (op_name, arg_names, len(args)))
+        if op.list_auxiliary_states():
+            raise MXNetError(
+                "%s has auxiliary states; use the symbolic API" % op_name)
+        arrays = [a if isinstance(a, NDArray) else None for a in args]
+        if any(a is None for a in arrays):
+            raise MXNetError("%s: inputs must be NDArrays" % op_name)
+
+        rng = None
+        if is_train or not arg_names:  # sampling ops need a key
+            from . import random as _random
+
+            rng = _random.next_key()
+
+        if not arrays:
+            # zero-input ops (samplers): run directly
+            outs, _ = op.apply(OpContext(is_train, rng), [], [])
+            res = [NDArray(o) for o in outs]
+            return res[0] if len(res) == 1 else res
+
+        def compute(*datas):
+            outs, _ = op.apply(OpContext(is_train, rng), list(datas), [])
+            return outs
+        first = arrays[0]
+        out_holder: List[NDArray] = []
+
+        # evaluate once to know the output count, routed via the engine
+        import jax
+
+        results = compute(*[a._data for a in arrays])
+        res_nd = [NDArray(o, ctx=first._ctx) for o in results]
+        return res_nd[0] if len(res_nd) == 1 else res_nd
+
+    fn.__name__ = op_name
+    fn.__doc__ = cls.__doc__ or "Imperative %s." % op_name
+    return fn
+
+
+def init_ndarray_ops(nd_module):
+    """Populate the nd namespace with imperative op functions (skipping
+    names already hand-defined there, e.g. the reduce/unary zoo)."""
+    done = set()
+    for _, cls in list(OP_REGISTRY.items()):
+        for name in (cls.op_name,) + getattr(cls, "op_aliases", ()):
+            if name in done or hasattr(nd_module, name):
+                continue
+            done.add(name)
+            setattr(nd_module, name, _make_imperative(name))

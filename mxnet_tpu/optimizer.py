@@ -143,6 +143,12 @@ class SGD(Optimizer):
         get_engine().push(_do, const_vars=[grad._var], mutable_vars=muts)
 
 
+@register("ccsgd")
+class ccSGD(SGD):
+    """Alias of SGD kept for reference-script compatibility (the
+    reference's C++-side ccSGD, optimizer.py:426)."""
+
+
 @register("nag")
 class NAG(SGD):
     """Nesterov accelerated gradient (reference optimizer.py:313)."""

@@ -1,0 +1,7 @@
+"""Plugin bridges (reference ``plugin/``: torch, caffe, warpctc, ...).
+
+Available here: the torch bridge (``plugin/torch`` modernized to PyTorch).
+The caffe/warpctc/sframe plugins have no usable host libraries in this
+environment and are intentionally absent.
+"""
+from . import torch_bridge  # noqa: F401

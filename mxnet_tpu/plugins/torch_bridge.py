@@ -1,0 +1,127 @@
+"""Torch bridge plugin.
+
+Re-design of the reference's torch plugin (``plugin/torch/
+torch_module-inl.h``, ``torch_criterion-inl.h``, ``python/mxnet/torch.py``
+— which bridged Lua Torch modules/criterions into the graph): here any
+**PyTorch** ``nn.Module`` (CPU) becomes a symbolic op. Forward runs the
+module under ``torch.enable_grad`` inside a host callback; backward
+re-runs it and uses ``torch.autograd.grad`` — wired into the XLA graph by
+the CustomOp machinery (host callbacks + custom_vjp).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Callable, List, Sequence
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import operator as mop
+
+__all__ = ["torch_module", "torch_criterion"]
+
+_uid = itertools.count()
+
+
+def _make_prop(module_factory: Callable, n_inputs: int, infer_shape_fn):
+    class _TorchProp(mop.CustomOpProp):
+        def __init__(self, **_kw):
+            super().__init__(need_top_grad=True)
+            self._module = module_factory()
+
+        def list_arguments(self):
+            return ["data%d" % i for i in range(n_inputs)] \
+                if n_inputs > 1 else ["data"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [infer_shape_fn(in_shape)], []
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            module = self._module
+
+            class _TorchOp(mop.CustomOp):
+                def _run(self, arrays, need_grad):
+                    import torch
+
+                    tens = [torch.from_numpy(np.ascontiguousarray(a))
+                            .requires_grad_(need_grad) for a in arrays]
+                    with torch.enable_grad() if need_grad \
+                            else torch.no_grad():
+                        out = module(*tens)
+                    return tens, out
+
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    _, out = self._run([x.asnumpy() for x in in_data], False)
+                    self.assign(out_data[0], req[0], out.detach().numpy())
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    import torch
+
+                    tens, out = self._run([x.asnumpy() for x in in_data],
+                                          True)
+                    g = torch.from_numpy(
+                        np.ascontiguousarray(out_grad[0].asnumpy()))
+                    grads = torch.autograd.grad(out, tens, g,
+                                                allow_unused=True)
+                    for dst, r, gr, t in zip(in_grad, req, grads, tens):
+                        self.assign(dst, r,
+                                    gr.numpy() if gr is not None
+                                    else np.zeros(t.shape, np.float32))
+            return _TorchOp()
+    return _TorchProp
+
+
+def torch_module(module_factory: Callable, data, n_inputs: int = 1,
+                 infer_shape_fn=None, name=None):
+    """Wrap a PyTorch module as a symbol (reference ``mx.sym.TorchModule``).
+
+    ``module_factory`` builds the (CPU) torch module; its parameters are
+    owned torch-side (reference torch plugin semantics: the module carries
+    its own weights). ``infer_shape_fn(in_shapes) -> out_shape`` defaults
+    to same-as-first-input.
+    """
+    from .. import symbol as sym_mod
+
+    if infer_shape_fn is None:
+        infer_shape_fn = lambda in_shapes: in_shapes[0]  # noqa: E731
+    reg_name = "_torch_module_%d" % next(_uid)
+    mop.register(reg_name)(_make_prop(module_factory, n_inputs,
+                                      infer_shape_fn))
+    kwargs = {"op_type": reg_name}
+    if name is not None:
+        kwargs["name"] = name
+    if isinstance(data, (list, tuple)):
+        for i, d in enumerate(data):
+            kwargs["data%d" % i if len(data) > 1 else "data"] = d
+    else:
+        kwargs["data"] = data
+    return getattr(sym_mod, "Custom")(**kwargs)
+
+
+def torch_criterion(criterion_factory: Callable, data, label, name=None):
+    """Wrap a torch loss (reference ``mx.sym.TorchCriterion``): forward
+    emits the scalar loss; backward is d(loss)/d(data), label gets zero
+    grad."""
+    from .. import symbol as sym_mod
+
+    def factory():
+        import torch
+
+        crit = criterion_factory()
+
+        class _Wrap(torch.nn.Module):
+            def forward(self, data, label):
+                return crit(data, label).reshape(1)
+        return _Wrap()
+
+    reg_name = "_torch_criterion_%d" % next(_uid)
+    mop.register(reg_name)(
+        _make_prop(factory, 2, lambda in_shapes: [1]))
+    kwargs = {"op_type": reg_name, "data0": data, "data1": label}
+    if name is not None:
+        kwargs["name"] = name
+    return getattr(sym_mod, "Custom")(**kwargs)

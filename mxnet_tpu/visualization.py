@@ -58,7 +58,9 @@ def print_summary(symbol, shape: Optional[Dict] = None, line_length: int = 120,
                 if jn["op"] == "null" and (
                         jn["name"].endswith("weight") or jn["name"].endswith("bias")
                         or jn["name"].endswith("gamma") or jn["name"].endswith("beta")):
-                    s = shape_dict.get(jn["name"] + "_output")
+                    # variable outputs are listed under their bare name
+                    s = shape_dict.get(jn["name"]) or \
+                        shape_dict.get(jn["name"] + "_output")
                     if s:
                         n = 1
                         for d in s:

@@ -1,0 +1,150 @@
+"""Torch plugin bridge + imperative op unification + monitor/viz/remat
+coverage."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def test_torch_module_forward_backward():
+    torch = pytest.importorskip("torch")
+    from mxnet_tpu.plugins.torch_bridge import torch_module
+
+    lin = torch.nn.Linear(4, 4, bias=False)
+    with torch.no_grad():
+        lin.weight.copy_(torch.eye(4) * 2.0)
+
+    data = sym.Variable("data")
+    out = torch_module(lambda: lin, data, name="t0") * 1.0
+    x = np.random.randn(3, 4).astype(np.float32)
+    g = mx.nd.zeros((3, 4))
+    ex = out.bind(mx.cpu(), {"data": mx.nd.array(x)}, args_grad={"data": g})
+    ex.forward(is_train=True)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), 2 * x, rtol=1e-5)
+    ex.backward()
+    np.testing.assert_allclose(g.asnumpy(), np.full((3, 4), 2.0), rtol=1e-5)
+
+
+def test_torch_criterion():
+    torch = pytest.importorskip("torch")
+    from mxnet_tpu.plugins.torch_bridge import torch_criterion
+
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    loss = torch_criterion(lambda: torch.nn.MSELoss(), data, label,
+                           name="mse")
+    x = np.array([[1.0, 2.0]], dtype=np.float32)
+    y = np.array([[0.0, 0.0]], dtype=np.float32)
+    gx = mx.nd.zeros((1, 2))
+    ex = loss.bind(mx.cpu(), {"data": mx.nd.array(x), "label": mx.nd.array(y)},
+                   args_grad={"data": gx},
+                   grad_req={"data": "write", "label": "null"})
+    ex.forward(is_train=True)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), [2.5], rtol=1e-5)
+    ex.backward()
+    np.testing.assert_allclose(gx.asnumpy(), x, rtol=1e-5)  # d(mse)/dx = x
+
+
+def test_imperative_ops_unified():
+    """SimpleOp parity: registered symbolic ops callable from mx.nd."""
+    x = mx.nd.array(np.random.randn(2, 6).astype(np.float32))
+    out = mx.nd.SliceChannel(x, num_outputs=3, axis=1)
+    assert isinstance(out, list) and len(out) == 3
+    np.testing.assert_allclose(out[0].asnumpy(), x.asnumpy()[:, :2])
+
+    f = mx.nd.Flatten(mx.nd.array(np.ones((2, 3, 4), np.float32)))
+    assert f.shape == (2, 12)
+
+    a = mx.nd.array(np.random.rand(4, 4).astype(np.float32))
+    sm = mx.nd.SoftmaxActivation(a)
+    np.testing.assert_allclose(sm.asnumpy().sum(axis=1), np.ones(4),
+                               rtol=1e-5)
+
+    with pytest.raises(Exception, match="auxiliary"):
+        mx.nd.BatchNorm(a, mx.nd.ones((4,)), mx.nd.zeros((4,)))
+
+
+def test_monitor():
+    net = sym.SoftmaxOutput(sym.FullyConnected(
+        sym.Variable("data"), num_hidden=3, name="fc"), name="sm")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 4))
+    ex.arg_dict["data"][:] = np.random.randn(2, 4)
+    ex.arg_dict["fc_weight"][:] = np.random.randn(3, 4)
+    mon = mx.Monitor(interval=1, pattern=".*fc.*")
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=True)
+    ex.backward()
+    rows = mon.toc()
+    names = [k for _, k, _ in rows]
+    assert any("fc_output" in n for n in names)
+    assert any(n == "fc_weight" for n in names)
+    assert any(n == "fc_weight_grad" for n in names)
+
+
+def test_print_summary(capsys):
+    from mxnet_tpu import models
+
+    net = models.get_mlp(10)
+    mx.viz.print_summary(net, shape={"data": (1, 784)})
+    out = capsys.readouterr().out
+    assert "fc1" in out
+    assert "Total params" in out
+    # 784*128+128 + 128*64+64 + 64*10+10
+    assert str(784 * 128 + 128 + 128 * 64 + 64 + 64 * 10 + 10) in out
+
+
+def test_backward_do_mirror_equivalence():
+    """Remat (the mirroring flag) must not change results."""
+    net = sym.SoftmaxOutput(sym.FullyConnected(
+        sym.Variable("data"), num_hidden=4, name="fc"), name="sm")
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 6).astype(np.float32)
+    w = rng.randn(4, 6).astype(np.float32)
+
+    def run():
+        ex = net.simple_bind(ctx=mx.cpu(), data=(4, 6))
+        ex.arg_dict["data"][:] = x
+        ex.arg_dict["fc_weight"][:] = w
+        ex.arg_dict["sm_label"][:] = np.array([0, 1, 2, 3], np.float32)
+        ex.forward(is_train=True)
+        ex.backward()
+        return ex.grad_dict["fc_weight"].asnumpy()
+
+    g1 = run()
+    os.environ["MXNET_BACKWARD_DO_MIRROR"] = "1"
+    try:
+        g2 = run()
+    finally:
+        del os.environ["MXNET_BACKWARD_DO_MIRROR"]
+    np.testing.assert_allclose(g1, g2, rtol=1e-6)
+
+
+def test_ccsgd_alias():
+    from mxnet_tpu import optimizer as opt
+
+    o = opt.create("ccsgd", learning_rate=0.1)
+    assert isinstance(o, opt.SGD)
+
+
+def test_do_checkpoint_callback(tmp_path):
+    from mxnet_tpu import models
+
+    prefix = str(tmp_path / "cp")
+    rng = np.random.RandomState(0)
+    X = rng.randn(40, 5).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    data = mx.io.NDArrayIter(X, y, batch_size=10)
+    net = sym.SoftmaxOutput(sym.FullyConnected(
+        sym.Variable("data"), num_hidden=2, name="fc"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(data, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    assert os.path.exists(prefix + "-0001.params")
+    assert os.path.exists(prefix + "-0002.params")
+    loaded_sym, arg, aux = mx.model.load_checkpoint(prefix, 2)
+    assert "fc_weight" in arg
