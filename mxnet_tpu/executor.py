@@ -302,6 +302,11 @@ class Executor:
             req = self._grad_req[name]
 
             def _assign(garr=garr, g=g, req=req):
+                import jax.dtypes
+
+                if getattr(g, "dtype", None) == jax.dtypes.float0:
+                    # integer-dtype arg: jax emits a float0 zero-tangent
+                    g = jnp.zeros(g.shape, garr.dtype)
                 garr._data = (garr._data + g.astype(garr.dtype)
                               if req == "add" else g.astype(garr.dtype))
             get_engine().push(_assign, mutable_vars=[garr._var])

@@ -104,6 +104,11 @@ class FeedForward:
 
         label_names = [d.name for d in data_iter.provide_label]
         data_names = [d.name for d in data_iter.provide_data]
+        if not label_names:
+            # label-less iterator (predict): label args stay inputs, not
+            # params (reference names labels <output>_label by convention)
+            label_names = [n for n in self.symbol.list_arguments()
+                           if n.endswith("_label") and n not in data_names]
         mod = Module(self.symbol, data_names=data_names,
                      label_names=label_names, context=self.ctx)
         return mod

@@ -267,19 +267,33 @@ def _expand_reshape(cur_shape, shape):
     return tuple(shape)
 
 
-def _new_from(src: NDArray, fn, reads: Sequence[NDArray], ctx=None, dtype=None) -> NDArray:
-    out = NDArray.__new__(NDArray)
-    out._ctx = ctx or src._ctx
-    out._var = get_engine().new_variable()
-    out.writable = True
-    out._data = None  # type: ignore[assignment]
+def _new_from_multi(ctx, fn, reads: Sequence[NDArray],
+                    n_out: int) -> List[NDArray]:
+    """Engine-ordered op: read ``reads``' vars, write ``n_out`` fresh
+    output NDArrays. ``fn(*datas)`` returns a list of n_out jax arrays."""
+    eng = get_engine()
+    outs = []
+    for _ in range(n_out):
+        o = NDArray.__new__(NDArray)
+        o._ctx = ctx
+        o._var = eng.new_variable()
+        o.writable = True
+        o._data = None  # type: ignore[assignment]
+        outs.append(o)
 
     def _do():
-        out._data = fn(*[r._data for r in reads])
-        return out._data
-    get_engine().push(_do, const_vars=[r._var for r in reads],
-                      mutable_vars=[out._var])
-    return out
+        results = fn(*[r._data for r in reads])
+        for o, r in zip(outs, results):
+            o._data = r
+        return [o._data for o in outs]
+    eng.push(_do, const_vars=[r._var for r in reads],
+             mutable_vars=[o._var for o in outs])
+    return outs
+
+
+def _new_from(src: NDArray, fn, reads: Sequence[NDArray], ctx=None, dtype=None) -> NDArray:
+    return _new_from_multi(ctx or src._ctx,
+                           lambda *datas: [fn(*datas)], reads, 1)[0]
 
 
 def _binary(lhs: NDArray, rhs, fn) -> NDArray:

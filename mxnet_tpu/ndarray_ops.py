@@ -3,16 +3,15 @@
 The reference's SimpleOp registry (``include/mxnet/operator_util.h:243-481``)
 registers an op once and exposes it BOTH as an NDArray function and a
 symbolic op. Here the same unification: every operator in the registry is
-materialized as ``mx.nd.<OpName>(*ndarrays, **params)`` which applies it
-eagerly (one jit-cached XLA call), mirroring the auto-generation in
+materialized as ``mx.nd.<OpName>(*ndarrays, **params)``: one dependency-
+engine op that reads the input vars and writes fresh output vars, applying
+the op's jnp/XLA kernel. Mirrors the auto-generation in
 ``python/mxnet/ndarray.py:1127-1306``.
 """
 from __future__ import annotations
 
-from typing import List
-
 from .base import MXNetError
-from .ndarray import NDArray, _new_from
+from .ndarray import NDArray, _new_from_multi
 from .ops import OP_REGISTRY
 from .ops.registry import OpContext
 
@@ -48,17 +47,15 @@ def _make_imperative(op_name: str):
             res = [NDArray(o) for o in outs]
             return res[0] if len(res) == 1 else res
 
+        # ONE engine op reading the input vars and writing fresh output
+        # vars — imperative ops are ordered by the dependency engine
+        # exactly like NDArray arithmetic, so async-pending inputs are safe
         def compute(*datas):
             outs, _ = op.apply(OpContext(is_train, rng), list(datas), [])
             return outs
-        first = arrays[0]
-        out_holder: List[NDArray] = []
 
-        # evaluate once to know the output count, routed via the engine
-        import jax
-
-        results = compute(*[a._data for a in arrays])
-        res_nd = [NDArray(o, ctx=first._ctx) for o in results]
+        res_nd = _new_from_multi(arrays[0]._ctx, compute, arrays,
+                                 len(op.list_outputs()))
         return res_nd[0] if len(res_nd) == 1 else res_nd
 
     fn.__name__ = op_name

@@ -9,6 +9,14 @@ matmuls).
 """
 from .sharding import (make_mesh, make_param_shardings, shard_args,
                        build_sgd_train_step, ShardingRule)
+from .pipeline import (pipeline_forward, build_pipeline_train_step,
+                       stack_stage_params, sequential_reference)
+from .moe import (moe_ffn_local, moe_reference, init_moe_params,
+                  expert_capacity)
 
 __all__ = ["make_mesh", "make_param_shardings", "shard_args",
-           "build_sgd_train_step", "ShardingRule"]
+           "build_sgd_train_step", "ShardingRule",
+           "pipeline_forward", "build_pipeline_train_step",
+           "stack_stage_params", "sequential_reference",
+           "moe_ffn_local", "moe_reference", "init_moe_params",
+           "expert_capacity"]

@@ -11,11 +11,10 @@ the CustomOp machinery (host callbacks + custom_vjp).
 from __future__ import annotations
 
 import itertools
-from typing import Callable, List, Sequence
+from typing import Callable
 
 import numpy as np
 
-from ..base import MXNetError
 from .. import operator as mop
 
 __all__ = ["torch_module", "torch_criterion"]
@@ -43,17 +42,39 @@ def _make_prop(module_factory: Callable, n_inputs: int, infer_shape_fn):
             module = self._module
 
             class _TorchOp(mop.CustomOp):
+                _seed = 0
+                _is_train = False
+
                 def _run(self, arrays, need_grad):
+                    """Run the module under a forked, seeded torch RNG so
+                    the backward re-run sees the SAME dropout masks as the
+                    forward the user observed."""
                     import torch
 
-                    tens = [torch.from_numpy(np.ascontiguousarray(a))
-                            .requires_grad_(need_grad) for a in arrays]
-                    with torch.enable_grad() if need_grad \
-                            else torch.no_grad():
-                        out = module(*tens)
+                    # only float tensors can carry grad (int labels etc.
+                    # are handled by autograd.grad(allow_unused=True))
+                    tens = []
+                    for a in arrays:
+                        t = torch.from_numpy(np.ascontiguousarray(a))
+                        if t.is_floating_point():
+                            if need_grad:
+                                t.requires_grad_(True)
+                        else:
+                            # torch criterions want Long targets; jax's
+                            # default int is int32
+                            t = t.long()
+                        tens.append(t)
+                    module.train(self._is_train)
+                    with torch.random.fork_rng(devices=[]):
+                        torch.manual_seed(self._seed)
+                        with torch.enable_grad() if need_grad \
+                                else torch.no_grad():
+                            out = module(*tens)
                     return tens, out
 
                 def forward(self, is_train, req, in_data, out_data, aux):
+                    self._is_train = bool(is_train)
+                    self._seed = int(np.random.randint(1 << 31))
                     _, out = self._run([x.asnumpy() for x in in_data], False)
                     self.assign(out_data[0], req[0], out.detach().numpy())
 
@@ -61,12 +82,26 @@ def _make_prop(module_factory: Callable, n_inputs: int, infer_shape_fn):
                              in_grad, aux):
                     import torch
 
+                    # re-running forward must not double-update stateful
+                    # buffers (BatchNorm running stats)
+                    buffers = {k: v.clone()
+                               for k, v in module.named_buffers()}
                     tens, out = self._run([x.asnumpy() for x in in_data],
                                           True)
                     g = torch.from_numpy(
                         np.ascontiguousarray(out_grad[0].asnumpy()))
-                    grads = torch.autograd.grad(out, tens, g,
-                                                allow_unused=True)
+                    idx = [i for i, t in enumerate(tens)
+                           if t.requires_grad]
+                    got = torch.autograd.grad(out, [tens[i] for i in idx],
+                                              g, allow_unused=True)
+                    # restore AFTER grad (in-place restore would bump
+                    # versions of tensors autograd saved)
+                    with torch.no_grad():
+                        for k, v in module.named_buffers():
+                            v.copy_(buffers[k])
+                    grads = [None] * len(tens)
+                    for i, gr in zip(idx, got):
+                        grads[i] = gr
                     for dst, r, gr, t in zip(in_grad, req, grads, tens):
                         self.assign(dst, r,
                                     gr.numpy() if gr is not None

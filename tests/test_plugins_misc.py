@@ -148,3 +148,75 @@ def test_do_checkpoint_callback(tmp_path):
     assert os.path.exists(prefix + "-0002.params")
     loaded_sym, arg, aux = mx.model.load_checkpoint(prefix, 2)
     assert "fc_weight" in arg
+
+
+def test_torch_bridge_int_label_criterion():
+    """Integer labels: no requires_grad on int tensors, int32→Long cast,
+    float0 label grad mapped to zeros."""
+    torch = pytest.importorskip("torch")
+    from mxnet_tpu.plugins.torch_bridge import torch_criterion
+
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    loss = torch_criterion(lambda: torch.nn.CrossEntropyLoss(), data,
+                           label, name="ce_int")
+    ex = loss.simple_bind(mx.cpu(), data=(4, 3), label=(4,),
+                          type_dict={"label": np.int64})
+    ex.arg_dict["data"][:] = np.random.rand(4, 3).astype(np.float32)
+    ex.arg_dict["label"][:] = np.array([0, 1, 2, 0])
+    ex.forward(is_train=True)
+    ex.backward()
+    assert np.abs(ex.grad_dict["data"].asnumpy()).sum() > 0
+    np.testing.assert_allclose(ex.grad_dict["label"].asnumpy(),
+                               np.zeros(4))
+
+
+def test_torch_bridge_stateful_module_consistency():
+    """Dropout masks must match between forward and the backward re-run,
+    eval mode must disable dropout, and BatchNorm running stats must not
+    be double-updated by backward."""
+    torch = pytest.importorskip("torch")
+    from mxnet_tpu.plugins.torch_bridge import torch_module
+
+    data = sym.Variable("data")
+    net = torch_module(lambda: torch.nn.Dropout(0.5), data,
+                       name="torchdrop")
+    ex = net.simple_bind(mx.cpu(), data=(64, 8),
+                         grad_req={"data": "write"})
+    x = np.random.rand(64, 8).astype(np.float32) + 1.0
+    ex.arg_dict["data"][:] = x
+    ex.forward(is_train=True)
+    ex.backward(mx.nd.ones((64, 8)))
+    out = ex.outputs[0].asnumpy()
+    grad = ex.grad_dict["data"].asnumpy()
+    # same mask: grad is 2 exactly where output survived, 0 where dropped
+    np.testing.assert_allclose((out != 0).astype(np.float32) * 2.0, grad)
+    assert (out == 0).any()  # dropout actually active in train mode
+
+    # eval mode: dropout off → identity
+    ex.forward(is_train=False)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), x, rtol=1e-6)
+
+    # BatchNorm: backward's re-run must not advance running stats again
+    bn_holder = {}
+
+    def make_bn():
+        bn_holder["m"] = torch.nn.BatchNorm1d(8)
+        return bn_holder["m"]
+
+    net2 = torch_module(make_bn, sym.Variable("data"), name="torchbn")
+    ex2 = net2.simple_bind(mx.cpu(), data=(16, 8),
+                           grad_req={"data": "write"})
+    ex2.arg_dict["data"][:] = np.random.rand(16, 8).astype(np.float32)
+    ex2.forward(is_train=True)
+    _ = ex2.outputs[0].asnumpy()
+    mean_after_fwd = bn_holder["m"].running_mean.clone().numpy()
+    ex2.forward(is_train=True)
+    ex2.backward(mx.nd.ones((16, 8)))
+    _ = ex2.grad_dict["data"].asnumpy()
+    mean_after_bwd = bn_holder["m"].running_mean.clone().numpy()
+    # exactly one more update from the second forward, none from backward
+    expect = mean_after_fwd + 0.1 * (
+        np.asarray(ex2.arg_dict["data"].asnumpy()).mean(0)
+        - mean_after_fwd)
+    np.testing.assert_allclose(mean_after_bwd, expect, rtol=1e-5)

@@ -135,3 +135,56 @@ def test_train_forward_is_lazy():
     assert ex._outputs is None
     ex.backward()
     assert ex._outputs is not None
+
+
+def test_imperative_op_on_async_pending_input():
+    """Registry-generated imperative ops must go through the dependency
+    engine: an input whose compute is still queued (ThreadedEngine) has
+    _data=None and must not crash."""
+    import mxnet_tpu.engine as eng
+
+    old = eng.get_engine()
+    eng.set_engine(eng.ThreadedEngine())
+    try:
+        x = mx.nd.array(np.random.rand(4, 3, 5, 5).astype(np.float32))
+        y = x + 1
+        z = mx.nd.Flatten(y)
+        w = mx.nd.Concat(z, z, num_args=2, dim=1)
+        assert w.shape == (4, 150)
+        np.testing.assert_allclose(
+            w.asnumpy()[:, :75], (x.asnumpy() + 1).reshape(4, 75),
+            rtol=1e-6)
+    finally:
+        eng.set_engine(old)
+
+
+def test_predict_with_labelless_iterator():
+    """FeedForward.predict must not treat the label argument as a missing
+    parameter when the iterator provides no labels."""
+    X = np.random.rand(32, 5).astype(np.float32)
+    y = np.random.randint(0, 2, 32).astype(np.float32)
+    net = sym.SoftmaxOutput(sym.FullyConnected(
+        sym.Variable("data"), num_hidden=2, name="fc"), name="softmax")
+    model = mx.model.FeedForward(net, ctx=mx.cpu(), num_epoch=1,
+                                 learning_rate=0.1)
+    model.fit(X=mx.io.NDArrayIter(X, y, batch_size=8))
+    preds = model.predict(mx.io.NDArrayIter(X, None, batch_size=8))
+    assert preds.shape == (32, 2)
+
+
+def test_backward_grad_for_integer_argument_is_zero():
+    """Integer-dtype args (e.g. int labels) produce float0 jax tangents;
+    backward must map them to zeros, not crash."""
+    data = sym.Variable("data")
+    emb = sym.Embedding(data, input_dim=6, output_dim=3, name="emb")
+    net = sym.MakeLoss(sym.sum(emb * emb))
+    ex = net.simple_bind(mx.cpu(), data=(4,),
+                         type_dict={"data": np.int32},
+                         grad_req={"data": "write", "emb_weight": "write"})
+    ex.arg_dict["data"][:] = np.array([0, 1, 2, 3])
+    ex.arg_dict["emb_weight"][:] = np.random.rand(6, 3).astype(np.float32)
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               np.zeros(4), atol=0)
+    assert np.abs(ex.grad_dict["emb_weight"].asnumpy()).sum() > 0
