@@ -1,0 +1,169 @@
+"""accnn tool: low-rank conv/FC decomposition of a saved model.
+
+Reference analogue: tools/accnn/{acc_conv,acc_fc,rank_selection}.py.
+Full-rank decomposition must reproduce the original outputs exactly
+(up to float error); truncated rank must approximate them.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import accnn  # noqa: E402
+
+
+def _small_net():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=6,
+                             pad=(1, 1), name="conv1")
+    net = mx.sym.Activation(data=net, act_type="relu", name="relu1")
+    net = mx.sym.Flatten(data=net, name="flat")
+    net = mx.sym.FullyConnected(data=net, num_hidden=10, name="fc1")
+    return net
+
+
+def _init_params(sym, data_shape):
+    arg_shapes, _, _ = sym.infer_shape(data=data_shape)
+    rng = np.random.RandomState(3)
+    params = {}
+    for name, shape in zip(sym.list_arguments(), arg_shapes):
+        if name == "data":
+            continue
+        params[name] = mx.nd.array(
+            rng.randn(*shape).astype(np.float32) * 0.1)
+    return params
+
+
+def _forward(sym, params, x):
+    exe = sym.simple_bind(ctx=mx.cpu(), data=x.shape)
+    exe.copy_params_from(params, {})
+    exe.forward(is_train=False, data=x)
+    return exe.outputs[0].asnumpy()
+
+
+def test_decompose_full_rank_exact():
+    data_shape = (2, 4, 8, 8)
+    sym = _small_net()
+    params = _init_params(sym, data_shape)
+    x = np.random.RandomState(0).rand(*data_shape).astype(np.float32)
+    ref = _forward(sym, params, x)
+
+    # full ranks: conv (C*y=12 vs N*x=18) -> 12; fc min(10, D)
+    new_sym, new_params = accnn.decompose_model(
+        sym, params, {"conv1": 12, "fc1": 10})
+    args = new_sym.list_arguments()
+    assert "conv1_v_weight" in args and "conv1_h_weight" in args
+    assert "fc1_red_weight" in args and "fc1_rec_weight" in args
+    assert "conv1_weight" not in args
+    new_params = {k: (v if isinstance(v, mx.nd.NDArray) else mx.nd.array(v))
+                  for k, v in new_params.items()}
+    out = _forward(new_sym, new_params, x)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_decompose_truncated_approximates():
+    data_shape = (2, 4, 8, 8)
+    sym = _small_net()
+    params = _init_params(sym, data_shape)
+    x = np.random.RandomState(1).rand(*data_shape).astype(np.float32)
+    ref = _forward(sym, params, x)
+    new_sym, new_params = accnn.decompose_model(
+        sym, params, {"conv1": 8, "fc1": 6})
+    new_params = {k: (v if isinstance(v, mx.nd.NDArray) else mx.nd.array(v))
+                  for k, v in new_params.items()}
+    out = _forward(new_sym, new_params, x)
+    # truncated: correlated but not exact
+    err = np.abs(out - ref).mean() / (np.abs(ref).mean() + 1e-8)
+    assert err < 0.5
+    assert not np.allclose(out, ref)
+
+
+def test_rank_selection_cost_model():
+    # decomposed cost K*(C*ky + N*kx) <= orig/ratio
+    C, N, ky, kx, ratio = 16, 32, 3, 3, 2.0
+    K = accnn.select_rank_conv(C, N, ky, kx, ratio)
+    assert K >= 1
+    assert K * (C * ky + N * kx) <= N * C * ky * kx / ratio
+    K = accnn.select_rank_fc(256, 128, 4.0)
+    assert K * (256 + 128) <= 256 * 128 / 4.0
+
+
+def test_accnn_cli(tmp_path):
+    data_shape = (1, 4, 8, 8)
+    sym = _small_net()
+    params = _init_params(sym, data_shape)
+    prefix = str(tmp_path / "model")
+    mx.model.save_checkpoint(prefix, 1, sym, params, {})
+    out_prefix = str(tmp_path / "small")
+    accnn.main(["-m", prefix, "--epoch", "1", "--save-model", out_prefix,
+                "--ratio", "1.5", "--data-shape", str(data_shape)])
+    assert os.path.exists(out_prefix + "-symbol.json")
+    ranks = json.load(open(out_prefix + "-ranks.json"))
+    assert "conv1" in ranks and "fc1" in ranks
+    new_sym, new_args, _ = mx.model.load_checkpoint(out_prefix, 0)
+    x = np.random.RandomState(2).rand(*data_shape).astype(np.float32)
+    out = _forward(new_sym, new_args, x)
+    assert out.shape == (1, 10)
+
+
+def test_shared_weight_survives_partial_decompose():
+    # one weight Variable feeding two convs; decompose only one
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("shared_weight")
+    c1 = mx.sym.Convolution(data=data, weight=w, kernel=(3, 3),
+                            num_filter=4, pad=(1, 1), name="ca")
+    c2 = mx.sym.Convolution(data=data, weight=w, kernel=(3, 3),
+                            num_filter=4, pad=(1, 1), name="cb")
+    sym = c1 + c2
+    shape = (1, 4, 6, 6)
+    params = _init_params(sym, shape)
+    new_sym, new_params = accnn.decompose_model(sym, params, {"ca": 12})
+    args = new_sym.list_arguments()
+    assert "shared_weight" in args          # still used by cb
+    assert "ca_v_weight" in args
+    assert "shared_weight" in new_params
+    new_params = {k: (v if isinstance(v, mx.nd.NDArray) else mx.nd.array(v))
+                  for k, v in new_params.items()}
+    x = np.random.RandomState(5).rand(*shape).astype(np.float32)
+    ref = _forward(sym, params, x)
+    out = _forward(new_sym, new_params, x)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_rejects_grouped_and_dilated():
+    import pytest
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=4,
+                           dilate=(2, 2), name="cd")
+    shape = (1, 4, 9, 9)
+    params = _init_params(c, shape)
+    with pytest.raises(ValueError):
+        accnn.decompose_model(c, params, {"cd": 4})
+    # auto_ranks skips it instead of selecting a rank
+    nodes = json.loads(c.tojson())["nodes"]
+    ranks = accnn.auto_ranks(c, nodes, {"data": shape}, 2.0)
+    assert "cd" not in ranks
+
+
+def test_shared_bias_both_decomposed():
+    data = mx.sym.Variable("data")
+    b = mx.sym.Variable("shared_bias")
+    f1 = mx.sym.FullyConnected(data=mx.sym.Flatten(data=data), bias=b,
+                               num_hidden=6, name="fa")
+    f2 = mx.sym.FullyConnected(data=mx.sym.Flatten(data=data), bias=b,
+                               num_hidden=6, name="fb")
+    sym = f1 + f2
+    shape = (2, 3, 4, 4)
+    params = _init_params(sym, shape)
+    new_sym, new_params = accnn.decompose_model(sym, params,
+                                                {"fa": 6, "fb": 6})
+    new_params = {k: (v if isinstance(v, mx.nd.NDArray) else mx.nd.array(v))
+                  for k, v in new_params.items()}
+    x = np.random.RandomState(7).rand(*shape).astype(np.float32)
+    ref = _forward(sym, params, x)
+    out = _forward(new_sym, new_params, x)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)

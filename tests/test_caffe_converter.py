@@ -1,0 +1,160 @@
+"""caffe_converter tool: prototxt text parsing and symbol conversion.
+
+Reference analogue: tools/caffe_converter/convert_symbol.py (prototxt
+NetParameter → mx.symbol script). Here conversion is direct to Symbol.
+"""
+import os
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import caffe_converter  # noqa: E402
+
+LENET = """
+name: "LeNet"
+input: "data"
+input_dim: 2
+input_dim: 1
+input_dim: 28
+input_dim: 28
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  convolution_param { num_output: 8 kernel_size: 5 stride: 1 }
+}
+layer {
+  name: "pool1"
+  type: "Pooling"
+  bottom: "conv1"
+  top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "relu1"
+  type: "ReLU"
+  bottom: "pool1"
+  top: "pool1"
+}
+layer {
+  name: "ip1"
+  type: "InnerProduct"
+  bottom: "pool1"
+  top: "ip1"
+  inner_product_param { num_output: 10 }
+}
+layer {
+  name: "loss"
+  type: "SoftmaxWithLoss"
+  bottom: "ip1"
+  bottom: "label"
+}
+"""
+
+
+def test_parse_prototxt_basic():
+    msg = caffe_converter.parse_prototxt(LENET)
+    assert msg["name"] == "LeNet"
+    assert msg["input"] == "data"
+    assert msg["input_dim"] == [2, 1, 28, 28]
+    layers = msg["layer"]
+    assert len(layers) == 5
+    assert layers[0]["convolution_param"]["num_output"] == 8
+    assert layers[1]["pooling_param"]["pool"] == "MAX"
+
+
+def test_convert_lenet_forward():
+    sym, input_shape = caffe_converter.convert_symbol(LENET)
+    assert input_shape == (2, 1, 28, 28)
+    arg_shapes, out_shapes, _ = sym.infer_shape(data=input_shape)
+    assert out_shapes[0] == (2, 10)
+    # executes end to end
+    exe = sym.simple_bind(ctx=mx.cpu(), data=input_shape)
+    exe.forward(is_train=False,
+                data=np.random.rand(*input_shape).astype(np.float32))
+    out = exe.outputs[0].asnumpy()
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(2), rtol=1e-5)
+
+
+def test_convert_v1_and_eltwise(tmp_path):
+    proto = """
+    input: "data"
+    input_dim: 1 input_dim: 4 input_dim: 8 input_dim: 8
+    layers { name: "c1" type: CONVOLUTION bottom: "data" top: "c1"
+             convolution_param { num_output: 4 kernel_size: 3 pad: 1 } }
+    layers { name: "sum" type: ELTWISE bottom: "data" bottom: "c1"
+             top: "sum" eltwise_param { operation: SUM } }
+    layers { name: "bn" type: BATCHNORM bottom: "sum" top: "bn" }
+    layers { name: "sc" type: SCALE bottom: "bn" top: "bn" }
+    layers { name: "sm" type: SOFTMAX_LOSS bottom: "bn" }
+    """
+    sym, shape = caffe_converter.convert_symbol(proto)
+    assert shape == (1, 4, 8, 8)
+    arg_shapes, out_shapes, aux = sym.infer_shape(data=shape)
+    assert out_shapes[0] == shape  # softmax over channel of same shape
+    # CLI writes loadable symbol json
+    pp = tmp_path / "net.prototxt"
+    pp.write_text(proto)
+    out = caffe_converter.main([str(pp), str(tmp_path / "net")])
+    loaded = mx.sym.load(out)
+    assert loaded.list_arguments() == sym.list_arguments()
+
+
+def test_pair_field_forms():
+    # caffe's three geometry spellings: scalar, repeated, kernel_h/kernel_w
+    assert caffe_converter._pair({"kernel_size": 3}, "kernel_size", 1) == \
+        (3, 3)
+    assert caffe_converter._pair({"kernel_size": [3, 5]}, "kernel_size",
+                                 1) == (3, 5)
+    assert caffe_converter._pair({"kernel_h": 4, "kernel_w": 2},
+                                 "kernel_size", 1) == (4, 2)
+    assert caffe_converter._pair({"stride_h": 2, "stride_w": 1},
+                                 "stride", 1) == (2, 1)
+
+
+def test_pooling_kernel_h_w_and_eltwise_coeff():
+    proto = """
+    input: "data"
+    input_dim: 1 input_dim: 1 input_dim: 9 input_dim: 8
+    layer { name: "p" type: "Pooling" bottom: "data" top: "p"
+            pooling_param { pool: MAX kernel_h: 4 kernel_w: 2
+                            stride_h: 1 stride_w: 2 } }
+    """
+    sym, shape = caffe_converter.convert_symbol(proto)
+    _, out_shapes, _ = sym.infer_shape(data=shape)
+    assert out_shapes[0] == (1, 1, 6, 4)
+
+    # Eltwise with coeff 1,-1 = subtraction
+    proto2 = """
+    input: "data"
+    input_dim: 1 input_dim: 2 input_dim: 4 input_dim: 4
+    layer { name: "d" type: "Eltwise" bottom: "data" bottom: "data"
+            top: "d" eltwise_param { operation: SUM coeff: 1 coeff: -1 } }
+    """
+    sym2, shape2 = caffe_converter.convert_symbol(proto2)
+    exe = sym2.simple_bind(ctx=mx.cpu(), data=shape2)
+    exe.forward(is_train=False,
+                data=np.random.rand(*shape2).astype(np.float32))
+    np.testing.assert_allclose(exe.outputs[0].asnumpy(),
+                               np.zeros(shape2), atol=1e-6)
+
+
+def test_parser_and_pool_errors():
+    import pytest
+    with pytest.raises(ValueError, match="truncated"):
+        caffe_converter.parse_prototxt("name")
+    with pytest.raises(ValueError, match="truncated"):
+        caffe_converter.parse_prototxt("name:")
+    proto = """
+    input: "data"
+    input_dim: 1 input_dim: 1 input_dim: 4 input_dim: 4
+    layer { name: "p" type: "Pooling" bottom: "data" top: "p"
+            pooling_param { pool: STOCHASTIC kernel_size: 2 } }
+    """
+    with pytest.raises(ValueError, match="pool type"):
+        caffe_converter.convert_symbol(proto)

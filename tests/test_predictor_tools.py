@@ -99,3 +99,18 @@ def test_launch_local(tmp_path):
     assert out.returncode == 0, out.stderr
     for r in range(3):
         assert (tmp_path / ("out_%d" % r)).read_text() == "%d/3" % r
+
+
+def test_bandwidth_tool():
+    # in-process: conftest already forced the 8-device CPU platform
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import bandwidth
+    finally:
+        sys.path.pop(0)
+    res = bandwidth.main(["--num-mb", "0.5", "--iters", "2", "--test",
+                          "both"])
+    assert len(res) == 2
+    assert res[0]["devices"] == 8
+    assert res[0]["bus_gb_s"] > 0
+    assert res[1]["bus_gb_s"] > 0

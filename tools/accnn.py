@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""AccNN: accelerate a trained CNN by low-rank factorization.
+
+Equivalent of the reference's ``tools/accnn/`` (accnn.py, acc_conv.py,
+acc_fc.py, rank_selection.py): decompose expensive layers of a saved
+checkpoint into pairs of cheaper layers, preserving the function
+approximately, to cut test-time FLOPs and parameters.
+
+* Convolution ``(N,C,y,x)`` → vertical conv ``(K,C,y,1)`` + horizontal
+  conv ``(N,K,1,x)`` (Jaderberg-style VH decomposition). The 4-D kernel
+  is flattened to a ``(C*y, N*x)`` matrix, SVD'd, and the two factors
+  become the two kernels.
+* FullyConnected ``(N,D)`` → ``(K,D)`` + ``(N,K)`` via truncated SVD.
+
+Rank selection: the reference ran a dynamic program over per-layer
+speedup/accuracy trade-offs; here ranks come from a closed-form cost
+model — pick the largest ``K`` with
+``decomposed_cost(K) <= original_cost / ratio`` — or from an explicit
+``--config`` JSON ``{layer_name: K}``.
+
+Usage:
+    python tools/accnn.py -m model_prefix --epoch 1 --save-model new \
+        --ratio 2 [--config ranks.json] [--layers conv1,fc1]
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _svd_factor(mat, K):
+    """Rank-K factorization mat ≈ A @ B with A:(rows,K), B:(K,cols)."""
+    U, S, Vt = np.linalg.svd(mat, full_matrices=False)
+    K = max(1, min(K, S.size))
+    sq = np.sqrt(S[:K])
+    return U[:, :K] * sq[None, :], sq[:, None] * Vt[:K, :]
+
+
+def decompose_conv_weights(W, K):
+    """VH-decompose conv kernel W:(N,C,y,x) → V:(K,C,y,1), H:(N,K,1,x)."""
+    N, C, y, x = W.shape
+    # M[(c,i),(n,j)] = W[n,c,i,j]
+    M = W.transpose(1, 2, 0, 3).reshape(C * y, N * x)
+    A, B = _svd_factor(M, K)
+    K = A.shape[1]
+    V = A.reshape(C, y, K).transpose(2, 0, 1)[..., None]        # (K,C,y,1)
+    H = B.reshape(K, N, x).transpose(1, 0, 2)[:, :, None, :]    # (N,K,1,x)
+    return V.astype(W.dtype), H.astype(W.dtype)
+
+
+def decompose_fc_weights(W, K):
+    """SVD-decompose FC weight W:(N,D) → W1:(K,D), W2:(N,K)."""
+    A, B = _svd_factor(W, K)  # W ≈ A @ B ; A:(N,K), B:(K,D)
+    return B.astype(W.dtype), A.astype(W.dtype)
+
+
+def select_rank_conv(C, N, ky, kx, ratio):
+    orig = N * C * ky * kx
+    per_k = C * ky + N * kx
+    return max(1, min(int(orig / (ratio * per_k)), min(C * ky, N * kx)))
+
+
+def select_rank_fc(D, N, ratio):
+    orig = D * N
+    per_k = D + N
+    return max(1, min(int(orig / (ratio * per_k)), min(D, N)))
+
+
+def _infer_input_channels(sym, json_nodes, data_shapes):
+    """Per-conv input channel counts via shape inference on the graph."""
+    import mxnet_tpu as mx  # noqa: F401
+    internals = sym.get_internals()
+    out_names = internals.list_outputs()
+    _, out_shapes, _ = internals.infer_shape(**data_shapes)
+    shape_of = dict(zip(out_names, out_shapes))
+    chans = {}
+    for node in json_nodes:
+        if node["op"] not in ("Convolution", "FullyConnected"):
+            continue
+        src_idx = node["inputs"][0][0]
+        src = json_nodes[src_idx]
+        key = src["name"] if src["op"] == "null" \
+            else src["name"] + "_output"
+        shp = shape_of.get(key)
+        if shp is not None:
+            chans[node["name"]] = shp
+    return chans
+
+
+def decompose_model(sym, arg_params, ranks):
+    """Rewrite graph + params. ``ranks``: {layer_name: K}.
+
+    Returns (new_sym, new_arg_params). Layers not in ``ranks`` pass
+    through untouched.
+    """
+    import mxnet_tpu as mx
+
+    graph = json.loads(sym.tojson())
+    old_nodes = graph["nodes"]
+    new_nodes = []
+    new_heads = []
+    ref_map = {}          # old node idx -> new node idx
+    new_params = dict(arg_params)
+    # null nodes consumed ONLY by decomposed layers get dropped; a weight
+    # shared with an untouched layer must survive
+    consumers = {}
+    for idx, node in enumerate(old_nodes):
+        for (i, _) in node["inputs"]:
+            consumers.setdefault(i, set()).add(idx)
+    decomposed = set()
+    for idx, node in enumerate(old_nodes):
+        if node["op"] in ("Convolution", "FullyConnected") and \
+                node["name"] in ranks:
+            p = node["param"]
+            if node["op"] == "Convolution":
+                if int(p.get("num_group", "1")) != 1:
+                    raise ValueError("%s: grouped conv not supported"
+                                     % node["name"])
+                dil = p.get("dilate")
+                if dil and tuple(ast.literal_eval(dil)) != (1, 1):
+                    raise ValueError("%s: dilated conv not supported"
+                                     % node["name"])
+            decomposed.add(idx)
+    drop = set()
+    for idx in decomposed:
+        for (i, _) in old_nodes[idx]["inputs"][1:]:  # weight (+ bias)
+            if consumers[i] <= decomposed:
+                drop.add(i)
+
+    def add(node):
+        new_nodes.append(node)
+        return len(new_nodes) - 1
+
+    def null(name):
+        return add({"op": "null", "name": name, "param": {},
+                    "inputs": [], "attr": {}})
+
+    for idx, node in enumerate(old_nodes):
+        if idx in drop:
+            continue
+        name = node["name"]
+        if node["op"] in ("Convolution", "FullyConnected") and name in ranks:
+            K = ranks[name]
+            data_ref = [ref_map[node["inputs"][0][0]], node["inputs"][0][1]]
+            p = dict(node["param"])
+            no_bias = p.get("no_bias", "False") in ("True", "1", True)
+            w_idx = node["inputs"][1][0]
+            w_name = old_nodes[w_idx]["name"]
+            w_val = arg_params[w_name]
+            W = np.asarray(w_val.asnumpy() if hasattr(w_val, "asnumpy")
+                           else w_val)
+            if w_idx in drop:
+                new_params.pop(w_name, None)
+            bias_val = None
+            if not no_bias:
+                b_idx = node["inputs"][2][0]
+                b_name = old_nodes[b_idx]["name"]
+                bias_val = arg_params[b_name]
+                if b_idx in drop:
+                    new_params.pop(b_name, None)
+            if node["op"] == "Convolution":
+                ky, kx = ast.literal_eval(p["kernel"])
+                sy, sx = ast.literal_eval(p.get("stride", "(1, 1)"))
+                py, px = ast.literal_eval(p.get("pad", "(0, 0)"))
+                V, H = decompose_conv_weights(W, K)
+                K = V.shape[0]
+                wv = null(name + "_v_weight")
+                v_idx = add({"op": "Convolution", "name": name + "_v",
+                             "param": {"num_filter": str(K),
+                                       "kernel": str((ky, 1)),
+                                       "stride": str((sy, 1)),
+                                       "pad": str((py, 0)),
+                                       "no_bias": "True"},
+                             "inputs": [data_ref, [wv, 0]], "attr": {}})
+                wh = null(name + "_h_weight")
+                inputs = [[v_idx, 0], [wh, 0]]
+                hparam = {"num_filter": p["num_filter"],
+                          "kernel": str((1, kx)),
+                          "stride": str((1, sx)),
+                          "pad": str((0, px)),
+                          "no_bias": str(no_bias)}
+                if not no_bias:
+                    hb = null(name + "_h_bias")
+                    inputs.append([hb, 0])
+                    new_params[name + "_h_bias"] = bias_val
+                h_idx = add({"op": "Convolution", "name": name + "_h",
+                             "param": hparam, "inputs": inputs, "attr": {}})
+                new_params[name + "_v_weight"] = V
+                new_params[name + "_h_weight"] = H
+                ref_map[idx] = h_idx
+            else:  # FullyConnected
+                W1, W2 = decompose_fc_weights(W, K)
+                K = W1.shape[0]
+                w1 = null(name + "_red_weight")
+                r_idx = add({"op": "FullyConnected", "name": name + "_red",
+                             "param": {"num_hidden": str(K),
+                                       "no_bias": "True"},
+                             "inputs": [data_ref, [w1, 0]], "attr": {}})
+                w2 = null(name + "_rec_weight")
+                inputs = [[r_idx, 0], [w2, 0]]
+                rparam = {"num_hidden": p["num_hidden"],
+                          "no_bias": str(no_bias)}
+                if not no_bias:
+                    b2 = null(name + "_rec_bias")
+                    inputs.append([b2, 0])
+                    new_params[name + "_rec_bias"] = bias_val
+                rec_idx = add({"op": "FullyConnected", "name": name + "_rec",
+                               "param": rparam, "inputs": inputs,
+                               "attr": {}})
+                new_params[name + "_red_weight"] = W1
+                new_params[name + "_rec_weight"] = W2
+                ref_map[idx] = rec_idx
+        else:
+            remapped = dict(node)
+            remapped["inputs"] = [[ref_map[i], oi]
+                                  for i, oi in node["inputs"]]
+            ref_map[idx] = add(remapped)
+
+    for i, oi in graph["heads"]:
+        new_heads.append([ref_map[i], oi])
+    new_graph = {
+        "nodes": new_nodes,
+        "arg_nodes": [i for i, n in enumerate(new_nodes)
+                      if n["op"] == "null"],
+        "heads": new_heads,
+    }
+    new_sym = mx.sym.load_json(json.dumps(new_graph))
+    return new_sym, new_params
+
+
+def auto_ranks(sym, json_nodes, data_shapes, ratio, only=None):
+    """Closed-form rank selection for every conv/FC layer."""
+    shape_of = _infer_input_channels(sym, json_nodes, data_shapes)
+    ranks = {}
+    for node in json_nodes:
+        name = node["name"]
+        if only and name not in only:
+            continue
+        in_shape = shape_of.get(name)
+        if in_shape is None:
+            continue
+        if node["op"] == "Convolution":
+            p = node["param"]
+            ky, kx = ast.literal_eval(p["kernel"])
+            if ky == 1 or kx == 1:
+                continue  # already cheap in one direction
+            if int(p.get("num_group", "1")) != 1:
+                continue  # grouped convs not decomposable here
+            dil = p.get("dilate")
+            if dil and tuple(ast.literal_eval(dil)) != (1, 1):
+                continue
+            N = int(node["param"]["num_filter"])
+            C = in_shape[1]
+            ranks[name] = select_rank_conv(C, N, ky, kx, ratio)
+        elif node["op"] == "FullyConnected":
+            N = int(node["param"]["num_hidden"])
+            D = int(np.prod(in_shape[1:]))
+            ranks[name] = select_rank_fc(D, N, ratio)
+    return ranks
+
+
+def main(argv=None):
+    import mxnet_tpu as mx
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("-m", "--model", required=True, help="checkpoint prefix")
+    p.add_argument("--epoch", type=int, default=1)
+    p.add_argument("--save-model", required=True, help="output prefix")
+    p.add_argument("--ratio", type=float, default=2.0,
+                   help="target per-layer FLOP reduction")
+    p.add_argument("--config", default=None,
+                   help="JSON file {layer: K}; skips rank selection")
+    p.add_argument("--layers", default=None,
+                   help="comma list of layers to decompose (default: all)")
+    p.add_argument("--data-shape", default="(1,3,224,224)")
+    args = p.parse_args(argv)
+
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.model, args.epoch)
+    json_nodes = json.loads(sym.tojson())["nodes"]
+    if args.config:
+        with open(args.config) as f:
+            ranks = {k: int(v) for k, v in json.load(f).items()}
+    else:
+        only = set(args.layers.split(",")) if args.layers else None
+        shapes = {"data": ast.literal_eval(args.data_shape)}
+        ranks = auto_ranks(sym, json_nodes, shapes, args.ratio, only)
+        with open(args.save_model + "-ranks.json", "w") as f:
+            json.dump(ranks, f, indent=2)
+    print("decomposing: %s" % ranks)
+    new_sym, new_params = decompose_model(sym, arg_params, ranks)
+    new_params = {k: (v if isinstance(v, mx.nd.NDArray) else mx.nd.array(v))
+                  for k, v in new_params.items()}
+    mx.model.save_checkpoint(args.save_model, 0, new_sym, new_params,
+                             aux_params)
+    print("saved %s-symbol.json / %s-0000.params"
+          % (args.save_model, args.save_model))
+
+
+if __name__ == "__main__":
+    main()

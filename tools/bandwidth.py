@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Communication bandwidth benchmark.
+
+TPU-native equivalent of the reference's ``tools/bandwidth/measure.py``,
+which timed KVStore push+pull of model-sized gradient arrays across
+devices and reported per-GPU "bus bandwidth". Here the measured
+primitive is what actually moves bytes on TPU:
+
+* ``--test allreduce`` — a fused ``jax.lax.psum`` over every device on
+  the mesh (what data-parallel training lowers to on ICI).
+* ``--test kvstore``  — KVStore push (reduce) + pull (broadcast) through
+  the explicit API, matching the reference's measurement shape.
+
+Bus bandwidth follows the reference's convention: each all-reduce of
+``S`` bytes over ``n`` devices moves ``2 * S * (n - 1) / n`` bytes per
+device (reduce-scatter + all-gather), so
+
+    bus_bw = 2 * S * (n - 1) / n / time / device.
+
+Run on one chip it degrades to a copy benchmark; run under a virtual CPU
+mesh (``XLA_FLAGS=--xla_force_host_platform_device_count=8``) it
+validates the collective path end to end.
+
+Usage:
+    python tools/bandwidth.py --num-mb 64 --iters 10 --test allreduce
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _sync(x):
+    for leaf in x if isinstance(x, (list, tuple)) else [x]:
+        leaf.block_until_ready()
+
+
+def bench_allreduce(num_mb: float, iters: int, dtype: str) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from mxnet_tpu.parallel._compat import shard_map
+
+    devices = np.asarray(jax.devices())
+    n = devices.size
+    mesh = Mesh(devices, ("dp",))
+    itemsize = jnp.dtype(dtype).itemsize
+    nelem = int(num_mb * 1e6 / itemsize)
+    # Per-device shard; total array is n shards reduced together.
+    x = jnp.ones((n, nelem), dtype=dtype)
+
+    @jax.jit
+    def step(x):
+        def allreduce(shard):
+            return jax.lax.psum(shard, axis_name="dp")
+
+        return shard_map(allreduce, mesh=mesh, in_specs=P("dp", None),
+                         out_specs=P("dp", None))(x)
+
+    _sync(step(x))  # compile + warm up
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(x)
+    _sync(out)
+    dt = (time.perf_counter() - t0) / iters
+    size = nelem * itemsize  # bytes reduced per device shard
+    bus = 2.0 * size * (n - 1) / max(n, 1) / dt if n > 1 else size / dt
+    return {"test": "allreduce", "devices": n, "size_mb": size / 1e6,
+            "avg_time_s": dt, "bus_gb_s": bus / 1e9}
+
+
+def bench_kvstore(num_mb: float, iters: int, dtype: str, kv_type: str) -> dict:
+    import jax
+
+    import mxnet_tpu as mx
+
+    n = len(jax.devices())
+    kv = mx.kv.create(kv_type)
+    itemsize = np.dtype(dtype).itemsize
+    nelem = int(num_mb * 1e6 / itemsize)
+    vals = [mx.nd.ones((nelem,), dtype=dtype) for _ in range(max(n, 2))]
+    outs = [mx.nd.zeros((nelem,), dtype=dtype) for _ in range(max(n, 2))]
+    kv.init(0, vals[0])
+    kv.push(0, vals)
+    kv.pull(0, out=outs)
+    for o in outs:
+        o.wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        kv.push(0, vals)
+        kv.pull(0, out=outs)
+    for o in outs:
+        o.wait_to_read()
+    dt = (time.perf_counter() - t0) / iters
+    size = nelem * itemsize
+    nd = len(vals)
+    bus = 2.0 * size * (nd - 1) / nd / dt
+    return {"test": "kvstore(%s)" % kv_type, "devices": nd,
+            "size_mb": size / 1e6, "avg_time_s": dt, "bus_gb_s": bus / 1e9}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--num-mb", type=float, default=16.0,
+                   help="payload size in MB")
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--test", default="allreduce",
+                   choices=["allreduce", "kvstore", "both"])
+    p.add_argument("--kv-type", default="device")
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. cpu; combine with "
+                        "XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+                        "for a virtual mesh)")
+    args = p.parse_args(argv)
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    results = []
+    if args.test in ("allreduce", "both"):
+        results.append(bench_allreduce(args.num_mb, args.iters, args.dtype))
+    if args.test in ("kvstore", "both"):
+        results.append(bench_kvstore(args.num_mb, args.iters, args.dtype,
+                                     args.kv_type))
+    for r in results:
+        print("%-22s devices=%d size=%.1fMB time=%.4fs bus=%.2f GB/s"
+              % (r["test"], r["devices"], r["size_mb"], r["avg_time_s"],
+                 r["bus_gb_s"]))
+    return results
+
+
+if __name__ == "__main__":
+    main()

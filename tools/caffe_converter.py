@@ -1,0 +1,356 @@
+#!/usr/bin/env python
+"""Caffe prototxt → mxnet_tpu Symbol converter.
+
+Equivalent of the reference's ``tools/caffe_converter/convert_symbol.py``,
+which parsed a text-format ``NetParameter`` via a bundled ``caffe_pb2``
+and emitted mx.symbol calls. This version needs no caffe/protobuf at
+all: text-format prototxt is a simple recursive ``key { ... }`` /
+``key: value`` grammar, parsed here directly.
+
+Supported layers (new-style ``layer {}`` with string types, plus the
+old V1 ``layers {}`` enum spellings): Data/Input, Convolution,
+Deconvolution, Pooling (MAX/AVE), InnerProduct, ReLU, Sigmoid, TanH,
+LRN, Dropout, Concat, Eltwise (SUM/PROD/MAX), Flatten, BatchNorm
+(+following Scale folded in), Softmax / SoftmaxWithLoss / Accuracy.
+
+Weight conversion from binary ``.caffemodel`` requires the caffe
+protobuf schema and is out of scope (the reference needed caffe_pb2 for
+that too); use ``convert_symbol`` + your own weight loading, or retrain.
+
+Usage:
+    python tools/caffe_converter.py net.prototxt out_prefix
+    # writes out_prefix-symbol.json
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_TOKEN = re.compile(r"""
+    (?P<comment>\#[^\n]*)
+  | (?P<brace>[{}])
+  | (?P<colon>:)
+  | (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<atom>[^\s{}:"#]+)
+""", re.VERBOSE)
+
+
+def _tokenize(text):
+    for m in _TOKEN.finditer(text):
+        kind = m.lastgroup
+        if kind == "comment":
+            continue
+        yield kind, m.group()
+
+
+def _coerce(tok_kind, tok):
+    if tok_kind == "string":
+        return tok[1:-1]
+    low = tok.lower()
+    if low == "true":
+        return True
+    if low == "false":
+        return False
+    try:
+        return int(tok)
+    except ValueError:
+        pass
+    try:
+        return float(tok)
+    except ValueError:
+        return tok
+
+
+def parse_prototxt(text):
+    """Parse protobuf text format into a dict; repeated keys become lists."""
+    tokens = list(_tokenize(text))
+    pos = 0
+
+    def parse_block(pos, end_at_brace):
+        msg = {}
+
+        def put(key, value):
+            if key in msg:
+                cur = msg[key]
+                if not isinstance(cur, list):
+                    msg[key] = cur = [cur]
+                cur.append(value)
+            else:
+                msg[key] = value
+
+        while pos < len(tokens):
+            kind, tok = tokens[pos]
+            if kind == "brace" and tok == "}":
+                if not end_at_brace:
+                    raise ValueError("unexpected '}'")
+                return msg, pos + 1
+            if kind != "atom":
+                raise ValueError("expected field name, got %r" % tok)
+            key = tok
+            pos += 1
+            if pos >= len(tokens):
+                raise ValueError("truncated input after field %r" % key)
+            kind, tok = tokens[pos]
+            if kind == "brace" and tok == "{":
+                sub, pos = parse_block(pos + 1, True)
+                put(key, sub)
+            elif kind == "colon":
+                pos += 1
+                if pos >= len(tokens):
+                    raise ValueError("truncated input after '%s:'" % key)
+                kind, tok = tokens[pos]
+                if kind == "brace" and tok == "{":  # "key: { ... }" form
+                    sub, pos = parse_block(pos + 1, True)
+                    put(key, sub)
+                else:
+                    put(key, _coerce(kind, tok))
+                    pos += 1
+            else:
+                raise ValueError("expected ':' or '{' after %s" % key)
+        if end_at_brace:
+            raise ValueError("missing '}'")
+        return msg, pos
+
+    msg, _ = parse_block(0, False)
+    return msg
+
+
+def _as_list(v):
+    if v is None:
+        return []
+    return v if isinstance(v, list) else [v]
+
+
+def _first(v, default=None):
+    vals = _as_list(v)
+    return vals[0] if vals else default
+
+
+def _pair(param, key, default):
+    """Caffe geometry fields: scalar, repeated (one per spatial axis), or
+    explicit ``kernel_h``/``kernel_w`` (note: *not* ``kernel_size_h``) —
+    normalize all three to an (h, w) tuple."""
+    v = param.get(key)
+    if v is None:
+        base = key[:-5] if key.endswith("_size") else key  # kernel_size→kernel
+        h = param.get(base + "_h")
+        w = param.get(base + "_w")
+        if h is not None or w is not None:
+            return (int(h if h is not None else default),
+                    int(w if w is not None else default))
+        return (default, default)
+    if isinstance(v, list):
+        if len(v) >= 2:
+            return (int(v[0]), int(v[1]))
+        v = v[0]
+    return (int(v), int(v))
+
+
+_V1_TYPES = {  # old enum spellings → new string types
+    "CONVOLUTION": "Convolution", "DECONVOLUTION": "Deconvolution",
+    "POOLING": "Pooling", "INNER_PRODUCT": "InnerProduct",
+    "RELU": "ReLU", "SIGMOID": "Sigmoid", "TANH": "TanH", "LRN": "LRN",
+    "DROPOUT": "Dropout", "CONCAT": "Concat", "ELTWISE": "Eltwise",
+    "FLATTEN": "Flatten", "SOFTMAX": "Softmax",
+    "SOFTMAX_LOSS": "SoftmaxWithLoss", "DATA": "Data", "ACCURACY": "Accuracy",
+    "BATCHNORM": "BatchNorm", "SCALE": "Scale",
+}
+
+
+def convert_symbol(prototxt, input_name="data"):
+    """Convert prototxt text/path → (Symbol, input_shape or None).
+
+    Mirrors reference ``convert_symbol.py:proto2script`` semantics: walks
+    layers in order, keeps a bottom-name → symbol mapping, returns the
+    last top. A trailing SoftmaxWithLoss/Softmax becomes SoftmaxOutput
+    (reference emitted ``mx.symbol.SoftmaxOutput``).
+    """
+    import mxnet_tpu as mx
+
+    if os.path.exists(prototxt):
+        with open(prototxt) as f:
+            text = f.read()
+    else:
+        text = prototxt
+    net = parse_prototxt(text)
+    layers = _as_list(net.get("layer")) + _as_list(net.get("layers"))
+
+    mapping = {}
+    input_shape = None
+    if "input" in net:
+        name = _first(net["input"], input_name)
+        mapping[name] = mx.sym.Variable(name)
+        dims = net.get("input_dim")
+        if dims is None and isinstance(net.get("input_shape"), dict):
+            dims = net["input_shape"].get("dim")
+        if dims is not None:
+            input_shape = tuple(int(d) for d in _as_list(dims))
+    last = None
+
+    for layer in layers:
+        ltype = str(layer.get("type", ""))
+        ltype = _V1_TYPES.get(ltype, ltype)
+        name = str(layer.get("name", ltype))
+        bottoms = [str(b) for b in _as_list(layer.get("bottom"))]
+        tops = [str(t) for t in _as_list(layer.get("top"))] or [name]
+        # skip test-phase-only layers
+        include = layer.get("include")
+        if isinstance(include, dict) and include.get("phase") == "TEST":
+            continue
+        ins = [mapping[b] for b in bottoms if b in mapping]
+
+        if ltype in ("Data", "Input", "ImageData", "HDF5Data", "MemoryData"):
+            var = mx.sym.Variable(input_name)
+            for t in tops:
+                mapping[t] = var
+            if ip := layer.get("input_param"):
+                shape = ip.get("shape")
+                if isinstance(shape, dict):
+                    input_shape = tuple(
+                        int(d) for d in _as_list(shape.get("dim")))
+            continue
+        if not ins and ltype not in ("Accuracy",):
+            # bottom not produced (e.g. label-only path): make a variable
+            ins = [mx.sym.Variable(b) for b in bottoms]
+        x = ins[0] if ins else None
+
+        if ltype == "Convolution" or ltype == "Deconvolution":
+            p = layer.get("convolution_param", {})
+            kernel = _pair(p, "kernel_size", 1)
+            op = mx.sym.Convolution if ltype == "Convolution" \
+                else mx.sym.Deconvolution
+            kw = dict(num_filter=int(_first(p.get("num_output"), 1)),
+                      kernel=kernel, stride=_pair(p, "stride", 1),
+                      pad=_pair(p, "pad", 0),
+                      no_bias=not p.get("bias_term", True), name=name)
+            group = int(_first(p.get("group"), 1))
+            if group != 1 and ltype == "Convolution":
+                kw["num_group"] = group
+            dil = p.get("dilation")
+            if dil is not None and ltype == "Convolution":
+                d = int(_first(dil))
+                if d > 1:
+                    kw["dilate"] = (d, d)
+            out = op(data=x, **kw)
+        elif ltype == "Pooling":
+            p = layer.get("pooling_param", {})
+            raw_pool = p.get("pool", "MAX")
+            pool = {0: "max", 1: "avg", "MAX": "max",
+                    "AVE": "avg"}.get(raw_pool)
+            if pool is None:  # 2/STOCHASTIC has no equivalent here
+                raise ValueError("unsupported pool type %r (layer %s)"
+                                 % (raw_pool, name))
+            if p.get("global_pooling"):
+                out = mx.sym.Pooling(data=x, kernel=(1, 1), pool_type=pool,
+                                     global_pool=True, name=name)
+            else:
+                # caffe pools with ceil ("full") convention; pad covers the
+                # common nets since kernel/stride normally divide evenly
+                out = mx.sym.Pooling(
+                    data=x, kernel=_pair(p, "kernel_size", 2),
+                    stride=_pair(p, "stride", 1), pad=_pair(p, "pad", 0),
+                    pool_type=pool, name=name)
+        elif ltype == "InnerProduct":
+            p = layer.get("inner_product_param", {})
+            out = mx.sym.FullyConnected(
+                data=mx.sym.Flatten(data=x),
+                num_hidden=int(_first(p.get("num_output"), 1)),
+                no_bias=not p.get("bias_term", True), name=name)
+        elif ltype == "ReLU":
+            out = mx.sym.Activation(data=x, act_type="relu", name=name)
+        elif ltype == "Sigmoid":
+            out = mx.sym.Activation(data=x, act_type="sigmoid", name=name)
+        elif ltype == "TanH":
+            out = mx.sym.Activation(data=x, act_type="tanh", name=name)
+        elif ltype == "LRN":
+            p = layer.get("lrn_param", {})
+            out = mx.sym.LRN(data=x, alpha=float(p.get("alpha", 1e-4)),
+                             beta=float(p.get("beta", 0.75)),
+                             knorm=float(p.get("k", 1.0)),
+                             nsize=int(p.get("local_size", 5)), name=name)
+        elif ltype == "Dropout":
+            p = layer.get("dropout_param", {})
+            out = mx.sym.Dropout(data=x,
+                                 p=float(p.get("dropout_ratio", 0.5)),
+                                 name=name)
+        elif ltype == "Concat":
+            p = layer.get("concat_param", {})
+            out = mx.sym.Concat(*ins, dim=int(p.get("axis", 1)), name=name)
+        elif ltype == "Eltwise":
+            p = layer.get("eltwise_param", {})
+            op_name = p.get("operation", "SUM")
+            if op_name in ("SUM", 1):
+                coeff = [float(c) for c in _as_list(p.get("coeff"))]
+                if coeff and len(coeff) != len(ins):
+                    raise ValueError(
+                        "Eltwise %s: %d coeffs for %d bottoms"
+                        % (name, len(coeff), len(ins)))
+                terms = [s if not coeff or coeff[i] == 1.0 else s * coeff[i]
+                         for i, s in enumerate(ins)]
+                out = terms[0]
+                for other in terms[1:]:
+                    out = out + other
+            elif op_name in ("PROD", 0):
+                out = ins[0]
+                for other in ins[1:]:
+                    out = out * other
+            else:  # MAX
+                out = ins[0]
+                for other in ins[1:]:
+                    out = mx.sym._Maximum(out, other)
+        elif ltype == "Flatten":
+            out = mx.sym.Flatten(data=x, name=name)
+        elif ltype == "BatchNorm":
+            p = layer.get("batch_norm_param", {})
+            out = mx.sym.BatchNorm(
+                data=x, eps=float(p.get("eps", 1e-5)),
+                momentum=float(p.get("moving_average_fraction", 0.9)),
+                fix_gamma=False, name=name)
+        elif ltype == "Scale":
+            # caffe BatchNorm has no affine params; the following Scale
+            # layer supplies them — our BatchNorm already has gamma/beta,
+            # so Scale folds away (reference converter did the same).
+            out = x
+        elif ltype in ("Softmax", "SoftmaxWithLoss"):
+            label = None
+            if len(bottoms) > 1:
+                label = mapping.get(bottoms[1],
+                                    mx.sym.Variable(bottoms[1]))
+            kw = {"name": name if name else "softmax"}
+            if label is not None:
+                kw["label"] = label
+            out = mx.sym.SoftmaxOutput(data=x, **kw)
+        elif ltype == "Accuracy":
+            continue
+        else:
+            raise ValueError("unsupported caffe layer type %r (layer %s)"
+                             % (ltype, name))
+        for t in tops:
+            mapping[t] = out
+        last = out
+
+    if last is None:
+        raise ValueError("no layers converted")
+    return last, input_shape
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("prototxt", help="path to .prototxt")
+    p.add_argument("out_prefix", help="writes <out_prefix>-symbol.json")
+    args = p.parse_args(argv)
+    sym, input_shape = convert_symbol(args.prototxt)
+    out = args.out_prefix + "-symbol.json"
+    sym.save(out)
+    print("saved %s" % out)
+    if input_shape:
+        print("input shape: %s" % (input_shape,))
+    return out
+
+
+if __name__ == "__main__":
+    main()
