@@ -1,14 +1,32 @@
-# Build the native runtime library (C++ engine + recordio).
+# Build the native runtime library (C++ engine + recordio) and the
+# C predict ABI (CPython-embedding deployment library).
 CXX ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -Wall -pthread
 LIB := mxnet_tpu/_native/libmxtpu.so
 SRCS := $(wildcard src/native/*.cc)
+PREDICT_LIB := mxnet_tpu/_native/libmxtpu_predict.so
+PREDICT_SRCS := $(wildcard src/capi/*.cc)
+# deferred expansion: only runs python3-config when building $(PREDICT_LIB)
+PY_INCLUDES = $(shell python3-config --includes)
+PY_LDFLAGS = $(shell python3-config --ldflags --embed)
+HAS_PYCONFIG := $(shell command -v python3-config 2>/dev/null)
 
+ifeq ($(HAS_PYCONFIG),)
 all: $(LIB)
+	@echo "python3-config not found: skipping $(PREDICT_LIB) (needs python dev headers; build later with 'make predict')"
+else
+all: $(LIB) $(PREDICT_LIB)
+endif
+
+predict: $(PREDICT_LIB)
 
 $(LIB): $(SRCS)
 	@mkdir -p mxnet_tpu/_native
 	$(CXX) $(CXXFLAGS) -shared -o $@ $(SRCS)
+
+$(PREDICT_LIB): $(PREDICT_SRCS) include/mxnet_tpu/c_predict_api.h
+	@mkdir -p mxnet_tpu/_native
+	$(CXX) $(CXXFLAGS) $(PY_INCLUDES) -shared -o $@ $(PREDICT_SRCS) $(PY_LDFLAGS)
 
 test: $(LIB)
 	python -m pytest tests/ -q
@@ -16,4 +34,4 @@ test: $(LIB)
 clean:
 	rm -rf mxnet_tpu/_native
 
-.PHONY: all test clean
+.PHONY: all predict test clean

@@ -1,0 +1,93 @@
+/*!
+ * C predict API — the deployment ABI for non-Python frontends.
+ *
+ * Mirrors the reference's include/mxnet/c_predict_api.h:60-170 surface
+ * (MXPredCreate / MXPredSetInput / MXPredForward / MXPredGetOutput /
+ * MXPredFree plus the MXNDList* param-blob readers and the
+ * -1 + MXGetLastError() error convention of src/c_api/c_api_error.h).
+ * The implementation (src/capi/c_predict_api.cc) hosts the TPU runtime
+ * by embedding CPython and driving mxnet_tpu.predictor.Predictor; the
+ * compute itself is the XLA-compiled graph, so the embedding layer is
+ * control-plane only.
+ */
+#ifndef MXNET_TPU_C_PREDICT_API_H_
+#define MXNET_TPU_C_PREDICT_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *PredictorHandle;
+typedef void *NDListHandle;
+typedef uint32_t mx_uint;
+typedef float mx_float;
+
+/*! \brief last error message from a failed (-1) call; thread-local. */
+const char *MXGetLastError(void);
+
+/*!
+ * \brief Create a predictor from a symbol JSON string and a parameter
+ * blob (the bytes of a saved .params file).
+ * \param symbol_json_str symbol JSON
+ * \param param_bytes param file bytes
+ * \param param_size length of param_bytes
+ * \param dev_type 1=cpu, 2=tpu
+ * \param dev_id device ordinal
+ * \param num_input_nodes number of dynamic inputs
+ * \param input_keys input names
+ * \param input_shape_indptr offsets into input_shape_data per input
+ *        (length num_input_nodes+1)
+ * \param input_shape_data concatenated input dims
+ * \param out created handle
+ * \return 0 on success, -1 on failure
+ */
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out);
+
+/*! \brief Re-bind with new input shapes, sharing weights. */
+int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data, PredictorHandle handle,
+                  PredictorHandle *out);
+
+/*! \brief Shape of output index; pointers valid until next call/Free. */
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim);
+
+/*! \brief Copy input data (row-major float32 of the bound shape). */
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size);
+
+/*! \brief Run the forward pass. */
+int MXPredForward(PredictorHandle handle);
+
+/*! \brief Copy output index into user buffer of `size` floats. */
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size);
+
+/*! \brief Free the predictor. */
+int MXPredFree(PredictorHandle handle);
+
+/*! \brief Load a saved NDArray container (e.g. mean image file). */
+int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                   NDListHandle *out, mx_uint *out_length);
+
+/*! \brief Get entry `index`: name, data pointer, shape. Pointers valid
+ * until MXNDListFree. */
+int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
+                const mx_float **out_data, const mx_uint **out_shape,
+                mx_uint *out_ndim);
+
+/*! \brief Free the list. */
+int MXNDListFree(NDListHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXNET_TPU_C_PREDICT_API_H_ */

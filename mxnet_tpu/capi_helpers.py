@@ -1,0 +1,81 @@
+"""Python-side helpers for the C predict ABI.
+
+``src/capi/c_predict_api.cc`` embeds CPython and calls these functions;
+keeping the marshalling logic here (instead of hand-written C calls into
+numpy) keeps the C layer control-plane only. The surface mirrors the
+reference's src/c_api/c_predict_api.cc behaviors.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .context import cpu, tpu
+
+
+def _ctx(dev_type: int, dev_id: int):
+    if dev_type == 1:
+        return cpu(dev_id)
+    if dev_type == 2:
+        return tpu(dev_id)
+    raise MXNetError("unknown dev_type %d (1=cpu, 2=tpu)" % dev_type)
+
+
+def create_predictor(symbol_json, param_bytes, input_shapes, dev_type,
+                     dev_id):
+    from .predictor import Predictor
+
+    shapes = {k: tuple(int(d) for d in v) for k, v in input_shapes.items()}
+    return Predictor(symbol_json, bytes(param_bytes), shapes,
+                     ctx=_ctx(dev_type, dev_id))
+
+
+def reshape_predictor(predictor, input_shapes):
+    shapes = {k: tuple(int(d) for d in v) for k, v in input_shapes.items()}
+    return predictor.reshape(shapes)
+
+
+def output_shape(predictor, index):
+    outs = predictor._executor.outputs
+    if index >= len(outs):
+        raise MXNetError("output index %d out of range (%d outputs)"
+                         % (index, len(outs)))
+    return tuple(int(d) for d in outs[index].shape)
+
+
+def set_input(predictor, key, memview):
+    arr = np.frombuffer(memview, dtype=np.float32)
+    target = predictor._executor.arg_dict.get(key)
+    if target is None:
+        raise MXNetError("unknown input '%s'" % key)
+    predictor.set_input(key, arr.reshape(target.shape))
+
+
+def output_bytes(predictor, index):
+    out = predictor.get_output(index)
+    return np.ascontiguousarray(out, dtype=np.float32).tobytes()
+
+
+def ndlist_load(blob):
+    """Parse a saved NDArray container → [(name, float32 bytes, shape)]."""
+    import os
+    import tempfile
+
+    from . import ndarray as nd
+
+    fd, path = tempfile.mkstemp()
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(bytes(blob))
+        arrays = nd.load(path)
+    finally:
+        os.unlink(path)
+    if isinstance(arrays, dict):
+        items = list(arrays.items())
+    else:
+        items = [(str(i), a) for i, a in enumerate(arrays)]
+    out = []
+    for name, arr in items:
+        a = np.ascontiguousarray(arr.asnumpy(), dtype=np.float32)
+        out.append((name, a.tobytes(), tuple(int(d) for d in a.shape)))
+    return out

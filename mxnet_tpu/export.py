@@ -1,0 +1,198 @@
+"""AOT model export: the TPU-native answer to amalgamation.
+
+The reference shipped models to phones by amalgamating the whole C++
+core into one translation unit plus the C predict API
+(``amalgamation/``, ``include/mxnet/c_predict_api.h``). On TPU the
+deployment unit is a *compiled program*, not a source bundle: this
+module freezes a symbol + trained params into a serialized StableHLO
+artifact via ``jax.export`` that runs with zero framework code — only
+jax — and is loadable from C/C++ through PJRT as well.
+
+Artifact format: a zip with
+  * ``model.shlo``  — ``jax.export.Exported.serialize()`` bytes
+  * ``meta.json``   — input names/shapes/dtypes, output count, version
+
+Params are baked into the program as constants (like the reference's
+frozen ``mxnet_predict0`` blob); inputs stay dynamic arguments.
+"""
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["export_model", "export_checkpoint", "ExportedPredictor",
+           "load_exported"]
+
+_FORMAT_VERSION = 1
+
+
+def export_model(symbol, arg_params: Dict, aux_params: Optional[Dict],
+                 input_shapes: Dict[str, tuple],
+                 input_dtypes: Optional[Dict[str, str]] = None,
+                 platforms: Optional[Sequence[str]] = None) -> bytes:
+    """Freeze ``symbol`` with ``arg_params``/``aux_params`` into a
+    serialized inference artifact. ``input_shapes`` names the dynamic
+    inputs; every other argument must be in ``arg_params``.
+
+    ``platforms``: lowering platforms for cross-platform deployment
+    (e.g. ``["cpu", "tpu"]``); defaults to the current jax backend.
+    """
+    import jax
+    from jax import export as jex
+
+    from .executor import make_graph_eval
+
+    aux_params = aux_params or {}
+    input_dtypes = dict(input_dtypes or {})
+    eval_graph, n_aux = make_graph_eval(symbol)
+
+    arg_names = symbol.list_arguments()
+    aux_names = symbol.list_auxiliary_states()
+    input_names = [n for n in arg_names if n in input_shapes]
+    if set(input_names) != set(input_shapes):
+        raise MXNetError("input_shapes contains non-argument names: %s"
+                         % sorted(set(input_shapes) - set(input_names)))
+
+    def _const(v):
+        a = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+        return jax.numpy.asarray(a)
+
+    arg_shapes, _, _ = symbol.infer_shape(
+        **{n: tuple(s) for n, s in input_shapes.items()})
+    shape_of = dict(zip(arg_names, arg_shapes))
+    consts = {}
+    for name in arg_names:
+        if name in input_shapes:
+            continue
+        if name in arg_params:
+            consts[name] = _const(arg_params[name])
+        elif name.endswith("label") and shape_of.get(name) is not None:
+            # loss-layer labels don't affect inference outputs; bake zeros
+            # (the reference predictor zero-fills label args the same way)
+            import jax.numpy as jnp
+            consts[name] = jnp.zeros(shape_of[name], dtype=np.float32)
+        else:
+            raise MXNetError("missing parameter '%s'" % name)
+    aux_list = []
+    for name in aux_names:
+        if name not in aux_params:
+            raise MXNetError("missing auxiliary state '%s'" % name)
+        aux_list.append(_const(aux_params[name]))
+
+    def fwd(*inputs):
+        by_name = dict(zip(input_names, inputs))
+        args = [by_name[n] if n in by_name else consts[n]
+                for n in arg_names]
+        outputs, _ = eval_graph(args, aux_list, None, is_train=False)
+        return tuple(outputs)
+
+    specs = [jax.ShapeDtypeStruct(tuple(input_shapes[n]),
+                                  np.dtype(input_dtypes.get(n, "float32")))
+             for n in input_names]
+    kwargs = {}
+    if platforms is not None:
+        kwargs["platforms"] = list(platforms)
+    exported = jex.export(jax.jit(fwd), **kwargs)(*specs)
+    blob = exported.serialize()
+
+    meta = {
+        "version": _FORMAT_VERSION,
+        "inputs": [{"name": n,
+                    "shape": list(input_shapes[n]),
+                    "dtype": str(np.dtype(input_dtypes.get(n, "float32")))}
+                   for n in input_names],
+        "num_outputs": len(symbol.list_outputs()),
+        "output_names": symbol.list_outputs(),
+        "platforms": list(exported.platforms),
+    }
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("model.shlo", blob)
+        z.writestr("meta.json", json.dumps(meta, indent=2))
+    return buf.getvalue()
+
+
+def export_checkpoint(prefix: str, epoch: int,
+                      input_shapes: Dict[str, tuple], path: str,
+                      **kwargs) -> str:
+    """Export a saved checkpoint (reference prefix-epoch convention) to
+    ``path``."""
+    from . import model as model_mod
+
+    sym, arg_params, aux_params = model_mod.load_checkpoint(prefix, epoch)
+    data = export_model(sym, arg_params, aux_params, input_shapes, **kwargs)
+    with open(path, "wb") as f:
+        f.write(data)
+    return path
+
+
+class ExportedPredictor:
+    """Run an exported artifact. API mirrors :class:`Predictor`
+    (set-input → forward → get-output), but the compute is the frozen
+    StableHLO program — no symbol layer, no op registry."""
+
+    def __init__(self, path_or_bytes):
+        from jax import export as jex
+
+        if isinstance(path_or_bytes, (bytes, bytearray)):
+            buf = io.BytesIO(path_or_bytes)
+        else:
+            buf = open(path_or_bytes, "rb")
+        try:
+            with zipfile.ZipFile(buf) as z:
+                blob = z.read("model.shlo")
+                self.meta = json.loads(z.read("meta.json"))
+        finally:
+            buf.close()
+        if self.meta.get("version") != _FORMAT_VERSION:
+            raise MXNetError("unsupported export format version %r"
+                             % self.meta.get("version"))
+        self._exported = jex.deserialize(bytearray(blob))
+        self._input_names = [i["name"] for i in self.meta["inputs"]]
+        self._input_specs = {i["name"]: i for i in self.meta["inputs"]}
+        self._inputs = {}
+        self._outputs = None
+
+    @property
+    def input_names(self):
+        return list(self._input_names)
+
+    @property
+    def output_names(self):
+        return list(self.meta["output_names"])
+
+    def set_input(self, name: str, value):
+        spec = self._input_specs.get(name)
+        if spec is None:
+            raise MXNetError("unknown input '%s' (expects %s)"
+                             % (name, self._input_names))
+        arr = np.asarray(value, dtype=np.dtype(spec["dtype"]))
+        if list(arr.shape) != spec["shape"]:
+            raise MXNetError("input '%s' shape %s != exported %s"
+                             % (name, arr.shape, tuple(spec["shape"])))
+        self._inputs[name] = arr
+
+    def forward(self, **inputs):
+        for name, value in inputs.items():
+            self.set_input(name, value)
+        missing = [n for n in self._input_names if n not in self._inputs]
+        if missing:
+            raise MXNetError("inputs not set: %s" % missing)
+        args = [self._inputs[n] for n in self._input_names]
+        self._outputs = self._exported.call(*args)
+        return self._outputs
+
+    def get_output(self, index: int) -> np.ndarray:
+        if self._outputs is None:
+            raise MXNetError("call forward() first")
+        return np.asarray(self._outputs[index])
+
+
+def load_exported(path_or_bytes) -> ExportedPredictor:
+    return ExportedPredictor(path_or_bytes)

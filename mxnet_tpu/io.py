@@ -13,6 +13,7 @@ device as jax arrays via NDArray.
 from __future__ import annotations
 
 import gzip
+import logging
 import os
 import queue
 import struct
@@ -483,6 +484,7 @@ class ImageRecordIter(DataIter):
             self.mean = np.array([mean_r, mean_g, mean_b],
                                  dtype=np.float32).reshape(3, 1, 1)
         self._rng = np.random.RandomState(seed)
+        self._path_imgrec = path_imgrec
         # load record offsets; shard by record index (InputSplit semantics)
         self._records: List[bytes] = []
         reader = rio.MXRecordIO(path_imgrec, "r")
@@ -502,6 +504,47 @@ class ImageRecordIter(DataIter):
         self.num_data = len(self._records)
         if self.num_data == 0:
             raise MXNetError("no records found in %s" % path_imgrec)
+        if mean_img is not None and self.mean is None:
+            # first use: compute the dataset mean image and cache it to
+            # disk (reference iter_normalize.h computes + saves mean_img
+            # the same way before training starts)
+            self.mean = self._compute_mean(mean_img)
+
+    def _compute_mean(self, path: str) -> np.ndarray:
+        from . import ndarray as nd
+        from . import recordio as rio
+
+        rand_crop, rand_mirror = self.rand_crop, self.rand_mirror
+        scale = self.scale
+        # deterministic, unscaled pass (mean lives in raw-pixel units;
+        # _decode applies it before scale) over the FULL dataset — not
+        # just this worker's shard — so every worker agrees on the mean
+        self.rand_crop = self.rand_mirror = False
+        self.scale = 1.0
+        try:
+            acc = np.zeros(self.data_shape, dtype=np.float64)
+            count = 0
+            reader = rio.MXRecordIO(self._path_imgrec, "r")
+            while True:
+                rec = reader.read()
+                if rec is None:
+                    break
+                img, _ = self._decode(rec)
+                acc += img
+                count += 1
+            reader.close()
+        finally:
+            self.rand_crop, self.rand_mirror = rand_crop, rand_mirror
+            self.scale = scale
+        logging.info("computed mean image from %d records -> %s",
+                     count, path)
+        mean = (acc / max(count, 1)).astype(np.float32)
+        # atomic publish: a killed run must not leave a torn cache file
+        # that every later construction would crash loading
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        nd.save(tmp, {"mean_img": nd.array(mean)})
+        os.replace(tmp, path)
+        return mean
 
     @property
     def provide_data(self):

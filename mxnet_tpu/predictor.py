@@ -29,12 +29,16 @@ class Predictor:
         self._ctx = ctx or cpu()
         symbol = sym_mod.load_json(symbol_json)
         if isinstance(param_bytes_or_file, (bytes, bytearray)):
+            import os
             import tempfile
 
-            with tempfile.NamedTemporaryFile(delete=False) as f:
-                f.write(param_bytes_or_file)
-                path = f.name
-            params = nd.load(path)
+            fd, path = tempfile.mkstemp()
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(param_bytes_or_file)
+                params = nd.load(path)
+            finally:
+                os.unlink(path)
         else:
             params = nd.load(param_bytes_or_file)
         arg_params, aux_params = {}, {}
@@ -56,6 +60,10 @@ class Predictor:
                 if tuple(arg_params[name].shape) != tuple(shape):
                     raise MXNetError("param '%s' shape mismatch" % name)
                 args[name] = arg_params[name].as_in_context(self._ctx)
+            elif name.endswith("label"):
+                # loss-layer labels are inference-irrelevant; zero-fill
+                # (reference c_predict_api.cc does the same)
+                args[name] = nd.zeros(shape, ctx=self._ctx)
             else:
                 raise MXNetError("missing parameter '%s'" % name)
         aux = []
@@ -84,5 +92,11 @@ class Predictor:
         return self._outputs[index].asnumpy()
 
     def reshape(self, input_shapes: Dict[str, tuple]) -> "Predictor":
-        self._executor = self._executor.reshape(**input_shapes)
-        return self
+        """New predictor bound to new input shapes, sharing unchanged
+        weights; the original stays valid (reference MXPredReshape)."""
+        new = object.__new__(Predictor)
+        new._ctx = self._ctx
+        new._input_names = list(self._input_names)
+        new._executor = self._executor.reshape(**input_shapes)
+        new._outputs = None
+        return new

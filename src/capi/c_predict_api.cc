@@ -1,0 +1,296 @@
+/*!
+ * C predict API implementation: CPython-embedding host for the
+ * deployment ABI (see include/mxnet_tpu/c_predict_api.h).
+ *
+ * Reference analogue: src/c_api/c_predict_api.cc (305 LoC) built the
+ * executor directly in C++; here the graph compiles through XLA, so
+ * this layer only marshals control + buffers into
+ * mxnet_tpu.predictor.Predictor. Error convention matches
+ * src/c_api/c_api_error.h: every call returns 0/-1 and the message is
+ * retrievable via MXGetLastError() (thread-local).
+ *
+ * Works both as a true embedding host (standalone C program: we
+ * initialize the interpreter) and when loaded into an existing Python
+ * process (interpreter already live; we only take the GIL).
+ */
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "../../include/mxnet_tpu/c_predict_api.h"
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string &msg) { g_last_error = msg; }
+
+/* Capture the pending Python exception into g_last_error. */
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject *s = PyObject_Str(value);
+    if (s) {
+      const char *c = PyUnicode_AsUTF8(s);
+      if (c) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+bool ensure_interpreter() {
+  /* Serialize first-call init: two threads racing past Py_IsInitialized
+   * would double-init and the loser's PyEval_SaveThread would abort. */
+  static std::mutex init_mutex;
+  std::lock_guard<std::mutex> lock(init_mutex);
+  if (Py_IsInitialized()) return true;
+  Py_InitializeEx(0);
+  if (!Py_IsInitialized()) {
+    set_error("failed to initialize python interpreter");
+    return false;
+  }
+  /* Release the GIL the init took; all entry points re-take it via
+   * PyGILState_Ensure so any thread may call in. */
+  PyEval_SaveThread();
+  return true;
+}
+
+class GIL {
+ public:
+  GIL() : state_(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+struct PredRec {
+  PyObject *predictor = nullptr;            /* mxnet_tpu Predictor */
+  std::vector<std::vector<mx_uint>> output_shapes;
+};
+
+struct NDListRec {
+  PyObject *arrays = nullptr;  /* list of (name, np.float32 C-contig array) */
+  std::vector<std::string> keys;
+  std::vector<std::vector<mx_uint>> shapes;
+};
+
+PyObject *shape_tuple(const mx_uint *dims, mx_uint n) {
+  PyObject *t = PyTuple_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromUnsignedLong(dims[i]));
+  return t;
+}
+
+/* Run `expr` from the helper module namespace. The helper is pure
+ * Python living in mxnet_tpu.capi_helpers, imported once. */
+PyObject *helper_module() {
+  static PyObject *mod = nullptr; /* under GIL */
+  if (!mod) mod = PyImport_ImportModule("mxnet_tpu.capi_helpers");
+  return mod;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError(void) { return g_last_error.c_str(); }
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *helpers = helper_module();
+  if (!helpers) { set_error_from_python(); return -1; }
+
+  PyObject *shapes = PyDict_New();
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject *t = shape_tuple(input_shape_data + lo, hi - lo);
+    PyDict_SetItemString(shapes, input_keys[i], t);
+    Py_DECREF(t);
+  }
+  PyObject *params = PyBytes_FromStringAndSize(
+      static_cast<const char *>(param_bytes), param_size);
+  PyObject *pred = PyObject_CallMethod(
+      helpers, "create_predictor", "sOOii", symbol_json_str, params, shapes,
+      dev_type, dev_id);
+  Py_DECREF(params);
+  Py_DECREF(shapes);
+  if (!pred) { set_error_from_python(); return -1; }
+  PredRec *rec = new PredRec();
+  rec->predictor = pred;
+  *out = rec;
+  return 0;
+}
+
+int MXPredReshape(mx_uint num_input_nodes, const char **input_keys,
+                  const mx_uint *input_shape_indptr,
+                  const mx_uint *input_shape_data, PredictorHandle handle,
+                  PredictorHandle *out) {
+  GIL gil;
+  PredRec *rec = static_cast<PredRec *>(handle);
+  PyObject *helpers = helper_module();
+  if (!helpers) { set_error_from_python(); return -1; }
+  PyObject *shapes = PyDict_New();
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject *t = shape_tuple(input_shape_data + lo, hi - lo);
+    PyDict_SetItemString(shapes, input_keys[i], t);
+    Py_DECREF(t);
+  }
+  PyObject *pred = PyObject_CallMethod(helpers, "reshape_predictor", "OO",
+                                       rec->predictor, shapes);
+  Py_DECREF(shapes);
+  if (!pred) { set_error_from_python(); return -1; }
+  PredRec *nrec = new PredRec();
+  nrec->predictor = pred;
+  *out = nrec;
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim) {
+  GIL gil;
+  PredRec *rec = static_cast<PredRec *>(handle);
+  PyObject *helpers = helper_module();
+  if (!helpers) { set_error_from_python(); return -1; }
+  PyObject *shape = PyObject_CallMethod(helpers, "output_shape", "OI",
+                                        rec->predictor, index);
+  if (!shape) { set_error_from_python(); return -1; }
+  Py_ssize_t n = PyTuple_Size(shape);
+  if (rec->output_shapes.size() <= index) rec->output_shapes.resize(index + 1);
+  auto &dims = rec->output_shapes[index];
+  dims.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    dims[i] = (mx_uint)PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shape, i));
+  Py_DECREF(shape);
+  *shape_data = dims.data();
+  *shape_ndim = (mx_uint)n;
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size) {
+  GIL gil;
+  PredRec *rec = static_cast<PredRec *>(handle);
+  PyObject *helpers = helper_module();
+  if (!helpers) { set_error_from_python(); return -1; }
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<mx_float *>(data)),
+      (Py_ssize_t)size * sizeof(mx_float), PyBUF_READ);
+  PyObject *r = PyObject_CallMethod(helpers, "set_input", "OsO",
+                                    rec->predictor, key, mv);
+  Py_DECREF(mv);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  GIL gil;
+  PredRec *rec = static_cast<PredRec *>(handle);
+  PyObject *r = PyObject_CallMethod(rec->predictor, "forward", nullptr);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size) {
+  GIL gil;
+  PredRec *rec = static_cast<PredRec *>(handle);
+  PyObject *helpers = helper_module();
+  if (!helpers) { set_error_from_python(); return -1; }
+  PyObject *bytes = PyObject_CallMethod(helpers, "output_bytes", "OI",
+                                        rec->predictor, index);
+  if (!bytes) { set_error_from_python(); return -1; }
+  Py_ssize_t n = PyBytes_Size(bytes);
+  if ((mx_uint)(n / sizeof(mx_float)) != size) {
+    Py_DECREF(bytes);
+    set_error("output size mismatch: have " +
+              std::to_string(n / sizeof(mx_float)) + " floats, caller asked " +
+              std::to_string(size));
+    return -1;
+  }
+  std::memcpy(data, PyBytes_AsString(bytes), (size_t)n);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  GIL gil;
+  PredRec *rec = static_cast<PredRec *>(handle);
+  Py_XDECREF(rec->predictor);
+  delete rec;
+  return 0;
+}
+
+int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                   NDListHandle *out, mx_uint *out_length) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *helpers = helper_module();
+  if (!helpers) { set_error_from_python(); return -1; }
+  PyObject *blob =
+      PyBytes_FromStringAndSize(nd_file_bytes, (Py_ssize_t)nd_file_size);
+  PyObject *lst = PyObject_CallMethod(helpers, "ndlist_load", "O", blob);
+  Py_DECREF(blob);
+  if (!lst) { set_error_from_python(); return -1; }
+  NDListRec *rec = new NDListRec();
+  rec->arrays = lst;
+  Py_ssize_t n = PyList_Size(lst);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *pair = PyList_GET_ITEM(lst, i);
+    rec->keys.push_back(PyUnicode_AsUTF8(PyTuple_GET_ITEM(pair, 0)));
+    PyObject *shape = PyTuple_GET_ITEM(pair, 2);
+    std::vector<mx_uint> dims(PyTuple_Size(shape));
+    for (size_t d = 0; d < dims.size(); ++d)
+      dims[d] = (mx_uint)PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shape, d));
+    rec->shapes.push_back(std::move(dims));
+  }
+  *out = rec;
+  *out_length = (mx_uint)n;
+  return 0;
+}
+
+int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
+                const mx_float **out_data, const mx_uint **out_shape,
+                mx_uint *out_ndim) {
+  GIL gil;
+  NDListRec *rec = static_cast<NDListRec *>(handle);
+  if (index >= rec->keys.size()) {
+    set_error("ndlist index out of range");
+    return -1;
+  }
+  PyObject *pair = PyList_GET_ITEM(rec->arrays, (Py_ssize_t)index);
+  PyObject *bytes = PyTuple_GET_ITEM(pair, 1); /* held by the list */
+  *out_key = rec->keys[index].c_str();
+  *out_data = reinterpret_cast<const mx_float *>(PyBytes_AsString(bytes));
+  *out_shape = rec->shapes[index].data();
+  *out_ndim = (mx_uint)rec->shapes[index].size();
+  return 0;
+}
+
+int MXNDListFree(NDListHandle handle) {
+  GIL gil;
+  NDListRec *rec = static_cast<NDListRec *>(handle);
+  Py_XDECREF(rec->arrays);
+  delete rec;
+  return 0;
+}
+
+}  /* extern "C" */

@@ -1,0 +1,90 @@
+"""AOT export (amalgamation equivalent): freeze symbol+params to a
+serialized StableHLO artifact and run it without the symbol layer.
+
+Reference analogue: amalgamation/ + c_predict_api deployment flow
+(create from symbol JSON + param blob → set input → forward →
+get output). Here the artifact is a jax.export bundle.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def _net_and_params(with_bn=False):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    if with_bn:
+        net = mx.sym.BatchNorm(data=net, name="bn")
+    net = mx.sym.Activation(data=net, act_type="relu")
+    net = mx.sym.FullyConnected(data=net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(data=net, name="softmax")
+    shapes = {"data": (4, 6)}
+    arg_shapes, _, aux_shapes = net.infer_shape(**shapes)
+    rng = np.random.RandomState(0)
+    args = {n: mx.nd.array(rng.randn(*s).astype(np.float32) * 0.3)
+            for n, s in zip(net.list_arguments(), arg_shapes)
+            if n not in ("data", "softmax_label")}
+    aux = {n: mx.nd.array(np.abs(rng.randn(*s)).astype(np.float32))
+           for n, s in zip(net.list_auxiliary_states(), aux_shapes)}
+    return net, args, aux, shapes
+
+
+def test_export_roundtrip(tmp_path):
+    net, args, aux, shapes = _net_and_params()
+    x = np.random.RandomState(1).rand(4, 6).astype(np.float32)
+
+    # reference output via normal executor
+    exe = net.simple_bind(ctx=mx.cpu(), data=shapes["data"])
+    exe.copy_params_from(args, aux)
+    exe.forward(is_train=False, data=x)
+    ref = exe.outputs[0].asnumpy()
+
+    blob = mx.export.export_model(net, args, aux, {"data": shapes["data"]})
+    assert isinstance(blob, bytes) and len(blob) > 0
+    path = tmp_path / "model.mxa"
+    path.write_bytes(blob)
+
+    pred = mx.export.load_exported(str(path))
+    assert pred.input_names == ["data"]
+    out = pred.forward(data=x)
+    np.testing.assert_allclose(np.asarray(out[0]), ref, rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(pred.get_output(0), ref, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_export_batchnorm_uses_moving_stats(tmp_path):
+    net, args, aux, shapes = _net_and_params(with_bn=True)
+    x = np.random.RandomState(2).rand(4, 6).astype(np.float32)
+    exe = net.simple_bind(ctx=mx.cpu(), data=shapes["data"])
+    exe.copy_params_from(args, aux)
+    exe.forward(is_train=False, data=x)
+    ref = exe.outputs[0].asnumpy()
+    blob = mx.export.export_model(net, args, aux, {"data": shapes["data"]})
+    pred = mx.export.ExportedPredictor(blob)
+    out = pred.forward(data=x)
+    np.testing.assert_allclose(np.asarray(out[0]), ref, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_export_checkpoint_and_errors(tmp_path):
+    net, args, aux, shapes = _net_and_params()
+    prefix = str(tmp_path / "ckpt")
+    mx.model.save_checkpoint(prefix, 3, net, args, aux)
+    path = str(tmp_path / "model.mxa")
+    mx.export.export_checkpoint(prefix, 3, {"data": shapes["data"]}, path)
+    pred = mx.export.load_exported(path)
+    x = np.random.RandomState(3).rand(4, 6).astype(np.float32)
+    out = pred.forward(data=x)
+    assert np.asarray(out[0]).shape == (4, 3)
+
+    with pytest.raises(MXNetError, match="unknown input"):
+        pred.set_input("bogus", x)
+    with pytest.raises(MXNetError, match="shape"):
+        pred.set_input("data", np.zeros((2, 6), np.float32))
+    with pytest.raises(MXNetError, match="missing parameter"):
+        mx.export.export_model(net, {}, aux, {"data": shapes["data"]})
+    with pytest.raises(MXNetError, match="non-argument"):
+        mx.export.export_model(net, args, aux, {"nope": (1,)})
