@@ -36,11 +36,16 @@ def reshape_predictor(predictor, input_shapes):
 
 
 def output_shape(predictor, index):
-    outs = predictor._executor.outputs
-    if index >= len(outs):
+    # statically known at bind time — the reference API exposes shapes
+    # right after MXPredCreate, before any forward, so clients can size
+    # their buffers first
+    exe = predictor._executor
+    _, out_shapes, _ = exe._symbol.infer_shape(
+        **{n: a.shape for n, a in exe.arg_dict.items()})
+    if index >= len(out_shapes):
         raise MXNetError("output index %d out of range (%d outputs)"
-                         % (index, len(outs)))
-    return tuple(int(d) for d in outs[index].shape)
+                         % (index, len(out_shapes)))
+    return tuple(int(d) for d in out_shapes[index])
 
 
 def set_input(predictor, key, memview):

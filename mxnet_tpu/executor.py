@@ -362,17 +362,20 @@ class Executor:
                     raise MXNetError("unknown aux '%s'" % name)
 
     def reshape(self, partial_shaping: bool = False, allow_up_sizing: bool = False,
-                **kwargs) -> "Executor":
+                fresh_args=(), **kwargs) -> "Executor":
         """Rebind to new input shapes, sharing parameter arrays whose shape
-        is unchanged (reference ``executor.py:270``)."""
+        is unchanged (reference ``executor.py:270``). Names in
+        ``fresh_args`` always get new storage even at the same shape, so
+        writes through the new executor can't alias the old one's inputs."""
         from . import ndarray as nd
 
+        fresh = set(fresh_args)
         arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
         new_args = []
         new_grads: Dict[str, NDArray] = {}
         for name, shape, arr, grad in zip(self.arg_names, arg_shapes,
                                           self.arg_arrays, self.grad_arrays):
-            if shape == arr.shape:
+            if shape == arr.shape and name not in fresh:
                 new_args.append(arr)
                 if grad is not None:
                     new_grads[name] = grad

@@ -23,7 +23,7 @@ import numpy as np
 
 from ..base import getenv
 
-__all__ = ["fused_linear", "pallas_available"]
+__all__ = ["fused_linear", "flash_attention", "pallas_available"]
 
 # float32 MXU-friendly tiles (sublane 8, lane 128)
 TILE_M = 128
@@ -138,3 +138,147 @@ def fused_linear(x, weight, bias=None, act: str = "none") -> Optional[object]:
 
     f.defvjp(f_fwd, f_bwd)
     return f(x, weight, b)
+
+
+# ---------------------------------------------------------------------------
+# Flash attention
+# ---------------------------------------------------------------------------
+
+BLOCK_Q = 128
+BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _flash_call(q, k, v, scale: float, causal: bool):
+    """Online-softmax tiled attention. q/k/v: (BH, T, D) float32.
+
+    The cuDNN-class fused kernel of this framework (the reference's GPU
+    fast path was cudnn_*-inl.h): one pass over K/V blocks per Q block,
+    carrying running max / normalizer / weighted accumulator in VMEM
+    scratch, so the (T, T) score matrix never materializes in HBM.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t, d = q.shape
+    grid = (bh, t // BLOCK_Q, t // BLOCK_K)
+    nk = grid[2]
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref):
+        ik = pl.program_id(2)
+
+        @pl.when(ik == 0)
+        def _():
+            m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+            l_ref[:] = jnp.zeros_like(l_ref)
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        iq = pl.program_id(1)
+
+        def body():
+            s = jax.lax.dot_general(
+                q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # (BQ, BK)
+            if causal:
+                row = iq * BLOCK_Q + jax.lax.broadcasted_iota(
+                    jnp.int32, (BLOCK_Q, BLOCK_K), 0)
+                col = ik * BLOCK_K + jax.lax.broadcasted_iota(
+                    jnp.int32, (BLOCK_Q, BLOCK_K), 1)
+                s = jnp.where(row >= col, s, _NEG_INF)
+
+            m_prev = m_ref[:, :1]                          # (BQ, 1)
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)                         # (BQ, BK)
+            alpha = jnp.exp(m_prev - m_new)                # (BQ, 1)
+            l_ref[:, :1] = (l_ref[:, :1] * alpha
+                            + p.sum(axis=-1, keepdims=True))
+            m_ref[:, :1] = m_new
+            acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+                p, v_ref[0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+        if causal:
+            # blocks fully above the diagonal contribute nothing; skip
+            # their MXU work (their DMA is already pipelined by pallas)
+            @pl.when(iq * BLOCK_Q // BLOCK_K >= ik)
+            def _():
+                body()
+        else:
+            body()
+
+        @pl.when(ik == nk - 1)
+        def _():
+            o_ref[0] = acc_ref[:] / l_ref[:, :1]
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, BLOCK_K, d), lambda b, iq, ik: (b, ik, 0)),
+            pl.BlockSpec((1, BLOCK_K, d), lambda b, iq, ik: (b, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, d), lambda b, iq, ik: (b, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK_Q, 128), jnp.float32),   # running max
+            pltpu.VMEM((BLOCK_Q, 128), jnp.float32),   # running normalizer
+            pltpu.VMEM((BLOCK_Q, d), jnp.float32),     # weighted accumulator
+        ],
+        interpret=_interpret_mode(),
+    )(q, k, v)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None) -> Optional[object]:
+    """Fused attention over (B, T, H, D) inputs (layout shared with
+    :mod:`mxnet_tpu.parallel.ring_attention`).
+
+    Returns None when the kernel does not apply (seq len not a multiple
+    of the 128 block, non-f32, pallas unavailable) — callers fall back to
+    the XLA reference path. Backward recomputes through the reference
+    attention (rematerialization: the O(T^2) probs never hit HBM in fwd).
+    """
+    if not pallas_available():
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    b, t, h, d = q.shape
+    if (t % BLOCK_Q or t % BLOCK_K or q.dtype != jnp.float32
+            or d > 256):
+        return None
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+
+    def _pack(x):   # (B, T, H, D) -> (B*H, T, D)
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    def _unpack(x):
+        return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        return _unpack(_flash_call(_pack(q), _pack(k), _pack(v),
+                                   scale, causal))
+
+    def _ref(q, k, v):
+        # recompute path shares the single attention oracle, pinned to
+        # the kernel's scale and finite mask value
+        from ..parallel.ring_attention import reference_attention
+
+        return reference_attention(q, k, v, causal=causal, scale=scale,
+                                   mask_value=_NEG_INF)
+
+    def f_fwd(q, k, v):
+        return f(q, k, v), (q, k, v)
+
+    def f_bwd(res, g):
+        q, k, v = res
+        _, vjp = jax.vjp(_ref, q, k, v)
+        return vjp(g)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(q, k, v)

@@ -97,6 +97,9 @@ class Predictor:
         new = object.__new__(Predictor)
         new._ctx = self._ctx
         new._input_names = list(self._input_names)
-        new._executor = self._executor.reshape(**input_shapes)
+        # inputs always get fresh storage: set_input on the new predictor
+        # must never write through to the original's arrays
+        new._executor = self._executor.reshape(
+            fresh_args=self._input_names, **input_shapes)
         new._outputs = None
         return new

@@ -192,6 +192,7 @@ int MXPredSetInput(PredictorHandle handle, const char *key,
   PyObject *mv = PyMemoryView_FromMemory(
       reinterpret_cast<char *>(const_cast<mx_float *>(data)),
       (Py_ssize_t)size * sizeof(mx_float), PyBUF_READ);
+  if (!mv) { set_error_from_python(); return -1; }
   PyObject *r = PyObject_CallMethod(helpers, "set_input", "OsO",
                                     rec->predictor, key, mv);
   Py_DECREF(mv);

@@ -283,3 +283,50 @@ def test_reshape_keeps_original_handle(tmp_path):
     np.testing.assert_allclose(out2[2:], ref, rtol=1e-5, atol=1e-5)
     assert lib.MXPredFree(h1) == 0
     assert lib.MXPredFree(h2) == 0
+
+
+def test_output_shape_before_forward_and_same_shape_reshape(tmp_path):
+    _build_lib()
+    sym_json, pfile, x, ref = _save_model(tmp_path)
+    lib = _load()
+    param_blob = open(pfile, "rb").read()
+    h1 = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint32 * 2)(0, 2)
+    dims = (ctypes.c_uint32 * 2)(2, 5)
+    assert lib.MXPredCreate(sym_json.encode(), param_blob, len(param_blob),
+                            1, 0, 1, keys, indptr, dims,
+                            ctypes.byref(h1)) == 0
+
+    # canonical client flow: shape is queryable BEFORE any forward
+    sdata = ctypes.POINTER(ctypes.c_uint32)()
+    ndim = ctypes.c_uint32()
+    assert lib.MXPredGetOutputShape(h1, 0, ctypes.byref(sdata),
+                                    ctypes.byref(ndim)) == 0, \
+        lib.MXGetLastError()
+    assert tuple(sdata[i] for i in range(ndim.value)) == (2, 3)
+
+    # same-shape reshape must NOT alias inputs between handles
+    h2 = ctypes.c_void_p()
+    assert lib.MXPredReshape(1, keys, indptr, dims, h1,
+                             ctypes.byref(h2)) == 0, lib.MXGetLastError()
+    xs = np.ascontiguousarray(x)
+    zeros = np.zeros_like(xs)
+    assert lib.MXPredSetInput(h1, b"data",
+                              xs.ctypes.data_as(
+                                  ctypes.POINTER(ctypes.c_float)),
+                              xs.size) == 0
+    # writing through h2 must not clobber h1's pending input
+    assert lib.MXPredSetInput(h2, b"data",
+                              zeros.ctypes.data_as(
+                                  ctypes.POINTER(ctypes.c_float)),
+                              zeros.size) == 0
+    assert lib.MXPredForward(h1) == 0
+    out = np.zeros((2, 3), np.float32)
+    assert lib.MXPredGetOutput(h1, 0,
+                               out.ctypes.data_as(
+                                   ctypes.POINTER(ctypes.c_float)),
+                               out.size) == 0
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+    lib.MXPredFree(h1)
+    lib.MXPredFree(h2)

@@ -2,6 +2,8 @@
 compile natively on TPU)."""
 import os
 
+import jax.numpy as jnp
+
 import numpy as np
 import pytest
 
@@ -114,3 +116,48 @@ def test_rtc_bad_source():
     out = mx.nd.zeros((4, 4))
     with pytest.raises(Exception):
         Rtc("bad", [("x", x)], [("out", out)], "this is not python !!!")
+
+
+def test_flash_attention_matches_reference():
+    from mxnet_tpu.ops import pallas_kernels as pk
+    from mxnet_tpu.parallel.ring_attention import reference_attention
+
+    rng = np.random.RandomState(0)
+    B, T, H, D = 2, 256, 2, 64
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    for causal in (False, True):
+        out = pk.flash_attention(q, k, v, causal=causal)
+        assert out is not None
+        ref = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_grads():
+    import jax
+    from mxnet_tpu.ops import pallas_kernels as pk
+    from mxnet_tpu.parallel.ring_attention import reference_attention
+
+    rng = np.random.RandomState(1)
+    B, T, H, D = 1, 128, 2, 32
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+    g = jax.grad(lambda q, k, v: (pk.flash_attention(q, k, v, causal=True)
+                                  ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: (reference_attention(q, k, v, causal=True)
+                                   ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_flash_attention_fallback():
+    from mxnet_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.RandomState(2)
+    # T not a multiple of the block -> caller must fall back
+    q = jnp.asarray(rng.randn(1, 100, 2, 32).astype(np.float32))
+    assert pk.flash_attention(q, q, q) is None
