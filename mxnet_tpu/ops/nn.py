@@ -322,11 +322,12 @@ class Pooling(Operator):
         window = (1, 1) + tuple(kernel)
         strides = (1, 1) + tuple(stride)
         padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+        is_float = jnp.issubdtype(x.dtype, jnp.floating)  # incl. bfloat16
         if self.pool_type == "max":
-            init = -jnp.inf if np.issubdtype(x.dtype, np.floating) else np.iinfo(x.dtype).min
+            init = -jnp.inf if is_float else np.iinfo(x.dtype).min
             out = lax.reduce_window(x, init, lax.max, window, strides, padding)
         elif self.pool_type in ("avg", "sum"):
-            out = lax.reduce_window(x, 0.0 if np.issubdtype(x.dtype, np.floating) else 0,
+            out = lax.reduce_window(x, 0.0 if is_float else 0,
                                     lax.add, window, strides, padding)
             if self.pool_type == "avg":
                 out = out / float(np.prod(kernel))
@@ -372,17 +373,27 @@ class BatchNorm(Operator):
             gamma = jnp.ones_like(gamma)
         use_batch_stats = ctx.is_train and not self.use_global_stats
         if use_batch_stats:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.mean(jnp.square(x - mean.reshape(bshape)), axis=axes)
+            # statistics in f32 even under bf16 mixed precision: a batch
+            # mean over 1e5+ elements accumulated in bf16 loses the
+            # moving averages (standard TPU mixed-precision practice)
+            x32 = x.astype(jnp.promote_types(x.dtype, jnp.float32))
+            mean = jnp.mean(x32, axis=axes)
+            var = jnp.mean(jnp.square(x32 - mean.reshape(bshape)),
+                           axis=axes)
             m = self.momentum
-            new_mean = moving_mean * m + jax.lax.stop_gradient(mean) * (1 - m)
-            new_var = moving_var * m + jax.lax.stop_gradient(var) * (1 - m)
+            new_mean = moving_mean * m + jax.lax.stop_gradient(
+                mean.astype(moving_mean.dtype)) * (1 - m)
+            new_var = moving_var * m + jax.lax.stop_gradient(
+                var.astype(moving_var.dtype)) * (1 - m)
             new_aux = [new_mean, new_var]
+            mean = mean.astype(x.dtype)
+            var = var.astype(x.dtype)
         else:
-            mean = jax.lax.stop_gradient(moving_mean)
-            var = jax.lax.stop_gradient(moving_var)
+            mean = jax.lax.stop_gradient(moving_mean).astype(x.dtype)
+            var = jax.lax.stop_gradient(moving_var).astype(x.dtype)
             new_aux = [moving_mean, moving_var]
-        inv = jax.lax.rsqrt(var.reshape(bshape) + self.eps)
+        inv = jax.lax.rsqrt(var.reshape(bshape) + jnp.asarray(
+            self.eps, x.dtype))
         out = (x - mean.reshape(bshape)) * inv * gamma.reshape(bshape) \
             + beta.reshape(bshape)
         return [out], new_aux

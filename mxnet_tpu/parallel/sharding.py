@@ -79,12 +79,19 @@ def shard_args(mesh, arrays: Dict[str, np.ndarray], shardings: Dict):
 
 
 def build_sgd_train_step(symbol, data_names: Sequence[str],
-                         label_names: Sequence[str], lr: float = 0.01):
+                         label_names: Sequence[str], lr: float = 0.01,
+                         compute_dtype=None):
     """Return ``step(params, data, aux, key) -> (outputs, new_params,
     new_aux)`` — forward, backward (jax.vjp through the whole graph) and
     SGD update fused into ONE jittable computation. Under a mesh with
     sharded inputs, XLA inserts the gradient all-reduce (dp) and the
-    matmul collectives (tp) automatically."""
+    matmul collectives (tp) automatically.
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``) enables mixed precision:
+    params and data are cast on entry (labels never are), activations and
+    matmuls run in that dtype on the MXU, while master weights, the SGD
+    update, and BatchNorm statistics stay float32. The vjp of the cast
+    returns float32 gradients automatically."""
     import jax
     import jax.numpy as jnp
 
@@ -92,12 +99,26 @@ def build_sgd_train_step(symbol, data_names: Sequence[str],
 
     eval_graph, n_aux = make_graph_eval(symbol)
     arg_names = symbol.list_arguments()
-    input_names = set(data_names) | set(label_names)
+    label_set = set(label_names)
+    input_names = set(data_names) | label_set
     param_names = [n for n in arg_names if n not in input_names]
+
+    def _cast(x):
+        if compute_dtype is not None and jnp.issubdtype(x.dtype,
+                                                        jnp.floating):
+            return x.astype(compute_dtype)
+        return x
 
     def step(params: Dict, data: Dict, aux: List, key):
         def f(params):
-            args = [params[n] if n in params else data[n] for n in arg_names]
+            args = []
+            for n in arg_names:
+                if n in params:
+                    args.append(_cast(params[n]))
+                elif n in label_set:
+                    args.append(data[n])  # labels keep full precision
+                else:
+                    args.append(_cast(data[n]))
             outputs, aux_out = eval_graph(args, aux, key, True)
             return outputs, aux_out
 
@@ -106,6 +127,7 @@ def build_sgd_train_step(symbol, data_names: Sequence[str],
         zero_aux = [jnp.zeros_like(a) for a in aux_out]
         grads, = vjp((heads, zero_aux))
         new_params = {n: params[n] - lr * grads[n] for n in params}
+        aux_out = [a.astype(b.dtype) for a, b in zip(aux_out, aux)]
         return outputs, new_params, aux_out
 
     return step, param_names

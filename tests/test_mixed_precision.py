@@ -1,0 +1,87 @@
+"""Mixed precision (bf16 compute, f32 master weights) train step.
+
+Reference analogue: fp16 training validated via check_consistency
+(test_utils.py:588-640, gpu/cpu x fp16/32/64 tolerances). Here the TPU
+idiom is bfloat16 activations/matmuls with float32 master weights,
+BatchNorm statistics pinned to f32 (ops/nn.py BatchNorm).
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import build_sgd_train_step
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=8,
+                             pad=(1, 1), name="c1")
+    net = mx.sym.BatchNorm(data=net, name="bn1")
+    net = mx.sym.Activation(data=net, act_type="relu")
+    net = mx.sym.Pooling(data=net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Flatten(data=net)
+    net = mx.sym.FullyConnected(data=net, num_hidden=2, name="fc")
+    return mx.sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def _setup(batch=16):
+    import jax
+
+    net = _net()
+    shapes = {"data": (batch, 1, 8, 8)}
+    arg_shapes, _, aux_shapes = net.infer_shape(**shapes)
+    rng = np.random.RandomState(0)
+    params = {}
+    for name, shape in zip(net.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        if name.endswith("gamma"):
+            params[name] = jnp.ones(shape, jnp.float32)
+        else:
+            params[name] = jnp.asarray(rng.randn(*shape) * 0.1, jnp.float32)
+    aux = [jnp.ones(s, jnp.float32) if "var" in n
+           else jnp.zeros(s, jnp.float32)
+           for n, s in zip(net.list_auxiliary_states(), aux_shapes)]
+    y = rng.randint(0, 2, batch).astype(np.float32)
+    x = (rng.randn(batch, 1, 8, 8) * 0.5
+         + y[:, None, None, None]).astype(np.float32)
+    data = {"data": jnp.asarray(x), "softmax_label": jnp.asarray(y)}
+    key = jax.random.PRNGKey(0)
+    return net, params, aux, data, y, key
+
+
+def test_bf16_step_converges_and_keeps_f32_state():
+    import jax
+
+    net, params, aux, data, y, key = _setup()
+    step, _ = build_sgd_train_step(net, ["data"], ["softmax_label"],
+                                   lr=0.1, compute_dtype=jnp.bfloat16)
+    jstep = jax.jit(step)
+    for i in range(30):
+        outputs, params, aux = jstep(params, data, aux,
+                                     jax.random.fold_in(key, i))
+    # master weights and BN stats stayed f32
+    assert all(p.dtype == jnp.float32 for p in params.values())
+    assert all(a.dtype == jnp.float32 for a in aux)
+    probs = np.asarray(outputs[0], dtype=np.float32)
+    acc = (probs.argmax(axis=1) == y).mean()
+    assert acc > 0.9, acc
+
+
+def test_bf16_matches_f32_first_step():
+    import jax
+
+    net, params, aux, data, y, key = _setup()
+    s32, _ = build_sgd_train_step(net, ["data"], ["softmax_label"], lr=0.1)
+    s16, _ = build_sgd_train_step(net, ["data"], ["softmax_label"], lr=0.1,
+                                  compute_dtype=jnp.bfloat16)
+    o32, p32, _ = jax.jit(s32)(params, data, aux, key)
+    o16, p16, _ = jax.jit(s16)(params, data, aux, key)
+    # bf16 has ~3 decimal digits; outputs/updates agree loosely
+    np.testing.assert_allclose(np.asarray(o16[0], np.float32),
+                               np.asarray(o32[0]), atol=0.06)
+    for n in p32:
+        np.testing.assert_allclose(np.asarray(p16[n], np.float32),
+                                   np.asarray(p32[n]), atol=0.12)
