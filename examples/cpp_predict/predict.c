@@ -1,0 +1,104 @@
+/* Minimal C consumer of the predict ABI (reference example/cpp +
+ * matlab/amalgamation wrappers consumed include/mxnet/c_predict_api.h
+ * the same way).
+ *
+ * Build (after `make predict` at the repo root):
+ *   gcc predict.c -o predict -I ../../include \
+ *       -L ../../mxnet_tpu/_native -lmxtpu_predict \
+ *       -Wl,-rpath,$PWD/../../mxnet_tpu/_native
+ * Run:
+ *   PYTHONPATH=../../ ./predict model-symbol.json model-0001.params \
+ *       1,3,224,224
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxnet_tpu/c_predict_api.h"
+
+static char *read_file(const char *path, long *size) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    fprintf(stderr,
+            "usage: %s <symbol.json> <model.params> <N,C,H,W>\n", argv[0]);
+    return 1;
+  }
+  long sym_size, param_size;
+  char *sym_json = read_file(argv[1], &sym_size);
+  char *params = read_file(argv[2], &param_size);
+  if (!sym_json || !params) {
+    fprintf(stderr, "cannot read model files\n");
+    return 1;
+  }
+
+  mx_uint dims[8], ndim = 0;
+  for (char *tok = strtok(argv[3], ","); tok && ndim < 8;
+       tok = strtok(NULL, ","))
+    dims[ndim++] = (mx_uint)atoi(tok);
+  mx_uint indptr[2] = {0, ndim};
+  const char *keys[] = {"data"};
+
+  PredictorHandle h;
+  if (MXPredCreate(sym_json, params, (int)param_size, 1, 0, 1, keys,
+                   indptr, dims, &h) != 0) {
+    fprintf(stderr, "MXPredCreate: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  mx_uint in_size = 1;
+  for (mx_uint i = 0; i < ndim; ++i) in_size *= dims[i];
+  float *x = (float *)malloc(in_size * sizeof(float));
+  for (mx_uint i = 0; i < in_size; ++i) x[i] = (float)(i % 255) / 255.0f;
+
+  if (MXPredSetInput(h, "data", x, in_size) != 0 ||
+      MXPredForward(h) != 0) {
+    fprintf(stderr, "forward: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  mx_uint *shape, out_ndim;
+  if (MXPredGetOutputShape(h, 0, &shape, &out_ndim) != 0) {
+    fprintf(stderr, "shape: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_uint out_size = 1;
+  printf("output shape: ");
+  for (mx_uint i = 0; i < out_ndim; ++i) {
+    printf("%u ", shape[i]);
+    out_size *= shape[i];
+  }
+  printf("\n");
+
+  float *out = (float *)malloc(out_size * sizeof(float));
+  if (MXPredGetOutput(h, 0, out, out_size) != 0) {
+    fprintf(stderr, "output: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_uint best = 0;
+  for (mx_uint i = 1; i < out_size && i < shape[out_ndim - 1]; ++i)
+    if (out[i] > out[best]) best = i;
+  printf("argmax: %u (%.6f)\n", best, out[best]);
+
+  MXPredFree(h);
+  free(x);
+  free(out);
+  free(sym_json);
+  free(params);
+  return 0;
+}

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Train CIFAR-10 (reference example/image-classification/train_cifar10.py).
+
+The reference's CIFAR benchmark net is Inception-BN-28-small at batch 128
+(BASELINE.md: 842 img/s on 1 GTX 980). Data comes from recordio files
+(cifar/train.rec, cifar/test.rec — build with tools/im2rec.py), with a
+synthetic fallback so the script runs offline.
+
+Examples:
+    python train_cifar10.py --data-dir cifar/ --num-epochs 20
+    python train_cifar10.py --network resnet --kv-store tpu_sync
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+def get_net(name, num_classes=10):
+    if name == "inception-bn-28-small":
+        return models.get_inception_bn_28_small(num_classes)
+    if name == "resnet":
+        return models.get_resnet50(num_classes, small_input=True)
+    if name == "lenet":
+        return models.get_lenet(num_classes)
+    raise ValueError("unknown network %s" % name)
+
+
+def get_iters(args):
+    train_rec = os.path.join(args.data_dir, "train.rec")
+    val_rec = os.path.join(args.data_dir, "test.rec")
+    if os.path.exists(train_rec):
+        mean_img = os.path.join(args.data_dir, "mean.nd")
+        train = mx.io.ImageRecordIter(
+            path_imgrec=train_rec, data_shape=(3, 28, 28), mean_img=mean_img,
+            batch_size=args.batch_size, rand_crop=True, rand_mirror=True,
+            shuffle=True, num_parts=args.num_parts,
+            part_index=args.part_index)
+        val = mx.io.ImageRecordIter(
+            path_imgrec=val_rec, data_shape=(3, 28, 28), mean_img=mean_img,
+            batch_size=args.batch_size)
+        return train, val
+    logging.warning("CIFAR recordio not found in %s; using synthetic data",
+                    args.data_dir)
+    rng = np.random.RandomState(0)
+    n = 2048
+    y = rng.randint(0, 10, n).astype(np.float32)
+    X = rng.randn(n, 3, 28, 28).astype(np.float32) * 0.3
+    for i in range(n):  # class-dependent channel shift: separable
+        X[i, int(y[i]) % 3] += 0.5 + 0.2 * int(y[i])
+    cut = n * 7 // 8
+    train = mx.io.NDArrayIter(X[:cut], y[:cut], batch_size=args.batch_size,
+                              shuffle=True, last_batch_handle="discard")
+    val = mx.io.NDArrayIter(X[cut:], y[cut:], batch_size=args.batch_size)
+    return train, val
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train cifar10")
+    parser.add_argument("--network", default="inception-bn-28-small",
+                        choices=["inception-bn-28-small", "resnet", "lenet"])
+    parser.add_argument("--data-dir", default="cifar/")
+    parser.add_argument("--gpus", default=None,
+                        help="accelerator ids, e.g. '0' or '0,1'")
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--lr-factor", type=float, default=0.94)
+    parser.add_argument("--kv-store", default="local")
+    parser.add_argument("--num-parts", type=int, default=1)
+    parser.add_argument("--part-index", type=int, default=0)
+    parser.add_argument("--model-prefix", default=None)
+    parser.add_argument("--load-epoch", type=int, default=None)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = get_net(args.network)
+    train, val = get_iters(args)
+    if args.gpus:
+        ctx = [mx.tpu(int(i)) for i in args.gpus.split(",")]
+    else:
+        ctx = [mx.cpu()]
+    kv = mx.kv.create(args.kv_store)
+
+    arg_params = aux_params = None
+    begin_epoch = 0
+    if args.model_prefix and args.load_epoch is not None:
+        net, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+        begin_epoch = args.load_epoch
+
+    checkpoint = (mx.callback.do_checkpoint(args.model_prefix)
+                  if args.model_prefix else None)
+    model = mx.model.FeedForward(
+        symbol=net, ctx=ctx, num_epoch=args.num_epochs,
+        learning_rate=args.lr, momentum=0.9, wd=1e-4,
+        lr_scheduler=mx.lr_scheduler.FactorScheduler(
+            step=max(1, 50000 // args.batch_size), factor=args.lr_factor),
+        initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+        arg_params=arg_params, aux_params=aux_params,
+        begin_epoch=begin_epoch)
+    model.fit(X=train, eval_data=val, kvstore=kv,
+              batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                         50),
+              epoch_end_callback=checkpoint)
+
+
+if __name__ == "__main__":
+    main()

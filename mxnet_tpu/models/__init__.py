@@ -5,7 +5,10 @@ from .lenet import get_lenet
 from .resnet import get_resnet, get_resnet50
 from .inception_bn import get_inception_bn, get_inception_bn_28_small
 from .lstm import lstm_unroll, lstm_fused
+from .vision import (get_alexnet, get_vgg, get_googlenet,
+                     get_inception_v3)
 
 __all__ = ["get_mlp", "get_lenet", "get_resnet", "get_resnet50",
            "get_inception_bn", "get_inception_bn_28_small",
-           "lstm_unroll", "lstm_fused"]
+           "lstm_unroll", "lstm_fused", "get_alexnet", "get_vgg",
+           "get_googlenet", "get_inception_v3"]

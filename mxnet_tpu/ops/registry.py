@@ -166,6 +166,11 @@ def create_operator(op_name: str, **params) -> Operator:
     return cls(**params)
 
 
+def get_operator_class(op_name: str):
+    """Registered Operator class, or None if unknown (no raise)."""
+    return OP_REGISTRY.find(op_name)
+
+
 def same_shape_binary(in_shapes):
     """Shape rule for elementwise binary ops: both inputs same shape."""
     known = _first_known(in_shapes)

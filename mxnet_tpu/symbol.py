@@ -432,6 +432,14 @@ def _create(op_name: str, *args, **kwargs) -> Symbol:
             sym_kwargs[k] = v
         else:
             param_kwargs[k] = v
+    # variadic ops (Concat, ElementWiseSum): the reference frontend filled
+    # num_args from the positional input count (symbol.py Compose)
+    if args and "num_args" not in param_kwargs:
+        from .ops.registry import get_operator_class
+
+        cls = get_operator_class(op_name)
+        if cls is not None and "num_args" in getattr(cls, "PARAMS", {}):
+            param_kwargs["num_args"] = len(args)
     op = create_operator(op_name, **param_kwargs)
     arg_names = op.list_arguments()
     name = NameManager.current().get(name, op.name_hint)

@@ -166,3 +166,31 @@ def test_lstm_unroll_fused_consistency():
     ex_f.arg_dict["data"][:] = X
     out_f = ex_f.forward(is_train=False)[0].asnumpy()
     np.testing.assert_allclose(out_u, out_f, rtol=1e-4, atol=1e-5)
+
+
+def test_alexnet_shapes():
+    net = models.get_alexnet(num_classes=1000)
+    _, out_shapes, _ = net.infer_shape(data=(2, 3, 224, 224))
+    assert out_shapes[0] == (2, 1000)
+
+
+def test_vgg_variants_shapes():
+    for depth in (11, 13, 16, 19):
+        net = models.get_vgg(num_classes=10, num_layers=depth)
+        args, out_shapes, _ = net.infer_shape(data=(1, 3, 224, 224))
+        assert out_shapes[0] == (1, 10)
+    n_conv16 = sum(1 for n in models.get_vgg(num_layers=16).list_arguments()
+                   if n.startswith("conv") and n.endswith("_weight"))
+    assert n_conv16 == 13  # VGG-16 = 13 conv + 3 fc
+
+
+def test_googlenet_shapes_and_forward():
+    net = models.get_googlenet(num_classes=50)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 224, 224))
+    assert out_shapes[0] == (1, 50)
+
+
+def test_inception_v3_shapes():
+    net = models.get_inception_v3(num_classes=100)
+    _, out_shapes, aux = net.infer_shape(data=(1, 3, 299, 299))
+    assert out_shapes[0] == (1, 100)
