@@ -30,7 +30,7 @@ from typing import Callable, Iterable, List, Optional, Sequence
 from .base import MXNetError, getenv
 
 __all__ = ["Engine", "Var", "get_engine", "set_engine", "NaiveEngine",
-           "XLAEngine", "ThreadedEngine"]
+           "XLAEngine", "ThreadedEngine", "ThreadedEnginePooled"]
 
 _var_counter = itertools.count()
 
@@ -57,9 +57,10 @@ class Var:
 
 class _OprBlock:
     __slots__ = ("fn", "const_vars", "mutable_vars", "priority", "wait",
-                 "lock", "seq")
+                 "lock", "seq", "prop")
 
-    def __init__(self, fn, const_vars, mutable_vars, priority, seq):
+    def __init__(self, fn, const_vars, mutable_vars, priority, seq,
+                 prop="normal"):
         self.fn = fn
         self.const_vars = const_vars
         self.mutable_vars = mutable_vars
@@ -67,6 +68,7 @@ class _OprBlock:
         self.seq = seq
         self.wait = 0
         self.lock = threading.Lock()
+        self.prop = prop
 
     def dec_wait(self) -> bool:
         with self.lock:
@@ -92,7 +94,11 @@ class Engine:
         return Var()
 
     def push(self, fn: Callable[[], object], const_vars: Sequence[Var] = (),
-             mutable_vars: Sequence[Var] = (), priority: int = 0) -> None:
+             mutable_vars: Sequence[Var] = (), priority: int = 0,
+             prop: str = "normal") -> None:
+        """``prop`` mirrors the reference's ``FnProperty`` (engine.h:
+        Normal / CopyFromGPU / CopyToGPU / kAsync): engines with a
+        dedicated I/O pool route ``"io"``/``"copy"`` ops there."""
         raise NotImplementedError
 
     def wait_for_var(self, var: Var) -> None:
@@ -117,7 +123,8 @@ class XLAEngine(Engine):
     provides device-side overlap (the reference's per-device worker streams,
     ``src/engine/threaded_engine_perdevice.cc:26-187``, map onto it)."""
 
-    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
+             prop="normal"):
         _check_duplicates(const_vars, mutable_vars)
         fn()
         _bump_versions(mutable_vars)
@@ -138,7 +145,8 @@ class NaiveEngine(Engine):
     """Synchronous debugging engine (reference ``src/engine/naive_engine.cc``).
     If the closure returns jax arrays they are blocked on immediately."""
 
-    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
+             prop="normal"):
         _check_duplicates(const_vars, mutable_vars)
         ret = fn()
         _bump_versions(mutable_vars)
@@ -245,11 +253,13 @@ class ThreadedEngine(Engine):
                 self._dispatch(opr)
 
     # -- scheduling --------------------------------------------------------
-    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
+             prop="normal"):
         _check_duplicates(const_vars, mutable_vars)
         const_vars = list(const_vars)
         mutable_vars = list(mutable_vars)
-        opr = _OprBlock(fn, const_vars, mutable_vars, priority, next(self._seq))
+        opr = _OprBlock(fn, const_vars, mutable_vars, priority,
+                        next(self._seq), prop)
         with self._pending_lock:
             self._pending += 1
         # Guard counter: assume every dep is unready plus one guard unit, so
@@ -275,14 +285,16 @@ class ThreadedEngine(Engine):
             heapq.heappush(self._heap, (-opr.priority, opr.seq, opr))
             self._heap_lock.notify()
 
-    def _worker_loop(self):
+    def _worker_loop(self, heap=None, cond=None):
+        heap = self._heap if heap is None else heap
+        cond = self._heap_lock if cond is None else cond
         while True:
-            with self._heap_lock:
-                while not self._heap and not self._shutdown:
-                    self._heap_lock.wait()
-                if self._shutdown and not self._heap:
+            with cond:
+                while not heap and not self._shutdown:
+                    cond.wait()
+                if self._shutdown and not heap:
                     return
-                _, _, opr = heapq.heappop(self._heap)
+                _, _, opr = heapq.heappop(heap)
             try:
                 opr.fn()
             finally:
@@ -310,6 +322,45 @@ class ThreadedEngine(Engine):
         with self._heap_lock:
             self._shutdown = True
             self._heap_lock.notify_all()
+
+
+class ThreadedEnginePooled(ThreadedEngine):
+    """Global compute pool + dedicated I/O pool (reference
+    ``src/engine/threaded_engine_pooled.cc:24-121``: one thread pool for
+    compute, a separate single-thread pool for I/O/copy ops so long
+    reads never starve compute). Ops pushed with ``prop="io"`` or
+    ``prop="copy"`` run on the I/O workers."""
+
+    def __init__(self, num_workers: Optional[int] = None,
+                 num_io_workers: Optional[int] = None):
+        super().__init__(num_workers)
+        self._io_heap: List = []
+        self._io_lock = threading.Condition()
+        n_io = (num_io_workers if num_io_workers is not None
+                else getenv("MXNET_CPU_IO_NTHREADS", 1))
+        self._io_workers = []
+        for i in range(n_io):
+            t = threading.Thread(
+                target=self._worker_loop, args=(self._io_heap,
+                                                self._io_lock),
+                name="mxtpu-engine-io-%d" % i, daemon=True)
+            t.start()
+            self._io_workers.append(t)
+
+    def _dispatch(self, opr: _OprBlock):
+        # with no I/O workers (MXNET_CPU_IO_NTHREADS=0), io ops must fall
+        # through to the compute pool or they would never run
+        if opr.prop in ("io", "copy") and self._io_workers:
+            with self._io_lock:
+                heapq.heappush(self._io_heap, (-opr.priority, opr.seq, opr))
+                self._io_lock.notify()
+        else:
+            super()._dispatch(opr)
+
+    def stop(self):
+        super().stop()
+        with self._io_lock:
+            self._io_lock.notify_all()
 
 
 class NativeThreadedEngine(Engine):
@@ -365,7 +416,8 @@ class NativeThreadedEngine(Engine):
 
     _ptr_table: dict = {}
 
-    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
+             prop="normal"):
         ctypes = self._ctypes
 
         _check_duplicates(const_vars, mutable_vars)
@@ -412,7 +464,9 @@ def _create_engine() -> Engine:
     kind = getenv("MXNET_ENGINE_TYPE", "XLAEngine")
     if kind in ("NaiveEngine",):
         return NaiveEngine()
-    if kind in ("ThreadedEngine", "ThreadedEnginePooled"):
+    if kind == "ThreadedEnginePooled":
+        return ThreadedEnginePooled()
+    if kind == "ThreadedEngine":
         return ThreadedEngine()
     if kind in ("NativeEngine", "NativeThreadedEngine"):
         return NativeThreadedEngine()

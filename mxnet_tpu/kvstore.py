@@ -165,6 +165,35 @@ class KVStore:
     def send_command_to_servers(self, head: int, body: str):
         pass  # no server tier on TPU; optimizer runs worker-side
 
+    def num_dead_node(self, node_id: int = 0) -> int:
+        """Count of failed peers (reference ``KVStore::get_num_dead_node``,
+        ``kvstore_dist.h:149-158``). The jax.distributed runtime either
+        has every process healthy or the job has already failed, so a
+        reachable store always reports 0; recovery is checkpoint-based
+        (docs/distributed.md)."""
+        return 0
+
+    def set_barrier_before_exit(self, do_barrier: bool = True):
+        """Reference ``barrier_before_exit`` control (``c_api.cc:1295``):
+        when set, interpreter exit waits for all workers. Registered via
+        atexit for deterministic timing (a __del__ barrier could fire
+        mid-run on GC, or never at interpreter teardown)."""
+        import atexit
+
+        if do_barrier and not getattr(self, "_exit_barrier", False):
+            self._exit_barrier = True
+            atexit.register(self._exit_barrier_hook)
+        elif not do_barrier and getattr(self, "_exit_barrier", False):
+            self._exit_barrier = False
+            atexit.unregister(self._exit_barrier_hook)
+
+    def _exit_barrier_hook(self):
+        if getattr(self, "_exit_barrier", False):
+            try:
+                self.barrier()
+            except Exception:
+                pass
+
     def save_optimizer_states(self, fname: str):
         if self._optimizer is None or self._updater is None:
             raise MXNetError("no optimizer set")
