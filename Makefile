@@ -24,7 +24,7 @@ $(LIB): $(SRCS)
 	@mkdir -p mxnet_tpu/_native
 	$(CXX) $(CXXFLAGS) -shared -o $@ $(SRCS)
 
-$(PREDICT_LIB): $(PREDICT_SRCS) include/mxnet_tpu/c_predict_api.h
+$(PREDICT_LIB): $(PREDICT_SRCS) $(wildcard include/mxnet_tpu/*.h) $(wildcard src/capi/*.h)
 	@mkdir -p mxnet_tpu/_native
 	$(CXX) $(CXXFLAGS) $(PY_INCLUDES) -shared -o $@ $(PREDICT_SRCS) $(PY_LDFLAGS)
 

@@ -1,0 +1,118 @@
+/*!
+ * Core C API — the training-capable ABI subset for non-Python frontends.
+ *
+ * The reference exposed ~110 MX* functions (include/mxnet/c_api.h) that
+ * the R/Scala/Matlab bindings consumed: NDArray create/copy/save/load,
+ * symbol compose/infer, executor bind/forward/backward, KVStore. This
+ * header is the re-designed equivalent over the TPU runtime: the subset
+ * that a frontend needs to build tensors, load/compose symbols, run
+ * training steps, and read gradients. Deployment-only clients should
+ * prefer c_predict_api.h.
+ *
+ * Conventions follow the reference (src/c_api/c_api_error.h): every
+ * function returns 0 on success, -1 on failure with the message
+ * available from MXGetLastError() (thread-local).
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+typedef uint32_t mx_uint;
+typedef float mx_float;
+
+const char *MXGetLastError(void);
+
+/* ---- NDArray ---------------------------------------------------------- */
+
+/*! \brief Create an f32 NDArray of the given shape (dev_type 1=cpu,
+ * 2=tpu), zero-initialized. */
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, NDArrayHandle *out);
+int MXNDArrayFree(NDArrayHandle handle);
+/*! \brief Shape query; pointers valid until the next call on this
+ * handle or Free. */
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_ndim,
+                      const mx_uint **out_pdata);
+/*! \brief Blocking host->device copy of `size` floats. */
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const mx_float *data,
+                             mx_uint size);
+/*! \brief Blocking device->host copy of `size` floats. */
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, mx_float *data,
+                           mx_uint size);
+int MXNDArrayWaitAll(void);
+/*! \brief Save named arrays to the reference-compatible container. */
+int MXNDArraySave(const char *fname, mx_uint num_args,
+                  NDArrayHandle *args, const char **keys);
+/*! \brief Load a container; returns parallel arrays of handles and
+ * names (valid until MXNDArrayListFree). */
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names);
+int MXNDArrayListFree(NDArrayHandle *arr, mx_uint size,
+                      const char **names);
+
+/* ---- Symbol ----------------------------------------------------------- */
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+/*! \brief Serialize; the returned string is valid until the next call
+ * on this handle or Free. */
+int MXSymbolSaveToJSON(SymbolHandle handle, const char **out_json);
+/*! \brief List argument names; valid until next call/Free. */
+int MXSymbolListArguments(SymbolHandle handle, mx_uint *out_size,
+                          const char ***out_array);
+int MXSymbolListOutputs(SymbolHandle handle, mx_uint *out_size,
+                        const char ***out_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle handle, mx_uint *out_size,
+                                const char ***out_array);
+/*! \brief Shape inference from named input shapes. Returns per-argument
+ * shapes (csr layout: ind[i]..ind[i+1] into data). Buffers valid until
+ * next call/Free. */
+int MXSymbolInferShape(SymbolHandle handle, mx_uint num_args,
+                       const char **keys, const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data,
+                       mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data);
+int MXSymbolFree(SymbolHandle handle);
+
+/* ---- Executor --------------------------------------------------------- */
+
+/*! \brief simple_bind: infer shapes from named inputs, allocate
+ * args/grads/aux, bind (grad_req "write" when for_training != 0). */
+int MXExecutorSimpleBind(SymbolHandle symbol, int dev_type, int dev_id,
+                         mx_uint num_args, const char **keys,
+                         const mx_uint *arg_ind_ptr,
+                         const mx_uint *arg_shape_data, int for_training,
+                         ExecutorHandle *out);
+/*! \brief Copy data into a named argument (input or parameter). */
+int MXExecutorSetArg(ExecutorHandle handle, const char *name,
+                     const mx_float *data, mx_uint size);
+int MXExecutorForward(ExecutorHandle handle, int is_train);
+/*! \brief Backward with implicit all-ones head gradients. */
+int MXExecutorBackward(ExecutorHandle handle);
+/*! \brief Number of outputs. */
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size);
+/*! \brief Copy output `index` to host (`size` floats must match). */
+int MXExecutorGetOutput(ExecutorHandle handle, mx_uint index,
+                        mx_float *data, mx_uint size);
+/*! \brief Copy the gradient of argument `name` to host. */
+int MXExecutorGetGrad(ExecutorHandle handle, const char *name,
+                      mx_float *data, mx_uint size);
+int MXExecutorFree(ExecutorHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXNET_TPU_C_API_H_ */

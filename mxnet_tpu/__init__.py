@@ -55,3 +55,4 @@ from . import visualization   # noqa: E402
 from . import visualization as viz  # noqa: E402
 from . import test_utils      # noqa: E402
 from . import export          # noqa: E402
+from . import profiler        # noqa: E402

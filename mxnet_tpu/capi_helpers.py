@@ -84,3 +84,102 @@ def ndlist_load(blob):
         a = np.ascontiguousarray(arr.asnumpy(), dtype=np.float32)
         out.append((name, a.tobytes(), tuple(int(d) for d in a.shape)))
     return out
+
+
+# ---- core C API helpers (src/capi/c_api.cc) ------------------------------
+
+def ndarray_create(shape, dev_type, dev_id):
+    from . import ndarray as nd
+
+    return nd.zeros(tuple(int(d) for d in shape),
+                    ctx=_ctx(dev_type, dev_id))
+
+
+def ndarray_set(arr, memview):
+    data = np.frombuffer(memview, dtype=np.float32)
+    if data.size != int(np.prod(arr.shape)):
+        raise MXNetError("copy size %d != array size %d"
+                         % (data.size, int(np.prod(arr.shape))))
+    arr[:] = data.reshape(arr.shape)
+    arr.wait_to_read()
+
+
+def ndarray_bytes(arr):
+    return np.ascontiguousarray(arr.asnumpy(),
+                                dtype=np.float32).tobytes()
+
+
+def wait_all():
+    from .engine import get_engine
+
+    get_engine().wait_for_all()
+
+
+def ndarray_save(fname, names, arrs):
+    from . import ndarray as nd
+
+    nd.save(fname, dict(zip(names, arrs)))
+
+
+def ndarray_load_pairs(fname):
+    from . import ndarray as nd
+
+    arrays = nd.load(fname)
+    items = arrays.items() if isinstance(arrays, dict) \
+        else ((str(i), a) for i, a in enumerate(arrays))
+    return [(name, arr, tuple(int(d) for d in arr.shape))
+            for name, arr in items]
+
+
+def symbol_from_json(json_str):
+    from . import symbol as sym_mod
+
+    return sym_mod.load_json(json_str)
+
+
+def symbol_infer_shape(sym, shapes):
+    arg_shapes, out_shapes, _ = sym.infer_shape(
+        **{k: tuple(int(d) for d in v) for k, v in shapes.items()})
+    return ([tuple(int(d) for d in s) for s in arg_shapes],
+            [tuple(int(d) for d in s) for s in out_shapes])
+
+
+def executor_simple_bind(sym, dev_type, dev_id, shapes, for_training):
+    kw = {k: tuple(int(d) for d in v) for k, v in shapes.items()}
+    return sym.simple_bind(ctx=_ctx(dev_type, dev_id),
+                           grad_req="write" if for_training else "null",
+                           **kw)
+
+
+def executor_set_arg(exe, name, memview):
+    target = exe.arg_dict.get(name)
+    if target is None:
+        raise MXNetError("unknown argument '%s'" % name)
+    data = np.frombuffer(memview, dtype=np.float32)
+    target[:] = data.reshape(target.shape)
+    # the C caller's buffer may be freed the moment we return; force the
+    # (possibly deferred) copy to complete before then
+    target.wait_to_read()
+
+
+def executor_forward(exe, is_train):
+    exe.forward(is_train=bool(is_train))
+
+
+def executor_num_outputs(exe):
+    return len(exe.output_names)
+
+
+def executor_output_bytes(exe, index):
+    outs = exe.outputs
+    if index >= len(outs):
+        raise MXNetError("output index %d out of range" % index)
+    return np.ascontiguousarray(outs[index].asnumpy(),
+                                dtype=np.float32).tobytes()
+
+
+def executor_grad_bytes(exe, name):
+    g = exe.grad_dict.get(name)
+    if g is None:
+        raise MXNetError("no gradient for argument '%s'" % name)
+    return np.ascontiguousarray(g.asnumpy(), dtype=np.float32).tobytes()

@@ -1,0 +1,116 @@
+"""Profiling: XLA trace capture + per-step timing.
+
+The reference's observability was the Monitor callback, `Speedometer`,
+engine op logging, and `check_speed` (SURVEY §5 — no chrome-trace
+profiler existed in that era). The TPU-native tier adds what the
+hardware provides: XLA/TPU trace capture through ``jax.profiler``
+(viewable in TensorBoard / Perfetto) plus host-side named spans.
+
+API follows the start/stop convention later MXNet adopted::
+
+    mx.profiler.start("/tmp/prof")      # begin device trace capture
+    ... training steps ...
+    mx.profiler.stop()                  # writes the trace
+
+    with mx.profiler.annotate("data-load"):   # named span inside traces
+        batch = next(it)
+
+    timer = mx.profiler.StepTimer()     # per-step wall-time stats
+    for batch in it:
+        with timer:
+            step(...)
+    print(timer.summary())
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import List, Optional
+
+from .base import MXNetError
+
+__all__ = ["start", "stop", "annotate", "StepTimer", "is_running"]
+
+_active_logdir: Optional[str] = None
+
+
+def start(logdir: str):
+    """Begin an XLA trace capture into ``logdir``."""
+    global _active_logdir
+    if _active_logdir is not None:
+        raise MXNetError("profiler already running (logdir=%s)"
+                         % _active_logdir)
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    _active_logdir = logdir
+
+
+def stop():
+    """End the capture and flush the trace."""
+    global _active_logdir
+    if _active_logdir is None:
+        raise MXNetError("profiler is not running")
+    import jax
+
+    try:
+        jax.profiler.stop_trace()
+    finally:
+        _active_logdir = None  # never wedge the profiler on flush errors
+
+
+def is_running() -> bool:
+    return _active_logdir is not None
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named span; shows up in captured traces (TraceAnnotation) and is
+    harmless outside a capture."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class StepTimer:
+    """Wall-clock per-step statistics (the reference's Speedometer
+    measured throughput; this measures latency percentiles). Use as a
+    context manager around each step."""
+
+    def __init__(self, sync_fn=None):
+        self._times: List[float] = []
+        self._t0 = 0.0
+        self._sync_fn = sync_fn
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._sync_fn is not None:
+            self._sync_fn()
+        self._times.append(time.perf_counter() - self._t0)
+        return False
+
+    @property
+    def times(self) -> List[float]:
+        return list(self._times)
+
+    def reset(self):
+        self._times.clear()
+
+    def summary(self, skip_first: int = 1) -> dict:
+        """Stats excluding the first ``skip_first`` (compile) steps;
+        ``steps: 0`` if nothing remains after skipping."""
+        ts = sorted(self._times[skip_first:])
+        if not ts:
+            return {"steps": 0}
+        n = len(ts)
+        return {
+            "steps": n,
+            "mean_ms": sum(ts) / n * 1e3,
+            "p50_ms": ts[n // 2] * 1e3,
+            "p90_ms": ts[min(n - 1, int(n * 0.9))] * 1e3,
+            "max_ms": ts[-1] * 1e3,
+        }

@@ -1,0 +1,470 @@
+/*!
+ * Core C API implementation (see include/mxnet_tpu/c_api.h).
+ *
+ * Reference analogue: src/c_api/c_api.cc (~110 MX* functions over the
+ * C++ runtime). Here the runtime compiles through XLA, so this layer
+ * marshals handles and buffers into mxnet_tpu via the embedded
+ * interpreter (plumbing shared with c_predict_api.cc). Handles own a
+ * Python object reference plus cached C views (shapes, string lists)
+ * so returned pointers outlive the GIL scope.
+ */
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "../../include/mxnet_tpu/c_api.h"
+#include "embed_common.h"
+
+using namespace mxtpu_embed;
+
+namespace {
+
+struct StrList {
+  std::vector<std::string> store;
+  std::vector<const char *> ptrs;
+
+  const char **fill(PyObject *list_of_str) {
+    store.clear();
+    ptrs.clear();
+    Py_ssize_t n = PyList_Size(list_of_str);
+    for (Py_ssize_t i = 0; i < n; ++i)
+      store.emplace_back(PyUnicode_AsUTF8(PyList_GET_ITEM(list_of_str, i)));
+    for (auto &s : store) ptrs.push_back(s.c_str());
+    return ptrs.data();
+  }
+};
+
+struct ShapeList {
+  std::vector<std::vector<mx_uint>> shapes;
+  std::vector<mx_uint> ndims;
+  std::vector<const mx_uint *> ptrs;
+
+  void fill(PyObject *list_of_shape_tuples) {
+    shapes.clear();
+    ndims.clear();
+    ptrs.clear();
+    Py_ssize_t n = PyList_Size(list_of_shape_tuples);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *t = PyList_GET_ITEM(list_of_shape_tuples, i);
+      std::vector<mx_uint> dims(PyTuple_Size(t));
+      for (size_t d = 0; d < dims.size(); ++d)
+        dims[d] = (mx_uint)PyLong_AsUnsignedLong(PyTuple_GET_ITEM(t, d));
+      shapes.push_back(std::move(dims));
+    }
+    for (auto &s : shapes) {
+      ndims.push_back((mx_uint)s.size());
+      ptrs.push_back(s.data());
+    }
+  }
+};
+
+struct NDArrayRec {
+  PyObject *arr = nullptr;
+  std::vector<mx_uint> shape;
+};
+
+struct SymbolRec {
+  PyObject *sym = nullptr;
+  std::string json;
+  StrList args, outputs, aux;
+  ShapeList in_shapes, out_shapes;
+};
+
+struct ExecRec {
+  PyObject *exe = nullptr; /* mxnet_tpu Executor */
+};
+
+PyObject *shape_tuple(const mx_uint *dims, mx_uint n) {
+  PyObject *t = PyTuple_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromUnsignedLong(dims[i]));
+  return t;
+}
+
+PyObject *shape_dict(mx_uint num, const char **keys, const mx_uint *indptr,
+                     const mx_uint *data) {
+  PyObject *d = PyDict_New();
+  for (mx_uint i = 0; i < num; ++i) {
+    PyObject *t = shape_tuple(data + indptr[i], indptr[i + 1] - indptr[i]);
+    PyDict_SetItemString(d, keys[i], t);
+    Py_DECREF(t);
+  }
+  return d;
+}
+
+/* Call helpers.<fn>(...) returning new ref or null (error set). */
+PyObject *call_helper(const char *fn, const char *fmt, ...) {
+  PyObject *helpers = helper_module();
+  if (!helpers) {
+    set_error_from_python();
+    return nullptr;
+  }
+  va_list va;
+  va_start(va, fmt);
+  PyObject *callable = PyObject_GetAttrString(helpers, fn);
+  PyObject *r = nullptr;
+  if (callable) {
+    PyObject *args = Py_VaBuildValue(fmt, va); /* fmt always "(...)" */
+    if (args) {
+      r = PyObject_CallObject(callable, args);
+      Py_DECREF(args);
+    }
+    Py_DECREF(callable);
+  }
+  va_end(va);
+  if (!r) set_error_from_python();
+  return r;
+}
+
+int copy_floats_out(PyObject *bytes, mx_float *data, mx_uint size,
+                    const char *what) {
+  Py_ssize_t n = PyBytes_Size(bytes);
+  if ((mx_uint)(n / sizeof(mx_float)) != size) {
+    set_error(std::string(what) + " size mismatch: have " +
+              std::to_string(n / sizeof(mx_float)) + " floats, caller asked " +
+              std::to_string(size));
+    return -1;
+  }
+  std::memcpy(data, PyBytes_AsString(bytes), (size_t)n);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+/* ---- NDArray ---------------------------------------------------------- */
+
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, NDArrayHandle *out) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *t = shape_tuple(shape, ndim);
+  PyObject *arr = call_helper("ndarray_create", "(Oii)", t, dev_type, dev_id);
+  Py_DECREF(t);
+  if (!arr) return -1;
+  NDArrayRec *rec = new NDArrayRec();
+  rec->arr = arr;
+  rec->shape.assign(shape, shape + ndim);
+  *out = rec;
+  return 0;
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  GIL gil;
+  NDArrayRec *rec = static_cast<NDArrayRec *>(handle);
+  Py_XDECREF(rec->arr);
+  delete rec;
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_ndim,
+                      const mx_uint **out_pdata) {
+  NDArrayRec *rec = static_cast<NDArrayRec *>(handle);
+  *out_ndim = (mx_uint)rec->shape.size();
+  *out_pdata = rec->shape.data();
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const mx_float *data,
+                             mx_uint size) {
+  GIL gil;
+  NDArrayRec *rec = static_cast<NDArrayRec *>(handle);
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<mx_float *>(data)),
+      (Py_ssize_t)size * sizeof(mx_float), PyBUF_READ);
+  if (!mv) { set_error_from_python(); return -1; }
+  PyObject *r = call_helper("ndarray_set", "(OO)", rec->arr, mv);
+  Py_DECREF(mv);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, mx_float *data,
+                           mx_uint size) {
+  GIL gil;
+  NDArrayRec *rec = static_cast<NDArrayRec *>(handle);
+  PyObject *bytes = call_helper("ndarray_bytes", "(O)", rec->arr);
+  if (!bytes) return -1;
+  int rc = copy_floats_out(bytes, data, size, "ndarray");
+  Py_DECREF(bytes);
+  return rc;
+}
+
+int MXNDArrayWaitAll(void) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *r = call_helper("wait_all", "()");
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySave(const char *fname, mx_uint num_args, NDArrayHandle *args,
+                  const char **keys) {
+  GIL gil;
+  PyObject *names = PyList_New(num_args);
+  PyObject *arrs = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyList_SET_ITEM(names, i, PyUnicode_FromString(keys[i]));
+    PyObject *a = static_cast<NDArrayRec *>(args[i])->arr;
+    Py_INCREF(a);
+    PyList_SET_ITEM(arrs, i, a);
+  }
+  PyObject *r = call_helper("ndarray_save", "(sOO)", fname, names, arrs);
+  Py_DECREF(names);
+  Py_DECREF(arrs);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+struct NDLoadRec {
+  std::vector<NDArrayHandle> handles;
+  StrList names;
+};
+
+static std::vector<NDLoadRec *> g_load_recs;  /* guarded by the GIL */
+
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *pairs = call_helper("ndarray_load_pairs", "(s)", fname);
+  if (!pairs) return -1;
+  Py_ssize_t n = PyList_Size(pairs);
+  NDLoadRec *load = new NDLoadRec();
+  PyObject *name_list = PyList_New(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *pair = PyList_GET_ITEM(pairs, i);
+    PyObject *name = PyTuple_GET_ITEM(pair, 0);
+    PyObject *arr = PyTuple_GET_ITEM(pair, 1);
+    Py_INCREF(name);
+    PyList_SET_ITEM(name_list, i, name);
+    NDArrayRec *rec = new NDArrayRec();
+    Py_INCREF(arr);
+    rec->arr = arr;
+    PyObject *shape = PyTuple_GET_ITEM(pair, 2);
+    for (Py_ssize_t d = 0; d < PyTuple_Size(shape); ++d)
+      rec->shape.push_back(
+          (mx_uint)PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shape, d)));
+    load->handles.push_back(rec);
+  }
+  load->names.fill(name_list);
+  Py_DECREF(name_list);
+  Py_DECREF(pairs);
+  *out_size = (mx_uint)load->handles.size();
+  *out_arr = load->handles.data();
+  *out_name_size = (mx_uint)load->names.ptrs.size();
+  *out_names = load->names.ptrs.data();
+  /* The NDLoadRec lives until MXNDArrayListFree: the caller's pointers
+   * alias its storage. */
+  g_load_recs.push_back(load);
+  return 0;
+}
+
+int MXNDArrayListFree(NDArrayHandle *arr, mx_uint size, const char **names) {
+  GIL gil;
+  (void)names;
+  for (auto it = g_load_recs.begin(); it != g_load_recs.end(); ++it) {
+    if ((*it)->handles.data() == arr) {
+      for (mx_uint i = 0; i < size; ++i) MXNDArrayFree((*it)->handles[i]);
+      delete *it;
+      g_load_recs.erase(it);
+      return 0;
+    }
+  }
+  set_error("unknown ndarray list");
+  return -1;
+}
+
+/* ---- Symbol ----------------------------------------------------------- */
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *sym = call_helper("symbol_from_json", "(s)", json);
+  if (!sym) return -1;
+  SymbolRec *rec = new SymbolRec();
+  rec->sym = sym;
+  *out = rec;
+  return 0;
+}
+
+int MXSymbolSaveToJSON(SymbolHandle handle, const char **out_json) {
+  GIL gil;
+  SymbolRec *rec = static_cast<SymbolRec *>(handle);
+  PyObject *s = PyObject_CallMethod(rec->sym, "tojson", nullptr);
+  if (!s) { set_error_from_python(); return -1; }
+  rec->json = PyUnicode_AsUTF8(s);
+  Py_DECREF(s);
+  *out_json = rec->json.c_str();
+  return 0;
+}
+
+static int list_strings(SymbolRec *rec, const char *method, StrList *into,
+                        mx_uint *out_size, const char ***out_array) {
+  GIL gil;
+  PyObject *lst = PyObject_CallMethod(rec->sym, method, nullptr);
+  if (!lst) { set_error_from_python(); return -1; }
+  *out_array = into->fill(lst);
+  *out_size = (mx_uint)into->ptrs.size();
+  Py_DECREF(lst);
+  return 0;
+}
+
+int MXSymbolListArguments(SymbolHandle handle, mx_uint *out_size,
+                          const char ***out_array) {
+  SymbolRec *rec = static_cast<SymbolRec *>(handle);
+  return list_strings(rec, "list_arguments", &rec->args, out_size,
+                      out_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle handle, mx_uint *out_size,
+                        const char ***out_array) {
+  SymbolRec *rec = static_cast<SymbolRec *>(handle);
+  return list_strings(rec, "list_outputs", &rec->outputs, out_size,
+                      out_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle handle, mx_uint *out_size,
+                                const char ***out_array) {
+  SymbolRec *rec = static_cast<SymbolRec *>(handle);
+  return list_strings(rec, "list_auxiliary_states", &rec->aux, out_size,
+                      out_array);
+}
+
+int MXSymbolInferShape(SymbolHandle handle, mx_uint num_args,
+                       const char **keys, const mx_uint *arg_ind_ptr,
+                       const mx_uint *arg_shape_data, mx_uint *in_shape_size,
+                       const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data) {
+  GIL gil;
+  SymbolRec *rec = static_cast<SymbolRec *>(handle);
+  PyObject *shapes = shape_dict(num_args, keys, arg_ind_ptr, arg_shape_data);
+  PyObject *r = call_helper("symbol_infer_shape", "(OO)", rec->sym, shapes);
+  Py_DECREF(shapes);
+  if (!r) return -1;
+  rec->in_shapes.fill(PyTuple_GET_ITEM(r, 0));
+  rec->out_shapes.fill(PyTuple_GET_ITEM(r, 1));
+  Py_DECREF(r);
+  *in_shape_size = (mx_uint)rec->in_shapes.shapes.size();
+  *in_shape_ndim = rec->in_shapes.ndims.data();
+  *in_shape_data = rec->in_shapes.ptrs.data();
+  *out_shape_size = (mx_uint)rec->out_shapes.shapes.size();
+  *out_shape_ndim = rec->out_shapes.ndims.data();
+  *out_shape_data = rec->out_shapes.ptrs.data();
+  return 0;
+}
+
+int MXSymbolFree(SymbolHandle handle) {
+  GIL gil;
+  SymbolRec *rec = static_cast<SymbolRec *>(handle);
+  Py_XDECREF(rec->sym);
+  delete rec;
+  return 0;
+}
+
+/* ---- Executor --------------------------------------------------------- */
+
+int MXExecutorSimpleBind(SymbolHandle symbol, int dev_type, int dev_id,
+                         mx_uint num_args, const char **keys,
+                         const mx_uint *arg_ind_ptr,
+                         const mx_uint *arg_shape_data, int for_training,
+                         ExecutorHandle *out) {
+  GIL gil;
+  SymbolRec *srec = static_cast<SymbolRec *>(symbol);
+  PyObject *shapes = shape_dict(num_args, keys, arg_ind_ptr, arg_shape_data);
+  PyObject *exe = call_helper("executor_simple_bind", "(OiiOi)", srec->sym,
+                              dev_type, dev_id, shapes, for_training);
+  Py_DECREF(shapes);
+  if (!exe) return -1;
+  ExecRec *rec = new ExecRec();
+  rec->exe = exe;
+  *out = rec;
+  return 0;
+}
+
+int MXExecutorSetArg(ExecutorHandle handle, const char *name,
+                     const mx_float *data, mx_uint size) {
+  GIL gil;
+  ExecRec *rec = static_cast<ExecRec *>(handle);
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<mx_float *>(data)),
+      (Py_ssize_t)size * sizeof(mx_float), PyBUF_READ);
+  if (!mv) { set_error_from_python(); return -1; }
+  PyObject *r = call_helper("executor_set_arg", "(OsO)", rec->exe, name, mv);
+  Py_DECREF(mv);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  GIL gil;
+  ExecRec *rec = static_cast<ExecRec *>(handle);
+  PyObject *r = call_helper("executor_forward", "(Oi)", rec->exe, is_train);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorBackward(ExecutorHandle handle) {
+  GIL gil;
+  ExecRec *rec = static_cast<ExecRec *>(handle);
+  PyObject *r = PyObject_CallMethod(rec->exe, "backward", nullptr);
+  if (!r) { set_error_from_python(); return -1; }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size) {
+  GIL gil;
+  ExecRec *rec = static_cast<ExecRec *>(handle);
+  PyObject *n = call_helper("executor_num_outputs", "(O)", rec->exe);
+  if (!n) return -1;
+  *out_size = (mx_uint)PyLong_AsUnsignedLong(n);
+  Py_DECREF(n);
+  return 0;
+}
+
+int MXExecutorGetOutput(ExecutorHandle handle, mx_uint index, mx_float *data,
+                        mx_uint size) {
+  GIL gil;
+  ExecRec *rec = static_cast<ExecRec *>(handle);
+  PyObject *bytes = call_helper("executor_output_bytes", "(OI)", rec->exe,
+                                index);
+  if (!bytes) return -1;
+  int rc = copy_floats_out(bytes, data, size, "output");
+  Py_DECREF(bytes);
+  return rc;
+}
+
+int MXExecutorGetGrad(ExecutorHandle handle, const char *name, mx_float *data,
+                      mx_uint size) {
+  GIL gil;
+  ExecRec *rec = static_cast<ExecRec *>(handle);
+  PyObject *bytes = call_helper("executor_grad_bytes", "(Os)", rec->exe,
+                                name);
+  if (!bytes) return -1;
+  int rc = copy_floats_out(bytes, data, size, "grad");
+  Py_DECREF(bytes);
+  return rc;
+}
+
+int MXExecutorFree(ExecutorHandle handle) {
+  GIL gil;
+  ExecRec *rec = static_cast<ExecRec *>(handle);
+  Py_XDECREF(rec->exe);
+  delete rec;
+  return 0;
+}
+
+}  /* extern "C" */

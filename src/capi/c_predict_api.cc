@@ -21,8 +21,9 @@
 #include <vector>
 
 #include "../../include/mxnet_tpu/c_predict_api.h"
+#include "embed_common.h"
 
-namespace {
+namespace mxtpu_embed {
 
 thread_local std::string g_last_error;
 
@@ -65,15 +66,6 @@ bool ensure_interpreter() {
   return true;
 }
 
-class GIL {
- public:
-  GIL() : state_(PyGILState_Ensure()) {}
-  ~GIL() { PyGILState_Release(state_); }
-
- private:
-  PyGILState_STATE state_;
-};
-
 struct PredRec {
   PyObject *predictor = nullptr;            /* mxnet_tpu Predictor */
   std::vector<std::vector<mx_uint>> output_shapes;
@@ -100,7 +92,9 @@ PyObject *helper_module() {
   return mod;
 }
 
-}  // namespace
+}  // namespace mxtpu_embed
+
+using namespace mxtpu_embed;
 
 extern "C" {
 

@@ -1,0 +1,35 @@
+/*!
+ * Shared CPython-embedding plumbing for the C ABI translation units
+ * (c_predict_api.cc and c_api.cc compile into one libmxtpu_predict.so).
+ * Error convention and interpreter lifecycle live here; definitions are
+ * in c_predict_api.cc.
+ */
+#ifndef MXNET_TPU_SRC_CAPI_EMBED_COMMON_H_
+#define MXNET_TPU_SRC_CAPI_EMBED_COMMON_H_
+
+#include <Python.h>
+
+#include <string>
+
+namespace mxtpu_embed {
+
+void set_error(const std::string &msg);
+void set_error_from_python();
+bool ensure_interpreter();
+/* mxnet_tpu.capi_helpers module (borrowed ref cached under the GIL). */
+PyObject *helper_module();
+
+class GIL {
+ public:
+  GIL() : state_(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(state_); }
+  GIL(const GIL &) = delete;
+  GIL &operator=(const GIL &) = delete;
+
+ private:
+  PyGILState_STATE state_;
+};
+
+}  // namespace mxtpu_embed
+
+#endif  /* MXNET_TPU_SRC_CAPI_EMBED_COMMON_H_ */

@@ -1,0 +1,181 @@
+"""Core C ABI (training-capable subset): ctypes drive of NDArray /
+Symbol / Executor functions.
+
+Reference analogue: src/c_api/c_api.cc consumed by the R/Scala
+bindings — create tensors, load symbols, bind, forward/backward, read
+gradients, update weights host-side.
+"""
+import ctypes
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+LIB = os.path.join(REPO, "mxnet_tpu", "_native", "libmxtpu_predict.so")
+
+
+def _lib():
+    if not shutil.which("make"):
+        pytest.skip("no make toolchain")
+    r = subprocess.run(["make", "-C", REPO, "predict"], capture_output=True,
+                       text=True)
+    if r.returncode != 0 or not os.path.exists(LIB):
+        pytest.skip("c api build failed: %s" % r.stderr[-500:])
+    lib = ctypes.CDLL(LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _fptr(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def test_ndarray_roundtrip_and_saveload(tmp_path):
+    lib = _lib()
+    h = ctypes.c_void_p()
+    shape = (ctypes.c_uint32 * 2)(3, 4)
+    assert lib.MXNDArrayCreate(shape, 2, 1, 0, ctypes.byref(h)) == 0, \
+        lib.MXGetLastError()
+
+    ndim = ctypes.c_uint32()
+    pdata = ctypes.POINTER(ctypes.c_uint32)()
+    assert lib.MXNDArrayGetShape(h, ctypes.byref(ndim),
+                                 ctypes.byref(pdata)) == 0
+    assert tuple(pdata[i] for i in range(ndim.value)) == (3, 4)
+
+    x = np.arange(12, dtype=np.float32)
+    assert lib.MXNDArraySyncCopyFromCPU(h, _fptr(x), 12) == 0, \
+        lib.MXGetLastError()
+    out = np.zeros(12, dtype=np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(h, _fptr(out), 12) == 0
+    np.testing.assert_array_equal(out, x)
+    assert lib.MXNDArrayWaitAll() == 0
+
+    # save/load container roundtrip
+    fname = str(tmp_path / "arrs.nd").encode()
+    keys = (ctypes.c_char_p * 1)(b"w")
+    handles = (ctypes.c_void_p * 1)(h)
+    assert lib.MXNDArraySave(fname, 1, handles, keys) == 0, \
+        lib.MXGetLastError()
+    out_size = ctypes.c_uint32()
+    out_arr = ctypes.POINTER(ctypes.c_void_p)()
+    name_size = ctypes.c_uint32()
+    out_names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXNDArrayLoad(fname, ctypes.byref(out_size),
+                             ctypes.byref(out_arr),
+                             ctypes.byref(name_size),
+                             ctypes.byref(out_names)) == 0, \
+        lib.MXGetLastError()
+    assert out_size.value == 1 and out_names[0] == b"w"
+    loaded = np.zeros(12, dtype=np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(ctypes.c_void_p(out_arr[0]),
+                                      _fptr(loaded), 12) == 0
+    np.testing.assert_array_equal(loaded, x)
+    assert lib.MXNDArrayListFree(out_arr, 1, out_names) == 0
+    assert lib.MXNDArrayFree(h) == 0
+
+    # error path: size mismatch
+    h2 = ctypes.c_void_p()
+    lib.MXNDArrayCreate(shape, 2, 1, 0, ctypes.byref(h2))
+    bad = np.zeros(5, dtype=np.float32)
+    assert lib.MXNDArraySyncCopyFromCPU(h2, _fptr(bad), 5) == -1
+    assert b"size" in lib.MXGetLastError()
+    lib.MXNDArrayFree(h2)
+
+
+def test_symbol_and_training_loop():
+    lib = _lib()
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc1")
+    net = mx.sym.Activation(data=net, act_type="tanh")
+    net = mx.sym.FullyConnected(data=net, num_hidden=1, name="fc2")
+    net = mx.sym.LinearRegressionOutput(data=net, name="lro")
+    json = net.tojson().encode()
+
+    sh = ctypes.c_void_p()
+    assert lib.MXSymbolCreateFromJSON(json, ctypes.byref(sh)) == 0, \
+        lib.MXGetLastError()
+
+    # round trip JSON
+    out_json = ctypes.c_char_p()
+    assert lib.MXSymbolSaveToJSON(sh, ctypes.byref(out_json)) == 0
+    assert mx.sym.load_json(out_json.value.decode()).list_arguments() == \
+        net.list_arguments()
+
+    n_args = ctypes.c_uint32()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXSymbolListArguments(sh, ctypes.byref(n_args),
+                                     ctypes.byref(names)) == 0
+    arg_names = [names[i].decode() for i in range(n_args.value)]
+    assert arg_names == net.list_arguments()
+
+    # infer shapes from data shape
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint32 * 2)(0, 2)
+    sdata = (ctypes.c_uint32 * 2)(8, 3)
+    in_size = ctypes.c_uint32()
+    in_ndim = ctypes.POINTER(ctypes.c_uint32)()
+    in_data = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint32))()
+    out_size = ctypes.c_uint32()
+    out_ndim = ctypes.POINTER(ctypes.c_uint32)()
+    out_data = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint32))()
+    assert lib.MXSymbolInferShape(
+        sh, 1, keys, indptr, sdata, ctypes.byref(in_size),
+        ctypes.byref(in_ndim), ctypes.byref(in_data),
+        ctypes.byref(out_size), ctypes.byref(out_ndim),
+        ctypes.byref(out_data)) == 0, lib.MXGetLastError()
+    arg_shapes = [tuple(in_data[i][d] for d in range(in_ndim[i]))
+                  for i in range(in_size.value)]
+    assert arg_shapes[arg_names.index("fc1_weight")] == (4, 3)
+    assert tuple(out_data[0][d] for d in range(out_ndim[0])) == (8, 1)
+
+    # bind for training
+    eh = ctypes.c_void_p()
+    assert lib.MXExecutorSimpleBind(sh, 1, 0, 1, keys, indptr, sdata, 1,
+                                    ctypes.byref(eh)) == 0, \
+        lib.MXGetLastError()
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(8, 3).astype(np.float32)
+    y = (X @ np.array([1.0, -2.0, 0.5], np.float32))[:, None]
+    params = {n: (rng.randn(*s) * 0.3).astype(np.float32)
+              for n, s in zip(arg_names, arg_shapes)
+              if n not in ("data", "lro_label")}
+
+    def set_arg(name, arr):
+        a = np.ascontiguousarray(arr, dtype=np.float32)
+        assert lib.MXExecutorSetArg(eh, name.encode(), _fptr(a),
+                                    a.size) == 0, lib.MXGetLastError()
+
+    losses = []
+    lr = 0.05
+    for step in range(60):
+        set_arg("data", X)
+        set_arg("lro_label", y)
+        for n, v in params.items():
+            set_arg(n, v)
+        assert lib.MXExecutorForward(eh, 1) == 0, lib.MXGetLastError()
+        assert lib.MXExecutorBackward(eh) == 0, lib.MXGetLastError()
+        n_out = ctypes.c_uint32()
+        assert lib.MXExecutorOutputs(eh, ctypes.byref(n_out)) == 0
+        pred = np.zeros((8, 1), np.float32)
+        assert lib.MXExecutorGetOutput(eh, 0, _fptr(pred), 8) == 0
+        losses.append(float(((pred - y) ** 2).mean()))
+        for n in params:
+            g = np.zeros_like(params[n])
+            assert lib.MXExecutorGetGrad(eh, n.encode(), _fptr(g),
+                                         g.size) == 0, lib.MXGetLastError()
+            params[n] = params[n] - lr * g
+    assert losses[-1] < losses[0] * 0.2, losses[::10]
+
+    # error: unknown grad name
+    g = np.zeros(4, np.float32)
+    assert lib.MXExecutorGetGrad(eh, b"nope", _fptr(g), 4) == -1
+    assert lib.MXExecutorFree(eh) == 0
+    assert lib.MXSymbolFree(sh) == 0

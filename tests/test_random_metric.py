@@ -100,3 +100,36 @@ def test_mixed_initializer():
     bias = mx.nd.ones((3,))
     init("fc_bias", bias)
     np.testing.assert_allclose(bias.asnumpy(), np.zeros(3))
+
+
+def test_profiler_trace_and_steptimer(tmp_path):
+    import glob
+    import time as _time
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.base import MXNetError
+
+    logdir = str(tmp_path / "prof")
+    mx.profiler.start(logdir)
+    assert mx.profiler.is_running()
+    with pytest.raises(MXNetError):
+        mx.profiler.start(logdir)   # double start rejected
+    with mx.profiler.annotate("span"):
+        x = mx.nd.ones((32, 32))
+        (x * 2).asnumpy()
+    mx.profiler.stop()
+    assert not mx.profiler.is_running()
+    with pytest.raises(MXNetError):
+        mx.profiler.stop()
+    # a trace file was written
+    assert glob.glob(logdir + "/**/*.trace*", recursive=True) or \
+        glob.glob(logdir + "/**/*.pb", recursive=True)
+
+    timer = mx.profiler.StepTimer()
+    for _ in range(5):
+        with timer:
+            _time.sleep(0.002)
+    s = timer.summary()
+    assert s["steps"] == 4          # first step skipped as compile
+    assert s["mean_ms"] >= 1.5
+    assert s["p50_ms"] <= s["max_ms"]
