@@ -107,13 +107,31 @@ def make_graph_eval(symbol, node_device=None):
     return eval_graph, n_aux
 
 
+_UNSET = object()  # distinguishes "not passed" from explicit None
+
+
 class Executor:
     def __init__(self, symbol, ctx: Context, args, args_grad=None,
                  grad_req: Union[str, Dict[str, str], List[str]] = "write",
-                 aux_states=None, group2ctx=None, shared_exec=None):
+                 aux_states=None, group2ctx=None, shared_exec=None,
+                 compute_dtype=_UNSET, label_names=None):
         self._symbol = symbol
         self._ctx = ctx
         self._group2ctx = group2ctx or {}
+        # mixed precision: compute in this dtype (e.g. "bfloat16") with
+        # full-precision params/grads outside the jitted graph. Default
+        # comes from MXNET_COMPUTE_DTYPE so existing scripts opt in via
+        # env; pass compute_dtype=None to force full precision for this
+        # executor even when the env var is set.
+        if compute_dtype is _UNSET:
+            compute_dtype = getenv("MXNET_COMPUTE_DTYPE", None)
+        self._compute_dtype = compute_dtype
+        # args that must never be cast under mixed precision; when the
+        # binder doesn't say (plain symbol.bind), fall back to the
+        # "*label" naming convention
+        self._label_names = (set(label_names) if label_names is not None
+                             else {n for n in symbol.list_arguments()
+                                   if n.endswith("label")})
         self.arg_names = symbol.list_arguments()
         self.output_names = symbol.list_outputs()
         self.aux_names = symbol.list_auxiliary_states()
@@ -187,14 +205,55 @@ class Executor:
                     if self._grad_req.get(n, "null") != "null"]
         self._grad_idx = grad_idx
 
+        cdtype = None
+        if self._compute_dtype is not None:
+            import jax.numpy as jnp
+            if isinstance(self._compute_dtype, str):
+                cdtype = getattr(jnp, self._compute_dtype, None)
+                if cdtype is None or not isinstance(cdtype, type):
+                    raise MXNetError(
+                        "invalid compute dtype %r (MXNET_COMPUTE_DTYPE / "
+                        "compute_dtype); expected a jax dtype name like "
+                        "'bfloat16' or 'float16'" % (self._compute_dtype,))
+            else:
+                cdtype = self._compute_dtype
+        # label args keep full precision (bf16 cannot represent class ids
+        # >= 256 exactly); everything else float casts to compute dtype
+        cast_arg = [cdtype is not None and n not in self._label_names
+                    for n in self.arg_names]
+
+        def cast_in(args):
+            if cdtype is None:
+                return args
+            import jax.numpy as jnp
+            return [a.astype(cdtype)
+                    if c and jnp.issubdtype(a.dtype, jnp.floating) else a
+                    for a, c in zip(args, cast_arg)]
+
+        def cast_out(outs):
+            if cdtype is None:
+                return outs
+            import jax.numpy as jnp
+            return [o.astype(jnp.float32)
+                    if jnp.issubdtype(o.dtype, jnp.floating) else o
+                    for o in outs]
+
+        def run_graph(args, aux, key, is_train, **kw):
+            res = eval_graph(cast_in(args), aux, key, is_train, **kw)
+            if kw.get("want_internals"):
+                outs, aux_out, internals = res
+                return cast_out(outs), aux_out, internals
+            outs, aux_out = res
+            return cast_out(outs), aux_out
+
         @jax.jit
         def fwd_infer(args, aux, key):
-            outs, _ = eval_graph(args, aux, key, False)
+            outs, _ = run_graph(args, aux, key, False)
             return outs
 
         @jax.jit
         def fwd_train(args, aux, key):
-            return eval_graph(args, aux, key, True)
+            return run_graph(args, aux, key, True)
 
         # MXNET_BACKWARD_DO_MIRROR (reference static_graph.cc:395-439
         # memonger mirroring): trade FLOPs for memory by rematerializing
@@ -210,7 +269,9 @@ class Executor:
                 full = list(args)
                 for pos, i in enumerate(grad_idx):
                     full[i] = garr[pos]
-                outs, aux_out = eval_graph(full, aux, key, True)
+                # casts live inside the vjp'd fn: gradients come back in
+                # the arrays' own (full) precision automatically
+                outs, aux_out = run_graph(full, aux, key, True)
                 return outs, aux_out
 
             if do_mirror:
@@ -223,7 +284,7 @@ class Executor:
 
         @jax.jit
         def fwd_monitor(args, aux, key):
-            return eval_graph(args, aux, key, True, want_internals=True)
+            return run_graph(args, aux, key, True, want_internals=True)
 
         self._fwd_infer = fwd_infer
         self._fwd_train = fwd_train
@@ -389,7 +450,9 @@ class Executor:
                            else nd.zeros(shape, ctx=self._ctx, dtype=arr.dtype))
         return Executor(self._symbol, self._ctx, new_args,
                         new_grads or None, self._grad_req, new_aux,
-                        group2ctx=self._group2ctx)
+                        group2ctx=self._group2ctx,
+                        compute_dtype=self._compute_dtype,
+                        label_names=self._label_names)
 
     def debug_str(self) -> str:
         """Allocation/graph plan dump (reference GraphExecutor::Print)."""

@@ -145,7 +145,8 @@ class DataParallelExecutorGroup:
                 aux.append(self._place(np.zeros(shape, dtype=np.float32), None))
 
         self.executor = Executor(self.symbol, self.contexts[0], args,
-                                 grads or None, self.grad_req, aux)
+                                 grads or None, self.grad_req, aux,
+                                 label_names=self.label_names)
         self.execs = [self.executor]  # reference exposes per-device list
 
     def _batch_axis_of(self, name: str) -> int:

@@ -350,11 +350,12 @@ class Symbol:
         return Executor(self, ctx, args, args_grad, grad_req, aux_states)
 
     def bind(self, ctx, args, args_grad=None, grad_req="write",
-             aux_states=None, group2ctx=None, shared_exec=None):
+             aux_states=None, group2ctx=None, shared_exec=None, **kwargs):
         from .executor import Executor
 
         return Executor(self, ctx, args, args_grad, grad_req, aux_states,
-                        group2ctx=group2ctx, shared_exec=shared_exec)
+                        group2ctx=group2ctx, shared_exec=shared_exec,
+                        **kwargs)
 
     # evaluation convenience (not in reference; handy for tests)
     def eval(self, ctx=None, **kwargs):

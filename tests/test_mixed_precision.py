@@ -85,3 +85,95 @@ def test_bf16_matches_f32_first_step():
     for n in p32:
         np.testing.assert_allclose(np.asarray(p16[n], np.float32),
                                    np.asarray(p32[n]), atol=0.12)
+
+
+def test_executor_amp_env_var(monkeypatch):
+    """MXNET_COMPUTE_DTYPE=bfloat16 turns on mixed precision for the
+    whole Module/FeedForward path: bf16 compute, f32 params/grads/
+    outputs, labels untouched."""
+    monkeypatch.setenv("MXNET_COMPUTE_DTYPE", "bfloat16")
+    rng = np.random.RandomState(0)
+    n = 128
+    y = rng.randint(0, 2, n).astype(np.float32)
+    X = (rng.randn(n, 1, 8, 8) * 0.5
+         + y[:, None, None, None]).astype(np.float32)
+    net = _net()
+    it = mx.io.NDArrayIter(X, y, batch_size=32, label_name="softmax_label")
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=4, optimizer_params={"learning_rate": 0.1})
+    score = dict(mod.score(mx.io.NDArrayIter(X, y, batch_size=32,
+                                             label_name="softmax_label"),
+                           "acc"))
+    assert score["accuracy"] > 0.9, score
+    args, _ = mod.get_params()
+    assert all(a.asnumpy().dtype == np.float32 for a in args.values())
+
+
+def test_executor_amp_kwarg_matches_f32_loosely():
+    net = _net()
+    shapes = {"data": (8, 1, 8, 8)}
+    arg_shapes, _, aux_shapes = net.infer_shape(**shapes)
+    rng = np.random.RandomState(1)
+    args = {}
+    for name, shape in zip(net.list_arguments(), arg_shapes):
+        if name == "data":
+            args[name] = mx.nd.array(rng.rand(*shape).astype(np.float32))
+        elif name == "softmax_label":
+            args[name] = mx.nd.array(
+                rng.randint(0, 2, shape).astype(np.float32))
+        elif name.endswith("gamma"):
+            args[name] = mx.nd.ones(shape)
+        else:
+            args[name] = mx.nd.array(
+                (rng.randn(*shape) * 0.1).astype(np.float32))
+    aux = [mx.nd.ones(s) if "var" in n else mx.nd.zeros(s)
+           for n, s in zip(net.list_auxiliary_states(), aux_shapes)]
+    from mxnet_tpu.executor import Executor
+
+    e32 = Executor(net, mx.cpu(), dict(args), aux_states=list(aux),
+                   grad_req="null")
+    e16 = Executor(net, mx.cpu(), dict(args), aux_states=list(aux),
+                   grad_req="null", compute_dtype="bfloat16")
+    o32 = e32.forward(is_train=False)[0].asnumpy()
+    o16 = e16.forward(is_train=False)[0].asnumpy()
+    assert o16.dtype == np.float32          # outputs cast back
+    np.testing.assert_allclose(o16, o32, atol=0.05)
+    assert not np.array_equal(o16, o32)     # genuinely lower precision
+
+
+def test_amp_explicit_label_names_and_off_switch(monkeypatch):
+    import jax.numpy as jnp
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.executor import Executor
+
+    # a label variable with a non-conventional name, 1000 classes
+    data = mx.sym.Variable("data")
+    tgt = mx.sym.Variable("target")
+    net = mx.sym.FullyConnected(data=data, num_hidden=1000, name="fc")
+    net = mx.sym.SoftmaxOutput(data=net, label=tgt, name="softmax")
+    args = {
+        "data": mx.nd.array(np.random.rand(4, 8).astype(np.float32)),
+        "fc_weight": mx.nd.array(
+            np.random.randn(1000, 8).astype(np.float32) * 0.01),
+        "fc_bias": mx.nd.zeros((1000,)),
+        "target": mx.nd.array(np.array([257, 513, 999, 0], np.float32)),
+    }
+    exe = Executor(net, mx.cpu(), args, grad_req="null",
+                   compute_dtype="bfloat16", label_names=["target"])
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (4, 1000)
+
+    # env var set, but explicit None forces full precision
+    monkeypatch.setenv("MXNET_COMPUTE_DTYPE", "bfloat16")
+    e_off = Executor(net, mx.cpu(), args, grad_req="null",
+                     compute_dtype=None)
+    e_on = Executor(net, mx.cpu(), args, grad_req="null")
+    o_off = e_off.forward(is_train=False)[0].asnumpy()
+    o_on = e_on.forward(is_train=False)[0].asnumpy()
+    assert not np.array_equal(o_off, o_on)
+
+    # invalid dtype name -> clear error naming the setting
+    monkeypatch.setenv("MXNET_COMPUTE_DTYPE", "bf16")
+    with pytest.raises(MXNetError, match="MXNET_COMPUTE_DTYPE"):
+        Executor(net, mx.cpu(), args, grad_req="null")
