@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Multi-task training (reference example/multi-task): one trunk, two
+heads/losses joined with sym.Group, custom multi-metric.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build_net():
+    data = mx.sym.Variable("data")
+    trunk = mx.sym.FullyConnected(data=data, num_hidden=32, name="fc1")
+    trunk = mx.sym.Activation(data=trunk, act_type="relu")
+    head1 = mx.sym.FullyConnected(data=trunk, num_hidden=4, name="fc_cls")
+    head1 = mx.sym.SoftmaxOutput(data=head1, name="softmax1",
+                                 label=mx.sym.Variable("cls_label"))
+    head2 = mx.sym.FullyConnected(data=trunk, num_hidden=1, name="fc_reg")
+    head2 = mx.sym.LinearRegressionOutput(data=head2, name="reg",
+                                          label=mx.sym.Variable("reg_label"))
+    return mx.sym.Group([head1, head2])
+
+
+class MultiMetric(mx.metric.EvalMetric):
+    """Accuracy on the classification head + MSE on the regression head
+    (reference example/multi-task's Multi_Accuracy idea)."""
+
+    def __init__(self):
+        super().__init__("multi")
+
+    def update(self, labels, preds):
+        cls_lbl = labels[0].asnumpy()
+        probs = preds[0].asnumpy()
+        reg_lbl = labels[1].asnumpy()
+        reg = preds[1].asnumpy()
+        acc = (probs.argmax(axis=1) == cls_lbl).mean()
+        mse = ((reg - reg_lbl) ** 2).mean()
+        # store acc - mse as a single "higher is better" scalar for fit
+        # logging; score both properly below
+        self.sum_metric += float(acc - mse)
+        self.num_inst += 1
+
+
+def main():
+    rng = np.random.RandomState(0)
+    n = 512
+    y_cls = rng.randint(0, 4, n).astype(np.float32)
+    X = rng.randn(n, 8).astype(np.float32) * 0.3
+    X[np.arange(n), (y_cls * 2).astype(int)] += 1.5
+    y_reg = (X.sum(axis=1) * 0.5).astype(np.float32).reshape(n, 1)
+
+    net = build_net()
+    mod = mx.mod.Module(net, data_names=["data"],
+                        label_names=["cls_label", "reg_label"])
+    it = mx.io.NDArrayIter({"data": X},
+                           {"cls_label": y_cls, "reg_label": y_reg},
+                           batch_size=64)
+    mod.fit(it, num_epoch=20, eval_metric=MultiMetric(),
+            optimizer_params={"learning_rate": 0.2})
+
+    # score both tasks
+    it.reset()
+    accs, mses = [], []
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        probs, reg = [o.asnumpy() for o in mod.get_outputs()]
+        cls = batch.label[0].asnumpy()
+        tgt = batch.label[1].asnumpy()
+        accs.append((probs.argmax(axis=1) == cls).mean())
+        mses.append(((reg - tgt) ** 2).mean())
+    print("cls acc %.3f | reg mse %.4f"
+          % (float(np.mean(accs)), float(np.mean(mses))))
+    assert np.mean(accs) > 0.9
+    assert np.mean(mses) < 0.3
+
+
+if __name__ == "__main__":
+    main()

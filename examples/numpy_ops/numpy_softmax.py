@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Frontend-defined operators (reference example/numpy-ops/numpy_softmax.py
+and example/python-howto): implement an op in numpy via CustomOp and train
+with it.
+
+The CustomOp runs as a host callback inside the compiled graph
+(jax.pure_callback + custom_vjp) — the TPU-native form of the reference's
+ctypes callback machinery (src/operator/custom-inl.h).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class NumpySoftmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        lbl = in_data[1].asnumpy().astype(int)
+        y = out_data[0].asnumpy().copy()
+        y[np.arange(lbl.shape[0]), lbl] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(y / lbl.shape[0]))
+
+
+@mx.operator.register("numpy_softmax")
+class NumpySoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return NumpySoftmax()
+
+
+def main():
+    rng = np.random.RandomState(0)
+    n = 512
+    y = rng.randint(0, 4, n).astype(np.float32)
+    X = rng.randn(n, 16).astype(np.float32) * 0.3
+    X[np.arange(n), (y * 4).astype(int)] += 2.0
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    net = mx.sym.Custom(data=net, label=mx.sym.Variable("softmax_label"),
+                        op_type="numpy_softmax", name="softmax")
+    mod = mx.mod.Module(net)
+    it = mx.io.NDArrayIter(X, y, batch_size=64, shuffle=True,
+                           label_name="softmax_label")
+    mod.fit(it, num_epoch=8, optimizer_params={"learning_rate": 0.5})
+    acc = dict(mod.score(mx.io.NDArrayIter(X, y, batch_size=64,
+                                           label_name="softmax_label"),
+                         "acc"))
+    print("train accuracy with numpy CustomOp softmax: %.3f"
+          % acc["accuracy"])
+    assert acc["accuracy"] > 0.9
+
+
+if __name__ == "__main__":
+    main()
