@@ -340,12 +340,18 @@ class Executor:
         if not self._train_pending:
             raise MXNetError("backward called without forward(is_train=True)")
         if out_grads is None:
-            sig = tuple(a.shape for a in self.arg_arrays)
+            import jax
+
+            sig = tuple((a.shape, str(a.dtype)) for a in self.arg_arrays)
             if getattr(self, "_head_sig", None) != sig:
-                _, out_shapes, _ = self._symbol.infer_shape(
-                    **{n: a.shape for n, a in self.arg_dict.items()})
-                self._head_ones = [jnp.ones(s, dtype=jnp.float32)
-                                   for s in out_shapes]
+                # exact output shapes AND dtypes from abstract evaluation —
+                # jax.vjp requires cotangents to match primal dtypes, so
+                # fp16/bf16 graphs need fp16/bf16 head grads
+                outs_spec, _ = jax.eval_shape(
+                    self._fwd_train, self._arg_data(), self._aux_data(),
+                    self._last_key)
+                self._head_ones = [jnp.ones(s.shape, dtype=s.dtype)
+                                   for s in outs_spec]
                 self._head_sig = sig
             heads = self._head_ones
         else:

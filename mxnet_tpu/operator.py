@@ -152,6 +152,15 @@ class Custom(Operator):
         return ([tuple(s) for s in in_s], [tuple(s) for s in out_s],
                 [tuple(s) for s in aux_s])
 
+    def infer_type(self, in_types):
+        # delegate to the prop (reference CustomOpProp.infer_type) — the
+        # default first-known-dtype rule would wrongly spread an int label
+        # dtype onto float inputs. User props expect concrete dtypes
+        # (reference contract), so defer until the fixpoint knows them all.
+        if any(t is None for t in in_types):
+            raise MXNetError("Custom: input dtypes not yet known")
+        return self._prop.infer_type(list(in_types))
+
     def _get_op(self, in_shapes, in_dtypes) -> CustomOp:
         if self._op_instance is None:
             self._op_instance = self._prop.create_operator(

@@ -636,6 +636,16 @@ class Embedding(Operator):
         return ([data, (self.input_dim, self.output_dim)],
                 [tuple(data) + (self.output_dim,)], [])
 
+    def infer_type(self, in_types, out_types=None):
+        # indices keep their own dtype (often int); weight/output share a
+        # float dtype and must NOT inherit the index dtype. No speculative
+        # float32 — an unknown weight stays None until the symbol-level
+        # default pass (it is a plain variable there).
+        data_t, weight_t = in_types
+        out_t = (out_types or [None])[0]
+        w = weight_t if weight_t is not None else out_t
+        return [data_t, w], [w], []
+
     def apply(self, ctx, inputs, aux):
         jnp = _jnp()
         data, weight = inputs

@@ -125,10 +125,20 @@ class Operator:
             raise MXNetError("%s: cannot infer shape" % type(self).__name__)
         return [shape] * len(in_shapes), [shape], []
 
-    def infer_type(self, in_types):
+    def infer_type(self, in_types, out_types=None):
+        """Default same-dtype rule: inputs and outputs all share the first
+        known dtype, looking at BOTH sides so the symbol-level fixpoint can
+        propagate backward (reference ``InferNodeTypes`` iterates nodes in
+        both directions). Returns None-filled lists when nothing is known —
+        never speculate; the symbol-level pass defaults leftover variables
+        to float32 afterwards."""
         import numpy as np
 
-        dtype = next((t for t in in_types if t is not None), np.float32)
+        known = list(in_types) + list(out_types or [])
+        dtype = next((t for t in known if t is not None), None)
+        if dtype is None:
+            return (list(in_types), [None] * self.num_outputs,
+                    [np.float32] * len(self.list_auxiliary_states()))
         return ([dtype] * len(in_types), [dtype] * self.num_outputs,
                 [np.float32] * len(self.list_auxiliary_states()))
 

@@ -194,8 +194,10 @@ class Cast(Operator):
     PARAMS = {"dtype": Param(str, REQUIRED)}
 
     def infer_type(self, in_types):
+        # input dtype stays whatever upstream says (None = still unknown —
+        # don't speculatively default during the fixpoint); output is fixed
         dtype = np.dtype(self.dtype)
-        return [in_types[0] or np.float32], [dtype], []
+        return [in_types[0]], [dtype], []
 
     def apply(self, ctx, inputs, aux):
         import jax.numpy as jnp

@@ -289,19 +289,120 @@ class Symbol:
         return arg_shapes, out_shapes, aux_list
 
     def infer_type(self, *args, **kwargs):
+        """Fixpoint dtype propagation through per-op ``infer_type`` rules
+        (reference ``StaticGraph::InferNodeTypes``,
+        ``src/symbol/static_graph.cc:160-213``): forward passes fill output
+        dtypes from inputs; write-back into still-unknown inputs propagates
+        dtypes to variables (so ``infer_type(data=float16)`` types every
+        downstream weight float16). Variables with no information after the
+        fixpoint default to float32, matching the reference's default dtype
+        for untyped arguments."""
         import numpy as np
 
         arg_names = self.list_arguments()
         known: Dict[str, Any] = {}
-        for name, t in zip(arg_names, args):
-            if t is not None:
-                known[name] = np.dtype(t)
+        if args:
+            if len(args) > len(arg_names):
+                raise MXNetError("too many positional types")
+            for name, t in zip(arg_names, args):
+                if t is not None:
+                    known[name] = np.dtype(t)
         for name, t in kwargs.items():
-            known[name] = np.dtype(t)
-        arg_types = [known.get(n, np.dtype("float32")) for n in arg_names]
-        out_types = [np.dtype("float32")] * len(self._outputs)
-        aux_types = [np.dtype("float32")] * len(self.list_auxiliary_states())
-        return arg_types, out_types, aux_types
+            if name not in arg_names:
+                raise MXNetError("infer_type: unknown argument '%s' (args: %s)"
+                                 % (name, arg_names))
+            if t is not None:  # None = unknown (np.dtype(None) is float64!)
+                known[name] = np.dtype(t)
+
+        nodes = self._topo()
+        types: Dict[int, List[Optional[Any]]] = {}
+        aux_types_map: Dict[int, List[Any]] = {}
+        seeded = set()
+        for node in nodes:
+            types[node.uid] = [None] * node.num_outputs()
+            if node.is_variable and node.name in known:
+                types[node.uid][0] = known[node.name]
+                seeded.add(node.uid)
+
+        def _store(uid, i, t, by):
+            # NB: don't compare a None slot with ``!=`` — numpy coerces
+            # None to float64 (np.dtype(None) is float64), which would make
+            # a float64 write into an unknown slot look like a no-op
+            t = np.dtype(t)
+            cur = types[uid][i]
+            if cur is None:
+                types[uid][i] = t
+                return True
+            if cur != t:
+                # genuine dtype inconsistency (two producers/consumers
+                # disagree, or a seed is contradicted) — the reference's
+                # InferNodeTypes errors on mismatch rather than flapping
+                raise MXNetError(
+                    "infer_type: op '%s' infers dtype %s where %s was "
+                    "%s" % (by, t,
+                            "explicitly given" if uid in seeded
+                            else "already inferred", cur))
+            return False
+
+        def _visit(node):
+            in_types = [types[src.uid][i] for src, i in node.inputs]
+            out_types = list(types[node.uid])
+            try:
+                try:
+                    in_filled, out_filled, aux = node.op.infer_type(
+                        in_types, out_types)
+                except TypeError:
+                    # op overrides with the single-argument signature
+                    in_filled, out_filled, aux = node.op.infer_type(in_types)
+            except MXNetError:
+                return False
+            changed = False
+            for (src, i), t in zip(node.inputs, in_filled):
+                if t is not None:
+                    changed |= _store(src.uid, i, t, node.name)
+            for i, t in enumerate(out_filled):
+                if t is not None:
+                    changed |= _store(node.uid, i, t, node.name)
+            aux_types_map[node.uid] = [np.dtype(t) for t in aux]
+            return changed
+
+        op_nodes = [n for n in nodes if not n.is_variable]
+
+        def _fixpoint():
+            # forward + reverse sweep per iteration (reference
+            # InferNodeTypes' bidirectional iteration): a dtype seeded on
+            # the last node of a chain reaches the first in one iteration
+            for _ in range(len(op_nodes) + 2):
+                changed = False
+                for node in op_nodes:
+                    changed |= _visit(node)
+                for node in reversed(op_nodes):
+                    changed |= _visit(node)
+                if not changed:
+                    break
+
+        _fixpoint()
+        # untyped variables default to float32; one more pass fills outputs
+        # that depended on them
+        defaulted = False
+        for node in nodes:
+            if node.is_variable and types[node.uid][0] is None:
+                types[node.uid][0] = np.dtype("float32")
+                defaulted = True
+        if defaulted:
+            _fixpoint()
+
+        arg_types = [types[n.uid][0] for n in nodes if n.is_variable]
+        out_types = [types[n.uid][i] for n, i in self._outputs]
+        aux_list: List[Any] = []
+        for node in nodes:
+            if not node.is_variable and node.op.list_auxiliary_states():
+                aux_list.extend(aux_types_map.get(
+                    node.uid,
+                    [np.dtype("float32")] * len(node.op.list_auxiliary_states())))
+        if any(t is None for t in out_types):
+            raise MXNetError("infer_type could not infer output dtypes")
+        return arg_types, out_types, aux_list
 
     # -- serialization (reference static_graph.cc:551-615 JSON) ------------
     def tojson(self) -> str:
@@ -334,19 +435,22 @@ class Symbol:
 
         arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
         arg_names = self.list_arguments()
-        arg_types = dict(type_dict or {})
-        args = [nd.zeros(s, ctx=ctx, dtype=arg_types.get(n, "float32"))
-                for n, s in zip(arg_names, arg_shapes)]
+        # dtype propagation: type_dict seeds (e.g. data=float16) flow through
+        # per-op infer_type so weights/grads/aux get their inferred dtypes
+        arg_types, _, aux_types = self.infer_type(**(type_dict or {}))
+        args = [nd.zeros(s, ctx=ctx, dtype=t)
+                for s, t in zip(arg_shapes, arg_types)]
         if grad_req == "null":
             args_grad = None
         else:
             args_grad = {}
             reqs = grad_req if isinstance(grad_req, dict) else \
                 {n: grad_req for n in arg_names}
-            for n, s in zip(arg_names, arg_shapes):
+            for n, s, t in zip(arg_names, arg_shapes, arg_types):
                 if reqs.get(n, "null") != "null":
-                    args_grad[n] = nd.zeros(s, ctx=ctx)
-        aux_states = [nd.zeros(s, ctx=ctx) for s in aux_shapes]
+                    args_grad[n] = nd.zeros(s, ctx=ctx, dtype=t)
+        aux_states = [nd.zeros(s, ctx=ctx, dtype=t)
+                      for s, t in zip(aux_shapes, aux_types)]
         return Executor(self, ctx, args, args_grad, grad_req, aux_states)
 
     def bind(self, ctx, args, args_grad=None, grad_req="write",

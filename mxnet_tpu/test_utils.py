@@ -212,7 +212,16 @@ def check_consistency(sym, ctx_list, scale: float = 1.0,
         outs = [o.asnumpy().astype(np.float64) for o in executor.outputs]
         grads = None
         if grad_req != "null":
-            executor.backward()
+            # random (seeded) head grads shared across configs: the
+            # reference uses the output as head grad
+            # (test_utils.py:651 ``exe.backward(exe.outputs[0])``), but
+            # that is degenerate for BatchNorm (grads cancel to ~0);
+            # a random cotangent exercises every grad path non-trivially
+            grng = np.random.RandomState(17)
+            heads = [nd.array(grng.normal(0, 1, size=o.shape)
+                              .astype(executor.outputs[i].dtype), ctx=ctx)
+                     for i, o in enumerate(outs)]
+            executor.backward(heads)
             grads = {n: executor.grad_dict[n].asnumpy().astype(np.float64)
                      for n in executor.grad_dict}
         results.append((outs, grads, max(tol.get(np.dtype(d), 1e-3)

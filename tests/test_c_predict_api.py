@@ -217,7 +217,10 @@ def test_standalone_c_host(tmp_path):
 
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["JAX_PLATFORMS"] = "cpu"
+    # force a pure-CPU child: site hooks register remote accelerator
+    # backends when these are set, and a dead tunnel then hangs jax init
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     r = subprocess.run([str(exe), str(sym_file), pfile],
                        capture_output=True, text=True, env=env,
                        timeout=300)

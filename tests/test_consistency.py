@@ -52,9 +52,9 @@ def test_consistency_batchnorm():
 def test_consistency_softmax_and_lrn():
     data = mx.sym.Variable("data")
     net = mx.sym.SoftmaxActivation(data=data)
-    check_consistency(net, _cfgs(data=(4, 10)), grad_req="null")
+    check_consistency(net, _cfgs(data=(4, 10)))
     net = mx.sym.LRN(data=data, nsize=3)
-    check_consistency(net, _cfgs(data=(2, 4, 5, 5)), grad_req="null")
+    check_consistency(net, _cfgs(data=(2, 4, 5, 5)))
 
 
 def test_consistency_elementwise_reduce():

@@ -1,0 +1,151 @@
+"""Type inference (reference StaticGraph::InferNodeTypes,
+src/symbol/static_graph.cc:160-213): dtype seeds propagate through per-op
+infer_type rules to every argument/output/aux at fixpoint."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_default_float32():
+    net = _mlp()
+    arg_types, out_types, aux_types = net.infer_type()
+    assert all(t == np.float32 for t in arg_types)
+    assert all(t == np.float32 for t in out_types)
+
+
+def test_fp16_seed_propagates_to_weights():
+    net = _mlp()
+    arg_types, out_types, _ = net.infer_type(data=np.float16)
+    types = dict(zip(net.list_arguments(), arg_types))
+    assert types["fc1_weight"] == np.float16
+    assert types["fc1_bias"] == np.float16
+    assert types["fc2_weight"] == np.float16
+    assert types["softmax_label"] == np.float16
+    assert out_types[0] == np.float16
+
+
+def test_fp64_positional():
+    net = _mlp()
+    arg_types, _, _ = net.infer_type(np.float64)
+    assert arg_types[0] == np.float64
+    assert all(t == np.float64 for t in arg_types)
+
+
+def test_cast_boundary():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    h = mx.sym.Cast(h, dtype="float16")
+    h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    arg_types, out_types, _ = h.infer_type(data=np.float32)
+    types = dict(zip(h.list_arguments(), arg_types))
+    # weights before the cast are f32, after are f16
+    assert types["fc1_weight"] == np.float32
+    assert types["fc2_weight"] == np.float16
+    assert out_types[0] == np.float16
+
+
+def test_batchnorm_aux_stays_f32():
+    data = mx.sym.Variable("data")
+    net = mx.sym.BatchNorm(data=data, name="bn")
+    arg_types, _, aux_types = net.infer_type(data=np.float16)
+    types = dict(zip(net.list_arguments(), arg_types))
+    assert types["bn_gamma"] == np.float16
+    # moving stats accumulate in f32 regardless of data dtype
+    assert all(t == np.float32 for t in aux_types)
+
+
+def test_unknown_argument_errors():
+    net = _mlp()
+    try:
+        net.infer_type(bogus=np.float32)
+    except mx.base.MXNetError as e:
+        assert "bogus" in str(e)
+    else:
+        raise AssertionError("expected MXNetError")
+
+
+def test_fp64_single_op():
+    # regression: None-vs-dtype comparison must not treat an unknown slot
+    # as float64 (np.dtype(None) is float64)
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4)
+    arg_types, out_types, _ = net.infer_type(data=np.float64)
+    assert all(t == np.float64 for t in arg_types)
+    assert out_types[0] == np.float64
+
+
+def test_seeded_dtype_conflict_raises():
+    # regression: an explicitly-given dtype must never be silently
+    # overwritten by propagation (reference InferNodeTypes errors too)
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = mx.sym.Variable("c")
+    net = (a * b) + (a * c)
+    try:
+        net.infer_type(b=np.float16, c=np.float64)
+    except mx.base.MXNetError:
+        pass
+    else:
+        raise AssertionError("expected dtype-conflict MXNetError")
+
+
+def test_late_seed_propagates():
+    # regression: speculative float32 defaults must not pre-empt a seed on
+    # a variable that appears late in topo order
+    xs = [mx.sym.Variable("x%d" % i) for i in range(5)]
+    net = xs[0]
+    for x in xs[1:]:
+        net = net * x
+    arg_types, out_types, _ = net.infer_type(x4=np.float16)
+    assert all(t == np.float16 for t in arg_types)
+    assert out_types[0] == np.float16
+
+
+def test_embedding_weight_follows_downstream():
+    # regression: Embedding must not speculatively pin weight to f32 —
+    # a downstream fp16 seed types the weight through backward propagation
+    data = mx.sym.Variable("data")
+    w2 = mx.sym.Variable("w2")
+    net = mx.sym.Embedding(data, input_dim=10, output_dim=4,
+                           name="emb") * w2
+    arg_types, _, _ = net.infer_type(w2=np.float16, data=np.int32)
+    types = dict(zip(net.list_arguments(), arg_types))
+    assert types["emb_weight"] == np.float16
+    assert types["data"] == np.int32
+
+
+def test_none_kwarg_means_unknown():
+    # regression: None dtype kwarg must not become np.dtype(None)==float64
+    net = _mlp()
+    arg_types, _, _ = net.infer_type(data=None)
+    assert all(t == np.float32 for t in arg_types)
+
+
+def test_producer_conflict_raises():
+    # two producers disagreeing is an error, not a flap (reference
+    # InferNodeTypes raises on mismatch)
+    x = mx.sym.Variable("x")
+    y = mx.sym.Variable("y")
+    net = mx.sym.Cast(x, dtype="float16") + mx.sym.Cast(y, dtype="float32")
+    try:
+        net.infer_type()
+    except mx.base.MXNetError:
+        pass
+    else:
+        raise AssertionError("expected dtype-conflict MXNetError")
+
+
+def test_simple_bind_allocates_inferred_dtypes():
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), type_dict={"data": np.float16},
+                         data=(4, 10))
+    assert ex.arg_dict["data"].dtype == np.float16
+    assert ex.arg_dict["fc1_weight"].dtype == np.float16
+    assert ex.grad_dict["fc1_weight"].dtype == np.float16
