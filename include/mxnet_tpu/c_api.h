@@ -16,6 +16,7 @@
 #ifndef MXNET_TPU_C_API_H_
 #define MXNET_TPU_C_API_H_
 
+#include <stddef.h>
 #include <stdint.h>
 
 #ifdef __cplusplus
@@ -25,8 +26,19 @@ extern "C" {
 typedef void *NDArrayHandle;
 typedef void *SymbolHandle;
 typedef void *ExecutorHandle;
+typedef void *FunctionHandle;
+typedef void *AtomicSymbolCreator;
+typedef void *DataIterCreator;
+typedef void *DataIterHandle;
+typedef void *KVStoreHandle;
+typedef void *RecordIOHandle;
 typedef uint32_t mx_uint;
 typedef float mx_float;
+
+/*! \brief KVStore updater: key, pushed value, stored value (mutate via
+ * MXNDArraySyncCopyFromCPU), user handle. Reference MXKVStoreUpdater. */
+typedef void (*MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                 NDArrayHandle local, void *handle);
 
 const char *MXGetLastError(void);
 
@@ -58,6 +70,42 @@ int MXNDArrayLoad(const char *fname, mx_uint *out_size,
                   const char ***out_names);
 int MXNDArrayListFree(NDArrayHandle *arr, mx_uint size,
                       const char **names);
+/*! \brief Create with explicit dtype (0=f32 1=f64 2=f16 3=u8 4=i32 5=i8
+ * 6=i64 7=bf16 — the mshadow-compatible ids). */
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int dtype, NDArrayHandle *out);
+/*! \brief Axis-0 slice [start, stop) as a NEW array (jax arrays are
+ * immutable, so unlike the reference this does not alias memory). */
+int MXNDArraySlice(NDArrayHandle handle, mx_uint start, mx_uint stop,
+                   NDArrayHandle *out);
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int *dims,
+                     NDArrayHandle *out);
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id);
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype);
+/*! \brief Wrap a CPython mxnet_tpu NDArray object (PyObject*) into a C
+ * handle (increfs). Internal bridge for callback plumbing. */
+int MXTPUNDArrayWrapPyObject(void *py_ndarray, NDArrayHandle *out);
+
+/* ---- NDArray function registry (reference c_api.cc:366-445) ----------- */
+
+/*! \brief Enumerate registered imperative functions; handles are valid
+ * for the process lifetime. */
+int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array);
+int MXGetFunction(const char *name, FunctionHandle *out);
+/*! \brief Name + doc + arity; strings valid for the process lifetime. */
+int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                  const char **description, mx_uint *num_args,
+                  const char ***arg_names, const char ***arg_type_infos,
+                  const char ***arg_descriptions);
+/*! \brief Arity contract: scalars follow the use vars (type_mask is
+ * always 1, kNDArrayArgBeforeScalar). */
+int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                   mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                   int *type_mask);
+/*! \brief result written into mutate_vars[0]. */
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                 const mx_float *scalar_args, NDArrayHandle *mutate_vars);
 
 /* ---- Symbol ----------------------------------------------------------- */
 
@@ -85,6 +133,112 @@ int MXSymbolInferShape(SymbolHandle handle, mx_uint num_args,
                        const mx_uint **out_shape_ndim,
                        const mx_uint ***out_shape_data);
 int MXSymbolFree(SymbolHandle handle);
+
+/* ---- Symbol registry + composition (reference c_api.cc:447-937) ------- */
+
+/*! \brief Enumerate registered operators. */
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array);
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **out_name);
+/*! \brief Op metadata: doc + declared params (name/type/doc triplets);
+ * key_var_num_args names the variadic-arity param ("num_args" for
+ * Concat-likes, "" otherwise). Strings valid for the process lifetime. */
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char **name, const char **description,
+                                mx_uint *num_args, const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions,
+                                const char **key_var_num_args);
+/*! \brief Create an un-composed op application from string params. */
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out);
+/*! \brief Supply inputs to an atomic symbol (keys NULL = positional);
+ * the handle becomes a composed Symbol in place. */
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args);
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out);
+int MXSymbolCopy(SymbolHandle symbol, SymbolHandle *out);
+int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out);
+int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index, SymbolHandle *out);
+/*! \brief Attribute access on a single-output symbol; *out is "" and
+ * *success 0 when unset. */
+int MXSymbolGetAttr(SymbolHandle symbol, const char *key, const char **out,
+                    int *success);
+int MXSymbolSetAttr(SymbolHandle symbol, const char *key, const char *value);
+/*! \brief Flattened [k0,v0,k1,v1,...] with keys as <node>__<attr>. */
+int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
+                     const char ***out);
+/*! \brief Dtype inference from named dtype ids (see MXNDArrayCreateEx);
+ * id arrays valid until the next call on this handle or Free. */
+int MXSymbolInferType(SymbolHandle handle, mx_uint num_args,
+                      const char **keys, const int *arg_type_data,
+                      mx_uint *in_type_size, const int **in_type_data,
+                      mx_uint *out_type_size, const int **out_type_data,
+                      mx_uint *aux_type_size, const int **aux_type_data);
+
+/* ---- Data iterators (reference c_api.cc:1110-1197) -------------------- */
+
+int MXListDataIters(mx_uint *out_size, DataIterCreator **out_array);
+int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                          const char **description);
+/*! \brief Create from string kwargs (values parsed as python literals
+ * where possible: ints, floats, tuples, bools; else kept as strings). */
+int MXDataIterCreateIter(DataIterCreator creator, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out);
+int MXDataIterBeforeFirst(DataIterHandle handle);
+/*! \brief Advance; *out = 1 while data remains. */
+int MXDataIterNext(DataIterHandle handle, int *out);
+/*! \brief Current batch data/label. The returned handle is owned by the
+ * iterator (do NOT free); valid until the next Next/Free. */
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+/*! \brief Instance indices of the current batch (uint64). *out_size 0
+ * when the iterator does not track indices. */
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                       uint64_t *out_size);
+int MXDataIterFree(DataIterHandle handle);
+
+/* ---- KVStore (reference c_api.cc:1199-1338) --------------------------- */
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXKVStoreFree(KVStoreHandle handle);
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals);
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+/*! \brief Install a C updater run on every push (server-side optimizer
+ * equivalent). */
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle);
+int MXKVStoreGetType(KVStoreHandle handle, const char **type);
+int MXKVStoreGetRank(KVStoreHandle handle, int *rank);
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *size);
+int MXKVStoreBarrier(KVStoreHandle handle);
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle, int do_barrier);
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id, int *number);
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_head,
+                                   const char *cmd_body);
+
+/* ---- RecordIO (reference MXRecordIO*) --------------------------------- */
+
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOWriterFree(RecordIOHandle handle);
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size);
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOReaderFree(RecordIOHandle handle);
+/*! \brief Read the next record; *size 0 at end of file. Buffer owned by
+ * the handle, valid until the next read/Free. */
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char **buf,
+                               size_t *size);
 
 /* ---- Executor --------------------------------------------------------- */
 

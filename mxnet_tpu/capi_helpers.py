@@ -49,7 +49,8 @@ def output_shape(predictor, index):
 
 
 def set_input(predictor, key, memview):
-    arr = np.frombuffer(memview, dtype=np.float32)
+    # .copy(): see ndarray_set — never let a C buffer view reach jax
+    arr = np.frombuffer(memview, dtype=np.float32).copy()
     target = predictor._executor.arg_dict.get(key)
     if target is None:
         raise MXNetError("unknown input '%s'" % key)
@@ -96,7 +97,10 @@ def ndarray_create(shape, dev_type, dev_id):
 
 
 def ndarray_set(arr, memview):
-    data = np.frombuffer(memview, dtype=np.float32)
+    # .copy() is load-bearing: jnp.asarray zero-copies aligned numpy
+    # arrays on CPU, so a frombuffer view would leave the jax buffer
+    # aliasing the C caller's memory after it is freed/reused
+    data = np.frombuffer(memview, dtype=np.float32).copy()
     if data.size != int(np.prod(arr.shape)):
         raise MXNetError("copy size %d != array size %d"
                          % (data.size, int(np.prod(arr.shape))))
@@ -155,10 +159,10 @@ def executor_set_arg(exe, name, memview):
     target = exe.arg_dict.get(name)
     if target is None:
         raise MXNetError("unknown argument '%s'" % name)
-    data = np.frombuffer(memview, dtype=np.float32)
+    # .copy(): see ndarray_set — wait_to_read alone does not help when
+    # jnp.asarray zero-copy-aliases the C buffer on CPU
+    data = np.frombuffer(memview, dtype=np.float32).copy()
     target[:] = data.reshape(target.shape)
-    # the C caller's buffer may be freed the moment we return; force the
-    # (possibly deferred) copy to complete before then
     target.wait_to_read()
 
 
@@ -183,3 +187,427 @@ def executor_grad_bytes(exe, name):
     if g is None:
         raise MXNetError("no gradient for argument '%s'" % name)
     return np.ascontiguousarray(g.asnumpy(), dtype=np.float32).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Registry enumeration + atomic symbol construction (reference
+# src/c_api/c_api.cc:447-937: MXSymbolListAtomicSymbolCreators,
+# MXSymbolGetAtomicSymbolInfo, MXSymbolCreateAtomicSymbol, MXSymbolCompose)
+# ---------------------------------------------------------------------------
+class _AtomicSymbol:
+    """An op application with parsed params but no inputs yet — the
+    reference's freshly-created atomic symbol, completed by Compose."""
+
+    def __init__(self, op_name, params):
+        self.op_name = op_name
+        self.params = params
+
+
+def atomic_symbol_creators():
+    """Stable sorted list of registered operator names."""
+    from .ops.registry import OP_REGISTRY
+
+    names = set()
+    for _, cls in OP_REGISTRY.items():
+        names.add(cls.op_name)
+        names.update(getattr(cls, "op_aliases", ()))
+    return sorted(names)
+
+
+def _param_type_str(spec):
+    if spec.ptype == "shape":
+        return "Shape(tuple)"
+    if isinstance(spec.ptype, type):
+        return spec.ptype.__name__
+    return str(spec.ptype)
+
+
+def atomic_symbol_info(name):
+    """(name, doc, [param names], [param types], [param docs],
+    key_var_num_args) for MXSymbolGetAtomicSymbolInfo."""
+    from .ops.registry import OP_REGISTRY, REQUIRED
+
+    cls = OP_REGISTRY.get(name)
+    pnames, ptypes, pdocs = [], [], []
+    for pname, spec in cls.PARAMS.items():
+        pnames.append(pname)
+        tstr = _param_type_str(spec)
+        if spec.default is not REQUIRED:
+            tstr += ", optional, default=%r" % (spec.default,)
+        else:
+            tstr += ", required"
+        ptypes.append(tstr)
+        pdocs.append(spec.doc or "")
+    kv = "num_args" if "num_args" in cls.PARAMS else ""
+    return (cls.op_name, cls.__doc__ or "", pnames, ptypes, pdocs, kv)
+
+
+def create_atomic_symbol(name, keys, vals):
+    from .ops.registry import OP_REGISTRY
+
+    OP_REGISTRY.get(name)  # raises for unknown ops before Compose time
+    return _AtomicSymbol(name, dict(zip(list(keys), list(vals))))
+
+
+def symbol_compose(obj, name, keys, args):
+    """Complete an atomic symbol with inputs (reference Symbol::Compose,
+    symbol.cc:335). ``args`` are composed Symbols; ``keys`` empty means
+    positional."""
+    from . import symbol as sym_mod
+
+    if not isinstance(obj, _AtomicSymbol):
+        raise MXNetError("compose target must be an un-composed atomic "
+                         "symbol (create it with MXSymbolCreateAtomicSymbol)")
+    creator = getattr(sym_mod, obj.op_name, None)
+    if creator is None:
+        raise MXNetError("no creation function for op '%s'" % obj.op_name)
+    kwargs = dict(obj.params)
+    if name:
+        kwargs["name"] = name
+    if keys:
+        for k, a in zip(keys, args):
+            kwargs[k] = a
+        return creator(**kwargs)
+    return creator(*args, **kwargs)
+
+
+def symbol_create_variable(name):
+    from .symbol import Variable
+
+    return Variable(name)
+
+
+def symbol_create_group(syms):
+    from .symbol import Group
+
+    return Group(list(syms))
+
+
+def symbol_copy(sym):
+    import copy
+
+    return copy.copy(sym)
+
+
+def symbol_get_internals(sym):
+    return sym.get_internals()
+
+
+def symbol_get_output(sym, index):
+    return sym[int(index)]
+
+
+def symbol_get_attr(sym, key):
+    v = sym.attr(key)
+    return "" if v is None else v
+
+
+def symbol_set_attr(sym, key, value):
+    sym._set_attr(**{key: value})
+
+
+def symbol_list_attr(sym):
+    """Flattened [k0, v0, k1, v1, ...] of <node>__<key> pairs (reference
+    MXSymbolListAttr's name__key layout)."""
+    flat = []
+    for node_name, attrs in sym.attr_dict().items():
+        for k, v in attrs.items():
+            flat.append("%s__%s" % (node_name, k))
+            flat.append(str(v))
+    return flat
+
+
+def _dtype_from_id(tid):
+    from .base import DTYPE_ID_TO_NP
+
+    try:
+        return DTYPE_ID_TO_NP[int(tid)]
+    except KeyError:
+        raise MXNetError("unknown dtype id %d" % tid)
+
+
+def symbol_infer_type(sym, named_ids):
+    """{arg name: dtype id} -> (arg ids, out ids, aux ids)."""
+    from .base import DTYPE_NP_TO_ID
+
+    kwargs = {k: _dtype_from_id(v) for k, v in named_ids.items()}
+    arg_t, out_t, aux_t = sym.infer_type(**kwargs)
+    to_id = lambda ts: [DTYPE_NP_TO_ID[np.dtype(t)] for t in ts]  # noqa: E731
+    return to_id(arg_t), to_id(out_t), to_id(aux_t)
+
+
+# ---------------------------------------------------------------------------
+# NDArray function registry (reference MXListFunctions/MXFuncInvoke,
+# c_api.cc:366-445): fixed-arity imperative functions over NDArrays.
+# ---------------------------------------------------------------------------
+_FUNC_TABLE = None
+
+
+def _func_table():
+    global _FUNC_TABLE
+    if _FUNC_TABLE is not None:
+        return _FUNC_TABLE
+    from . import ndarray as nd
+
+    t = {}
+
+    def reg(name, n_use, n_scalar, doc, fn):
+        t[name] = (fn, n_use, n_scalar, doc)
+
+    reg("_plus", 2, 0, "elementwise add", lambda u, s: u[0] + u[1])
+    reg("_minus", 2, 0, "elementwise subtract", lambda u, s: u[0] - u[1])
+    reg("_mul", 2, 0, "elementwise multiply", lambda u, s: u[0] * u[1])
+    reg("_div", 2, 0, "elementwise divide", lambda u, s: u[0] / u[1])
+    reg("_plus_scalar", 1, 1, "add scalar", lambda u, s: u[0] + s[0])
+    reg("_minus_scalar", 1, 1, "subtract scalar", lambda u, s: u[0] - s[0])
+    reg("_mul_scalar", 1, 1, "multiply by scalar", lambda u, s: u[0] * s[0])
+    reg("_div_scalar", 1, 1, "divide by scalar", lambda u, s: u[0] / s[0])
+    reg("_copyto", 1, 0, "copy", lambda u, s: u[0].copy())
+    reg("dot", 2, 0, "matrix product", lambda u, s: nd.dot(u[0], u[1]))
+    reg("clip", 1, 2, "clip to [a_min, a_max]",
+        lambda u, s: nd.clip(u[0], s[0], s[1]))
+    reg("sqrt", 1, 0, "elementwise sqrt", lambda u, s: nd.sqrt(u[0]))
+    reg("exp", 1, 0, "elementwise exp", lambda u, s: nd.exp(u[0]))
+    reg("log", 1, 0, "elementwise log", lambda u, s: nd.log(u[0]))
+    reg("square", 1, 0, "elementwise square", lambda u, s: nd.square(u[0]))
+    reg("abs", 1, 0, "elementwise abs", lambda u, s: nd.abs(u[0]))
+    reg("sign", 1, 0, "elementwise sign", lambda u, s: nd.sign(u[0]))
+    reg("norm", 1, 0, "L2 norm (1-element result)",
+        lambda u, s: nd.norm(u[0]).reshape((1,)))
+    _FUNC_TABLE = t
+    return t
+
+
+def list_functions():
+    return sorted(_func_table())
+
+
+def func_info(name):
+    fn, n_use, n_scalar, doc = _func_table()[name]
+    return (name, doc, n_use, n_scalar)
+
+
+def func_invoke(name, use_arrs, scalars, mutate_arrs):
+    """Compute and write the result into mutate_arrs[0] (the reference's
+    out-parameter convention)."""
+    fn, n_use, n_scalar, _ = _func_table()[name]
+    if len(use_arrs) != n_use or len(scalars) != n_scalar:
+        raise MXNetError(
+            "%s expects %d arrays + %d scalars, got %d + %d"
+            % (name, n_use, n_scalar, len(use_arrs), len(scalars)))
+    res = fn(list(use_arrs), [float(x) for x in scalars])
+    out = mutate_arrs[0]
+    out[:] = res.asnumpy().reshape(out.shape)
+    out.wait_to_read()
+
+
+# ---------------------------------------------------------------------------
+# Data iterators (reference c_api.cc:1110-1197: MXListDataIters,
+# MXDataIterCreateIter, Next/GetData/GetLabel/GetPadNum)
+# ---------------------------------------------------------------------------
+def list_data_iters():
+    from .io import _REG
+
+    return sorted(cls.__name__ for _, cls in _REG.items())
+
+
+def data_iter_info(name):
+    from .io import _REG
+
+    cls = _REG.get(name)
+    return (cls.__name__, cls.__doc__ or "")
+
+
+def _parse_value(v):
+    import ast
+
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def create_data_iter(name, keys, vals):
+    from .io import _REG
+
+    cls = _REG.get(name)
+    kwargs = {k: _parse_value(v) for k, v in zip(keys, vals)}
+    return cls(**kwargs)
+
+
+def iter_before_first(it):
+    it.reset()
+
+
+def iter_next(it):
+    return 1 if it.iter_next() else 0
+
+
+def _first(arrs, which):
+    if isinstance(arrs, (list, tuple)):
+        if not arrs:
+            raise MXNetError("iterator has no %s" % which)
+        return arrs[0]
+    return arrs
+
+
+def iter_get_data(it):
+    return _first(it.getdata(), "data")
+
+
+def iter_get_label(it):
+    return _first(it.getlabel(), "label")
+
+
+def iter_get_pad(it):
+    return int(it.getpad() or 0)
+
+
+def iter_get_index(it):
+    idx = it.getindex()
+    if idx is None:
+        return b""
+    return np.ascontiguousarray(idx, dtype=np.uint64).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# KVStore (reference c_api.cc:1199-1338)
+# ---------------------------------------------------------------------------
+def kv_create(kv_type):
+    from .kvstore import create
+
+    return create(kv_type)
+
+
+def kv_init(kv, keys, arrs):
+    kv.init([int(k) for k in keys], list(arrs))
+
+
+def kv_push(kv, keys, arrs, priority):
+    kv.push([int(k) for k in keys], list(arrs), priority=int(priority))
+
+
+def kv_pull(kv, keys, arrs, priority):
+    kv.pull([int(k) for k in keys], out=list(arrs), priority=int(priority))
+    for a in arrs:
+        a.wait_to_read()
+
+
+def kv_type(kv):
+    return kv.type
+
+
+def kv_rank(kv):
+    return int(kv.rank)
+
+
+def kv_group_size(kv):
+    return int(kv.num_workers)
+
+
+def kv_barrier(kv):
+    kv.barrier()
+
+
+def kv_send_command(kv, head, body):
+    kv.send_command_to_servers(int(head), body)
+
+
+def kv_num_dead_node(kv, node_id):
+    return int(kv.num_dead_node(int(node_id)))
+
+
+def kv_set_barrier_before_exit(kv, flag):
+    kv.set_barrier_before_exit(bool(flag))
+
+
+def kv_set_updater(kv, fnptr, user_handle, libpath):
+    """Install a C updater callback: void(int key, NDArrayHandle recv,
+    NDArrayHandle local, void*) — reference MXKVStoreSetUpdater. The C
+    function pointer is re-entered through ctypes; NDArray handles are
+    minted via the library's own MXTPUNDArrayWrapPyObject export."""
+    import ctypes
+
+    lib = ctypes.CDLL(libpath)
+    cb_t = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                            ctypes.c_void_p, ctypes.c_void_p)
+    cb = cb_t(fnptr)
+    wrap = lib.MXTPUNDArrayWrapPyObject
+    wrap.argtypes = [ctypes.py_object, ctypes.POINTER(ctypes.c_void_p)]
+    free_fn = lib.MXNDArrayFree
+    free_fn.argtypes = [ctypes.c_void_p]
+
+    def updater(key, recv, local):
+        h_recv, h_local = ctypes.c_void_p(), ctypes.c_void_p()
+        wrap(recv, ctypes.byref(h_recv))
+        wrap(local, ctypes.byref(h_local))
+        try:
+            cb(int(key), h_recv, h_local, ctypes.c_void_p(user_handle))
+        finally:
+            free_fn(h_recv)
+            free_fn(h_local)
+
+    # keep the ctypes objects alive as long as the kvstore
+    kv._c_updater_refs = (cb, lib)
+    kv.set_updater(updater)
+
+
+# ---------------------------------------------------------------------------
+# RecordIO (reference MXRecordIO* C functions)
+# ---------------------------------------------------------------------------
+def recordio_writer_create(uri):
+    from .recordio import MXRecordIO
+
+    r = MXRecordIO(uri, "w")
+    return r
+
+
+def recordio_reader_create(uri):
+    from .recordio import MXRecordIO
+
+    return MXRecordIO(uri, "r")
+
+
+def recordio_write(rec, memview):
+    rec.write(bytes(memview))
+
+
+def recordio_read(rec):
+    buf = rec.read()
+    return b"" if buf is None else buf
+
+
+def recordio_close(rec):
+    rec.close()
+
+
+# ---------------------------------------------------------------------------
+# NDArray extras (slice/reshape/context/dtype)
+# ---------------------------------------------------------------------------
+def ndarray_create_ex(shape, dev_type, dev_id, dtype_id):
+    from . import ndarray as nd
+
+    return nd.zeros(tuple(int(d) for d in shape), ctx=_ctx(dev_type, dev_id),
+                    dtype=_dtype_from_id(dtype_id))
+
+
+def ndarray_slice(arr, start, stop):
+    from . import ndarray as nd
+
+    return nd.array(arr.asnumpy()[int(start):int(stop)], ctx=arr.context)
+
+
+def ndarray_reshape(arr, shape):
+    return arr.reshape(tuple(int(d) for d in shape))
+
+
+def ndarray_context(arr):
+    ctx = arr.context
+    dev_type = 2 if ctx.device_type == "tpu" else 1
+    return (dev_type, int(ctx.device_id))
+
+
+def ndarray_dtype_id(arr):
+    from .base import DTYPE_NP_TO_ID
+
+    return DTYPE_NP_TO_ID[np.dtype(arr.dtype)]

@@ -347,12 +347,27 @@ class Symbol:
         def _visit(node):
             in_types = [types[src.uid][i] for src, i in node.inputs]
             out_types = list(types[node.uid])
-            try:
+            cls = type(node.op)
+            takes_out = cls.__dict__.get("_infer_type_takes_out")
+            if takes_out is None:
+                # detect once per op class whether infer_type accepts the
+                # out_types argument (catching TypeError at call time would
+                # misclassify genuine TypeErrors from user op bodies)
+                import inspect
+
                 try:
+                    params = inspect.signature(cls.infer_type).parameters
+                    takes_out = len(params) >= 3 or any(
+                        p.kind is inspect.Parameter.VAR_POSITIONAL
+                        for p in params.values())
+                except (ValueError, TypeError):
+                    takes_out = False
+                cls._infer_type_takes_out = takes_out
+            try:
+                if takes_out:
                     in_filled, out_filled, aux = node.op.infer_type(
                         in_types, out_types)
-                except TypeError:
-                    # op overrides with the single-argument signature
+                else:
                     in_filled, out_filled, aux = node.op.infer_type(in_types)
             except MXNetError:
                 return False

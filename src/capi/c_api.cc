@@ -9,8 +9,11 @@
  * so returned pointers outlive the GIL scope.
  */
 #include <Python.h>
+#include <dlfcn.h>
 
+#include <cstdint>
 #include <cstring>
+#include <map>
 #include <string>
 #include <vector>
 
@@ -68,8 +71,10 @@ struct NDArrayRec {
 struct SymbolRec {
   PyObject *sym = nullptr;
   std::string json;
-  StrList args, outputs, aux;
+  std::string attr_val;
+  StrList args, outputs, aux, attr_list;
   ShapeList in_shapes, out_shapes;
+  std::vector<int> in_ids, out_ids, aux_ids;  /* MXSymbolInferType */
 };
 
 struct ExecRec {
@@ -464,6 +469,872 @@ int MXExecutorFree(ExecutorHandle handle) {
   ExecRec *rec = static_cast<ExecRec *>(handle);
   Py_XDECREF(rec->exe);
   delete rec;
+  return 0;
+}
+
+}  /* extern "C" */
+
+/* ======================================================================
+ * Registry enumeration, function invoke, data iterators, KVStore and
+ * RecordIO (reference src/c_api/c_api.cc:366-445, 447-937, 1110-1338).
+ * Creator/function "handles" are 1-based indices into process-lifetime
+ * name tables fetched from the Python registries.
+ * ====================================================================== */
+
+namespace {
+
+/* Cached name tables (GIL-guarded lazily; live for the process). */
+struct NameTable {
+  std::vector<std::string> names;
+  std::vector<void *> handles;  /* 1-based index as opaque handle */
+  bool loaded = false;
+};
+
+NameTable g_op_table;     /* atomic symbol creators */
+NameTable g_func_table;   /* ndarray functions */
+NameTable g_iter_table;   /* data iterator creators */
+
+bool load_table(NameTable *t, const char *helper) {
+  if (t->loaded) return true;
+  PyObject *lst = call_helper(helper, "()");
+  if (!lst) return false;
+  Py_ssize_t n = PyList_Size(lst);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    t->names.emplace_back(PyUnicode_AsUTF8(PyList_GET_ITEM(lst, i)));
+    t->handles.push_back(reinterpret_cast<void *>((uintptr_t)(i + 1)));
+  }
+  Py_DECREF(lst);
+  t->loaded = true;
+  return true;
+}
+
+const std::string *table_name(NameTable *t, void *handle) {
+  uintptr_t idx = reinterpret_cast<uintptr_t>(handle);
+  if (idx < 1 || idx > t->names.size()) {
+    set_error("invalid registry handle");
+    return nullptr;
+  }
+  return &t->names[idx - 1];
+}
+
+/* Per-op info caches (string storage must outlive the call). */
+struct OpInfoRec {
+  std::string name, desc, key_var;
+  StrList arg_names, arg_types, arg_descs;
+  mx_uint n_use = 0, n_scalar = 0;  /* function-registry arity */
+};
+/* one cached rec per registry index; bounded by registry size */
+std::map<uintptr_t, OpInfoRec *> g_op_info, g_func_info, g_iter_info;
+
+OpInfoRec *cached_info(std::map<uintptr_t, OpInfoRec *> *cache,
+                       void *handle) {
+  auto it = cache->find(reinterpret_cast<uintptr_t>(handle));
+  return it == cache->end() ? nullptr : it->second;
+}
+
+struct IterRec {
+  PyObject *it = nullptr;
+  NDArrayRec data_view, label_view;   /* reused across batches */
+  std::vector<uint64_t> index;
+};
+
+struct KVRec {
+  PyObject *kv = nullptr;
+};
+
+struct RecIORec {
+  PyObject *rec = nullptr;
+  std::string buf;
+};
+
+void fill_ndarray_view(NDArrayRec *view, PyObject *arr) {
+  /* replace the wrapped object (borrowed semantics for iterators) */
+  Py_XDECREF(view->arr);
+  Py_INCREF(arr);
+  view->arr = arr;
+  view->shape.clear();
+  PyObject *shape = PyObject_GetAttrString(arr, "shape");
+  if (shape) {
+    for (Py_ssize_t d = 0; d < PyTuple_Size(shape); ++d)
+      view->shape.push_back(
+          (mx_uint)PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shape, d)));
+    Py_DECREF(shape);
+  }
+}
+
+std::string self_lib_path() {
+  Dl_info info;
+  if (dladdr(reinterpret_cast<void *>(&MXGetLastError), &info) &&
+      info.dli_fname)
+    return info.dli_fname;
+  return "";
+}
+
+}  /* namespace */
+
+extern "C" {
+
+/* ---- NDArray extras --------------------------------------------------- */
+
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int dtype, NDArrayHandle *out) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *t = shape_tuple(shape, ndim);
+  PyObject *arr = call_helper("ndarray_create_ex", "(Oiii)", t, dev_type,
+                              dev_id, dtype);
+  Py_DECREF(t);
+  if (!arr) return -1;
+  NDArrayRec *rec = new NDArrayRec();
+  rec->arr = arr;
+  rec->shape.assign(shape, shape + ndim);
+  *out = rec;
+  return 0;
+}
+
+static int wrap_result_ndarray(PyObject *arr, NDArrayHandle *out) {
+  if (!arr) return -1;
+  NDArrayRec *rec = new NDArrayRec();
+  rec->arr = arr;
+  PyObject *shape = PyObject_GetAttrString(arr, "shape");
+  if (shape) {
+    for (Py_ssize_t d = 0; d < PyTuple_Size(shape); ++d)
+      rec->shape.push_back(
+          (mx_uint)PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shape, d)));
+    Py_DECREF(shape);
+  } else {
+    PyErr_Clear();
+  }
+  *out = rec;
+  return 0;
+}
+
+int MXNDArraySlice(NDArrayHandle handle, mx_uint start, mx_uint stop,
+                   NDArrayHandle *out) {
+  GIL gil;
+  NDArrayRec *rec = static_cast<NDArrayRec *>(handle);
+  return wrap_result_ndarray(
+      call_helper("ndarray_slice", "(OII)", rec->arr, start, stop), out);
+}
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int *dims,
+                     NDArrayHandle *out) {
+  GIL gil;
+  NDArrayRec *rec = static_cast<NDArrayRec *>(handle);
+  PyObject *t = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(t, i, PyLong_FromLong(dims[i]));
+  PyObject *arr = call_helper("ndarray_reshape", "(OO)", rec->arr, t);
+  Py_DECREF(t);
+  return wrap_result_ndarray(arr, out);
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id) {
+  GIL gil;
+  NDArrayRec *rec = static_cast<NDArrayRec *>(handle);
+  PyObject *r = call_helper("ndarray_context", "(O)", rec->arr);
+  if (!r) return -1;
+  *out_dev_type = (int)PyLong_AsLong(PyTuple_GET_ITEM(r, 0));
+  *out_dev_id = (int)PyLong_AsLong(PyTuple_GET_ITEM(r, 1));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype) {
+  GIL gil;
+  NDArrayRec *rec = static_cast<NDArrayRec *>(handle);
+  PyObject *r = call_helper("ndarray_dtype_id", "(O)", rec->arr);
+  if (!r) return -1;
+  *out_dtype = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTPUNDArrayWrapPyObject(void *py_ndarray, NDArrayHandle *out) {
+  GIL gil;
+  PyObject *arr = static_cast<PyObject *>(py_ndarray);
+  Py_INCREF(arr);
+  return wrap_result_ndarray(arr, out);
+}
+
+/* ---- NDArray function registry ---------------------------------------- */
+
+static OpInfoRec *func_info_rec(FunctionHandle fun) {
+  OpInfoRec *info = cached_info(&g_func_info, fun);
+  if (info) return info;
+  const std::string *fname = table_name(&g_func_table, fun);
+  if (!fname) return nullptr;
+  PyObject *r = call_helper("func_info", "(s)", fname->c_str());
+  if (!r) return nullptr;
+  info = new OpInfoRec();
+  info->name = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 0));
+  info->desc = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 1));
+  info->n_use = (mx_uint)PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, 2));
+  info->n_scalar = (mx_uint)PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, 3));
+  Py_DECREF(r);
+  g_func_info[reinterpret_cast<uintptr_t>(fun)] = info;
+  return info;
+}
+
+int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  if (!load_table(&g_func_table, "list_functions")) return -1;
+  *out_size = (mx_uint)g_func_table.handles.size();
+  *out_array = g_func_table.handles.data();
+  return 0;
+}
+
+int MXGetFunction(const char *name, FunctionHandle *out) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  if (!load_table(&g_func_table, "list_functions")) return -1;
+  for (size_t i = 0; i < g_func_table.names.size(); ++i)
+    if (g_func_table.names[i] == name) {
+      *out = g_func_table.handles[i];
+      return 0;
+    }
+  set_error(std::string("unknown function '") + name + "'");
+  return -1;
+}
+
+int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                  const char **description, mx_uint *num_args,
+                  const char ***arg_names, const char ***arg_type_infos,
+                  const char ***arg_descriptions) {
+  GIL gil;
+  OpInfoRec *info = func_info_rec(fun);
+  if (!info) return -1;
+  *name = info->name.c_str();
+  *description = info->desc.c_str();
+  *num_args = 0;
+  if (arg_names) *arg_names = nullptr;
+  if (arg_type_infos) *arg_type_infos = nullptr;
+  if (arg_descriptions) *arg_descriptions = nullptr;
+  return 0;
+}
+
+int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                   mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                   int *type_mask) {
+  GIL gil;
+  OpInfoRec *info = func_info_rec(fun);
+  if (!info) return -1;
+  *num_use_vars = info->n_use;
+  *num_scalars = info->n_scalar;
+  *num_mutate_vars = 1;
+  *type_mask = 1;  /* kNDArrayArgBeforeScalar */
+  return 0;
+}
+
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                 const mx_float *scalar_args, NDArrayHandle *mutate_vars) {
+  GIL gil;
+  OpInfoRec *info = func_info_rec(fun);
+  if (!info) return -1;
+  const std::string *fname = &info->name;
+  mx_uint n_use = info->n_use, n_scalar = info->n_scalar;
+  PyObject *uses = PyList_New(n_use);
+  for (mx_uint i = 0; i < n_use; ++i) {
+    PyObject *a = static_cast<NDArrayRec *>(use_vars[i])->arr;
+    Py_INCREF(a);
+    PyList_SET_ITEM(uses, i, a);
+  }
+  PyObject *scalars = PyList_New(n_scalar);
+  for (mx_uint i = 0; i < n_scalar; ++i)
+    PyList_SET_ITEM(scalars, i, PyFloat_FromDouble(scalar_args[i]));
+  PyObject *muts = PyList_New(1);
+  PyObject *m = static_cast<NDArrayRec *>(mutate_vars[0])->arr;
+  Py_INCREF(m);
+  PyList_SET_ITEM(muts, 0, m);
+  PyObject *r = call_helper("func_invoke", "(sOOO)", fname->c_str(), uses,
+                            scalars, muts);
+  Py_DECREF(uses);
+  Py_DECREF(scalars);
+  Py_DECREF(muts);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- Symbol registry + composition ------------------------------------ */
+
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  if (!load_table(&g_op_table, "atomic_symbol_creators")) return -1;
+  *out_size = (mx_uint)g_op_table.handles.size();
+  *out_array = g_op_table.handles.data();
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **out_name) {
+  GIL gil;
+  const std::string *name = table_name(&g_op_table, creator);
+  if (!name) return -1;
+  *out_name = name->c_str();
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char **name, const char **description,
+                                mx_uint *num_args, const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions,
+                                const char **key_var_num_args) {
+  GIL gil;
+  OpInfoRec *info = cached_info(&g_op_info, creator);
+  if (!info) {
+    const std::string *op = table_name(&g_op_table, creator);
+    if (!op) return -1;
+    PyObject *r = call_helper("atomic_symbol_info", "(s)", op->c_str());
+    if (!r) return -1;
+    info = new OpInfoRec();
+    info->name = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 0));
+    info->desc = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 1));
+    info->arg_names.fill(PyTuple_GET_ITEM(r, 2));
+    info->arg_types.fill(PyTuple_GET_ITEM(r, 3));
+    info->arg_descs.fill(PyTuple_GET_ITEM(r, 4));
+    info->key_var = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 5));
+    Py_DECREF(r);
+    g_op_info[reinterpret_cast<uintptr_t>(creator)] = info;
+  }
+  *name = info->name.c_str();
+  *description = info->desc.c_str();
+  *num_args = (mx_uint)info->arg_names.ptrs.size();
+  *arg_names = info->arg_names.ptrs.data();
+  *arg_type_infos = info->arg_types.ptrs.data();
+  *arg_descriptions = info->arg_descs.ptrs.data();
+  *key_var_num_args = info->key_var.c_str();
+  return 0;
+}
+
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out) {
+  GIL gil;
+  const std::string *op = table_name(&g_op_table, creator);
+  if (!op) return -1;
+  PyObject *klist = PyList_New(num_param);
+  PyObject *vlist = PyList_New(num_param);
+  for (mx_uint i = 0; i < num_param; ++i) {
+    PyList_SET_ITEM(klist, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(vlist, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject *sym = call_helper("create_atomic_symbol", "(sOO)", op->c_str(),
+                              klist, vlist);
+  Py_DECREF(klist);
+  Py_DECREF(vlist);
+  if (!sym) return -1;
+  SymbolRec *rec = new SymbolRec();
+  rec->sym = sym;
+  *out = rec;
+  return 0;
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args) {
+  GIL gil;
+  SymbolRec *rec = static_cast<SymbolRec *>(sym);
+  PyObject *klist;
+  if (keys) {
+    klist = PyList_New(num_args);
+    for (mx_uint i = 0; i < num_args; ++i)
+      PyList_SET_ITEM(klist, i, PyUnicode_FromString(keys[i]));
+  } else {
+    klist = PyList_New(0);
+  }
+  PyObject *alist = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyObject *a = static_cast<SymbolRec *>(args[i])->sym;
+    Py_INCREF(a);
+    PyList_SET_ITEM(alist, i, a);
+  }
+  PyObject *composed = call_helper("symbol_compose", "(OsOO)", rec->sym,
+                                   name ? name : "", klist, alist);
+  Py_DECREF(klist);
+  Py_DECREF(alist);
+  if (!composed) return -1;
+  Py_DECREF(rec->sym);
+  rec->sym = composed;  /* handle becomes the composed symbol in place */
+  return 0;
+}
+
+static int wrap_symbol(PyObject *sym, SymbolHandle *out) {
+  if (!sym) return -1;
+  SymbolRec *rec = new SymbolRec();
+  rec->sym = sym;
+  *out = rec;
+  return 0;
+}
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  return wrap_symbol(call_helper("symbol_create_variable", "(s)", name), out);
+}
+
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out) {
+  GIL gil;
+  PyObject *lst = PyList_New(num_symbols);
+  for (mx_uint i = 0; i < num_symbols; ++i) {
+    PyObject *s = static_cast<SymbolRec *>(symbols[i])->sym;
+    Py_INCREF(s);
+    PyList_SET_ITEM(lst, i, s);
+  }
+  PyObject *grp = call_helper("symbol_create_group", "(O)", lst);
+  Py_DECREF(lst);
+  return wrap_symbol(grp, out);
+}
+
+int MXSymbolCopy(SymbolHandle symbol, SymbolHandle *out) {
+  GIL gil;
+  SymbolRec *rec = static_cast<SymbolRec *>(symbol);
+  return wrap_symbol(call_helper("symbol_copy", "(O)", rec->sym), out);
+}
+
+int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out) {
+  GIL gil;
+  SymbolRec *rec = static_cast<SymbolRec *>(symbol);
+  return wrap_symbol(call_helper("symbol_get_internals", "(O)", rec->sym),
+                     out);
+}
+
+int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index, SymbolHandle *out) {
+  GIL gil;
+  SymbolRec *rec = static_cast<SymbolRec *>(symbol);
+  return wrap_symbol(
+      call_helper("symbol_get_output", "(OI)", rec->sym, index), out);
+}
+
+int MXSymbolGetAttr(SymbolHandle symbol, const char *key, const char **out,
+                    int *success) {
+  GIL gil;
+  SymbolRec *rec = static_cast<SymbolRec *>(symbol);
+  PyObject *r = call_helper("symbol_get_attr", "(Os)", rec->sym, key);
+  if (!r) return -1;
+  rec->attr_val = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out = rec->attr_val.c_str();
+  *success = rec->attr_val.empty() ? 0 : 1;
+  return 0;
+}
+
+int MXSymbolSetAttr(SymbolHandle symbol, const char *key, const char *value) {
+  GIL gil;
+  SymbolRec *rec = static_cast<SymbolRec *>(symbol);
+  PyObject *r = call_helper("symbol_set_attr", "(Oss)", rec->sym, key, value);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
+                     const char ***out) {
+  GIL gil;
+  SymbolRec *rec = static_cast<SymbolRec *>(symbol);
+  PyObject *r = call_helper("symbol_list_attr", "(O)", rec->sym);
+  if (!r) return -1;
+  *out = rec->attr_list.fill(r);
+  *out_size = (mx_uint)rec->attr_list.ptrs.size();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolInferType(SymbolHandle handle, mx_uint num_args,
+                      const char **keys, const int *arg_type_data,
+                      mx_uint *in_type_size, const int **in_type_data,
+                      mx_uint *out_type_size, const int **out_type_data,
+                      mx_uint *aux_type_size, const int **aux_type_data) {
+  GIL gil;
+  SymbolRec *rec = static_cast<SymbolRec *>(handle);
+  PyObject *d = PyDict_New();
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyObject *v = PyLong_FromLong(arg_type_data[i]);
+    PyDict_SetItemString(d, keys[i], v);
+    Py_DECREF(v);
+  }
+  PyObject *r = call_helper("symbol_infer_type", "(OO)", rec->sym, d);
+  Py_DECREF(d);
+  if (!r) return -1;
+  auto fill = [](PyObject *lst, std::vector<int> *into) {
+    into->clear();
+    for (Py_ssize_t i = 0; i < PyList_Size(lst); ++i)
+      into->push_back((int)PyLong_AsLong(PyList_GET_ITEM(lst, i)));
+  };
+  fill(PyTuple_GET_ITEM(r, 0), &rec->in_ids);
+  fill(PyTuple_GET_ITEM(r, 1), &rec->out_ids);
+  fill(PyTuple_GET_ITEM(r, 2), &rec->aux_ids);
+  Py_DECREF(r);
+  *in_type_size = (mx_uint)rec->in_ids.size();
+  *in_type_data = rec->in_ids.data();
+  *out_type_size = (mx_uint)rec->out_ids.size();
+  *out_type_data = rec->out_ids.data();
+  *aux_type_size = (mx_uint)rec->aux_ids.size();
+  *aux_type_data = rec->aux_ids.data();
+  return 0;
+}
+
+/* ---- Data iterators --------------------------------------------------- */
+
+int MXListDataIters(mx_uint *out_size, DataIterCreator **out_array) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  if (!load_table(&g_iter_table, "list_data_iters")) return -1;
+  *out_size = (mx_uint)g_iter_table.handles.size();
+  *out_array = g_iter_table.handles.data();
+  return 0;
+}
+
+int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                          const char **description) {
+  GIL gil;
+  OpInfoRec *info = cached_info(&g_iter_info, creator);
+  if (!info) {
+    const std::string *iname = table_name(&g_iter_table, creator);
+    if (!iname) return -1;
+    PyObject *r = call_helper("data_iter_info", "(s)", iname->c_str());
+    if (!r) return -1;
+    info = new OpInfoRec();
+    info->name = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 0));
+    info->desc = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 1));
+    Py_DECREF(r);
+    g_iter_info[reinterpret_cast<uintptr_t>(creator)] = info;
+  }
+  *name = info->name.c_str();
+  *description = info->desc.c_str();
+  return 0;
+}
+
+int MXDataIterCreateIter(DataIterCreator creator, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out) {
+  GIL gil;
+  const std::string *iname = table_name(&g_iter_table, creator);
+  if (!iname) return -1;
+  PyObject *klist = PyList_New(num_param);
+  PyObject *vlist = PyList_New(num_param);
+  for (mx_uint i = 0; i < num_param; ++i) {
+    PyList_SET_ITEM(klist, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(vlist, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject *it = call_helper("create_data_iter", "(sOO)", iname->c_str(),
+                             klist, vlist);
+  Py_DECREF(klist);
+  Py_DECREF(vlist);
+  if (!it) return -1;
+  IterRec *rec = new IterRec();
+  rec->it = it;
+  *out = rec;
+  return 0;
+}
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  GIL gil;
+  IterRec *rec = static_cast<IterRec *>(handle);
+  PyObject *r = call_helper("iter_before_first", "(O)", rec->it);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterNext(DataIterHandle handle, int *out) {
+  GIL gil;
+  IterRec *rec = static_cast<IterRec *>(handle);
+  PyObject *r = call_helper("iter_next", "(O)", rec->it);
+  if (!r) return -1;
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out) {
+  GIL gil;
+  IterRec *rec = static_cast<IterRec *>(handle);
+  PyObject *arr = call_helper("iter_get_data", "(O)", rec->it);
+  if (!arr) return -1;
+  fill_ndarray_view(&rec->data_view, arr);
+  Py_DECREF(arr);
+  *out = &rec->data_view;
+  return 0;
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out) {
+  GIL gil;
+  IterRec *rec = static_cast<IterRec *>(handle);
+  PyObject *arr = call_helper("iter_get_label", "(O)", rec->it);
+  if (!arr) return -1;
+  fill_ndarray_view(&rec->label_view, arr);
+  Py_DECREF(arr);
+  *out = &rec->label_view;
+  return 0;
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad) {
+  GIL gil;
+  IterRec *rec = static_cast<IterRec *>(handle);
+  PyObject *r = call_helper("iter_get_pad", "(O)", rec->it);
+  if (!r) return -1;
+  *pad = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                       uint64_t *out_size) {
+  GIL gil;
+  IterRec *rec = static_cast<IterRec *>(handle);
+  PyObject *bytes = call_helper("iter_get_index", "(O)", rec->it);
+  if (!bytes) return -1;
+  Py_ssize_t n = PyBytes_Size(bytes);
+  rec->index.resize((size_t)n / sizeof(uint64_t));
+  std::memcpy(rec->index.data(), PyBytes_AsString(bytes), (size_t)n);
+  Py_DECREF(bytes);
+  *out_index = rec->index.data();
+  *out_size = (uint64_t)rec->index.size();
+  return 0;
+}
+
+int MXDataIterFree(DataIterHandle handle) {
+  GIL gil;
+  IterRec *rec = static_cast<IterRec *>(handle);
+  Py_XDECREF(rec->it);
+  Py_XDECREF(rec->data_view.arr);
+  Py_XDECREF(rec->label_view.arr);
+  delete rec;
+  return 0;
+}
+
+/* ---- KVStore ---------------------------------------------------------- */
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *kv = call_helper("kv_create", "(s)", type);
+  if (!kv) return -1;
+  KVRec *rec = new KVRec();
+  rec->kv = kv;
+  *out = rec;
+  return 0;
+}
+
+int MXKVStoreFree(KVStoreHandle handle) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  Py_XDECREF(rec->kv);
+  delete rec;
+  return 0;
+}
+
+static int kv_keys_vals(mx_uint num, const int *keys, NDArrayHandle *vals,
+                        PyObject **out_keys, PyObject **out_vals) {
+  PyObject *klist = PyList_New(num);
+  PyObject *vlist = PyList_New(num);
+  for (mx_uint i = 0; i < num; ++i) {
+    PyList_SET_ITEM(klist, i, PyLong_FromLong(keys[i]));
+    PyObject *a = static_cast<NDArrayRec *>(vals[i])->arr;
+    Py_INCREF(a);
+    PyList_SET_ITEM(vlist, i, a);
+  }
+  *out_keys = klist;
+  *out_vals = vlist;
+  return 0;
+}
+
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  PyObject *k, *v;
+  kv_keys_vals(num, keys, vals, &k, &v);
+  PyObject *r = call_helper("kv_init", "(OOO)", rec->kv, k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  PyObject *k, *v;
+  kv_keys_vals(num, keys, vals, &k, &v);
+  PyObject *r = call_helper("kv_push", "(OOOi)", rec->kv, k, v, priority);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  PyObject *k, *v;
+  kv_keys_vals(num, keys, vals, &k, &v);
+  PyObject *r = call_helper("kv_pull", "(OOOi)", rec->kv, k, v, priority);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  std::string lib = self_lib_path();
+  if (lib.empty()) {
+    set_error("cannot locate own shared library for updater bridge");
+    return -1;
+  }
+  PyObject *r = call_helper(
+      "kv_set_updater", "(OKKs)", rec->kv,
+      (unsigned long long)(uintptr_t)updater,
+      (unsigned long long)(uintptr_t)updater_handle, lib.c_str());
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetType(KVStoreHandle handle, const char **type) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  PyObject *r = call_helper("kv_type", "(O)", rec->kv);
+  if (!r) return -1;
+  static std::string stored;  /* GIL-guarded */
+  stored = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *type = stored.c_str();
+  return 0;
+}
+
+static int kv_int_query(KVStoreHandle handle, const char *helper, int *out) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  PyObject *r = call_helper(helper, "(O)", rec->kv);
+  if (!r) return -1;
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetRank(KVStoreHandle handle, int *rank) {
+  return kv_int_query(handle, "kv_rank", rank);
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *size) {
+  return kv_int_query(handle, "kv_group_size", size);
+}
+
+int MXKVStoreBarrier(KVStoreHandle handle) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  PyObject *r = call_helper("kv_barrier", "(O)", rec->kv);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle, int do_barrier) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  PyObject *r = call_helper("kv_set_barrier_before_exit", "(Oi)", rec->kv,
+                            do_barrier);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id, int *number) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  PyObject *r = call_helper("kv_num_dead_node", "(Oi)", rec->kv, node_id);
+  if (!r) return -1;
+  *number = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_head,
+                                   const char *cmd_body) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  PyObject *r = call_helper("kv_send_command", "(Ois)", rec->kv, cmd_head,
+                            cmd_body);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- RecordIO --------------------------------------------------------- */
+
+static int recio_create(const char *uri, const char *helper,
+                        RecordIOHandle *out) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *r = call_helper(helper, "(s)", uri);
+  if (!r) return -1;
+  RecIORec *rec = new RecIORec();
+  rec->rec = r;
+  *out = rec;
+  return 0;
+}
+
+static int recio_free(RecordIOHandle handle) {
+  GIL gil;
+  RecIORec *rec = static_cast<RecIORec *>(handle);
+  PyObject *r = call_helper("recordio_close", "(O)", rec->rec);
+  Py_XDECREF(r);
+  Py_XDECREF(rec->rec);
+  delete rec;
+  return r ? 0 : -1;
+}
+
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out) {
+  return recio_create(uri, "recordio_writer_create", out);
+}
+
+int MXRecordIOWriterFree(RecordIOHandle handle) { return recio_free(handle); }
+
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size) {
+  GIL gil;
+  RecIORec *rec = static_cast<RecIORec *>(handle);
+  PyObject *mv = PyMemoryView_FromMemory(const_cast<char *>(buf),
+                                         (Py_ssize_t)size, PyBUF_READ);
+  if (!mv) { set_error_from_python(); return -1; }
+  PyObject *r = call_helper("recordio_write", "(OO)", rec->rec, mv);
+  Py_DECREF(mv);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out) {
+  return recio_create(uri, "recordio_reader_create", out);
+}
+
+int MXRecordIOReaderFree(RecordIOHandle handle) { return recio_free(handle); }
+
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char **buf,
+                               size_t *size) {
+  GIL gil;
+  RecIORec *rec = static_cast<RecIORec *>(handle);
+  PyObject *bytes = call_helper("recordio_read", "(O)", rec->rec);
+  if (!bytes) return -1;
+  rec->buf.assign(PyBytes_AsString(bytes), (size_t)PyBytes_Size(bytes));
+  Py_DECREF(bytes);
+  *buf = rec->buf.data();
+  *size = rec->buf.size();
   return 0;
 }
 

