@@ -20,6 +20,23 @@ endif
 
 predict: $(PREDICT_LIB)
 
+# Perl frontend (perl-package/): XS glue over the C ABI, the role the
+# reference's R-package played over its C API.
+PERL_SO := perl-package/blib/auto/MXNetTPU/MXNetTPU.so
+PERL_CORE = $(shell perl -MConfig -e 'print $$Config{archlibexp}')/CORE
+PERL_CCFLAGS = $(shell perl -MConfig -e 'print $$Config{ccflags}')
+
+perl: $(PREDICT_LIB) $(PERL_SO)
+
+$(PERL_SO): perl-package/MXNetTPU.xs include/mxnet_tpu/c_api.h $(PREDICT_LIB)
+	@mkdir -p perl-package/blib/auto/MXNetTPU
+	xsubpp -typemap $(shell perl -MConfig -e 'print $$Config{privlibexp}')/ExtUtils/typemap \
+		perl-package/MXNetTPU.xs > perl-package/blib/MXNetTPU.c
+	$(CC) -O2 -fPIC -shared -o $@ perl-package/blib/MXNetTPU.c \
+		$(PERL_CCFLAGS) -I$(PERL_CORE) -Iinclude \
+		-Lmxnet_tpu/_native -lmxtpu_predict \
+		-Wl,-rpath,$(abspath mxnet_tpu/_native)
+
 $(LIB): $(SRCS)
 	@mkdir -p mxnet_tpu/_native
 	$(CXX) $(CXXFLAGS) -shared -o $@ $(SRCS)
@@ -32,6 +49,6 @@ test: $(LIB)
 	python -m pytest tests/ -q
 
 clean:
-	rm -rf mxnet_tpu/_native
+	rm -rf mxnet_tpu/_native perl-package/blib
 
-.PHONY: all predict test clean
+.PHONY: all predict perl test clean

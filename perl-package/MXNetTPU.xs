@@ -1,0 +1,229 @@
+/*
+ * XS glue: Perl -> libmxtpu_predict.so C ABI.
+ *
+ * The reference shipped R/Scala/Matlab frontends over its ~110-function
+ * C API (R-package/src, scala-package native JNI); this is the same
+ * pattern for Perl, the non-Python runtime available in this image:
+ * thin XSUBs over include/mxnet_tpu/c_api.h, with the object model
+ * (Symbol/Executor/NDArray classes) living in lib/MXNetTPU.pm, exactly
+ * as R kept its classes in R code over .Call stubs.
+ *
+ * Handles cross as IVs (pointer-sized integers); float buffers cross as
+ * Perl strings packed with pack("f*", ...), the idiomatic Perl binary
+ * representation.
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include <stdlib.h>
+
+#include <mxnet_tpu/c_api.h>
+
+static void croak_on(pTHX_ int rc, const char *what) {
+  if (rc != 0) croak("%s failed: %s", what, MXGetLastError());
+}
+
+MODULE = MXNetTPU  PACKAGE = MXNetTPU  PREFIX = mxtpu_
+
+PROTOTYPES: DISABLE
+
+const char *
+mxtpu_last_error()
+  CODE:
+    RETVAL = MXGetLastError();
+  OUTPUT:
+    RETVAL
+
+IV
+mxtpu_symbol_load_json(json)
+    const char *json
+  CODE:
+    SymbolHandle h;
+    croak_on(aTHX_ MXSymbolCreateFromJSON(json, &h), "MXSymbolCreateFromJSON");
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+const char *
+mxtpu_symbol_to_json(sym)
+    IV sym
+  CODE:
+    const char *json;
+    croak_on(aTHX_ MXSymbolSaveToJSON(INT2PTR(SymbolHandle, sym), &json),
+             "MXSymbolSaveToJSON");
+    RETVAL = json;
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu_symbol_list_arguments(sym)
+    IV sym
+  PPCODE:
+    mx_uint n;
+    const char **names;
+    croak_on(aTHX_ MXSymbolListArguments(INT2PTR(SymbolHandle, sym), &n,
+                                         &names),
+             "MXSymbolListArguments");
+    EXTEND(SP, n);
+    for (mx_uint i = 0; i < n; ++i)
+      PUSHs(sv_2mortal(newSVpv(names[i], 0)));
+
+void
+mxtpu_symbol_infer_shape(sym, data_name, ...)
+    IV sym
+    const char *data_name
+  PPCODE:
+    /* remaining stack items: the data dims; returns one arrayref of dims
+     * per argument, in list_arguments order */
+    mx_uint ndim = (mx_uint)(items - 2);
+    if (ndim > 16) croak("infer_shape: at most 16 data dims, got %u", ndim);
+    mx_uint indptr[2] = {0, ndim};
+    mx_uint dims[16];
+    for (mx_uint i = 0; i < ndim; ++i)
+      dims[i] = (mx_uint)SvUV(ST(2 + i));
+    const char *keys[1] = {data_name};
+    mx_uint in_n, out_n;
+    const mx_uint *in_ndim, *out_ndim;
+    const mx_uint **in_sh, **out_sh;
+    croak_on(aTHX_ MXSymbolInferShape(INT2PTR(SymbolHandle, sym), 1, keys,
+                                      indptr, dims, &in_n, &in_ndim, &in_sh,
+                                      &out_n, &out_ndim, &out_sh),
+             "MXSymbolInferShape");
+    EXTEND(SP, in_n);
+    for (mx_uint i = 0; i < in_n; ++i) {
+      AV *av = newAV();
+      for (mx_uint d = 0; d < in_ndim[i]; ++d)
+        av_push(av, newSVuv(in_sh[i][d]));
+      PUSHs(sv_2mortal(newRV_noinc((SV *)av)));
+    }
+
+void
+mxtpu_symbol_free(sym)
+    IV sym
+  CODE:
+    MXSymbolFree(INT2PTR(SymbolHandle, sym));
+
+void
+mxtpu_nd_load(fname)
+    const char *fname
+  PPCODE:
+    /* returns flat list: name0, packed0, name1, packed1, ... */
+    mx_uint n, nn;
+    NDArrayHandle *arrs;
+    const char **names;
+    croak_on(aTHX_ MXNDArrayLoad(fname, &n, &arrs, &nn, &names),
+             "MXNDArrayLoad");
+    EXTEND(SP, 2 * (int)n);
+    for (mx_uint i = 0; i < n; ++i) {
+      mx_uint ndim;
+      const mx_uint *dims;
+      MXNDArrayGetShape(arrs[i], &ndim, &dims);
+      mx_uint size = 1;
+      for (mx_uint d = 0; d < ndim; ++d) size *= dims[d];
+      /* mortal up-front: a croak below must not leak the SV */
+      SV *buf = sv_2mortal(newSV(size * sizeof(mx_float)));
+      SvPOK_on(buf);
+      SvCUR_set(buf, size * sizeof(mx_float));
+      if (MXNDArraySyncCopyToCPU(arrs[i], (mx_float *)SvPVX(buf), size)
+          != 0) {
+        MXNDArrayListFree(arrs, n, names);  /* no native leak on croak */
+        croak("MXNDArraySyncCopyToCPU failed: %s", MXGetLastError());
+      }
+      PUSHs(sv_2mortal(newSVpv(nn > i ? names[i] : "", 0)));
+      PUSHs(buf);
+    }
+    MXNDArrayListFree(arrs, n, names);
+
+IV
+mxtpu_executor_simple_bind(sym, for_training, data_name, ...)
+    IV sym
+    int for_training
+    const char *data_name
+  CODE:
+    mx_uint ndim = (mx_uint)(items - 3);
+    if (ndim > 16) croak("simple_bind: at most 16 data dims, got %u", ndim);
+    mx_uint indptr[2] = {0, ndim};
+    mx_uint dims[16];
+    for (mx_uint i = 0; i < ndim; ++i)
+      dims[i] = (mx_uint)SvUV(ST(3 + i));
+    const char *keys[1] = {data_name};
+    ExecutorHandle exe;
+    croak_on(aTHX_ MXExecutorSimpleBind(INT2PTR(SymbolHandle, sym), 1, 0, 1,
+                                        keys, indptr, dims, for_training,
+                                        &exe),
+             "MXExecutorSimpleBind");
+    RETVAL = PTR2IV(exe);
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu_executor_set_arg(exe, name, packed)
+    IV exe
+    const char *name
+    SV *packed
+  CODE:
+    STRLEN len;
+    const char *buf = SvPV(packed, len);
+    croak_on(aTHX_ MXExecutorSetArg(INT2PTR(ExecutorHandle, exe), name,
+                                    (const mx_float *)buf,
+                                    (mx_uint)(len / sizeof(mx_float))),
+             "MXExecutorSetArg");
+
+void
+mxtpu_executor_forward(exe, is_train)
+    IV exe
+    int is_train
+  CODE:
+    croak_on(aTHX_ MXExecutorForward(INT2PTR(ExecutorHandle, exe), is_train),
+             "MXExecutorForward");
+
+void
+mxtpu_executor_backward(exe)
+    IV exe
+  CODE:
+    croak_on(aTHX_ MXExecutorBackward(INT2PTR(ExecutorHandle, exe)),
+             "MXExecutorBackward");
+
+SV *
+mxtpu_executor_get_output(exe, index, size)
+    IV exe
+    unsigned index
+    unsigned size
+  CODE:
+    mx_float *tmp = (mx_float *)malloc((size_t)size * sizeof(mx_float));
+    if (!tmp) croak("out of memory");
+    if (MXExecutorGetOutput(INT2PTR(ExecutorHandle, exe), index, tmp, size)
+        != 0) {
+      free(tmp);
+      croak("MXExecutorGetOutput failed: %s", MXGetLastError());
+    }
+    RETVAL = newSVpvn((const char *)tmp, (STRLEN)size * sizeof(mx_float));
+    free(tmp);
+  OUTPUT:
+    RETVAL
+
+SV *
+mxtpu_executor_get_grad(exe, name, size)
+    IV exe
+    const char *name
+    unsigned size
+  CODE:
+    mx_float *tmp = (mx_float *)malloc((size_t)size * sizeof(mx_float));
+    if (!tmp) croak("out of memory");
+    if (MXExecutorGetGrad(INT2PTR(ExecutorHandle, exe), name, tmp, size)
+        != 0) {
+      free(tmp);
+      croak("MXExecutorGetGrad failed: %s", MXGetLastError());
+    }
+    RETVAL = newSVpvn((const char *)tmp, (STRLEN)size * sizeof(mx_float));
+    free(tmp);
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu_executor_free(exe)
+    IV exe
+  CODE:
+    MXExecutorFree(INT2PTR(ExecutorHandle, exe));

@@ -1,0 +1,89 @@
+#!/usr/bin/perl
+# Load a reference-format checkpoint, run inference, then one SGD step —
+# the frontend-parity demo (the reference's R-package "predict + train"
+# story over the C API, in Perl).
+#
+# Usage: train_step.pl <symbol.json> <params-file> <data.csv> <label.csv> <lr>
+# Prints: "probs=<comma list>" (pre-update inference on the batch),
+#         "probs_after=<comma list>" (after one SGD step),
+#         "loss_before=<v> loss_after=<v>".
+use strict;
+use warnings;
+use FindBin;
+use lib "$FindBin::Bin/../lib", "$FindBin::Bin/../blib";
+use MXNetTPU;
+
+my ($sym_file, $param_file, $data_csv, $label_csv, $lr) = @ARGV;
+die "usage: $0 sym.json params data.csv label.csv lr\n" unless $lr;
+
+sub read_csv {
+    my ($f) = @_;
+    open my $fh, '<', $f or die "open $f: $!";
+    my @rows;
+    while (<$fh>) {
+        chomp;
+        push @rows, [split /,/];
+    }
+    close $fh;
+    return \@rows;
+}
+
+my $X = read_csv($data_csv);
+my $y = read_csv($label_csv);
+my $batch = scalar @$X;
+my $feat  = scalar @{ $X->[0] };
+
+my $sym = MXNetTPU::Symbol->load($sym_file);
+my $params = MXNetTPU::NDArray->load_params($param_file);
+my $shapes = $sym->infer_shape("data", $batch, $feat);
+
+my $exe = $sym->simple_bind(for_training => 1, data => ["data", $batch, $feat]);
+
+# weights from the checkpoint (container keys are "arg:<name>")
+my @weight_names;
+for my $name ($sym->list_arguments) {
+    next if $name eq 'data' || $name eq 'softmax_label';
+    my $packed = $params->{"arg:$name"} // $params->{$name}
+      or die "checkpoint missing $name";
+    $exe->set_arg($name, $packed);
+    push @weight_names, $name;
+}
+
+my @flat_x = map { @$_ } @$X;
+my @flat_y = map { $_->[0] } @$y;
+$exe->set_arg("data",          pack("f*", @flat_x));
+$exe->set_arg("softmax_label", pack("f*", @flat_y));
+
+sub xent {
+    my ($probs) = @_;
+    my $loss = 0;
+    for my $i (0 .. $batch - 1) {
+        my $p = $probs->[ $i * 2 + $flat_y[$i] ];
+        $loss -= log($p > 1e-12 ? $p : 1e-12);
+    }
+    return $loss / $batch;
+}
+
+# inference before the update
+$exe->forward(0);
+my @probs = unpack("f*", $exe->get_output(0, $batch * 2));
+printf "probs=%s\n", join(",", map { sprintf "%.6f", $_ } @probs[0 .. 5]);
+printf "loss_before=%.6f\n", xent(\@probs);
+
+# one SGD step: forward(train) + backward + host-side update
+$exe->forward(1);
+$exe->backward;
+for my $name (@weight_names) {
+    my $dims = $shapes->{$name};
+    my $size = 1;
+    $size *= $_ for @$dims;
+    my @w = unpack("f*", $params->{"arg:$name"} // $params->{$name});
+    my @g = unpack("f*", $exe->get_grad($name, $size));
+    $w[$_] -= $lr * $g[$_] for 0 .. $size - 1;
+    $exe->set_arg($name, pack("f*", @w));
+}
+
+$exe->forward(0);
+my @probs2 = unpack("f*", $exe->get_output(0, $batch * 2));
+printf "probs_after=%s\n", join(",", map { sprintf "%.6f", $_ } @probs2[0 .. 5]);
+printf "loss_after=%.6f\n", xent(\@probs2);

@@ -1,0 +1,126 @@
+package MXNetTPU;
+
+# Perl frontend over the mxnet_tpu C ABI — the image's non-Python
+# binding, playing the role the reference's R-package played over its
+# C API (R-package/R/*.R over .Call stubs into src/c_api/c_api.cc):
+# object classes here, thin XSUBs in MXNetTPU.xs.
+#
+# Float tensors are Perl strings packed with pack("f*", @values).
+
+use strict;
+use warnings;
+use DynaLoader ();
+
+our $VERSION = '0.1';
+our @ISA = ('DynaLoader');
+
+sub dl_load_flags { 0x01 }    # RTLD_GLOBAL: libmxtpu_predict symbols
+
+__PACKAGE__->bootstrap($VERSION);
+
+# ---------------------------------------------------------------------------
+package MXNetTPU::Symbol;
+
+sub load_json {
+    my ($class, $json) = @_;
+    my $h = MXNetTPU::symbol_load_json($json);
+    return bless { handle => $h }, $class;
+}
+
+sub load {
+    my ($class, $fname) = @_;
+    open my $fh, '<', $fname or die "cannot open $fname: $!";
+    local $/;
+    my $json = <$fh>;
+    close $fh;
+    return $class->load_json($json);
+}
+
+sub tojson { MXNetTPU::symbol_to_json($_[0]{handle}) }
+
+sub list_arguments {
+    my ($self) = @_;
+    return MXNetTPU::symbol_list_arguments($self->{handle});
+}
+
+sub infer_shape {
+    my ($self, $data_name, @dims) = @_;
+    my @shapes =
+      MXNetTPU::symbol_infer_shape($self->{handle}, $data_name, @dims);
+    my @args = $self->list_arguments;
+    my %by_name;
+    $by_name{ $args[$_] } = $shapes[$_] for 0 .. $#args;
+    return \%by_name;
+}
+
+sub simple_bind {
+    my ($self, %opt) = @_;
+    my $train = $opt{for_training} ? 1 : 0;
+    my ($name, @dims) = @{ $opt{data} };
+    my $h =
+      MXNetTPU::executor_simple_bind($self->{handle}, $train, $name, @dims);
+    return bless { handle => $h, symbol => $self }, 'MXNetTPU::Executor';
+}
+
+sub DESTROY { MXNetTPU::symbol_free($_[0]{handle}) if $_[0]{handle} }
+
+# ---------------------------------------------------------------------------
+package MXNetTPU::Executor;
+
+sub set_arg {
+    my ($self, $name, $packed) = @_;
+    MXNetTPU::executor_set_arg($self->{handle}, $name, $packed);
+}
+
+sub forward {
+    my ($self, $is_train) = @_;
+    MXNetTPU::executor_forward($self->{handle}, $is_train ? 1 : 0);
+}
+
+sub backward { MXNetTPU::executor_backward($_[0]{handle}) }
+
+sub get_output {
+    my ($self, $index, $size) = @_;
+    return MXNetTPU::executor_get_output($self->{handle}, $index, $size);
+}
+
+sub get_grad {
+    my ($self, $name, $size) = @_;
+    return MXNetTPU::executor_get_grad($self->{handle}, $name, $size);
+}
+
+sub DESTROY { MXNetTPU::executor_free($_[0]{handle}) if $_[0]{handle} }
+
+# ---------------------------------------------------------------------------
+package MXNetTPU::NDArray;
+
+# Load a reference-format checkpoint container: returns
+# { name => packed-float-string }.
+sub load_params {
+    my ($class, $fname) = @_;
+    my %pairs = MXNetTPU::nd_load($fname);
+    return \%pairs;
+}
+
+1;
+__END__
+
+=head1 NAME
+
+MXNetTPU - Perl frontend for the mxnet_tpu TPU-native framework
+
+=head1 SYNOPSIS
+
+  use MXNetTPU;
+  my $sym = MXNetTPU::Symbol->load("model-symbol.json");
+  my $params = MXNetTPU::NDArray->load_params("model-0001.params");
+  my $exe = $sym->simple_bind(for_training => 1,
+                              data => ["data", 32, 10]);
+  $exe->set_arg("fc1_weight", $params->{"arg:fc1_weight"});
+  $exe->set_arg("data", pack("f*", @x));
+  $exe->forward(1);
+  my @probs = unpack("f*", $exe->get_output(0, 32 * 2));
+  $exe->backward;
+  my @grad = unpack("f*", $exe->get_grad("fc1_weight", 160));
+
+=cut
