@@ -1,0 +1,202 @@
+"""Connectionist Temporal Classification loss.
+
+Parity target: the reference's warp-ctc plugin
+(``/root/reference/plugin/warpctc/warpctc-inl.h:33-200``), whose operator
+``WarpCTC(data, label, label_length, input_length)`` outputs ``softmax(data)``
+and back-propagates the CTC gradient, ignoring the head gradient (same
+contract as SoftmaxOutput).
+
+TPU-native design: instead of binding Baidu's hand-written CUDA kernels, the
+CTC forward-backward is expressed as a log-semiring alpha recursion over
+``lax.scan`` — a single differentiable XLA computation. The gradient
+``softmax - posterior`` falls out of ``jax.grad`` of the negative
+log-likelihood, which is mathematically identical to warp-ctc's explicit
+beta-pass gradient but needs no hand-written backward kernel: XLA
+differentiates the scan (it keeps the alpha trellis as the residual, the
+same memory warp-ctc spends on its workspace).
+
+Conventions match warp-ctc: blank label is 0; ``label`` rows are padded with
+0 (``labelLengths`` in the reference counts non-blank entries, ibid.:86-99).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .registry import Operator, Param, register_op
+
+
+def _jax():
+    import jax
+    return jax
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+_NEG_INF = -1e30  # finite stand-in for log(0): keeps grads NaN-free
+
+
+def ctc_neg_log_likelihood(log_probs, labels, blank: int = 0):
+    """Per-sequence CTC negative log-likelihood.
+
+    log_probs: (T, B, A) log-softmax scores. labels: (B, L) int32, padded
+    with ``blank``; the real length of row b is its non-blank count.
+    Differentiable: ``jax.grad`` of its sum w.r.t. the pre-softmax logits
+    yields warp-ctc's ``softmax - posterior`` gradient.
+    """
+    jax = _jax()
+    jnp = _jnp()
+    lax = jax.lax
+
+    log_probs = log_probs.astype(jnp.float32)
+    T, B, A = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    labels = labels.astype(jnp.int32)
+
+    # extended label sequence: blank-interleaved (b, l1, b, l2, ..., b)
+    ext = jnp.full((B, S), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    label_len = (labels != blank).sum(axis=1)          # (B,)
+
+    # s may take the diagonal skip s-2 -> s only onto a non-blank that
+    # differs from the previous non-blank (standard CTC transition rule)
+    skip_ok = jnp.zeros((B, S), dtype=bool)
+    if S > 2:
+        skip_ok = skip_ok.at[:, 2:].set(
+            (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]))
+
+    batch_idx = jnp.arange(B)[:, None]                  # (B, 1)
+
+    def emit(lp_t):
+        return lp_t[batch_idx, ext]                     # (B, S)
+
+    alpha0 = jnp.full((B, S), _NEG_INF, dtype=jnp.float32)
+    alpha0 = alpha0.at[:, 0].set(log_probs[0, :, blank])
+    if S > 1:
+        alpha0 = alpha0.at[:, 1].set(log_probs[0][batch_idx[:, 0], ext[:, 1]])
+
+    def shift(a, k):
+        pad = jnp.full((B, k), _NEG_INF, dtype=a.dtype)
+        return jnp.concatenate([pad, a[:, :S - k]], axis=1)
+
+    def step(alpha, lp_t):
+        stay = alpha
+        diag = shift(alpha, 1)
+        skip = jnp.where(skip_ok, shift(alpha, 2), _NEG_INF)
+        m = jnp.maximum(jnp.maximum(stay, diag), skip)
+        tot = m + jnp.log(jnp.exp(stay - m) + jnp.exp(diag - m)
+                          + jnp.exp(skip - m))
+        return tot + emit(lp_t), None
+
+    alpha_T, _ = lax.scan(step, alpha0, log_probs[1:])
+
+    # end states: s = 2*len (trailing blank) and s = 2*len - 1 (last label)
+    end = 2 * label_len                                 # (B,)
+    a_end = alpha_T[batch_idx[:, 0], end]
+    a_last = jnp.where(label_len > 0,
+                       alpha_T[batch_idx[:, 0],
+                               jnp.maximum(end - 1, 0)], _NEG_INF)
+    m = jnp.maximum(a_end, a_last)
+    ll = m + jnp.log(jnp.exp(a_end - m) + jnp.exp(a_last - m))
+    return -ll                                          # (B,)
+
+
+@register_op("WarpCTC")
+class WarpCTC(Operator):
+    """warp-ctc plugin parity: forward = row softmax of ``data``
+    ((T*B, A), time-major blocks, ibid.:67-84); backward = CTC gradient
+    w.r.t. ``data``, head gradient ignored (ibid.:113-199)."""
+
+    name_hint = "warpctc"
+    PARAMS = {
+        "label_length": Param(int, 0, "padded label length per sequence"),
+        "input_length": Param(int, 0, "time steps per sequence"),
+    }
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("WarpCTC: data shape unknown")
+        if len(data) != 2:
+            raise MXNetError("WarpCTC: data must be 2D (T*B, alphabet)")
+        if self.input_length <= 0 or data[0] % self.input_length:
+            raise MXNetError("WarpCTC: rows %d not divisible by "
+                             "input_length %d" % (data[0], self.input_length))
+        minibatch = data[0] // self.input_length
+        label = (minibatch, self.label_length)
+        return [data, label], [data], []
+
+    def infer_type(self, in_types, out_types=None):
+        dt = in_types[0] or (out_types[0] if out_types else None) \
+            or np.float32
+        return [dt, in_types[1] or np.float32], [dt], []
+
+    def apply(self, ctx, inputs, aux):
+        jax = _jax()
+        jnp = _jnp()
+        T = self.input_length
+        A = inputs[0].shape[1]
+        B = inputs[0].shape[0] // T
+
+        @jax.custom_vjp
+        def f(data, label):
+            return jax.nn.softmax(data.astype(jnp.float32), axis=-1)
+
+        def f_fwd(data, label):
+            return f(data, label), (data, label)
+
+        def f_bwd(res, g):
+            data, label = res
+            lab2d = label.reshape(B, -1)
+
+            def nll(d):
+                lp = jax.nn.log_softmax(
+                    d.astype(jnp.float32).reshape(T, B, A), axis=-1)
+                return ctc_neg_log_likelihood(lp, lab2d).sum()
+
+            grad = jax.grad(nll)(data).astype(data.dtype)
+            return grad, jnp.zeros_like(label)
+
+        f.defvjp(f_fwd, f_bwd)
+        return [f(inputs[0], inputs[1])], []
+
+
+@register_op("CTCLoss", aliases=("ctc_loss",))
+class CTCLoss(Operator):
+    """Per-sequence CTC loss as an ordinary differentiable op (the shape
+    later MXNet exposes as ``contrib.ctc_loss``): data (T, B, A) raw
+    activations, label (B, L) 0-padded -> loss (B,). Gradients flow via
+    autodiff of the scan; use with MakeLoss-style heads."""
+
+    name_hint = "ctcloss"
+    PARAMS = {}
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def infer_shape(self, in_shapes):
+        data, label = in_shapes
+        if data is None:
+            raise MXNetError("CTCLoss: data shape unknown")
+        if len(data) != 3:
+            raise MXNetError("CTCLoss: data must be (T, B, alphabet)")
+        if label is None:
+            raise MXNetError("CTCLoss: label shape unknown (B, L)")
+        return [data, label], [(data[1],)], []
+
+    def infer_type(self, in_types, out_types=None):
+        dt = in_types[0] or (out_types[0] if out_types else None) \
+            or np.float32
+        return [dt, in_types[1] or np.float32], [np.float32], []
+
+    def apply(self, ctx, inputs, aux):
+        jax = _jax()
+        lp = jax.nn.log_softmax(inputs[0].astype(_jnp().float32), axis=-1)
+        return [ctc_neg_log_likelihood(lp, inputs[1])], []

@@ -1,0 +1,146 @@
+"""CTC loss: warp-ctc plugin parity (reference plugin/warpctc/warpctc-inl.h).
+
+Ground truth: torch.nn.CTCLoss (CPU) — same algorithm warp-ctc implements —
+for both the loss value and the gradient w.r.t. the pre-softmax activations.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _torch_ctc(x, labels, blank=0):
+    """x: (T, B, A) logits; labels: (B, L) 0-padded.
+    Returns (loss (B,), grad wrt x)."""
+    torch = pytest.importorskip("torch")
+    xt = torch.tensor(x, dtype=torch.float32, requires_grad=True)
+    lp = torch.log_softmax(xt, dim=-1)
+    T, B, A = x.shape
+    label_lens = (labels != blank).sum(axis=1)
+    targets = torch.tensor(
+        np.concatenate([labels[b, :label_lens[b]] for b in range(B)]),
+        dtype=torch.long)
+    loss = torch.nn.functional.ctc_loss(
+        lp, targets,
+        input_lengths=torch.full((B,), T, dtype=torch.long),
+        target_lengths=torch.tensor(label_lens, dtype=torch.long),
+        blank=blank, reduction="none", zero_infinity=False)
+    loss.sum().backward()
+    return loss.detach().numpy(), xt.grad.numpy()
+
+
+def test_ctc_nll_matches_torch():
+    from mxnet_tpu.ops.ctc import ctc_neg_log_likelihood
+    import jax
+    rng = np.random.RandomState(0)
+    T, B, A, L = 12, 4, 6, 4
+    x = rng.randn(T, B, A).astype(np.float32)
+    labels = np.zeros((B, L), dtype=np.int32)
+    # variable lengths, labels in 1..A-1 (0 = blank = pad)
+    for b, n in enumerate([4, 3, 2, 1]):
+        labels[b, :n] = rng.randint(1, A, n)
+    ref_loss, _ = _torch_ctc(x, labels)
+    lp = jax.nn.log_softmax(x, axis=-1)
+    ours = np.asarray(ctc_neg_log_likelihood(lp, labels))
+    np.testing.assert_allclose(ours, ref_loss, rtol=1e-4, atol=1e-5)
+
+
+def test_ctc_repeated_labels():
+    """Repeated labels exercise the skip-transition mask."""
+    from mxnet_tpu.ops.ctc import ctc_neg_log_likelihood
+    import jax
+    rng = np.random.RandomState(1)
+    T, B, A = 10, 2, 5
+    x = rng.randn(T, B, A).astype(np.float32)
+    labels = np.array([[2, 2, 3, 0], [1, 1, 1, 1]], dtype=np.int32)
+    ref_loss, _ = _torch_ctc(x, labels)
+    lp = jax.nn.log_softmax(x, axis=-1)
+    ours = np.asarray(ctc_neg_log_likelihood(lp, labels))
+    np.testing.assert_allclose(ours, ref_loss, rtol=1e-4, atol=1e-5)
+
+
+def test_warpctc_forward_backward():
+    """Reference contract: output is softmax(data); backward writes the CTC
+    gradient and ignores head grads (warpctc-inl.h:67-199)."""
+    rng = np.random.RandomState(2)
+    T, B, A, L = 8, 3, 5, 3
+    x = rng.randn(T * B, A).astype(np.float32)
+    labels = np.zeros((B, L), dtype=np.float32)
+    labels[0, :2] = [1, 2]
+    labels[1, :3] = [3, 3, 4]
+    labels[2, :1] = [2]
+
+    s = sym.WarpCTC(data=sym.Variable("data"), label=sym.Variable("label"),
+                    input_length=T, label_length=L)
+    args = {"data": mx.nd.array(x), "label": mx.nd.array(labels)}
+    grads = {"data": mx.nd.zeros((T * B, A))}
+    ex = s.bind(mx.cpu(), args, args_grad=grads,
+                grad_req={"data": "write", "label": "null"})
+    ex.forward(is_train=True)
+    out = ex.outputs[0].asnumpy()
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    np.testing.assert_allclose(out, e / e.sum(axis=1, keepdims=True),
+                               rtol=1e-5)
+    ex.backward()
+    _, ref_grad = _torch_ctc(x.reshape(T, B, A), labels.astype(np.int32))
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               ref_grad.reshape(T * B, A),
+                               rtol=1e-3, atol=1e-5)
+
+
+def test_ctcloss_op_values_and_infer():
+    rng = np.random.RandomState(3)
+    T, B, A, L = 9, 2, 4, 3
+    x = rng.randn(T, B, A).astype(np.float32)
+    labels = np.array([[1, 3, 0], [2, 0, 0]], dtype=np.float32)
+    s = sym.CTCLoss(data=sym.Variable("data"), label=sym.Variable("label"))
+    arg_shapes, out_shapes, _ = s.infer_shape(data=(T, B, A), label=(B, L))
+    assert out_shapes[0] == (B,)
+    ex = s.bind(mx.cpu(), {"data": mx.nd.array(x),
+                           "label": mx.nd.array(labels)})
+    ex.forward(is_train=False)
+    ref_loss, _ = _torch_ctc(x, labels.astype(np.int32))
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), ref_loss,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_warpctc_training_decreases_loss():
+    """A linear model + WarpCTC trains: loss (measured via CTCLoss) drops."""
+    rng = np.random.RandomState(4)
+    T, B, A, L, D = 6, 4, 5, 2, 8
+    x = rng.randn(T * B, D).astype(np.float32)
+    labels = rng.randint(1, A, (B, L)).astype(np.float32)
+
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data=data, num_hidden=A, name="fc")
+    net = sym.WarpCTC(data=fc, label=sym.Variable("label"),
+                      input_length=T, label_length=L)
+
+    w = (rng.randn(A, D) * 0.1).astype(np.float32)
+    b = np.zeros(A, dtype=np.float32)
+    args = {"data": mx.nd.array(x), "fc_weight": mx.nd.array(w),
+            "fc_bias": mx.nd.array(b), "label": mx.nd.array(labels)}
+    grads = {k: mx.nd.zeros(v.shape) for k, v in args.items()
+             if k in ("fc_weight", "fc_bias")}
+    ex = net.bind(mx.cpu(), args, args_grad=grads,
+                  grad_req={"fc_weight": "write", "fc_bias": "write",
+                            "data": "null", "label": "null"})
+
+    def loss_now():
+        import jax
+        from mxnet_tpu.ops.ctc import ctc_neg_log_likelihood
+        logits = (x @ np.asarray(args["fc_weight"].asnumpy()).T
+                  + args["fc_bias"].asnumpy())
+        lp = jax.nn.log_softmax(logits.reshape(T, B, A), axis=-1)
+        return float(np.sum(np.asarray(
+            ctc_neg_log_likelihood(lp, labels.astype(np.int32)))))
+
+    before = loss_now()
+    for _ in range(30):
+        ex.forward(is_train=True)
+        ex.backward()
+        for k in ("fc_weight", "fc_bias"):
+            args[k][:] = args[k].asnumpy() - 0.05 * grads[k].asnumpy()
+    after = loss_now()
+    assert after < before * 0.8, (before, after)
