@@ -455,8 +455,10 @@ class PrefetchingIter(DataIter):
 class ImageRecordIter(DataIter):
     """Image recordio iterator with sharding + augmentation (reference
     ``src/io/iter_image_recordio.cc:109-455``). Decode via PIL; augmentation
-    covers the defaults of ``image_aug_default.cc``: resize, random/center
-    crop, random mirror, mean subtraction, scale."""
+    covers ``image_aug_default.cc:40-300``: resize, random/center crop,
+    random mirror, mean subtraction, scale, rotation/shear (affine with
+    ``fill_value`` border), padding, and HSL color jitter
+    (``random_h/s/l``, OpenCV units: H in [0,180), S/L in [0,255])."""
 
     def __init__(self, path_imgrec: str, data_shape, batch_size: int,
                  path_imgidx: Optional[str] = None, label_width: int = 1,
@@ -466,6 +468,10 @@ class ImageRecordIter(DataIter):
                  rand_crop: bool = False, rand_mirror: bool = False,
                  resize: int = -1, round_batch: bool = True, seed: int = 0,
                  preprocess_threads: int = 4, prefetch_buffer: int = 2,
+                 max_rotate_angle: int = 0, rotate: float = -1.0,
+                 rotate_list=(), max_shear_ratio: float = 0.0,
+                 pad: int = 0, fill_value: int = 255,
+                 random_h: int = 0, random_s: int = 0, random_l: int = 0,
                  **kwargs):
         super().__init__()
         from . import recordio as rio
@@ -476,6 +482,17 @@ class ImageRecordIter(DataIter):
         self.rand_mirror = rand_mirror
         self.resize = resize
         self.scale = scale
+        self.max_rotate_angle = max_rotate_angle
+        self.rotate = rotate
+        if isinstance(rotate_list, str):
+            rotate_list = [v for v in rotate_list.split(",") if v.strip()]
+        self.rotate_list = [int(v) for v in rotate_list]
+        self.max_shear_ratio = max_shear_ratio
+        self.pad = pad
+        self.fill_value = fill_value
+        self.random_h = random_h
+        self.random_s = random_s
+        self.random_l = random_l
         self.mean = None
         if mean_img is not None and os.path.isfile(mean_img):
             from . import ndarray as nd
@@ -514,13 +531,18 @@ class ImageRecordIter(DataIter):
         from . import ndarray as nd
         from . import recordio as rio
 
-        rand_crop, rand_mirror = self.rand_crop, self.rand_mirror
-        scale = self.scale
-        # deterministic, unscaled pass (mean lives in raw-pixel units;
-        # _decode applies it before scale) over the FULL dataset — not
-        # just this worker's shard — so every worker agrees on the mean
+        saved = {k: getattr(self, k) for k in (
+            "rand_crop", "rand_mirror", "scale", "max_rotate_angle",
+            "rotate", "rotate_list", "max_shear_ratio", "random_h",
+            "random_s", "random_l")}
+        # deterministic, unscaled, unaugmented pass (mean lives in raw-pixel
+        # units; _decode applies it before scale) over the FULL dataset —
+        # not just this worker's shard — so every worker agrees on the mean
         self.rand_crop = self.rand_mirror = False
         self.scale = 1.0
+        self.max_rotate_angle = self.max_shear_ratio = 0
+        self.rotate, self.rotate_list = -1.0, []
+        self.random_h = self.random_s = self.random_l = 0
         try:
             acc = np.zeros(self.data_shape, dtype=np.float64)
             count = 0
@@ -534,8 +556,8 @@ class ImageRecordIter(DataIter):
                 count += 1
             reader.close()
         finally:
-            self.rand_crop, self.rand_mirror = rand_crop, rand_mirror
-            self.scale = scale
+            for k, v in saved.items():
+                setattr(self, k, v)
         logging.info("computed mean image from %d records -> %s",
                      count, path)
         mean = (acc / max(count, 1)).astype(np.float32)
@@ -563,6 +585,92 @@ class ImageRecordIter(DataIter):
         self.cursor += self.batch_size
         return self.cursor < self.num_data
 
+    def _affine_augment(self, img: np.ndarray) -> np.ndarray:
+        """Rotation + shear (reference affine path,
+        ``image_aug_default.cc:175-220``): forward matrix
+        [[a - s*b, b + s*a], [-b, a]] about the image center, constant
+        ``fill_value`` border. PIL wants the inverse (output->input) map."""
+        angle = 0.0
+        if self.max_rotate_angle > 0:
+            angle = float(self._rng.randint(-self.max_rotate_angle,
+                                            self.max_rotate_angle + 1))
+        if self.rotate > 0:
+            angle = float(self.rotate)
+        if self.rotate_list:
+            angle = float(self.rotate_list[
+                self._rng.randint(len(self.rotate_list))])
+        shear = 0.0
+        if self.max_shear_ratio > 0:
+            shear = (self._rng.rand() * 2 - 1) * self.max_shear_ratio
+        if angle == 0.0 and shear == 0.0:
+            return img
+        from PIL import Image
+        import math
+
+        h, w = img.shape[:2]
+        th = math.radians(angle)
+        a, b = math.cos(th), math.sin(th)
+        fwd = np.array([[a - shear * b, b + shear * a], [-b, a]])
+        inv = np.linalg.inv(fwd)
+        # PIL's AFFINE applies coefficients in the corner frame (pixel
+        # index + 0.5), so the image center there is exactly (w/2, h/2)
+        cx, cy = w / 2.0, h / 2.0
+        coeffs = (inv[0, 0], inv[0, 1], cx - inv[0, 0] * cx - inv[0, 1] * cy,
+                  inv[1, 0], inv[1, 1], cy - inv[1, 0] * cx - inv[1, 1] * cy)
+        color = img.shape[2] == 3
+        pim = Image.fromarray(np.clip(img, 0, 255).astype(np.uint8).squeeze())
+        fill = (self.fill_value,) * 3 if color else self.fill_value
+        pim = pim.transform((w, h), Image.AFFINE, coeffs,
+                            resample=Image.BILINEAR, fillcolor=fill)
+        out = np.asarray(pim).astype(np.float32)
+        return out if out.ndim == 3 else out[:, :, None]
+
+    def _hsl_augment(self, img: np.ndarray) -> np.ndarray:
+        """HSL color jitter (``image_aug_default.cc:269-300``): uniform
+        offsets in [-random_h, random_h] etc.; H clamps to [0, 180] and
+        S/L to [0, 255] exactly like the reference's limit[] table
+        (OpenCV HLS units)."""
+        if not (self.random_h or self.random_s or self.random_l) \
+                or img.shape[2] != 3:
+            return img
+        dh = (self._rng.rand() * 2 - 1) * self.random_h
+        ds = (self._rng.rand() * 2 - 1) * self.random_s
+        dl = (self._rng.rand() * 2 - 1) * self.random_l
+        eps = 1e-12
+        rgb = np.clip(img, 0, 255) / 255.0
+        r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+        maxc = np.maximum(np.maximum(r, g), b)
+        minc = np.minimum(np.minimum(r, g), b)
+        l = (maxc + minc) / 2.0
+        delta = maxc - minc
+        s = np.where(delta < eps, 0.0,
+                     np.where(l <= 0.5, delta / (maxc + minc + eps),
+                              delta / (2.0 - maxc - minc + eps)))
+        rc = (maxc - r) / (delta + eps)
+        gc = (maxc - g) / (delta + eps)
+        bc = (maxc - b) / (delta + eps)
+        hue = np.where(maxc == r, bc - gc,
+                       np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+        hue = np.where(delta < eps, 0.0, (hue / 6.0) % 1.0)
+        # jitter in OpenCV units, then back to [0, 1]
+        hue = np.clip(hue * 180.0 + dh, 0.0, 180.0) / 180.0
+        l = np.clip(l * 255.0 + dl, 0.0, 255.0) / 255.0
+        s = np.clip(s * 255.0 + ds, 0.0, 255.0) / 255.0
+        m2 = np.where(l <= 0.5, l * (1.0 + s), l + s - l * s)
+        m1 = 2.0 * l - m2
+
+        def channel(h12):
+            h12 = h12 % 1.0
+            return np.where(
+                h12 < 1 / 6, m1 + (m2 - m1) * h12 * 6.0,
+                np.where(h12 < 0.5, m2,
+                         np.where(h12 < 2 / 3,
+                                  m1 + (m2 - m1) * (2 / 3 - h12) * 6.0, m1)))
+
+        out = np.stack([channel(hue + 1 / 3), channel(hue),
+                        channel(hue - 1 / 3)], axis=-1)
+        return (out * 255.0).astype(np.float32)
+
     def _decode(self, rec: bytes) -> Tuple[np.ndarray, np.ndarray]:
         from . import recordio as rio
 
@@ -582,6 +690,10 @@ class ImageRecordIter(DataIter):
                 (nw, nh))).astype(np.float32)
             if img.ndim == 2:
                 img = img[:, :, None]
+        img = self._affine_augment(img)
+        if self.pad > 0:
+            img = np.pad(img, ((self.pad, self.pad), (self.pad, self.pad),
+                               (0, 0)), constant_values=float(self.fill_value))
         # crop to (h, w)
         ih, iw = img.shape[0], img.shape[1]
         if ih < h or iw < w:
@@ -600,6 +712,7 @@ class ImageRecordIter(DataIter):
         img = img[top:top + h, left:left + w]
         if self.rand_mirror and self._rng.rand() < 0.5:
             img = img[:, ::-1]
+        img = self._hsl_augment(img)
         img = img.transpose(2, 0, 1)  # HWC -> CHW
         if self.mean is not None:
             img = img - self.mean

@@ -56,6 +56,11 @@ def ctc_neg_log_likelihood(log_probs, labels, blank: int = 0):
     L = labels.shape[1]
     S = 2 * L + 1
     labels = labels.astype(jnp.int32)
+    # compact non-blank labels to the left (reference removeBlank,
+    # warpctc-inl.h:100-109, tolerates blanks anywhere in the row);
+    # stable argsort of the blank mask left-justifies the real labels
+    order = jnp.argsort(labels == blank, axis=1, stable=True)
+    labels = jnp.take_along_axis(labels, order, axis=1)
 
     # extended label sequence: blank-interleaved (b, l1, b, l2, ..., b)
     ext = jnp.full((B, S), blank, dtype=jnp.int32)
@@ -130,7 +135,13 @@ class WarpCTC(Operator):
             raise MXNetError("WarpCTC: rows %d not divisible by "
                              "input_length %d" % (data[0], self.input_length))
         minibatch = data[0] // self.input_length
-        label = (minibatch, self.label_length)
+        # reference InferShape assigns a FLAT label (label_length*minibatch,)
+        # (warpctc-inl.h:237-239); a user-supplied (minibatch, label_length)
+        # is accepted too — apply() reshapes either form
+        label = in_shapes[1]
+        if label is None or int(np.prod(label)) != \
+                minibatch * self.label_length:
+            label = (minibatch * self.label_length,)
         return [data, label], [data], []
 
     def infer_type(self, in_types, out_types=None):

@@ -1,0 +1,147 @@
+"""Augmentation parity tests: rotate / shear / pad / HSL color jitter
+(reference src/io/image_aug_default.cc:40-300)."""
+import colorsys
+
+import numpy as np
+import pytest
+
+pytest.importorskip("PIL")
+
+import mxnet_tpu.io as mio
+import mxnet_tpu.recordio as rio
+
+
+def _make_rec(tmp_path, imgs, fmt=".png"):
+    path = str(tmp_path / "aug.rec")
+    writer = rio.MXRecordIO(path, "w")
+    for i, img in enumerate(imgs):
+        writer.write(rio.pack_img(rio.IRHeader(0, float(i), i, 0), img,
+                                  quality=100, img_fmt=fmt))
+    writer.close()
+    return path
+
+
+def _iter(path, **kw):
+    kw.setdefault("data_shape", (3, 8, 8))
+    kw.setdefault("batch_size", 1)
+    return mio.ImageRecordIter(path_imgrec=path, **kw)
+
+
+def test_rotate_90_exact(tmp_path):
+    """Deterministic rotate=90 on a square image == np.rot90 in the
+    reference's convention (M = [[cos, sin], [-sin, cos]])."""
+    rng = np.random.RandomState(0)
+    img = (rng.rand(8, 8, 3) * 255).astype(np.uint8)
+    path = _make_rec(tmp_path, [img])
+    it = _iter(path, rotate=90)
+    out = next(iter(it)).data[0].asnumpy()[0]          # (3, 8, 8)
+    base = img.astype(np.float32).transpose(2, 0, 1)
+    # reference forward matrix [[a, b], [-b, a]] at 90 degrees maps
+    # (x, y) -> (y, -x): a counter-clockwise quarter turn (rot90 k=1);
+    # atol 1 for uint8 bilinear rounding
+    expected = np.rot90(base, k=1, axes=(1, 2))
+    assert np.abs(out - expected).max() <= 1.0
+
+
+def test_max_rotate_angle_changes_pixels(tmp_path):
+    rng = np.random.RandomState(1)
+    img = (rng.rand(8, 8, 3) * 255).astype(np.uint8)
+    path = _make_rec(tmp_path, [img])
+    plain = next(iter(_iter(path))).data[0].asnumpy()
+    rot = next(iter(_iter(path, max_rotate_angle=30, seed=3))).data[0].asnumpy()
+    assert np.abs(plain - rot).max() > 1.0
+
+
+def test_rotate_fill_value(tmp_path):
+    """Corners exposed by rotation are filled with fill_value."""
+    img = np.full((8, 8, 3), 200, dtype=np.uint8)
+    path = _make_rec(tmp_path, [img])
+    out = next(iter(_iter(path, rotate=45, fill_value=0))).data[0].asnumpy()[0]
+    assert out.min() < 1.0          # filled corners
+    assert out.max() > 150.0        # original content survives
+
+
+def test_shear_changes_pixels(tmp_path):
+    rng = np.random.RandomState(2)
+    img = (rng.rand(8, 8, 3) * 255).astype(np.uint8)
+    path = _make_rec(tmp_path, [img])
+    plain = next(iter(_iter(path))).data[0].asnumpy()
+    sheared = next(iter(_iter(path, max_shear_ratio=0.3, seed=7))).data[0] \
+        .asnumpy()
+    assert np.abs(plain - sheared).max() > 1.0
+
+
+def test_pad_then_crop(tmp_path):
+    """pad=2 then center-crop: border shows fill_value."""
+    img = np.full((8, 8, 3), 100, dtype=np.uint8)
+    path = _make_rec(tmp_path, [img])
+    out = next(iter(_iter(path, data_shape=(3, 12, 12), pad=2,
+                          fill_value=255))).data[0].asnumpy()[0]
+    assert abs(out[0, 0, 0] - 255.0) < 1e-4      # padded corner
+    assert abs(out[0, 6, 6] - 100.0) < 1e-4      # original center
+
+
+def test_hsl_lightness_direction(tmp_path):
+    """random_l with a forced positive draw brightens the image; the
+    magnitude matches the OpenCV unit convention (L in [0,255])."""
+    rng = np.random.RandomState(3)
+    img = (rng.rand(8, 8, 3) * 100 + 50).astype(np.uint8)
+    path = _make_rec(tmp_path, [img])
+    it = _iter(path, random_l=50)
+    it._rng = type("R", (), {
+        "rand": staticmethod(lambda *a: np.float64(1.0)),   # dl = +50
+        "randint": staticmethod(lambda *a, **k: 0),
+        "shuffle": staticmethod(lambda x: None)})()
+    out = next(iter(it)).data[0].asnumpy()[0]
+    base = img.astype(np.float32).transpose(2, 0, 1)
+    assert out.mean() > base.mean() + 20.0
+
+
+def test_hsl_zero_jitter_is_identity(tmp_path):
+    rng = np.random.RandomState(4)
+    img = (rng.rand(8, 8, 3) * 255).astype(np.uint8)
+    path = _make_rec(tmp_path, [img])
+    out = next(iter(_iter(path, random_h=0, random_s=0,
+                          random_l=0))).data[0].asnumpy()[0]
+    np.testing.assert_allclose(out, img.astype(np.float32).transpose(2, 0, 1),
+                               atol=1e-4)
+
+
+def test_hsl_roundtrip_matches_colorsys(tmp_path):
+    """The vectorized RGB<->HLS pair agrees with colorsys on random pixels
+    (jitter forced to zero offsets but conversion path exercised)."""
+    it = mio.ImageRecordIter.__new__(mio.ImageRecordIter)
+    it.random_h, it.random_s, it.random_l = 180, 0, 0
+    it._rng = type("R", (), {
+        "rand": staticmethod(lambda *a: np.float64(0.5))})()  # dh = 0
+    rng = np.random.RandomState(5)
+    img = (rng.rand(6, 6, 3) * 255).astype(np.float32)
+    out = it._hsl_augment(img)
+    np.testing.assert_allclose(out, img, atol=1.0)
+
+    # and a real hue shift agrees with colorsys applied pixelwise
+    it.random_h = 90
+    it._rng = type("R", (), {
+        "rand": staticmethod(lambda *a: np.float64(1.0))})()  # dh = +90
+    out = it._hsl_augment(img)
+    i, j = 2, 3
+    r, g, b = (img[i, j] / 255.0).tolist()
+    h, l, s = colorsys.rgb_to_hls(r, g, b)
+    h = min(h * 180.0 + 90.0, 180.0) / 180.0   # reference clamps H to 180
+    exp = np.array(colorsys.hls_to_rgb(h, l, s)) * 255.0
+    np.testing.assert_allclose(out[i, j], exp, atol=1.5)
+
+
+def test_mean_image_ignores_augmentation(tmp_path):
+    """The cached mean image must come from an unaugmented pass."""
+    rng = np.random.RandomState(6)
+    imgs = [(rng.rand(8, 8, 3) * 255).astype(np.uint8) for _ in range(4)]
+    path = _make_rec(tmp_path, imgs)
+    mean_path = str(tmp_path / "mean.bin")
+    it = _iter(path, mean_img=mean_path, max_rotate_angle=45,
+               random_l=50, max_shear_ratio=0.3)
+    expected = np.mean([im.astype(np.float32).transpose(2, 0, 1)
+                        for im in imgs], axis=0)
+    np.testing.assert_allclose(it.mean, expected, atol=1e-3)
+    # augmentation params restored after the mean pass
+    assert it.max_rotate_angle == 45 and it.random_l == 50

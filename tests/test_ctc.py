@@ -60,6 +60,39 @@ def test_ctc_repeated_labels():
     np.testing.assert_allclose(ours, ref_loss, rtol=1e-4, atol=1e-5)
 
 
+def test_ctc_mid_row_blanks():
+    """Blanks embedded mid-row are compacted out, like the reference's
+    removeBlank (warpctc-inl.h:100-109)."""
+    from mxnet_tpu.ops.ctc import ctc_neg_log_likelihood
+    import jax
+    rng = np.random.RandomState(7)
+    T, B, A = 10, 2, 5
+    x = rng.randn(T, B, A).astype(np.float32)
+    messy = np.array([[1, 0, 2, 0], [0, 3, 0, 4]], dtype=np.int32)
+    clean = np.array([[1, 2, 0, 0], [3, 4, 0, 0]], dtype=np.int32)
+    lp = jax.nn.log_softmax(x, axis=-1)
+    np.testing.assert_allclose(
+        np.asarray(ctc_neg_log_likelihood(lp, messy)),
+        np.asarray(ctc_neg_log_likelihood(lp, clean)), rtol=1e-6)
+    ref_loss, _ = _torch_ctc(x, clean)
+    np.testing.assert_allclose(np.asarray(ctc_neg_log_likelihood(lp, messy)),
+                               ref_loss, rtol=1e-4, atol=1e-5)
+
+
+def test_warpctc_flat_label_shape():
+    """Reference InferShape assigns a flat (label_length*minibatch,) label
+    (warpctc-inl.h:237-239)."""
+    T, B, A, L = 4, 3, 5, 2
+    s = sym.WarpCTC(data=sym.Variable("data"), label=sym.Variable("label"),
+                    input_length=T, label_length=L)
+    arg_shapes, out_shapes, _ = s.infer_shape(data=(T * B, A))
+    assert arg_shapes[1] == (B * L,)
+    assert out_shapes[0] == (T * B, A)
+    # a user-supplied 2D (B, L) label is also accepted
+    arg_shapes, _, _ = s.infer_shape(data=(T * B, A), label=(B, L))
+    assert arg_shapes[1] == (B, L)
+
+
 def test_warpctc_forward_backward():
     """Reference contract: output is softmax(data); backward writes the CTC
     gradient and ignores head grads (warpctc-inl.h:67-199)."""
