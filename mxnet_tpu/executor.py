@@ -133,6 +133,15 @@ class Executor:
                              else {n for n in symbol.list_arguments()
                                    if n.endswith("label")})
         self.arg_names = symbol.list_arguments()
+        if len(set(self.arg_names)) != len(self.arg_names):
+            # two distinct Variable nodes sharing a name: name-keyed
+            # binding would silently drop one (reference GraphExecutor
+            # rejects this with "Find duplicate argument name")
+            dups = sorted({n for n in self.arg_names
+                           if self.arg_names.count(n) > 1})
+            raise MXNetError(
+                "duplicate argument name(s) %s: reuse one Variable "
+                "instance instead of creating it twice" % dups)
         self.output_names = symbol.list_outputs()
         self.aux_names = symbol.list_auxiliary_states()
 

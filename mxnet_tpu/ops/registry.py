@@ -166,6 +166,11 @@ def register_op(name: str, aliases: Sequence[str] = ()):
         cls.op_aliases = tuple(aliases)
         OP_REGISTRY.register(name)(cls)
         for alias in aliases:
+            # the registry keys case-insensitively, so an alias that only
+            # differs in case (e.g. "crop" for "Crop") already resolves —
+            # it still matters for namespace exposure via op_aliases
+            if OP_REGISTRY.find(alias) is cls:
+                continue
             OP_REGISTRY.register(alias)(cls)
         return cls
     return _do

@@ -59,6 +59,49 @@ _def_binary("_Maximum", "maximum", lambda a, b: _jnp().maximum(a, b))
 _def_binary("_Minimum", "minimum", lambda a, b: _jnp().minimum(a, b))
 
 
+class _BroadcastBinaryOp(Operator):
+    """reference elementwise_binary_broadcast_op-inl.h: same ndim, each dim
+    equal or 1; gradients reduce over the broadcast dims (autodiff's vjp of
+    jnp broadcasting does exactly that)."""
+
+    fn = None
+
+    def list_arguments(self):
+        return ["lhs", "rhs"]
+
+    def infer_shape(self, in_shapes):
+        lhs, rhs = in_shapes
+        if lhs is None or rhs is None:
+            raise MXNetError("broadcast op: both input shapes required")
+        if len(lhs) != len(rhs):
+            raise MXNetError("broadcast op: ndim mismatch %s vs %s"
+                             % (lhs, rhs))
+        out = []
+        for a, b in zip(lhs, rhs):
+            if a != b and a != 1 and b != 1:
+                raise MXNetError("broadcast op: incompatible dims %s vs %s"
+                                 % (lhs, rhs))
+            out.append(max(a, b))
+        return [lhs, rhs], [tuple(out)], []
+
+    def apply(self, ctx, inputs, aux):
+        return [type(self).fn(inputs[0], inputs[1])], []
+
+
+def _def_broadcast(name, hint, fn):
+    cls = type(name, (_BroadcastBinaryOp,), {"fn": staticmethod(fn),
+                                             "name_hint": hint})
+    register_op(name)(cls)
+    return cls
+
+
+_def_broadcast("broadcast_plus", "broadcast_plus", lambda a, b: a + b)
+_def_broadcast("broadcast_minus", "broadcast_minus", lambda a, b: a - b)
+_def_broadcast("broadcast_mul", "broadcast_mul", lambda a, b: a * b)
+_def_broadcast("broadcast_div", "broadcast_div", lambda a, b: a / b)
+_def_broadcast("broadcast_power", "broadcast_power", lambda a, b: a ** b)
+
+
 class _ScalarOp(Operator):
     PARAMS = {"scalar": Param(float, REQUIRED)}
     fn = None
@@ -161,19 +204,38 @@ class Reshape(Operator):
     PARAMS = {
         "shape": Param("shape", None),
         "target_shape": Param("shape", None),
+        "reverse": Param(bool, False, "match 0-dims from the right"),
     }
 
     def _target(self, data):
-        shape = self.params["shape"] or self.target_shape
+        shape = self.params["shape"]
+        if shape is None and self.target_shape is not None:
+            # old API (reference reshape-inl.h target_shape): 0 means
+            # "infer this dim", unlike the new API where 0 means "keep"
+            shape = tuple(-1 if s == 0 else s for s in self.target_shape)
         if shape is None:
             raise MXNetError("Reshape: no target shape")
+        if self.reverse:
+            # reference reshape-inl.h reverse=True: apply the 0/-1 rules
+            # with both shapes right-aligned
+            data_r, shape_r = tuple(reversed(data)), tuple(reversed(shape))
+            out = self._expand(data_r, shape_r)
+            return tuple(reversed(out))
+        return tuple(self._expand(data, shape))
+
+    @staticmethod
+    def _expand(data, shape):
         out = []
         for i, s in enumerate(shape):
             out.append(data[i] if s == 0 and i < len(data) else s)
+        if out.count(-1) > 1:
+            raise MXNetError("Reshape: at most one dim may be inferred "
+                             "(-1, or 0 in the old target_shape API): %s"
+                             % (tuple(shape),))
         if -1 in out:
             known = int(np.prod([s for s in out if s != -1]))
             out[out.index(-1)] = int(np.prod(data)) // max(known, 1)
-        return tuple(out)
+        return out
 
     def infer_shape(self, in_shapes):
         data = in_shapes[0]
@@ -338,7 +400,7 @@ class ElementWiseSum(Operator):
         return [out], []
 
 
-@register_op("Crop")
+@register_op("Crop", aliases=("crop",))
 class Crop(Operator):
     """reference crop-inl.h: crop spatial dims to match a reference symbol
     or explicit h_w, with offset."""
@@ -349,6 +411,10 @@ class Crop(Operator):
         "offset": Param("shape", (0, 0)),
         "h_w": Param("shape", (0, 0)),
         "center_crop": Param(bool, False),
+        # matrix-crop form (reference crop() in matrix_op-inl.h, exposed
+        # as mx.nd.crop(x, begin=..., end=...)): any-rank begin/end slice
+        "begin": Param("shape", None),
+        "end": Param("shape", None),
     }
 
     def list_arguments(self):
@@ -358,6 +424,18 @@ class Crop(Operator):
         data = in_shapes[0]
         if data is None:
             raise MXNetError("Crop: data shape unknown")
+        if self.begin is not None:
+            if self.end is None or len(self.begin) != len(data) \
+                    or len(self.end) != len(data):
+                raise MXNetError("Crop: begin/end must both cover all %d "
+                                 "axes" % len(data))
+            for b, e, d in zip(self.begin, self.end, data):
+                if not (0 <= b < e <= d):
+                    raise MXNetError(
+                        "Crop: invalid range [%d, %d) on axis of size %d"
+                        % (b, e, d))
+            out = tuple(e - b for b, e in zip(self.begin, self.end))
+            return [data], [out], []
         if self.num_args == 2:
             like = in_shapes[1]
             if like is None:
@@ -369,6 +447,9 @@ class Crop(Operator):
 
     def apply(self, ctx, inputs, aux):
         x = inputs[0]
+        if self.begin is not None:
+            idx = tuple(slice(b, e) for b, e in zip(self.begin, self.end))
+            return [x[idx]], []
         if self.num_args == 2:
             h, w = inputs[1].shape[2:4]
         else:
@@ -379,6 +460,70 @@ class Crop(Operator):
         else:
             oh, ow = self.offset
         return [x[:, :, oh:oh + h, ow:ow + w]], []
+
+
+@register_op("slice_axis")
+class SliceAxis(Operator):
+    """reference slice_axis (matrix_op-inl.h): take [begin, end) along one
+    axis; backward scatters the gradient into zeros (autodiff here)."""
+
+    name_hint = "slice_axis"
+    PARAMS = {
+        "axis": Param(int, REQUIRED),
+        "begin": Param(int, REQUIRED),
+        "end": Param(int, REQUIRED),
+    }
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("slice_axis: data shape unknown")
+        if not (-len(data) <= self.axis < len(data)):
+            raise MXNetError("slice_axis: axis %d out of range for %d-d "
+                             "input" % (self.axis, len(data)))
+        ax = self.axis % len(data)
+        if not (0 <= self.begin < self.end <= data[ax]):
+            raise MXNetError("slice_axis: invalid [%d, %d) on axis %d of %s"
+                             % (self.begin, self.end, ax, (data,)))
+        out = tuple(self.end - self.begin if i == ax else d
+                    for i, d in enumerate(data))
+        return [data], [out], []
+
+    def apply(self, ctx, inputs, aux):
+        x = inputs[0]
+        if not (-x.ndim <= self.axis < x.ndim):
+            raise MXNetError("slice_axis: axis %d out of range for %d-d "
+                             "input" % (self.axis, x.ndim))
+        ax = self.axis % x.ndim
+        idx = tuple(slice(self.begin, self.end) if i == ax else slice(None)
+                    for i in range(x.ndim))
+        return [x[idx]], []
+
+
+@register_op("Flip", aliases=("flip",))
+class Flip(Operator):
+    """reference flip (matrix_op-inl.h): reverse one axis."""
+
+    name_hint = "flip"
+    PARAMS = {"axis": Param(int, REQUIRED)}
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("flip: data shape unknown")
+        if not (-len(data) <= self.axis < len(data)):
+            raise MXNetError("flip: axis %d out of range for %d-d input"
+                             % (self.axis, len(data)))
+        return [data], [data], []
+
+    def apply(self, ctx, inputs, aux):
+        x = inputs[0]
+        if not (-x.ndim <= self.axis < x.ndim):
+            raise MXNetError("flip: axis %d out of range for %d-d input"
+                             % (self.axis, x.ndim))
+        idx = tuple(slice(None, None, -1) if i == self.axis % x.ndim
+                    else slice(None) for i in range(x.ndim))
+        return [x[idx]], []
 
 
 # ---------------------------------------------------------------------------

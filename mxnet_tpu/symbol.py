@@ -621,6 +621,44 @@ def _make_creator(op_name: str):
     return creator
 
 
+def _make_minmax(fname, op, scalar_op, number_fn):
+    """mx.symbol.maximum/minimum (reference python/mxnet/symbol.py):
+    symbol x symbol, symbol x scalar (either order), or two plain numbers
+    (returns the number, like the reference)."""
+
+    def fn(lhs, rhs):
+        if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+            return _binary_create(op, scalar_op, lhs, rhs)
+        if isinstance(lhs, Symbol):
+            return _scalar_create(scalar_op, lhs, rhs)
+        if isinstance(rhs, Symbol):
+            return _scalar_create(scalar_op, rhs, lhs)
+        return number_fn(lhs, rhs)
+
+    fn.__name__ = fname
+    fn.__doc__ = _make_minmax.__doc__
+    return fn
+
+
+maximum = _make_minmax("maximum", "_Maximum", "_MaximumScalar",
+                       lambda a, b: a if a > b else b)
+minimum = _make_minmax("minimum", "_Minimum", "_MinimumScalar",
+                       lambda a, b: a if a < b else b)
+
+
+def pow(lhs, rhs):
+    """lhs ** rhs for symbol/scalar mixes; two numbers give the plain
+    power (reference mx.symbol.pow)."""
+    if isinstance(lhs, Symbol):
+        return lhs ** rhs
+    if isinstance(rhs, Symbol):
+        return rhs.__rpow__(lhs)
+    return lhs ** rhs
+
+
+__all__ += ["maximum", "minimum", "pow"]
+
+
 def _init_symbol_module():
     done = set()
     for lname, cls in list(OP_REGISTRY.items()):

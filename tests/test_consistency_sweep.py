@@ -1,0 +1,142 @@
+"""Per-op fp16-vs-fp32 consistency sweep.
+
+The reference's GPU tier (tests/python/gpu/test_operator_gpu.py:16-50)
+re-ran the operator suite through check_consistency over ctx x dtype
+configs. Here the sweep axis is dtype: every symbol below binds once in
+fp32 and once with fp16 inputs (type_dict), comparing outputs and
+gradients under per-dtype tolerance."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_consistency
+
+V = mx.sym.Variable
+
+
+def _two(**shapes):
+    return [
+        {"ctx": mx.cpu(), **shapes},
+        {"ctx": mx.cpu(), **shapes, "type_dict": {"data": np.float16}},
+    ]
+
+
+# (name, symbol builder, shapes dict, grad_req)
+SWEEP = [
+    ("fullyconnected",
+     lambda: mx.sym.FullyConnected(data=V("data"), num_hidden=8, name="fc"),
+     {"data": (4, 6)}, "write"),
+    ("convolution",
+     lambda: mx.sym.Convolution(data=V("data"), kernel=(3, 3), num_filter=4,
+                                pad=(1, 1), name="conv"),
+     {"data": (2, 3, 8, 8)}, "write"),
+    ("convolution_grouped",
+     lambda: mx.sym.Convolution(data=V("data"), kernel=(3, 3), num_filter=4,
+                                num_group=2, name="conv"),
+     {"data": (2, 4, 7, 7)}, "write"),
+    ("convolution_1x1_stride2",
+     lambda: mx.sym.Convolution(data=V("data"), kernel=(1, 1), num_filter=8,
+                                stride=(2, 2), name="conv"),
+     {"data": (2, 4, 8, 8)}, "write"),
+    ("deconvolution",
+     lambda: mx.sym.Deconvolution(data=V("data"), kernel=(3, 3),
+                                  num_filter=4, name="dc"),
+     {"data": (2, 3, 5, 5)}, "write"),
+    ("pooling_max",
+     lambda: mx.sym.Pooling(data=V("data"), kernel=(2, 2), stride=(2, 2),
+                            pool_type="max"),
+     {"data": (2, 3, 8, 8)}, "write"),
+    ("pooling_avg",
+     lambda: mx.sym.Pooling(data=V("data"), kernel=(3, 3), stride=(2, 2),
+                            pool_type="avg"),
+     {"data": (2, 3, 9, 9)}, "write"),
+    ("pooling_global",
+     lambda: mx.sym.Pooling(data=V("data"), kernel=(1, 1),
+                            global_pool=True, pool_type="max"),
+     {"data": (2, 3, 6, 6)}, "write"),
+    ("batchnorm",
+     lambda: mx.sym.BatchNorm(data=V("data"), fix_gamma=False, name="bn"),
+     {"data": (4, 3, 6, 6)}, "write"),
+    ("activation_relu",
+     lambda: mx.sym.Activation(data=V("data"), act_type="relu"),
+     {"data": (4, 8)}, "write"),
+    ("activation_tanh",
+     lambda: mx.sym.Activation(data=V("data"), act_type="tanh"),
+     {"data": (4, 8)}, "write"),
+    ("leakyrelu",
+     lambda: mx.sym.LeakyReLU(data=V("data"), act_type="leaky", slope=0.1),
+     {"data": (4, 8)}, "write"),
+    ("softmax_activation",
+     lambda: mx.sym.SoftmaxActivation(data=V("data")),
+     {"data": (4, 10)}, "write"),
+    ("lrn",
+     lambda: mx.sym.LRN(data=V("data"), nsize=3),
+     {"data": (2, 4, 5, 5)}, "write"),
+    ("dropout_eval",
+     lambda: mx.sym.Dropout(data=V("data"), p=0.5),
+     {"data": (4, 8)}, "null"),
+    ("flatten_reshape",
+     lambda: mx.sym.Reshape(mx.sym.Flatten(data=V("data")), shape=(0, 4, -1)),
+     {"data": (2, 4, 3, 2)}, "write"),
+    ("transpose",
+     lambda: mx.sym.transpose(V("data"), axes=(0, 2, 1)),
+     {"data": (2, 3, 4)}, "write"),
+    ("swapaxis",
+     lambda: mx.sym.SwapAxis(data=V("data"), dim1=1, dim2=2),
+     {"data": (2, 3, 4)}, "write"),
+    ("slice_axis",
+     lambda: mx.sym.slice_axis(V("data"), axis=1, begin=1, end=3),
+     {"data": (2, 4, 3)}, "write"),
+    ("flip",
+     lambda: mx.sym.Flip(data=V("data"), axis=1),
+     {"data": (2, 4, 3)}, "write"),
+    ("sum_axis",
+     lambda: mx.sym.sum(V("data"), axis=1),
+     {"data": (3, 4, 5)}, "write"),
+    ("max_axis",
+     lambda: mx.sym.max(V("data"), axis=2),
+     {"data": (3, 4, 5)}, "write"),
+    ("broadcast_axis",
+     lambda: mx.sym.broadcast_axis(V("data"), axis=1, size=4),
+     {"data": (3, 1, 5)}, "write"),
+    ("elemwise_chain",
+     lambda: (V("data") * 2 + 1) / 3 - 0.5,
+     {"data": (4, 5)}, "write"),
+    ("unary_chain",
+     lambda: mx.sym.exp(mx.sym.abs(V("data")) * 0.1),
+     {"data": (4, 5)}, "write"),
+    ("sqrt_square",
+     lambda: mx.sym.sqrt(mx.sym.square(V("data")) + 1.0),
+     {"data": (4, 5)}, "write"),
+    ("embedding",
+     lambda: mx.sym.Embedding(data=V("data"), input_dim=10, output_dim=4,
+                              name="emb"),
+     {"data": (6,)}, "null"),
+    ("upsampling_nearest",
+     lambda: mx.sym.UpSampling(V("data"), scale=2, sample_type="nearest",
+                               num_args=1),
+     {"data": (1, 2, 4, 4)}, "write"),
+    ("crop_spatial",
+     lambda: mx.sym.Crop(V("data"), num_args=1, h_w=(4, 4), offset=(1, 1)),
+     {"data": (1, 2, 6, 6)}, "write"),
+    ("smooth_l1",
+     lambda: mx.sym.smooth_l1(V("data"), scalar=1.0),
+     {"data": (4, 5)}, "write"),
+    ("l2normalization",
+     lambda: mx.sym.L2Normalization(data=V("data")),
+     {"data": (4, 6)}, "write"),
+    ("fc_relu_fc_stack",
+     lambda: mx.sym.FullyConnected(
+         data=mx.sym.Activation(
+             data=mx.sym.FullyConnected(data=V("data"), num_hidden=8,
+                                        name="fc1"),
+             act_type="relu"),
+         num_hidden=3, name="fc2"),
+     {"data": (4, 6)}, "write"),
+]
+
+
+@pytest.mark.parametrize("name,build,shapes,grad_req",
+                         SWEEP, ids=[c[0] for c in SWEEP])
+def test_fp16_fp32_consistency(name, build, shapes, grad_req):
+    check_consistency(build(), _two(**shapes), grad_req=grad_req)

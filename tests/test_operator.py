@@ -204,7 +204,10 @@ def test_reshape_flatten_transpose():
     r = sym.Reshape(data=data, shape=(2, 12))
     _, outs = _bind_forward(r, {"data": x})
     assert outs[0].shape == (2, 12)
-    r2 = sym.Reshape(data=data, target_shape=(0, -1))
+    # old API: target_shape 0 means "infer this dim" (reference
+    # reshape-inl.h, exercised as target_shape=(2,0) -> (2,75) in the
+    # reference's test_reshape)
+    r2 = sym.Reshape(data=data, target_shape=(2, 0))
     _, outs = _bind_forward(r2, {"data": x})
     assert outs[0].shape == (2, 12)
     f = sym.Flatten(data=data)
