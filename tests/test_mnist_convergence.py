@@ -1,0 +1,49 @@
+"""Real-data convergence gate (reference nightly CI:
+tests/nightly/test_all.sh:56-62 trains train_mnist.py --network lenet and
+requires accuracy >= 0.99).
+
+Zero-egress stand-in: tools/make_mnist_synth.py renders an MNIST-format
+idx dataset to disk; the example script consumes it through the same
+MNISTIter real-data path as the actual download."""
+import importlib.util
+import os
+import sys
+
+import pytest
+
+import mxnet_tpu  # noqa: F401  (ensures package import order)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_train_mnist():
+    spec = importlib.util.spec_from_file_location(
+        "train_mnist", os.path.join(
+            REPO, "examples", "image_classification", "train_mnist.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.nightly
+def test_lenet_mnist_gate(tmp_path):
+    pytest.importorskip("PIL")
+    sys.path.insert(0, REPO)
+    from tools.make_mnist_synth import generate
+
+    data_dir = str(tmp_path / "mnist")
+    generate(data_dir, n_train=8000, n_test=1000, seed=0)
+    # files exist in the reference's exact layout
+    for name in ("train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+                 "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"):
+        assert os.path.exists(os.path.join(data_dir, name))
+
+    argv = sys.argv
+    sys.argv = ["train_mnist.py", "--network", "lenet",
+                "--data-dir", data_dir, "--num-epochs", "8",
+                "--lr", "0.05"]
+    try:
+        acc = _load_train_mnist().main()
+    finally:
+        sys.argv = argv
+    assert acc >= 0.99, "LeNet MNIST gate: %.4f < 0.99" % acc

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Generate an MNIST-format dataset on disk (idx files) from rendered
+digit glyphs with random shift/rotation/scale/noise.
+
+Stands in for the real MNIST download of the reference's nightly gate
+(/root/reference/tests/nightly/test_all.sh:56-62 trains LeNet to >=0.99)
+in zero-egress environments: the files are byte-compatible idx
+(train-images-idx3-ubyte etc.), so MNISTIter and train_mnist.py consume
+them exactly like the real dataset.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import struct
+
+import numpy as np
+
+
+def render_digit(digit: int, rng: np.random.RandomState) -> np.ndarray:
+    """One 28x28 uint8 glyph: PIL text, random affine jitter + noise."""
+    from PIL import Image, ImageDraw, ImageFont
+
+    canvas = Image.new("L", (28, 28), 0)
+    glyph = Image.new("L", (16, 16), 0)
+    draw = ImageDraw.Draw(glyph)
+    font = ImageFont.load_default()
+    draw.text((4, 2), str(digit), fill=255, font=font)
+    glyph = glyph.crop(glyph.getbbox())          # tight box around strokes
+    size = rng.randint(14, 21)                   # target glyph height
+    w = max(6, int(glyph.width * size / glyph.height))
+    glyph = glyph.resize((w, size), Image.BILINEAR)
+    glyph = glyph.rotate(rng.uniform(-20, 20), resample=Image.BILINEAR,
+                         expand=True)
+    ox = (28 - glyph.width) // 2 + rng.randint(-3, 4)
+    oy = (28 - glyph.height) // 2 + rng.randint(-3, 4)
+    canvas.paste(glyph, (max(0, min(ox, 27 - glyph.width)),
+                         max(0, min(oy, 27 - glyph.height))))
+    img = np.asarray(canvas, dtype=np.float32)
+    img += rng.randn(28, 28) * 12.0
+    return np.clip(img, 0, 255).astype(np.uint8)
+
+
+def write_idx_images(path: str, images: np.ndarray) -> None:
+    n, h, w = images.shape
+    with open(path, "wb") as f:
+        f.write(struct.pack(">IIII", 0x803, n, h, w))
+        f.write(images.tobytes())
+
+
+def write_idx_labels(path: str, labels: np.ndarray) -> None:
+    with open(path, "wb") as f:
+        f.write(struct.pack(">II", 0x801, len(labels)))
+        f.write(labels.astype(np.uint8).tobytes())
+
+
+def generate(out_dir: str, n_train: int = 8000, n_test: int = 1000,
+             seed: int = 0) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    rng = np.random.RandomState(seed)
+    for split, n, img_name, lbl_name in [
+            ("train", n_train, "train-images-idx3-ubyte",
+             "train-labels-idx1-ubyte"),
+            ("test", n_test, "t10k-images-idx3-ubyte",
+             "t10k-labels-idx1-ubyte")]:
+        labels = rng.randint(0, 10, n)
+        images = np.stack([render_digit(int(d), rng) for d in labels])
+        write_idx_images(os.path.join(out_dir, img_name), images)
+        write_idx_labels(os.path.join(out_dir, lbl_name), labels)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--out-dir", default="mnist/")
+    p.add_argument("--n-train", type=int, default=8000)
+    p.add_argument("--n-test", type=int, default=1000)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    generate(args.out_dir, args.n_train, args.n_test, args.seed)
+    print("wrote %d train / %d test to %s"
+          % (args.n_train, args.n_test, args.out_dir))
+
+
+if __name__ == "__main__":
+    main()
