@@ -2,17 +2,14 @@
 tests/nightly/dist_sync_kvstore.py via launch.py local launcher): fork 2
 worker processes on this machine, assert exact arithmetic of synced
 push/pull."""
-import os
-import subprocess
-import sys
+import numpy as np
 
-import pytest
-
-REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+from dist_util import (REPO, TRAIN_PREAMBLE, fill, launch,
+                       maybe_skip_unavailable)
 
 WORKER = r"""
 import os, sys
-sys.path.insert(0, %r)
+sys.path.insert(0, %(repo)r)
 import jax
 jax.config.update("jax_platforms", "cpu")
 import numpy as np
@@ -41,55 +38,19 @@ kv.pull(11, out=(big := mx.nd.zeros((64, 64))))
 np.testing.assert_allclose(big.asnumpy(), np.full((64, 64), 1.5))
 
 kv.barrier()
-open(os.path.join(%r, "ok_%%d" %% rank), "w").write("pass")
+open(os.path.join(%(tmp)r, "ok_%d" % rank), "w").write("pass")
 """
 
 
 def test_dist_sync_kvstore_two_processes(tmp_path):
-    script = tmp_path / "worker.py"
-    script.write_text(WORKER % (REPO, str(tmp_path)))
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    out = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-         "-n", "2", "--coordinator", "127.0.0.1:13333",
-         sys.executable, str(script)],
-        capture_output=True, text=True, env=env, timeout=150)
-    if out.returncode != 0 and "distributed" in (out.stderr or "").lower():
-        pytest.skip("jax.distributed unavailable on this platform: %s"
-                    % out.stderr[-200:])
+    out = launch(tmp_path, fill(WORKER, tmp_path), 13333, timeout=150)
+    maybe_skip_unavailable(out, (tmp_path / "ok_0").exists())
     assert out.returncode == 0, (out.stdout[-800:], out.stderr[-800:])
     for r in range(2):
         assert (tmp_path / ("ok_%d" % r)).read_text() == "pass"
 
 
-TRAIN_WORKER = r"""
-import os, sys
-sys.path.insert(0, %r)
-import jax
-jax.config.update("jax_platforms", "cpu")
-import numpy as np
-import mxnet_tpu as mx
-
-kv = mx.kv.create("dist_sync")
-rank, nw = kv.rank, kv.num_workers
-
-# synthetic separable task, sharded by rank (reference dist_lenet.py:
-# ImageRecordIter(num_parts=kv.num_workers, part_index=kv.rank))
-rng = np.random.RandomState(0)
-n = 256
-y = rng.randint(0, 2, n).astype(np.float32)
-X = (rng.randn(n, 8).astype(np.float32) * 0.5 + y[:, None])
-Xs, ys = X[rank::nw], y[rank::nw]
-
-data = mx.sym.Variable("data")
-net = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
-net = mx.sym.Activation(data=net, act_type="relu")
-net = mx.sym.FullyConnected(data=net, num_hidden=2, name="fc2")
-net = mx.sym.SoftmaxOutput(data=net, name="softmax")
-
-it = mx.io.NDArrayIter(Xs, ys, batch_size=16, shuffle=False,
-                       label_name="softmax_label")
+TRAIN_WORKER = TRAIN_PREAMBLE + r"""
 mod = mx.mod.Module(net)
 mod.fit(it, num_epoch=6, kvstore=kv,
         optimizer_params={"learning_rate": 0.2})
@@ -101,9 +62,9 @@ assert score["accuracy"] > 0.9, score
 # synced training must leave every worker with identical weights
 args, _ = mod.get_params()
 w = args["fc1_weight"].asnumpy()
-np.save(os.path.join(%r, "w_%%d.npy" %% rank), w)
+np.save(os.path.join(TMP, "w_%d.npy" % rank), w)
 kv.barrier()
-open(os.path.join(%r, "trained_%%d" %% rank), "w").write("pass")
+open(os.path.join(TMP, "trained_%d" % rank), "w").write("pass")
 """
 
 
@@ -111,19 +72,9 @@ def test_dist_sync_training_two_processes(tmp_path):
     """reference tests/nightly/dist_lenet.py: train under dist_sync with
     rank-sharded data; gate on accuracy and cross-worker weight equality
     (multi_lenet.py's near-identical-weights check)."""
-    script = tmp_path / "train_worker.py"
-    script.write_text(TRAIN_WORKER % (REPO, str(tmp_path), str(tmp_path)))
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    out = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
-         "-n", "2", "--coordinator", "127.0.0.1:13341",
-         sys.executable, str(script)],
-        capture_output=True, text=True, env=env, timeout=300)
-    if out.returncode != 0 and "distributed" in (out.stderr or "").lower():
-        pytest.skip("jax.distributed unavailable: %s" % out.stderr[-200:])
+    out = launch(tmp_path, fill(TRAIN_WORKER, tmp_path), 13341)
+    maybe_skip_unavailable(out, (tmp_path / "trained_0").exists())
     assert out.returncode == 0, (out.stdout[-800:], out.stderr[-800:])
-    import numpy as np
     w0 = np.load(tmp_path / "w_0.npy")
     w1 = np.load(tmp_path / "w_1.npy")
     np.testing.assert_allclose(w0, w1, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,79 @@
+"""Elastic recovery: kill a worker mid-training, restart the job from the
+last checkpoint, converge (the reference's recovery story: ps-lite dead-node
+tracking kvstore_dist.h:35,73 + checkpoint/resume; here the launcher's
+failure detection kills the wedged survivors and a supervisor relaunches)."""
+import os
+
+import numpy as np
+import pytest
+
+from dist_util import TRAIN_PREAMBLE, fill, launch, maybe_skip_unavailable
+
+WORKER = TRAIN_PREAMBLE + r"""
+DIE_AT_EPOCH = int(os.environ.get("DIE_AT_EPOCH", "-1"))
+LOAD_EPOCH = int(os.environ.get("LOAD_EPOCH", "-1"))
+NUM_EPOCH = 6
+prefix = os.path.join(TMP, "ck")
+
+arg_params = aux_params = None
+begin_epoch = 0
+if LOAD_EPOCH >= 0:
+    _, arg_params, aux_params = mx.model.load_checkpoint(prefix, LOAD_EPOCH)
+    begin_epoch = LOAD_EPOCH
+
+ckpt = mx.callback.do_checkpoint(prefix) if rank == 0 else None
+
+def epoch_cb(epoch, symbol, arg, aux):
+    if ckpt is not None:
+        ckpt(epoch, symbol, arg, aux)
+    if DIE_AT_EPOCH >= 0 and epoch + 1 == DIE_AT_EPOCH and rank == 1:
+        # simulate a hard node failure: no cleanup, no exit barrier
+        os.kill(os.getpid(), signal.SIGKILL)
+
+mod = mx.mod.Module(net)
+mod.fit(it, num_epoch=NUM_EPOCH, kvstore=kv, begin_epoch=begin_epoch,
+        arg_params=arg_params, aux_params=aux_params,
+        allow_missing=arg_params is not None,
+        optimizer_params={"learning_rate": 0.2},
+        epoch_end_callback=epoch_cb)
+
+score = dict(mod.score(mx.io.NDArrayIter(Xs, ys, batch_size=16,
+                                         label_name="softmax_label"),
+                       "acc"))
+assert score["accuracy"] > 0.9, score
+args_out, _ = mod.get_params()
+np.save(os.path.join(TMP, "w_%d.npy" % rank),
+        args_out["fc1_weight"].asnumpy())
+kv.barrier()
+open(os.path.join(TMP, "done_%d" % rank), "w").write("pass")
+"""
+
+
+@pytest.mark.nightly
+def test_worker_death_then_checkpoint_restart(tmp_path):
+    # phase 1: rank 1 dies (SIGKILL) after epoch 2's checkpoint; the
+    # launcher's failure detection must kill the survivor and fail the job
+    out = launch(tmp_path, fill(WORKER, tmp_path), 13351,
+                 {"DIE_AT_EPOCH": "2"})
+    progressed = (tmp_path / "ck-0001.params").exists()
+    maybe_skip_unavailable(out, progressed)
+    assert out.returncode != 0, "job must fail when a worker dies"
+    assert "terminating" in out.stderr, out.stderr[-500:]
+    assert not (tmp_path / "done_0").exists()
+    # checkpoints for completed epochs survive the crash
+    assert (tmp_path / "ck-0002.params").exists(), os.listdir(tmp_path)
+    assert (tmp_path / "ck-symbol.json").exists()
+
+    # phase 2: supervisor restarts the job from the last checkpoint
+    out = launch(tmp_path, fill(WORKER, tmp_path), 13352,
+                 {"LOAD_EPOCH": "2"})
+    assert out.returncode == 0, (out.stdout[-800:], out.stderr[-800:])
+    for r in range(2):
+        assert (tmp_path / ("done_%d" % r)).read_text() == "pass"
+    # both workers end with identical converged weights
+    w0 = np.load(tmp_path / "w_0.npy")
+    w1 = np.load(tmp_path / "w_1.npy")
+    np.testing.assert_allclose(w0, w1, rtol=1e-5, atol=1e-6)
+    # and the resumed run kept training from the checkpoint, not scratch:
+    # final epoch checkpoints exist beyond the crash point
+    assert (tmp_path / "ck-0006.params").exists()

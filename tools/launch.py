@@ -54,9 +54,35 @@ def main():
                 p.terminate()
         signal.signal(signal.SIGINT, _kill)
         signal.signal(signal.SIGTERM, _kill)
+        # failure detection (reference dmlc_tracker behavior): if any
+        # worker dies abnormally, the survivors would hang in their next
+        # collective — kill the job and report the failure so a
+        # supervisor can restart from the last checkpoint
+        import time
         rc = 0
-        for p in procs:
-            rc = p.wait() or rc
+        pending = list(procs)
+        while pending:
+            time.sleep(0.2)
+            for p in list(pending):
+                prc = p.poll()
+                if prc is None:
+                    continue
+                pending.remove(p)
+                if prc != 0:
+                    rc = prc
+                    sys.stderr.write(
+                        "launch.py: worker pid %d exited with %d; "
+                        "terminating %d remaining worker(s)\n"
+                        % (p.pid, prc, len(pending)))
+                    for q in pending:
+                        q.terminate()
+                    for q in pending:
+                        try:
+                            q.wait(timeout=10)
+                        except subprocess.TimeoutExpired:
+                            q.kill()
+                    pending = []
+                    break
         sys.exit(rc)
     else:
         if not args.hostfile:
