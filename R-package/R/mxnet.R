@@ -1,0 +1,279 @@
+# R frontend over the TPU runtime's C ABI.
+#
+# Parity target: the reference R-package's R/ layer (R-package/R/
+# ndarray.R, symbol.R, executor.R, model.R) with the same user-facing
+# naming (mx.nd.array, mx.symbol.*, mx.simple.bind, mx.model.*). The
+# implementation is a fresh design over .Call stubs in src/mxnet_glue.c;
+# operators are generated at load time from the registry enumeration
+# (MXSymbolListAtomicSymbolCreators), exactly how the reference built
+# mx.symbol.Convolution and friends from its C registry.
+#
+# Array convention: R stores column-major; the runtime is row-major
+# (C order). Like the reference R-package, mx.nd.array() transposes on
+# the way in and as.array() transposes back, so R users see R-natural
+# indexing while buffers cross the ABI in C order.
+
+# ---- context ---------------------------------------------------------------
+
+mx.cpu <- function(dev.id = 0L) structure(
+  list(device = "cpu", device_typeid = 1L, device_id = as.integer(dev.id)),
+  class = "MXContext")
+
+mx.tpu <- function(dev.id = 0L) structure(
+  list(device = "tpu", device_typeid = 2L, device_id = as.integer(dev.id)),
+  class = "MXContext")
+
+# ---- NDArray ---------------------------------------------------------------
+
+mx.nd.array <- function(src.array, ctx = mx.cpu()) {
+  if (is.null(dim(src.array))) dim(src.array) <- length(src.array)
+  rdim <- dim(src.array)
+  cdim <- rev(rdim)                       # row-major shape
+  handle <- .Call(mxr_nd_create, as.integer(cdim), ctx$device_typeid,
+                  ctx$device_id)
+  # R column-major -> C row-major: aperm reverses the axis order
+  values <- as.double(aperm(src.array, rev(seq_along(rdim))))
+  .Call(mxr_nd_set, handle, values)
+  structure(list(handle = handle), class = "MXNDArray")
+}
+
+mx.nd.zeros <- function(shape, ctx = mx.cpu()) {
+  handle <- .Call(mxr_nd_create, as.integer(rev(shape)),
+                  ctx$device_typeid, ctx$device_id)
+  structure(list(handle = handle), class = "MXNDArray")
+}
+
+as.array.MXNDArray <- function(x, ...) {
+  values <- .Call(mxr_nd_get, x$handle)
+  cdim <- attr(values, "mx.dim")
+  arr <- array(values, dim = rev(cdim))   # fill column-major = C order rev
+  aperm(arr, rev(seq_along(cdim)))
+}
+
+dim.MXNDArray <- function(x) rev(.Call(mxr_nd_shape, x$handle))
+
+mx.nd.save <- function(ndarray.list, filename) {
+  handles <- lapply(ndarray.list, function(a) a$handle)
+  .Call(mxr_nd_save, filename, handles)
+  invisible(NULL)
+}
+
+mx.nd.load <- function(filename) {
+  handles <- .Call(mxr_nd_load, filename)
+  out <- lapply(handles, function(h)
+    structure(list(handle = h), class = "MXNDArray"))
+  names(out) <- names(handles)
+  out
+}
+
+# ---- Symbol ----------------------------------------------------------------
+
+mx.symbol.Variable <- function(name) structure(
+  list(handle = .Call(mxr_sym_variable, name)), class = "MXSymbol")
+
+mx.symbol.load.json <- function(json.str) structure(
+  list(handle = .Call(mxr_sym_from_json, json.str)), class = "MXSymbol")
+
+mx.symbol.load <- function(filename)
+  mx.symbol.load.json(paste(readLines(filename), collapse = "\n"))
+
+mx.symbol.save <- function(symbol, filename) {
+  writeLines(.Call(mxr_sym_to_json, symbol$handle), filename)
+  invisible(NULL)
+}
+
+tojson.MXSymbol <- function(symbol) .Call(mxr_sym_to_json, symbol$handle)
+
+arguments.MXSymbol <- function(symbol)
+  .Call(mxr_sym_list_arguments, symbol$handle)
+
+outputs.MXSymbol <- function(symbol)
+  .Call(mxr_sym_list_outputs, symbol$handle)
+
+mx.symbol.infer.shape <- function(symbol, ...) {
+  shapes <- list(...)
+  keys <- names(shapes)
+  ind <- c(0L)
+  data <- integer(0)
+  for (s in shapes) {                     # R shape -> C row-major shape
+    data <- c(data, as.integer(rev(s)))
+    ind <- c(ind, length(data))
+  }
+  res <- .Call(mxr_sym_infer_shape, symbol$handle, keys,
+               as.integer(ind), data)
+  res$arg.shapes <- lapply(res$arg.shapes, rev)
+  res$out.shapes <- lapply(res$out.shapes, rev)
+  res
+}
+
+# internal: apply a registered operator (reference mx.varg.symbol.*).
+# Symbol arguments may be positional (mx.symbol.Activation(net, ...)) or
+# named (data=net); mixing positional and named symbol inputs follows
+# the C ABI rule: either all inputs named or none.
+mx.symbol.create <- function(op.name, ..., name = "") {
+  args <- list(...)
+  keys <- names(args)
+  if (is.null(keys)) keys <- rep("", length(args))
+  params <- list()
+  pos.inputs <- list()
+  named.inputs <- list()
+  for (i in seq_along(args)) {
+    v <- args[[i]]
+    key <- keys[[i]]
+    if (inherits(v, "MXSymbol")) {
+      if (nzchar(key)) named.inputs[[key]] <- v
+      else pos.inputs[[length(pos.inputs) + 1L]] <- v
+    } else if (identical(key, "name")) {
+      name <- v
+    } else {
+      if (!nzchar(key)) stop("non-symbol positional argument to ",
+                             "mx.symbol.", op.name)
+      params[[key]] <- v
+    }
+  }
+  if (length(pos.inputs) > 0 && length(named.inputs) > 0)
+    stop("mx.symbol.", op.name,
+         ": use either all-named or all-positional symbol inputs")
+  param.keys <- names(params)
+  param.vals <- vapply(params, function(v) {
+    if (is.numeric(v) && length(v) > 1)
+      paste0("(", paste(as.integer(v), collapse = ", "), ")")
+    else as.character(v)
+  }, character(1))
+  handle <- .Call(mxr_sym_create_atomic, op.name,
+                  as.character(param.keys), as.character(param.vals))
+  if (length(named.inputs) > 0) {
+    in.keys <- as.character(names(named.inputs))
+    in.handles <- lapply(named.inputs, function(s) s$handle)
+  } else {
+    in.keys <- character(0)
+    in.handles <- lapply(pos.inputs, function(s) s$handle)
+  }
+  .Call(mxr_sym_compose, handle, name, in.keys, in.handles)
+  structure(list(handle = handle), class = "MXSymbol")
+}
+
+# generated operator namespace: mx.symbol.Convolution(...) etc.
+mx.symbol.list.operators <- function() .Call(mxr_sym_list_atomic)
+
+.mx.generate.operators <- function(envir) {
+  for (op in mx.symbol.list.operators()) {
+    if (grepl("^_", op)) next
+    fn <- local({
+      op.name <- op
+      function(..., name = "") mx.symbol.create(op.name, ..., name = name)
+    })
+    assign(paste0("mx.symbol.", op), fn, envir = envir)
+  }
+}
+
+# ---- Executor --------------------------------------------------------------
+
+mx.simple.bind <- function(symbol, ctx = mx.cpu(), grad.req = "write", ...) {
+  shapes <- list(...)
+  keys <- names(shapes)
+  ind <- c(0L)
+  data <- integer(0)
+  for (s in shapes) {
+    data <- c(data, as.integer(rev(s)))
+    ind <- c(ind, length(data))
+  }
+  handle <- .Call(mxr_exec_simple_bind, symbol$handle, ctx$device_typeid,
+                  ctx$device_id, keys, as.integer(ind), data,
+                  if (identical(grad.req, "null")) 0L else 1L)
+  structure(list(handle = handle, symbol = symbol), class = "MXExecutor")
+}
+
+mx.exec.set.arg <- function(executor, name, values) {
+  if (inherits(values, "MXNDArray")) values <- as.array(values)
+  if (!is.null(dim(values)))
+    values <- aperm(values, rev(seq_along(dim(values))))
+  .Call(mxr_exec_set_arg, executor$handle, name, as.double(values))
+  invisible(NULL)
+}
+
+mx.exec.forward <- function(executor, is.train = TRUE) {
+  .Call(mxr_exec_forward, executor$handle, as.integer(is.train))
+  invisible(NULL)
+}
+
+mx.exec.backward <- function(executor) {
+  .Call(mxr_exec_backward, executor$handle)
+  invisible(NULL)
+}
+
+mx.exec.get.output <- function(executor, index, shape) {
+  values <- .Call(mxr_exec_get_output, executor$handle,
+                  as.integer(index - 1L), as.integer(prod(shape)))
+  arr <- array(values, dim = rev(shape))
+  aperm(arr, rev(seq_along(shape)))
+}
+
+mx.exec.get.grad <- function(executor, name, shape) {
+  values <- .Call(mxr_exec_get_grad, executor$handle, name,
+                  as.integer(prod(shape)))
+  arr <- array(values, dim = rev(shape))
+  aperm(arr, rev(seq_along(shape)))
+}
+
+# ---- Model -----------------------------------------------------------------
+
+# Load a reference-layout checkpoint: <prefix>-symbol.json +
+# <prefix>-%04d.params with arg:/aux: key prefixes (reference
+# R-package/R/model.R mx.model.load).
+mx.model.load <- function(prefix, iteration) {
+  symbol <- mx.symbol.load(sprintf("%s-symbol.json", prefix))
+  params <- mx.nd.load(sprintf("%s-%04d.params", prefix, iteration))
+  keys <- names(params)
+  arg.params <- params[grepl("^arg:", keys)]
+  names(arg.params) <- sub("^arg:", "", names(arg.params))
+  aux.params <- params[grepl("^aux:", keys)]
+  names(aux.params) <- sub("^aux:", "", names(aux.params))
+  structure(list(symbol = symbol, arg.params = arg.params,
+                 aux.params = aux.params), class = "MXFeedForwardModel")
+}
+
+mx.exec.set.aux <- function(executor, name, values) {
+  if (inherits(values, "MXNDArray")) values <- as.array(values)
+  if (!is.null(dim(values)))
+    values <- aperm(values, rev(seq_along(dim(values))))
+  .Call(mxr_exec_set_aux, executor$handle, name, as.double(values))
+  invisible(NULL)
+}
+
+mx.exec.get.aux <- function(executor, name, shape) {
+  values <- .Call(mxr_exec_get_aux, executor$handle, name,
+                  as.integer(prod(shape)))
+  arr <- array(values, dim = rev(shape))
+  aperm(arr, rev(seq_along(shape)))
+}
+
+# Forward inference on a batch (X in R layout: first dim = sample).
+predict.MXFeedForwardModel <- function(object, X, ctx = mx.cpu(), ...) {
+  data.shape <- dim(X)
+  shapes <- mx.symbol.infer.shape(object$symbol, data = data.shape)
+  executor <- mx.simple.bind(object$symbol, ctx, grad.req = "null",
+                             data = data.shape)
+  for (name in names(object$arg.params))
+    mx.exec.set.arg(executor, name, object$arg.params[[name]])
+  for (name in names(object$aux.params))   # BatchNorm moving stats etc.
+    mx.exec.set.aux(executor, name, object$aux.params[[name]])
+  mx.exec.set.arg(executor, "data", X)
+  mx.exec.forward(executor, is.train = FALSE)
+  out.shape <- shapes$out.shapes[[1]]
+  mx.exec.get.output(executor, 1L, out.shape)
+}
+
+# One synchronous SGD step on a bound executor (the R-side analogue of
+# perl-package/examples/train_step.pl): `params` is a named list of R
+# arrays already set on the executor; returns the updated list.
+mx.model.sgd.step <- function(executor, params, learning.rate = 0.01) {
+  mx.exec.forward(executor, is.train = TRUE)
+  mx.exec.backward(executor)
+  for (name in names(params)) {
+    grad <- mx.exec.get.grad(executor, name, dim(params[[name]]))
+    params[[name]] <- params[[name]] - learning.rate * grad
+    mx.exec.set.arg(executor, name, params[[name]])
+  }
+  params
+}

@@ -1,0 +1,15 @@
+# Package hooks: generate mx.symbol.<Op> functions from the registry at
+# load time (reference R-package/R/zzz.R mx.symbol.infer the same way:
+# its init.symbol.methods walked the C registry).
+.onLoad <- function(libname, pkgname) {
+  ns <- asNamespace(pkgname)
+  tryCatch({
+    .mx.generate.operators(ns)
+    # export the generated creators so library() users see them (the
+    # static NAMESPACE cannot list load-time-generated names)
+    generated <- ls(ns, pattern = "^mx\\.symbol\\.")
+    namespaceExport(ns, generated)
+  }, error = function(e)
+    packageStartupMessage("mxnet.tpu: operator generation ",
+                          "deferred (", conditionMessage(e), ")"))
+}

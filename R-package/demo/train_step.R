@@ -1,0 +1,33 @@
+# Demo: load a Python-trained checkpoint, run inference, take SGD steps —
+# the same workflow perl-package/examples/train_step.pl proves in CI.
+#
+# Usage (with R installed and the package built):
+#   make capi && R CMD INSTALL R-package
+#   Rscript R-package/demo/train_step.R <prefix> <epoch>
+library(mxnet.tpu)
+
+args <- commandArgs(trailingOnly = TRUE)
+prefix <- ifelse(length(args) >= 1, args[[1]], "model")
+epoch <- ifelse(length(args) >= 2, as.integer(args[[2]]), 1L)
+
+model <- mx.model.load(prefix, epoch)
+cat("arguments:", paste(arguments.MXSymbol(model$symbol), collapse = ", "),
+    "\n")
+
+# inference on random data
+X <- array(rnorm(32 * 6), dim = c(32, 6))
+probs <- predict(model, X)
+cat("predict: dim", paste(dim(probs), collapse = "x"),
+    "row-sums ~1:", all(abs(rowSums(probs) - 1) < 1e-4), "\n")
+
+# one SGD step: bind for training, seed params, step
+executor <- mx.simple.bind(model$symbol, mx.cpu(), grad.req = "write",
+                           data = dim(X))
+params <- lapply(model$arg.params, as.array)
+for (name in names(params)) mx.exec.set.arg(executor, name, params[[name]])
+mx.exec.set.arg(executor, "data", X)
+labels <- sample(0:1, 32, replace = TRUE)
+mx.exec.set.arg(executor, "softmax_label", labels)
+params <- mx.model.sgd.step(executor, params, learning.rate = 0.05)
+cat("sgd step done; first weight delta:",
+    max(abs(params[[1]] - as.array(model$arg.params[[1]]))), "\n")

@@ -1,0 +1,430 @@
+/*
+ * .Call glue between R and the framework's C ABI (libmxtpu).
+ *
+ * Parity target: the reference R-package's src/ layer
+ * (R-package/src/ndarray.cc, symbol.cc, executor.cc — Rcpp modules over
+ * include/mxnet/c_api.h). This re-design uses the plain R C API (.Call /
+ * SEXP) instead of Rcpp so the package has zero compile-time deps beyond
+ * R itself, and targets the TPU runtime ABI (include/mxnet_tpu/c_api.h).
+ *
+ * Handles cross into R as external pointers with finalizers; tensors
+ * cross as R numeric vectors with a dim attribute (row-major order is
+ * converted on the R side; buffers here are the C-order floats the ABI
+ * expects).
+ *
+ * Built by R CMD INSTALL against an installed libmxtpu.so (see
+ * src/Makevars); this directory cannot be compiled without R headers,
+ * which is also true of the reference's R glue.
+ */
+#include <R.h>
+#include <Rinternals.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "../../include/mxnet_tpu/c_api.h"
+
+/* ---- helpers ---------------------------------------------------------- */
+
+static void chk(int rc) {
+  if (rc != 0) Rf_error("mxnet_tpu: %s", MXGetLastError());
+}
+
+static void ndarray_finalizer(SEXP ptr) {
+  NDArrayHandle h = R_ExternalPtrAddr(ptr);
+  if (h) { MXNDArrayFree(h); R_ClearExternalPtr(ptr); }
+}
+
+static void symbol_finalizer(SEXP ptr) {
+  SymbolHandle h = R_ExternalPtrAddr(ptr);
+  if (h) { MXSymbolFree(h); R_ClearExternalPtr(ptr); }
+}
+
+static void executor_finalizer(SEXP ptr) {
+  ExecutorHandle h = R_ExternalPtrAddr(ptr);
+  if (h) { MXExecutorFree(h); R_ClearExternalPtr(ptr); }
+}
+
+static SEXP wrap_handle(void *h, R_CFinalizer_t fin) {
+  SEXP ptr = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, fin, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+static SEXP charvec(mx_uint n, const char **strs) {
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, n));
+  for (mx_uint i = 0; i < n; ++i)
+    SET_STRING_ELT(out, i, Rf_mkChar(strs[i]));
+  UNPROTECT(1);
+  return out;
+}
+
+/* ---- NDArray ---------------------------------------------------------- */
+
+/* mxr_nd_create(shape_intvec, dev_type, dev_id) -> extptr */
+SEXP mxr_nd_create(SEXP shape, SEXP dev_type, SEXP dev_id) {
+  mx_uint ndim = (mx_uint)Rf_length(shape);
+  mx_uint *dims = (mx_uint *)R_alloc(ndim, sizeof(mx_uint));
+  for (mx_uint i = 0; i < ndim; ++i) dims[i] = (mx_uint)INTEGER(shape)[i];
+  NDArrayHandle h;
+  chk(MXNDArrayCreate(dims, ndim, Rf_asInteger(dev_type),
+                      Rf_asInteger(dev_id), &h));
+  return wrap_handle(h, ndarray_finalizer);
+}
+
+/* mxr_nd_set(extptr, numeric) — host->device copy */
+SEXP mxr_nd_set(SEXP ptr, SEXP values) {
+  NDArrayHandle h = R_ExternalPtrAddr(ptr);
+  R_xlen_t n = Rf_xlength(values);
+  float *buf = (float *)R_alloc(n, sizeof(float));
+  double *src = REAL(values);
+  for (R_xlen_t i = 0; i < n; ++i) buf[i] = (float)src[i];
+  chk(MXNDArraySyncCopyFromCPU(h, buf, (mx_uint)n));
+  return R_NilValue;
+}
+
+/* mxr_nd_get(extptr) -> numeric with dim attribute (C order) */
+SEXP mxr_nd_get(SEXP ptr) {
+  NDArrayHandle h = R_ExternalPtrAddr(ptr);
+  mx_uint ndim;
+  const mx_uint *dims;
+  chk(MXNDArrayGetShape(h, &ndim, &dims));
+  R_xlen_t n = 1;
+  for (mx_uint i = 0; i < ndim; ++i) n *= dims[i];
+  float *buf = (float *)R_alloc(n, sizeof(float));
+  chk(MXNDArraySyncCopyToCPU(h, buf, (mx_uint)n));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, n));
+  for (R_xlen_t i = 0; i < n; ++i) REAL(out)[i] = buf[i];
+  SEXP dim = PROTECT(Rf_allocVector(INTSXP, ndim));
+  for (mx_uint i = 0; i < ndim; ++i) INTEGER(dim)[i] = (int)dims[i];
+  Rf_setAttrib(out, Rf_install("mx.dim"), dim);
+  UNPROTECT(2);
+  return out;
+}
+
+SEXP mxr_nd_shape(SEXP ptr) {
+  NDArrayHandle h = R_ExternalPtrAddr(ptr);
+  mx_uint ndim;
+  const mx_uint *dims;
+  chk(MXNDArrayGetShape(h, &ndim, &dims));
+  SEXP out = PROTECT(Rf_allocVector(INTSXP, ndim));
+  for (mx_uint i = 0; i < ndim; ++i) INTEGER(out)[i] = (int)dims[i];
+  UNPROTECT(1);
+  return out;
+}
+
+/* mxr_nd_save(fname, list_of_extptr_named) */
+SEXP mxr_nd_save(SEXP fname, SEXP arrays) {
+  mx_uint n = (mx_uint)Rf_length(arrays);
+  NDArrayHandle *handles =
+      (NDArrayHandle *)R_alloc(n, sizeof(NDArrayHandle));
+  const char **keys = (const char **)R_alloc(n, sizeof(char *));
+  SEXP names = Rf_getAttrib(arrays, R_NamesSymbol);
+  for (mx_uint i = 0; i < n; ++i) {
+    handles[i] = R_ExternalPtrAddr(VECTOR_ELT(arrays, i));
+    keys[i] = (names == R_NilValue) ? ""
+              : CHAR(STRING_ELT(names, i));
+  }
+  chk(MXNDArraySave(CHAR(STRING_ELT(fname, 0)), n, handles,
+                    (names == R_NilValue) ? NULL : keys));
+  return R_NilValue;
+}
+
+/* mxr_nd_load(fname) -> named list of extptr */
+SEXP mxr_nd_load(SEXP fname) {
+  mx_uint size, name_size;
+  NDArrayHandle *arrs;
+  const char **names;
+  chk(MXNDArrayLoad(CHAR(STRING_ELT(fname, 0)), &size, &arrs,
+                    &name_size, &names));
+  SEXP out = PROTECT(Rf_allocVector(VECSXP, size));
+  for (mx_uint i = 0; i < size; ++i)
+    SET_VECTOR_ELT(out, i, wrap_handle(arrs[i], ndarray_finalizer));
+  if (name_size == size) {
+    SEXP nm = PROTECT(charvec(size, names));
+    Rf_setAttrib(out, R_NamesSymbol, nm);
+    UNPROTECT(1);
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+/* ---- Symbol ----------------------------------------------------------- */
+
+SEXP mxr_sym_from_json(SEXP json) {
+  SymbolHandle h;
+  chk(MXSymbolCreateFromJSON(CHAR(STRING_ELT(json, 0)), &h));
+  return wrap_handle(h, symbol_finalizer);
+}
+
+SEXP mxr_sym_to_json(SEXP ptr) {
+  const char *json;
+  chk(MXSymbolSaveToJSON(R_ExternalPtrAddr(ptr), &json));
+  return Rf_mkString(json);
+}
+
+SEXP mxr_sym_variable(SEXP name) {
+  SymbolHandle h;
+  chk(MXSymbolCreateVariable(CHAR(STRING_ELT(name, 0)), &h));
+  return wrap_handle(h, symbol_finalizer);
+}
+
+SEXP mxr_sym_list_arguments(SEXP ptr) {
+  mx_uint n;
+  const char **names;
+  chk(MXSymbolListArguments(R_ExternalPtrAddr(ptr), &n, &names));
+  return charvec(n, names);
+}
+
+SEXP mxr_sym_list_outputs(SEXP ptr) {
+  mx_uint n;
+  const char **names;
+  chk(MXSymbolListOutputs(R_ExternalPtrAddr(ptr), &n, &names));
+  return charvec(n, names);
+}
+
+SEXP mxr_sym_list_auxiliary(SEXP ptr) {
+  mx_uint n;
+  const char **names;
+  chk(MXSymbolListAuxiliaryStates(R_ExternalPtrAddr(ptr), &n, &names));
+  return charvec(n, names);
+}
+
+/* registry: list operator names */
+SEXP mxr_sym_list_atomic(void) {
+  mx_uint n;
+  AtomicSymbolCreator *creators;
+  chk(MXSymbolListAtomicSymbolCreators(&n, &creators));
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, n));
+  for (mx_uint i = 0; i < n; ++i) {
+    const char *name;
+    chk(MXSymbolGetAtomicSymbolName(creators[i], &name));
+    SET_STRING_ELT(out, i, Rf_mkChar(name));
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+/* name -> creator lookup, cached for the process lifetime (creator
+ * handles are stable per the ABI contract) */
+static AtomicSymbolCreator lookup_creator(const char *opname) {
+  static mx_uint nc = 0;
+  static AtomicSymbolCreator *creators = NULL;
+  static const char **names = NULL;
+  if (creators == NULL) {
+    chk(MXSymbolListAtomicSymbolCreators(&nc, &creators));
+    names = (const char **)malloc(nc * sizeof(char *));
+    for (mx_uint i = 0; i < nc; ++i)
+      chk(MXSymbolGetAtomicSymbolName(creators[i], &names[i]));
+  }
+  for (mx_uint i = 0; i < nc; ++i)
+    if (strcmp(names[i], opname) == 0) return creators[i];
+  Rf_error("mxnet_tpu: unknown operator %s", opname);
+  return NULL;
+}
+
+/* mxr_sym_create_atomic(opname, param_keys, param_vals) */
+SEXP mxr_sym_create_atomic(SEXP opname, SEXP keys, SEXP vals) {
+  AtomicSymbolCreator target = lookup_creator(CHAR(STRING_ELT(opname, 0)));
+  mx_uint np = (mx_uint)Rf_length(keys);
+  const char **ck = (const char **)R_alloc(np, sizeof(char *));
+  const char **cv = (const char **)R_alloc(np, sizeof(char *));
+  for (mx_uint i = 0; i < np; ++i) {
+    ck[i] = CHAR(STRING_ELT(keys, i));
+    cv[i] = CHAR(STRING_ELT(vals, i));
+  }
+  SymbolHandle h;
+  chk(MXSymbolCreateAtomicSymbol(target, np, ck, cv, &h));
+  return wrap_handle(h, symbol_finalizer);
+}
+
+/* mxr_sym_compose(sym, name, input_keys, input_syms_list) */
+SEXP mxr_sym_compose(SEXP ptr, SEXP name, SEXP keys, SEXP args) {
+  mx_uint n = (mx_uint)Rf_length(args);
+  int named = Rf_length(keys) > 0;
+  if (named && (mx_uint)Rf_length(keys) != n)
+    Rf_error("mxnet_tpu: compose keys/args length mismatch");
+  SymbolHandle *handles =
+      (SymbolHandle *)R_alloc(n, sizeof(SymbolHandle));
+  const char **ck = (const char **)R_alloc(n ? n : 1, sizeof(char *));
+  for (mx_uint i = 0; i < n; ++i) {
+    handles[i] = R_ExternalPtrAddr(VECTOR_ELT(args, i));
+    if (named) ck[i] = CHAR(STRING_ELT(keys, i));
+  }
+  chk(MXSymbolCompose(R_ExternalPtrAddr(ptr), CHAR(STRING_ELT(name, 0)),
+                      n, named ? ck : NULL, handles));
+  return ptr;
+}
+
+/* mxr_sym_infer_shape(sym, keys, ind_ptr, shape_data) ->
+ *   list(arg.shapes=list, out.shapes=list) */
+SEXP mxr_sym_infer_shape(SEXP ptr, SEXP keys, SEXP ind, SEXP data) {
+  mx_uint nk = (mx_uint)Rf_length(keys);
+  const char **ck = (const char **)R_alloc(nk ? nk : 1, sizeof(char *));
+  mx_uint *cind =
+      (mx_uint *)R_alloc(Rf_length(ind) ? Rf_length(ind) : 1,
+                         sizeof(mx_uint));
+  mx_uint *cdata =
+      (mx_uint *)R_alloc(Rf_length(data) ? Rf_length(data) : 1,
+                         sizeof(mx_uint));
+  for (mx_uint i = 0; i < nk; ++i) ck[i] = CHAR(STRING_ELT(keys, i));
+  for (int i = 0; i < Rf_length(ind); ++i)
+    cind[i] = (mx_uint)INTEGER(ind)[i];
+  for (int i = 0; i < Rf_length(data); ++i)
+    cdata[i] = (mx_uint)INTEGER(data)[i];
+  mx_uint in_n, out_n;
+  const mx_uint *in_ndim, *out_ndim;
+  const mx_uint **in_data, **out_data;
+  chk(MXSymbolInferShape(R_ExternalPtrAddr(ptr), nk, ck, cind, cdata,
+                         &in_n, &in_ndim, &in_data,
+                         &out_n, &out_ndim, &out_data));
+  SEXP arg_shapes = PROTECT(Rf_allocVector(VECSXP, in_n));
+  for (mx_uint i = 0; i < in_n; ++i) {
+    SEXP s = PROTECT(Rf_allocVector(INTSXP, in_ndim[i]));
+    for (mx_uint j = 0; j < in_ndim[i]; ++j)
+      INTEGER(s)[j] = (int)in_data[i][j];
+    SET_VECTOR_ELT(arg_shapes, i, s);
+    UNPROTECT(1);
+  }
+  SEXP out_shapes = PROTECT(Rf_allocVector(VECSXP, out_n));
+  for (mx_uint i = 0; i < out_n; ++i) {
+    SEXP s = PROTECT(Rf_allocVector(INTSXP, out_ndim[i]));
+    for (mx_uint j = 0; j < out_ndim[i]; ++j)
+      INTEGER(s)[j] = (int)out_data[i][j];
+    SET_VECTOR_ELT(out_shapes, i, s);
+    UNPROTECT(1);
+  }
+  SEXP res = PROTECT(Rf_allocVector(VECSXP, 2));
+  SET_VECTOR_ELT(res, 0, arg_shapes);
+  SET_VECTOR_ELT(res, 1, out_shapes);
+  SEXP nm = PROTECT(Rf_allocVector(STRSXP, 2));
+  SET_STRING_ELT(nm, 0, Rf_mkChar("arg.shapes"));
+  SET_STRING_ELT(nm, 1, Rf_mkChar("out.shapes"));
+  Rf_setAttrib(res, R_NamesSymbol, nm);
+  UNPROTECT(4);
+  return res;
+}
+
+/* ---- Executor --------------------------------------------------------- */
+
+/* mxr_exec_simple_bind(sym, dev_type, dev_id, keys, ind, data,
+ *                      for_training) */
+SEXP mxr_exec_simple_bind(SEXP sym, SEXP dev_type, SEXP dev_id, SEXP keys,
+                          SEXP ind, SEXP data, SEXP for_training) {
+  mx_uint nk = (mx_uint)Rf_length(keys);
+  const char **ck = (const char **)R_alloc(nk ? nk : 1, sizeof(char *));
+  mx_uint *cind =
+      (mx_uint *)R_alloc(Rf_length(ind) ? Rf_length(ind) : 1,
+                         sizeof(mx_uint));
+  mx_uint *cdata =
+      (mx_uint *)R_alloc(Rf_length(data) ? Rf_length(data) : 1,
+                         sizeof(mx_uint));
+  for (mx_uint i = 0; i < nk; ++i) ck[i] = CHAR(STRING_ELT(keys, i));
+  for (int i = 0; i < Rf_length(ind); ++i)
+    cind[i] = (mx_uint)INTEGER(ind)[i];
+  for (int i = 0; i < Rf_length(data); ++i)
+    cdata[i] = (mx_uint)INTEGER(data)[i];
+  ExecutorHandle h;
+  chk(MXExecutorSimpleBind(R_ExternalPtrAddr(sym),
+                           Rf_asInteger(dev_type), Rf_asInteger(dev_id),
+                           nk, ck, cind, cdata,
+                           Rf_asInteger(for_training), &h));
+  return wrap_handle(h, executor_finalizer);
+}
+
+SEXP mxr_exec_set_arg(SEXP ptr, SEXP name, SEXP values) {
+  R_xlen_t n = Rf_xlength(values);
+  float *buf = (float *)R_alloc(n, sizeof(float));
+  for (R_xlen_t i = 0; i < n; ++i) buf[i] = (float)REAL(values)[i];
+  chk(MXExecutorSetArg(R_ExternalPtrAddr(ptr), CHAR(STRING_ELT(name, 0)),
+                       buf, (mx_uint)n));
+  return R_NilValue;
+}
+
+SEXP mxr_exec_forward(SEXP ptr, SEXP is_train) {
+  chk(MXExecutorForward(R_ExternalPtrAddr(ptr), Rf_asInteger(is_train)));
+  return R_NilValue;
+}
+
+SEXP mxr_exec_backward(SEXP ptr) {
+  chk(MXExecutorBackward(R_ExternalPtrAddr(ptr)));
+  return R_NilValue;
+}
+
+SEXP mxr_exec_get_output(SEXP ptr, SEXP index, SEXP size) {
+  mx_uint n = (mx_uint)Rf_asInteger(size);
+  float *buf = (float *)R_alloc(n, sizeof(float));
+  chk(MXExecutorGetOutput(R_ExternalPtrAddr(ptr), Rf_asInteger(index),
+                          buf, n));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, n));
+  for (mx_uint i = 0; i < n; ++i) REAL(out)[i] = buf[i];
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP mxr_exec_get_grad(SEXP ptr, SEXP name, SEXP size) {
+  mx_uint n = (mx_uint)Rf_asInteger(size);
+  float *buf = (float *)R_alloc(n, sizeof(float));
+  chk(MXExecutorGetGrad(R_ExternalPtrAddr(ptr), CHAR(STRING_ELT(name, 0)),
+                        buf, n));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, n));
+  for (mx_uint i = 0; i < n; ++i) REAL(out)[i] = buf[i];
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP mxr_exec_set_aux(SEXP ptr, SEXP name, SEXP values) {
+  R_xlen_t n = Rf_xlength(values);
+  float *buf = (float *)R_alloc(n, sizeof(float));
+  for (R_xlen_t i = 0; i < n; ++i) buf[i] = (float)REAL(values)[i];
+  chk(MXExecutorSetAux(R_ExternalPtrAddr(ptr), CHAR(STRING_ELT(name, 0)),
+                       buf, (mx_uint)n));
+  return R_NilValue;
+}
+
+SEXP mxr_exec_get_aux(SEXP ptr, SEXP name, SEXP size) {
+  mx_uint n = (mx_uint)Rf_asInteger(size);
+  float *buf = (float *)R_alloc(n, sizeof(float));
+  chk(MXExecutorGetAux(R_ExternalPtrAddr(ptr), CHAR(STRING_ELT(name, 0)),
+                       buf, n));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, n));
+  for (mx_uint i = 0; i < n; ++i) REAL(out)[i] = buf[i];
+  UNPROTECT(1);
+  return out;
+}
+
+/* ---- registration ----------------------------------------------------- */
+
+static const R_CallMethodDef call_methods[] = {
+  {"mxr_nd_create", (DL_FUNC)&mxr_nd_create, 3},
+  {"mxr_nd_set", (DL_FUNC)&mxr_nd_set, 2},
+  {"mxr_nd_get", (DL_FUNC)&mxr_nd_get, 1},
+  {"mxr_nd_shape", (DL_FUNC)&mxr_nd_shape, 1},
+  {"mxr_nd_save", (DL_FUNC)&mxr_nd_save, 2},
+  {"mxr_nd_load", (DL_FUNC)&mxr_nd_load, 1},
+  {"mxr_sym_from_json", (DL_FUNC)&mxr_sym_from_json, 1},
+  {"mxr_sym_to_json", (DL_FUNC)&mxr_sym_to_json, 1},
+  {"mxr_sym_variable", (DL_FUNC)&mxr_sym_variable, 1},
+  {"mxr_sym_list_arguments", (DL_FUNC)&mxr_sym_list_arguments, 1},
+  {"mxr_sym_list_outputs", (DL_FUNC)&mxr_sym_list_outputs, 1},
+  {"mxr_sym_list_auxiliary", (DL_FUNC)&mxr_sym_list_auxiliary, 1},
+  {"mxr_sym_list_atomic", (DL_FUNC)&mxr_sym_list_atomic, 0},
+  {"mxr_sym_create_atomic", (DL_FUNC)&mxr_sym_create_atomic, 3},
+  {"mxr_sym_compose", (DL_FUNC)&mxr_sym_compose, 4},
+  {"mxr_sym_infer_shape", (DL_FUNC)&mxr_sym_infer_shape, 4},
+  {"mxr_exec_simple_bind", (DL_FUNC)&mxr_exec_simple_bind, 7},
+  {"mxr_exec_set_arg", (DL_FUNC)&mxr_exec_set_arg, 3},
+  {"mxr_exec_forward", (DL_FUNC)&mxr_exec_forward, 2},
+  {"mxr_exec_backward", (DL_FUNC)&mxr_exec_backward, 1},
+  {"mxr_exec_get_output", (DL_FUNC)&mxr_exec_get_output, 3},
+  {"mxr_exec_get_grad", (DL_FUNC)&mxr_exec_get_grad, 3},
+  {"mxr_exec_set_aux", (DL_FUNC)&mxr_exec_set_aux, 3},
+  {"mxr_exec_get_aux", (DL_FUNC)&mxr_exec_get_aux, 3},
+  {NULL, NULL, 0}
+};
+
+void R_init_mxnet_tpu(DllInfo *info) {
+  R_registerRoutines(info, NULL, call_methods, NULL, NULL);
+  R_useDynamicSymbols(info, FALSE);
+}

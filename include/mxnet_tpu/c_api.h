@@ -252,6 +252,13 @@ int MXExecutorSimpleBind(SymbolHandle symbol, int dev_type, int dev_id,
 /*! \brief Copy data into a named argument (input or parameter). */
 int MXExecutorSetArg(ExecutorHandle handle, const char *name,
                      const mx_float *data, mx_uint size);
+/*! \brief Copy data into a named auxiliary state (e.g. BatchNorm moving
+ * stats restored from a checkpoint's aux: entries). */
+int MXExecutorSetAux(ExecutorHandle handle, const char *name,
+                     const mx_float *data, mx_uint size);
+/*! \brief Copy auxiliary state `name` to host (`size` floats). */
+int MXExecutorGetAux(ExecutorHandle handle, const char *name,
+                     mx_float *data, mx_uint size);
 int MXExecutorForward(ExecutorHandle handle, int is_train);
 /*! \brief Backward with implicit all-ones head gradients. */
 int MXExecutorBackward(ExecutorHandle handle);

@@ -189,6 +189,24 @@ def executor_grad_bytes(exe, name):
     return np.ascontiguousarray(g.asnumpy(), dtype=np.float32).tobytes()
 
 
+def executor_set_aux(exe, name, memview):
+    """Write an auxiliary state (BatchNorm moving stats etc.) — needed by
+    frontends restoring aux: entries from a checkpoint."""
+    target = exe.aux_dict.get(name)
+    if target is None:
+        raise MXNetError("unknown auxiliary state '%s'" % name)
+    data = np.frombuffer(memview, dtype=np.float32).copy()
+    target[:] = data.reshape(target.shape)
+    target.wait_to_read()
+
+
+def executor_aux_bytes(exe, name):
+    a = exe.aux_dict.get(name)
+    if a is None:
+        raise MXNetError("unknown auxiliary state '%s'" % name)
+    return np.ascontiguousarray(a.asnumpy(), dtype=np.float32).tobytes()
+
+
 # ---------------------------------------------------------------------------
 # Registry enumeration + atomic symbol construction (reference
 # src/c_api/c_api.cc:447-937: MXSymbolListAtomicSymbolCreators,

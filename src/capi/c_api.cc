@@ -412,6 +412,33 @@ int MXExecutorSetArg(ExecutorHandle handle, const char *name,
   return 0;
 }
 
+int MXExecutorSetAux(ExecutorHandle handle, const char *name,
+                     const mx_float *data, mx_uint size) {
+  GIL gil;
+  ExecRec *rec = static_cast<ExecRec *>(handle);
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<mx_float *>(data)),
+      (Py_ssize_t)size * sizeof(mx_float), PyBUF_READ);
+  if (!mv) { set_error_from_python(); return -1; }
+  PyObject *r = call_helper("executor_set_aux", "(OsO)", rec->exe, name, mv);
+  Py_DECREF(mv);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorGetAux(ExecutorHandle handle, const char *name, mx_float *data,
+                     mx_uint size) {
+  GIL gil;
+  ExecRec *rec = static_cast<ExecRec *>(handle);
+  PyObject *bytes = call_helper("executor_aux_bytes", "(Os)", rec->exe,
+                                name);
+  if (!bytes) return -1;
+  int rc = copy_floats_out(bytes, data, size, "aux");
+  Py_DECREF(bytes);
+  return rc;
+}
+
 int MXExecutorForward(ExecutorHandle handle, int is_train) {
   GIL gil;
   ExecRec *rec = static_cast<ExecRec *>(handle);

@@ -179,3 +179,57 @@ def test_symbol_and_training_loop():
     assert lib.MXExecutorGetGrad(eh, b"nope", _fptr(g), 4) == -1
     assert lib.MXExecutorFree(eh) == 0
     assert lib.MXSymbolFree(sh) == 0
+
+
+def test_executor_aux_states_roundtrip():
+    """MXExecutorSetAux/GetAux: restore BatchNorm moving stats from C
+    (what the R frontend's predict() does for checkpoints with aux:
+    entries) and verify eval-mode forward consumes them."""
+    lib = _lib()
+    data = mx.sym.Variable("data")
+    net = mx.sym.BatchNorm(data=data, fix_gamma=False, name="bn")
+    sh = ctypes.c_void_p()
+    assert lib.MXSymbolCreateFromJSON(net.tojson().encode(),
+                                      ctypes.byref(sh)) == 0
+
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint32 * 2)(0, 2)
+    sdata = (ctypes.c_uint32 * 2)(4, 3)
+    eh = ctypes.c_void_p()
+    assert lib.MXExecutorSimpleBind(sh, 1, 0, 1, keys, indptr, sdata, 0,
+                                    ctypes.byref(eh)) == 0, \
+        lib.MXGetLastError()
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(4, 3).astype(np.float32) * 4 + 2
+    mean = np.array([2.0, 3.0, 4.0], np.float32)
+    var = np.array([4.0, 1.0, 0.25], np.float32)
+
+    def set_arg(name, arr):
+        a = np.ascontiguousarray(arr, dtype=np.float32)
+        assert lib.MXExecutorSetArg(eh, name.encode(), _fptr(a),
+                                    a.size) == 0, lib.MXGetLastError()
+
+    set_arg("data", X)
+    set_arg("bn_gamma", np.ones(3, np.float32))
+    set_arg("bn_beta", np.zeros(3, np.float32))
+    for name, val in [("bn_moving_mean", mean), ("bn_moving_var", var)]:
+        a = np.ascontiguousarray(val)
+        assert lib.MXExecutorSetAux(eh, name.encode(), _fptr(a),
+                                    a.size) == 0, lib.MXGetLastError()
+
+    # GetAux roundtrip
+    back = np.zeros(3, np.float32)
+    assert lib.MXExecutorGetAux(eh, b"bn_moving_mean", _fptr(back), 3) == 0
+    np.testing.assert_allclose(back, mean, rtol=1e-6)
+
+    # eval-mode forward normalizes with the restored stats
+    assert lib.MXExecutorForward(eh, 0) == 0, lib.MXGetLastError()
+    out = np.zeros((4, 3), np.float32)
+    assert lib.MXExecutorGetOutput(eh, 0, _fptr(out), out.size) == 0
+    expected = (X - mean) / np.sqrt(var + 1e-3)
+    np.testing.assert_allclose(out, expected, rtol=1e-2, atol=1e-2)
+
+    # unknown aux name errors cleanly
+    assert lib.MXExecutorSetAux(eh, b"nope", _fptr(back), 3) != 0
+    assert b"auxiliary" in lib.MXGetLastError()

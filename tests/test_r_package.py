@@ -1,0 +1,144 @@
+"""R frontend validation without an R runtime.
+
+Three gates (R-package/README.md): (1) the C glue compiles against the
+real c_api.h (stub R headers supply the SEXP surface), (2) every .Call
+from R resolves to a registered native routine with matching arity,
+(3) NAMESPACE exports exist in the R source. The ABI semantics under the
+glue are covered by test_c_api_core.py / test_perl_frontend.py."""
+import os
+import re
+import subprocess
+import tempfile
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+RPKG = os.path.join(REPO, "R-package")
+
+R_STUB = r"""
+#ifndef R_INTERNALS_STUB
+#define R_INTERNALS_STUB
+#include <stddef.h>
+typedef void *SEXP;
+typedef ptrdiff_t R_xlen_t;
+typedef void (*R_CFinalizer_t)(SEXP);
+#define STRSXP 16
+#define INTSXP 13
+#define REALSXP 14
+#define VECSXP 19
+extern SEXP R_NilValue, R_NamesSymbol;
+SEXP Rf_allocVector(int, R_xlen_t);
+SEXP Rf_mkChar(const char*); SEXP Rf_mkString(const char*);
+SEXP Rf_install(const char*);
+void SET_STRING_ELT(SEXP, R_xlen_t, SEXP);
+SEXP STRING_ELT(SEXP, R_xlen_t);
+void SET_VECTOR_ELT(SEXP, R_xlen_t, SEXP);
+SEXP VECTOR_ELT(SEXP, R_xlen_t);
+const char *CHAR(SEXP);
+int *INTEGER(SEXP); double *REAL(SEXP);
+int Rf_length(SEXP); R_xlen_t Rf_xlength(SEXP);
+int Rf_asInteger(SEXP);
+SEXP Rf_setAttrib(SEXP, SEXP, SEXP); SEXP Rf_getAttrib(SEXP, SEXP);
+SEXP PROTECT(SEXP); void UNPROTECT(int);
+void Rf_error(const char*, ...);
+char *R_alloc(size_t, int);
+SEXP R_MakeExternalPtr(void*, SEXP, SEXP);
+void *R_ExternalPtrAddr(SEXP);
+void R_ClearExternalPtr(SEXP);
+void R_RegisterCFinalizerEx(SEXP, R_CFinalizer_t, int);
+typedef void *DL_FUNC;
+typedef struct { const char *name; DL_FUNC fun; int numArgs; }
+    R_CallMethodDef;
+typedef struct _DllInfo DllInfo;
+int R_registerRoutines(DllInfo*, const void*, const R_CallMethodDef*,
+                       const void*, const void*);
+int R_useDynamicSymbols(DllInfo*, int);
+#ifndef TRUE
+#define TRUE 1
+#define FALSE 0
+#endif
+#endif
+"""
+
+
+def test_glue_compiles_against_real_c_api():
+    import shutil
+    if shutil.which("gcc") is None:
+        pytest.skip("no gcc toolchain")
+    with tempfile.TemporaryDirectory() as tmp:
+        with open(os.path.join(tmp, "Rinternals.h"), "w") as f:
+            f.write(R_STUB)
+        with open(os.path.join(tmp, "R.h"), "w") as f:
+            f.write('#include "Rinternals.h"\n')
+        out = subprocess.run(
+            ["gcc", "-fsyntax-only", "-Wall", "-Werror",
+             "-Wno-unused-variable", "-I", tmp, "-I", REPO,
+             os.path.join(RPKG, "src", "mxnet_glue.c")],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+
+
+def _registered_routines():
+    src = open(os.path.join(RPKG, "src", "mxnet_glue.c")).read()
+    return dict(re.findall(
+        r'\{"(mxr_\w+)",\s*\(DL_FUNC\)&\w+,\s*(\d+)\}', src))
+
+
+def _r_calls():
+    """Every .Call(symbol, args...) in R/ with its argument count."""
+    calls = []
+    for fname in os.listdir(os.path.join(RPKG, "R")):
+        src = open(os.path.join(RPKG, "R", fname)).read()
+        for m in re.finditer(r"\.Call\(", src):
+            i = m.end()
+            depth, args, cur = 1, [], []
+            while depth > 0:
+                ch = src[i]
+                if ch in "([":
+                    depth += 1
+                elif ch in ")]":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                elif ch == "," and depth == 1:
+                    args.append("".join(cur))
+                    cur = []
+                    i += 1
+                    continue
+                cur.append(ch)
+                i += 1
+            args.append("".join(cur))
+            calls.append((args[0].strip(), len(args) - 1, fname))
+    return calls
+
+
+def test_every_dotcall_resolves_with_matching_arity():
+    routines = _registered_routines()
+    calls = _r_calls()
+    assert calls, "no .Call sites found — parser broken?"
+    for symbol, nargs, fname in calls:
+        assert symbol in routines, "%s: unregistered .Call %s" % (
+            fname, symbol)
+        assert int(routines[symbol]) == nargs, (
+            "%s: .Call(%s) passes %d args, glue registers %s"
+            % (fname, symbol, nargs, routines[symbol]))
+
+
+def test_namespace_exports_defined():
+    ns = open(os.path.join(RPKG, "NAMESPACE")).read()
+    exports = re.findall(r"export\(([^)]+)\)", ns)
+    rsrc = "".join(open(os.path.join(RPKG, "R", f)).read()
+                   for f in os.listdir(os.path.join(RPKG, "R")))
+    for name in exports:
+        pattern = re.escape(name) + r"\s*<-\s*function"
+        assert re.search(pattern, rsrc), "export %s has no definition" % name
+
+
+def test_c_registration_table_covers_all_functions():
+    """Every SEXP-returning glue function is registered (a missing row
+    means the R symbol silently resolves to NULL at runtime)."""
+    src = open(os.path.join(RPKG, "src", "mxnet_glue.c")).read()
+    defined = set(re.findall(r"^SEXP (mxr_\w+)\(", src, re.M))
+    registered = set(_registered_routines())
+    assert defined == registered, (defined - registered,
+                                   registered - defined)
