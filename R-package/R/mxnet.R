@@ -23,6 +23,21 @@ mx.tpu <- function(dev.id = 0L) structure(
   list(device = "tpu", device_typeid = 2L, device_id = as.integer(dev.id)),
   class = "MXContext")
 
+# ---- layout marshalling helpers --------------------------------------------
+# The package's central invariant: R column-major <-> runtime row-major.
+
+.mx.to.c.order <- function(values) {
+  if (inherits(values, "MXNDArray")) values <- as.array(values)
+  if (!is.null(dim(values)))
+    values <- aperm(values, rev(seq_along(dim(values))))
+  as.double(values)
+}
+
+.mx.from.c.order <- function(values, shape) {
+  arr <- array(values, dim = rev(shape))
+  aperm(arr, rev(seq_along(shape)))
+}
+
 # ---- NDArray ---------------------------------------------------------------
 
 mx.nd.array <- function(src.array, ctx = mx.cpu()) {
@@ -31,9 +46,7 @@ mx.nd.array <- function(src.array, ctx = mx.cpu()) {
   cdim <- rev(rdim)                       # row-major shape
   handle <- .Call(mxr_nd_create, as.integer(cdim), ctx$device_typeid,
                   ctx$device_id)
-  # R column-major -> C row-major: aperm reverses the axis order
-  values <- as.double(aperm(src.array, rev(seq_along(rdim))))
-  .Call(mxr_nd_set, handle, values)
+  .Call(mxr_nd_set, handle, .mx.to.c.order(src.array))
   structure(list(handle = handle), class = "MXNDArray")
 }
 
@@ -46,8 +59,7 @@ mx.nd.zeros <- function(shape, ctx = mx.cpu()) {
 as.array.MXNDArray <- function(x, ...) {
   values <- .Call(mxr_nd_get, x$handle)
   cdim <- attr(values, "mx.dim")
-  arr <- array(values, dim = rev(cdim))   # fill column-major = C order rev
-  aperm(arr, rev(seq_along(cdim)))
+  .mx.from.c.order(values, rev(cdim))
 }
 
 dim.MXNDArray <- function(x) rev(.Call(mxr_nd_shape, x$handle))
@@ -170,6 +182,9 @@ mx.symbol.list.operators <- function() .Call(mxr_sym_list_atomic)
 # ---- Executor --------------------------------------------------------------
 
 mx.simple.bind <- function(symbol, ctx = mx.cpu(), grad.req = "write", ...) {
+  if (!grad.req %in% c("write", "null"))
+    stop("mx.simple.bind: unsupported grad.req '", grad.req,
+         "' (this binding supports 'write' and 'null')")
   shapes <- list(...)
   keys <- names(shapes)
   ind <- c(0L)
@@ -185,10 +200,7 @@ mx.simple.bind <- function(symbol, ctx = mx.cpu(), grad.req = "write", ...) {
 }
 
 mx.exec.set.arg <- function(executor, name, values) {
-  if (inherits(values, "MXNDArray")) values <- as.array(values)
-  if (!is.null(dim(values)))
-    values <- aperm(values, rev(seq_along(dim(values))))
-  .Call(mxr_exec_set_arg, executor$handle, name, as.double(values))
+  .Call(mxr_exec_set_arg, executor$handle, name, .mx.to.c.order(values))
   invisible(NULL)
 }
 
@@ -203,17 +215,16 @@ mx.exec.backward <- function(executor) {
 }
 
 mx.exec.get.output <- function(executor, index, shape) {
+  if (index < 1L) stop("mx.exec.get.output: index is 1-based")
   values <- .Call(mxr_exec_get_output, executor$handle,
                   as.integer(index - 1L), as.integer(prod(shape)))
-  arr <- array(values, dim = rev(shape))
-  aperm(arr, rev(seq_along(shape)))
+  .mx.from.c.order(values, shape)
 }
 
 mx.exec.get.grad <- function(executor, name, shape) {
   values <- .Call(mxr_exec_get_grad, executor$handle, name,
                   as.integer(prod(shape)))
-  arr <- array(values, dim = rev(shape))
-  aperm(arr, rev(seq_along(shape)))
+  .mx.from.c.order(values, shape)
 }
 
 # ---- Model -----------------------------------------------------------------
@@ -234,18 +245,14 @@ mx.model.load <- function(prefix, iteration) {
 }
 
 mx.exec.set.aux <- function(executor, name, values) {
-  if (inherits(values, "MXNDArray")) values <- as.array(values)
-  if (!is.null(dim(values)))
-    values <- aperm(values, rev(seq_along(dim(values))))
-  .Call(mxr_exec_set_aux, executor$handle, name, as.double(values))
+  .Call(mxr_exec_set_aux, executor$handle, name, .mx.to.c.order(values))
   invisible(NULL)
 }
 
 mx.exec.get.aux <- function(executor, name, shape) {
   values <- .Call(mxr_exec_get_aux, executor$handle, name,
                   as.integer(prod(shape)))
-  arr <- array(values, dim = rev(shape))
-  aperm(arr, rev(seq_along(shape)))
+  .mx.from.c.order(values, shape)
 }
 
 # Forward inference on a batch (X in R layout: first dim = sample).

@@ -2,7 +2,7 @@
 # the same workflow perl-package/examples/train_step.pl proves in CI.
 #
 # Usage (with R installed and the package built):
-#   make capi && R CMD INSTALL R-package
+#   make predict && R CMD INSTALL R-package
 #   Rscript R-package/demo/train_step.R <prefix> <epoch>
 library(mxnet.tpu)
 

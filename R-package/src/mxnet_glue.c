@@ -22,7 +22,7 @@
 #include <stdlib.h>
 #include <string.h>
 
-#include "../../include/mxnet_tpu/c_api.h"
+#include <mxnet_tpu/c_api.h>   /* via PKG_CPPFLAGS -I$(MXTPU_HOME)/include */
 
 /* ---- helpers ---------------------------------------------------------- */
 
@@ -123,12 +123,31 @@ SEXP mxr_nd_save(SEXP fname, SEXP arrays) {
   SEXP names = Rf_getAttrib(arrays, R_NamesSymbol);
   for (mx_uint i = 0; i < n; ++i) {
     handles[i] = R_ExternalPtrAddr(VECTOR_ELT(arrays, i));
-    keys[i] = (names == R_NilValue) ? ""
-              : CHAR(STRING_ELT(names, i));
+    if (names != R_NilValue) keys[i] = CHAR(STRING_ELT(names, i));
   }
+  /* NULL keys = unnamed container (loads back as a positional list) */
   chk(MXNDArraySave(CHAR(STRING_ELT(fname, 0)), n, handles,
                     (names == R_NilValue) ? NULL : keys));
   return R_NilValue;
+}
+
+/* Loaded arrays are owned collectively by the load record
+ * (MXNDArrayListFree frees record AND handles), so each R wrapper
+ * carries the same token in its 'prot' slot: only when every wrapper
+ * is collected does the token finalizer release the whole list. */
+struct LoadTok {
+  NDArrayHandle *arr;
+  mx_uint size;
+  const char **names;
+};
+
+static void loadlist_finalizer(SEXP ptr) {
+  struct LoadTok *tok = (struct LoadTok *)R_ExternalPtrAddr(ptr);
+  if (tok) {
+    MXNDArrayListFree(tok->arr, tok->size, tok->names);
+    free(tok);
+    R_ClearExternalPtr(ptr);
+  }
 }
 
 /* mxr_nd_load(fname) -> named list of extptr */
@@ -138,15 +157,22 @@ SEXP mxr_nd_load(SEXP fname) {
   const char **names;
   chk(MXNDArrayLoad(CHAR(STRING_ELT(fname, 0)), &size, &arrs,
                     &name_size, &names));
+  struct LoadTok *tok = (struct LoadTok *)malloc(sizeof(struct LoadTok));
+  tok->arr = arrs;
+  tok->size = size;
+  tok->names = names;
+  SEXP token = PROTECT(R_MakeExternalPtr(tok, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(token, loadlist_finalizer, TRUE);
   SEXP out = PROTECT(Rf_allocVector(VECSXP, size));
   for (mx_uint i = 0; i < size; ++i)
-    SET_VECTOR_ELT(out, i, wrap_handle(arrs[i], ndarray_finalizer));
+    /* no per-handle finalizer: the token releases the whole list */
+    SET_VECTOR_ELT(out, i, R_MakeExternalPtr(arrs[i], R_NilValue, token));
   if (name_size == size) {
     SEXP nm = PROTECT(charvec(size, names));
     Rf_setAttrib(out, R_NamesSymbol, nm);
     UNPROTECT(1);
   }
-  UNPROTECT(1);
+  UNPROTECT(2);
   return out;
 }
 

@@ -1,0 +1,24 @@
+function callmxtpu(rc)
+%CALLMXTPU load libmxtpu_predict once; with an argument, check a return
+% code and raise the runtime's last error on failure (the reference's
+% matlab/+mxnet/private/callmxnet.m pattern).
+%
+% Set the environment variable MXTPU_HOME to the repository root if the
+% library is not on the default relative path.
+  if ~libisloaded('libmxtpu_predict')
+    root = getenv('MXTPU_HOME');
+    if isempty(root)
+      here = fileparts(fileparts(mfilename('fullpath')));
+      root = fileparts(here);   % matlab-package/.. = repo root
+    end
+    lib = fullfile(root, 'mxnet_tpu', '_native', 'libmxtpu_predict.so');
+    hdr = fullfile(root, 'include', 'mxnet_tpu', 'c_predict_api.h');
+    assert(exist(lib, 'file') == 2, ...
+           ['libmxtpu_predict.so not found; run `make predict` in ', root]);
+    loadlibrary(lib, hdr);
+  end
+  if nargin > 0
+    assert(rc == 0, ['mxnet_tpu: ', ...
+                     calllib('libmxtpu_predict', 'MXGetLastError')]);
+  end
+end

@@ -1,0 +1,139 @@
+classdef model < handle
+%MODEL mxnet_tpu predictor: load a checkpoint, run forward.
+%
+% Parity target: the reference's matlab/+mxnet/model.m (loadlibrary +
+% calllib over the C predict API). This is a fresh implementation over
+% libmxtpu_predict.so (include/mxnet_tpu/c_predict_api.h): the predictor
+% is created from the symbol JSON plus the raw bytes of the .params
+% file, inputs cross as single() buffers, and MATLAB's column-major
+% layout is handled by reversing the shape at the ABI boundary exactly
+% as the reference documents (matlab/README.md "Note on Implementation").
+%
+%   model = mxnet.model;
+%   model.load('output/lenet', 8);
+%   pred = model.forward(single(img));   % img: W x H x C x N
+
+properties
+  % symbol JSON string
+  symbol
+  % raw bytes of the .params file
+  params
+  % print progress info
+  verbose
+end
+
+properties (Access = private)
+  predictor
+  prev_input_shape
+  prev_dev
+  prev_dev_id
+end
+
+methods
+  function obj = model()
+    obj.predictor = libpointer('voidPtr', 0);
+    obj.prev_input_shape = [];
+    obj.prev_dev = -1;
+    obj.prev_dev_id = -1;
+    obj.verbose = 1;
+  end
+
+  function delete(obj)
+    obj.free_predictor();
+  end
+
+  function load(obj, model_prefix, num_epoch)
+  %LOAD read <prefix>-symbol.json and <prefix>-%04d.params
+    obj.symbol = fileread([model_prefix, '-symbol.json']);
+    param_file = sprintf('%s-%04d.params', model_prefix, num_epoch);
+    fid = fopen(param_file, 'rb');
+    assert(fid >= 0, ['cannot open ', param_file]);
+    obj.params = fread(fid, inf, '*uint8');
+    fclose(fid);
+    obj.free_predictor();
+  end
+
+  function out = forward(obj, input, varargin)
+  %FORWARD run the model on a single input tensor.
+  %
+  % input : numeric array in MATLAB layout (e.g. W x H x C x N for
+  %         images); it is converted to single and its shape reversed
+  %         to the runtime's row-major convention (N x C x H x W).
+  % name/value options:
+  %   'device'  'cpu' (default) or 'tpu'
+  %   'dev_id'  device ordinal, default 0
+    dev_type = 1; dev_id = 0;
+    for i = 1:2:numel(varargin)
+      switch varargin{i}
+        case 'device'
+          if strcmp(varargin{i+1}, 'tpu'), dev_type = 2; end
+        case 'dev_id'
+          dev_id = varargin{i+1};
+      end
+    end
+
+    mxnet.callmxtpu();   % ensure the library is loaded
+
+    siz = size(input);
+    cshape = uint32(fliplr(siz));   % column-major -> row-major
+    if ~isequal(obj.prev_input_shape, cshape) || ...
+        obj.prev_dev ~= dev_type || obj.prev_dev_id ~= dev_id
+      obj.free_predictor();
+      keys = libpointer('stringPtrPtr', {'data'});
+      indptr = uint32([0, numel(cshape)]);
+      pred = libpointer('voidPtr', 0);
+      rc = calllib('libmxtpu_predict', 'MXPredCreate', obj.symbol, ...
+                   obj.params, int32(numel(obj.params)), ...
+                   int32(dev_type), int32(dev_id), uint32(1), keys, ...
+                   indptr, cshape, pred);
+      mxnet.callmxtpu(rc);
+      obj.predictor = pred;
+      obj.prev_input_shape = cshape;
+      obj.prev_dev = dev_type;
+      obj.prev_dev_id = dev_id;
+      if obj.verbose
+        fprintf('created predictor for input %s\n', mat2str(siz));
+      end
+    end
+
+    % MATLAB stores column-major: the linearized buffer of `input` is
+    % already the row-major buffer of the reversed shape
+    data = single(input(:));
+    rc = calllib('libmxtpu_predict', 'MXPredSetInput', obj.predictor, ...
+                 'data', data, uint32(numel(data)));
+    mxnet.callmxtpu(rc);
+    rc = calllib('libmxtpu_predict', 'MXPredForward', obj.predictor);
+    mxnet.callmxtpu(rc);
+
+    shape_data = libpointer('uint32PtrPtr', uint32(0));
+    shape_ndim = libpointer('uint32Ptr', uint32(0));
+    rc = calllib('libmxtpu_predict', 'MXPredGetOutputShape', ...
+                 obj.predictor, uint32(0), shape_data, shape_ndim);
+    mxnet.callmxtpu(rc);
+    ndim = double(shape_ndim.Value);
+    setdatatype(shape_data.Value, 'uint32Ptr', ndim);
+    cdims = double(shape_data.Value(1:ndim));
+    n = prod(cdims);
+
+    buf = libpointer('singlePtr', zeros(n, 1, 'single'));
+    rc = calllib('libmxtpu_predict', 'MXPredGetOutput', obj.predictor, ...
+                 uint32(0), buf, uint32(n));
+    mxnet.callmxtpu(rc);
+    setdatatype(buf, 'singlePtr', n);
+    % reverse back to MATLAB layout (pad to 2 dims: MATLAB's
+    % reshape rejects 1-element size vectors)
+    out = reshape(buf.Value, [fliplr(cdims), ones(1, max(0, 2 - ndim))]);
+  end
+end
+
+methods (Access = private)
+  function free_predictor(obj)
+    if ~isempty(obj.predictor) && obj.predictor.Value ~= 0
+      calllib('libmxtpu_predict', 'MXPredFree', obj.predictor);
+      obj.predictor = libpointer('voidPtr', 0);
+      obj.prev_input_shape = [];
+    end
+  end
+end
+
+end

@@ -1,0 +1,19 @@
+%% mxnet_tpu MATLAB demo (reference matlab/demo.m workflow)
+% Train any model with the Python frontend and save a checkpoint, e.g.:
+%   python examples/image_classification/train_mnist.py \
+%       --network lenet --model-prefix /tmp/lenet --num-epochs 8
+% then run prediction from MATLAB/Octave:
+
+clear model
+model = mxnet.model;
+model.load('/tmp/lenet', 8);
+
+% a batch of 2 random "images": MATLAB layout W x H x C x N
+img = single(rand(28, 28, 1, 2));
+pred = model.forward(img);
+% pred: num_classes x N (reversed row-major output shape)
+[p, label] = max(pred);
+fprintf('predicted classes: %s\n', mat2str(label - 1));
+
+% feature batch on tpu (when the runtime has one):
+% pred = model.forward(img, 'device', 'tpu', 'dev_id', 0);

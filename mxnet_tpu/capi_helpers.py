@@ -122,7 +122,10 @@ def wait_all():
 def ndarray_save(fname, names, arrs):
     from . import ndarray as nd
 
-    nd.save(fname, dict(zip(names, arrs)))
+    if names is None:
+        nd.save(fname, list(arrs))
+    else:
+        nd.save(fname, dict(zip(names, arrs)))
 
 
 def ndarray_load_pairs(fname):

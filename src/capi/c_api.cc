@@ -211,10 +211,19 @@ int MXNDArrayWaitAll(void) {
 int MXNDArraySave(const char *fname, mx_uint num_args, NDArrayHandle *args,
                   const char **keys) {
   GIL gil;
-  PyObject *names = PyList_New(num_args);
+  /* keys == NULL saves an unnamed list (reference MXNDArraySave allows
+   * nameless containers; load returns a positional list) */
+  PyObject *names;
+  if (keys) {
+    names = PyList_New(num_args);
+    for (mx_uint i = 0; i < num_args; ++i)
+      PyList_SET_ITEM(names, i, PyUnicode_FromString(keys[i]));
+  } else {
+    names = Py_None;
+    Py_INCREF(Py_None);
+  }
   PyObject *arrs = PyList_New(num_args);
   for (mx_uint i = 0; i < num_args; ++i) {
-    PyList_SET_ITEM(names, i, PyUnicode_FromString(keys[i]));
     PyObject *a = static_cast<NDArrayRec *>(args[i])->arr;
     Py_INCREF(a);
     PyList_SET_ITEM(arrs, i, a);

@@ -1,0 +1,70 @@
+"""MATLAB frontend validation without a MATLAB runtime (see
+matlab-package/README.md): calllib targets must exist in the predict
+header, the loader paths must be real, and the m-files must be
+structurally sound (balanced blocks, methods declared)."""
+import os
+import re
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+MPKG = os.path.join(REPO, "matlab-package")
+
+
+def _m_sources():
+    out = {}
+    for root, _, files in os.walk(MPKG):
+        for f in files:
+            if f.endswith(".m"):
+                path = os.path.join(root, f)
+                out[os.path.relpath(path, MPKG)] = open(path).read()
+    return out
+
+
+def test_calllib_targets_exist_in_header():
+    header = open(os.path.join(
+        REPO, "include", "mxnet_tpu", "c_predict_api.h")).read()
+    declared = set(re.findall(r"^(?:int|const char \*)\s*(MX\w+)\(",
+                              header, re.M))
+    srcs = _m_sources()
+    called = set()
+    for src in srcs.values():
+        called |= set(re.findall(
+            r"calllib\('libmxtpu_predict',\s*'(\w+)'", src))
+    assert called, "no calllib sites found"
+    missing = called - declared
+    assert not missing, "calllib of undeclared functions: %s" % missing
+
+
+def test_library_and_header_paths_referenced_correctly():
+    src = _m_sources()["+mxnet/callmxtpu.m"]
+    assert "libmxtpu_predict.so" in src
+    assert "c_predict_api.h" in src
+    # the referenced header really exists at the path the loader builds
+    assert os.path.exists(os.path.join(
+        REPO, "include", "mxnet_tpu", "c_predict_api.h"))
+
+
+def test_m_files_structurally_balanced():
+    """Every function/classdef/if/for/switch opens a block closed by
+    `end`; counting both gives a cheap structural syntax gate."""
+    openers = re.compile(
+        r"^\s*(classdef|function|if|for|while|switch|methods|properties)\b")
+    for name, src in _m_sources().items():
+        opens = ends = 0
+        for line in src.splitlines():
+            stripped = line.split("%", 1)[0]
+            if openers.match(stripped):
+                opens += 1
+            ends += len(re.findall(r"\bend\b", stripped))
+        assert opens == ends, (
+            "%s: %d block openers vs %d end keywords" % (name, opens, ends))
+
+
+def test_model_class_covers_reference_surface():
+    """The reference model.m exposes load/forward with predictor
+    caching; ours must too."""
+    src = _m_sources()["+mxnet/model.m"]
+    for method in ("function load(", "function out = forward(",
+                   "function free_predictor(", "MXPredCreate",
+                   "MXPredSetInput", "MXPredForward", "MXPredGetOutput",
+                   "MXPredFree"):
+        assert method in src, "missing: %s" % method

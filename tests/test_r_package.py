@@ -72,7 +72,8 @@ def test_glue_compiles_against_real_c_api():
             f.write('#include "Rinternals.h"\n')
         out = subprocess.run(
             ["gcc", "-fsyntax-only", "-Wall", "-Werror",
-             "-Wno-unused-variable", "-I", tmp, "-I", REPO,
+             "-Wno-unused-variable", "-I", tmp,
+             "-I", os.path.join(REPO, "include"),
              os.path.join(RPKG, "src", "mxnet_glue.c")],
             capture_output=True, text=True)
         assert out.returncode == 0, out.stderr
