@@ -1,7 +1,18 @@
 """Plugin bridges (reference ``plugin/``: torch, caffe, warpctc, ...).
 
-Available here: the torch bridge (``plugin/torch`` modernized to PyTorch).
-The caffe/warpctc/sframe plugins have no usable host libraries in this
-environment and are intentionally absent.
+- torch bridge (``plugin/torch`` modernized to PyTorch; imported lazily
+  so the heavy torch import is only paid when used)
+- caffe bridge (``plugin/caffe``'s CaffeOp/CaffeLoss over a jnp layer
+  emulation registry; registered eagerly so ``sym.CaffeOp`` exists)
+- warpctc is a first-class op (``mxnet_tpu/ops/ctc.py``), not a plugin —
+  the TPU runtime needs no external CTC library.
+- sframe has no usable host library in this environment.
 """
-from . import torch_bridge  # noqa: F401
+from . import caffe_op  # noqa: F401
+
+
+def __getattr__(name):
+    if name == "torch_bridge":
+        from . import torch_bridge
+        return torch_bridge
+    raise AttributeError(name)

@@ -1,0 +1,471 @@
+"""Caffe layer bridge: ``sym.CaffeOp`` / ``sym.CaffeLoss``.
+
+Parity target: the reference's caffe plugin
+(``/root/reference/plugin/caffe/caffe_op-inl.h`` ``CaffeOpParam``:
+``prototxt``/``num_data``/``num_weight``/``num_out``; ``caffe_loss-inl.h``
+``grad_scale``), which embedded real caffe layers into the symbolic graph
+so users could write
+``sym.CaffeOp(data_0=x, num_weight=2, prototxt='layer{type:"InnerProduct"
+inner_product_param{num_output: 128}}')``.
+
+TPU-native re-design: linking libcaffe (CPU-only, CUDA-era) into an XLA
+graph would break tracing, so the plugin ships a **layer emulation
+registry** — jnp implementations of the caffe layer zoo with caffe's
+exact parameter names, weight layouts and defaults, selected by parsing
+the same prototxt strings. User code written against the reference
+plugin runs unchanged; custom layers register via
+:func:`register_caffe_layer`. When a real pycaffe is importable it can
+be bridged per-layer through ``mxnet_tpu.operator.CustomOp`` (host
+callback), but none of the built-in emulations need it.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ops.registry import Operator, Param, register_op
+
+__all__ = ["parse_prototxt", "register_caffe_layer", "CAFFE_LAYERS"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _jax():
+    import jax
+    return jax
+
+
+# ---------------------------------------------------------------------------
+# prototxt mini-parser: 'layer{type:"TanH" param{k: v}}' -> nested dict
+# (the reference parsed this with caffe's protobuf TextFormat;
+# caffe_fieldentry.h shows the same string-typed field contract)
+# ---------------------------------------------------------------------------
+_TOKEN = re.compile(
+    r'[A-Za-z_][A-Za-z0-9_]*|"[^"]*"'
+    r'|-?(?:\d+\.?\d*|\.\d+)(?:[eE][-+]?\d+)?|[{}:]|\S')
+
+
+def parse_prototxt(text: str) -> Dict:
+    # strip '#' comments first (standard in .prototxt files)
+    text = re.sub(r"#[^\n]*", "", text)
+    tokens = _TOKEN.findall(text)
+    unknown = [t for t in tokens if len(t) == 1 and t not in "{}:"
+               and not t.isalnum()]
+    if unknown:
+        raise MXNetError("prototxt: unexpected characters %r"
+                         % sorted(set(unknown)))
+    pos = [0]
+
+    def parse_block():
+        out: Dict = {}
+        while pos[0] < len(tokens):
+            tok = tokens[pos[0]]
+            if tok == "}":
+                pos[0] += 1
+                return out
+            key = tok
+            pos[0] += 1
+            if pos[0] < len(tokens) and tokens[pos[0]] == ":":
+                pos[0] += 1
+                val = tokens[pos[0]]
+                pos[0] += 1
+                if val.startswith('"'):
+                    parsed = val[1:-1]
+                else:
+                    try:
+                        parsed = int(val)
+                    except ValueError:
+                        try:
+                            parsed = float(val)
+                        except ValueError:
+                            parsed = val  # bare enum like MAX / AVE
+                _store(out, key, parsed)
+            elif pos[0] < len(tokens) and tokens[pos[0]] == "{":
+                pos[0] += 1
+                _store(out, key, parse_block())
+            else:
+                raise MXNetError("prototxt parse error near %r" % key)
+        return out
+
+    def _store(d, k, v):
+        if k in d:
+            if not isinstance(d[k], list):
+                d[k] = [d[k]]
+            d[k].append(v)
+        else:
+            d[k] = v
+
+    root = parse_block()
+    return root.get("layer", root)
+
+
+# ---------------------------------------------------------------------------
+# layer emulation registry
+# ---------------------------------------------------------------------------
+CAFFE_LAYERS: Dict[str, "CaffeLayer"] = {}
+
+
+def register_caffe_layer(type_name: str):
+    def _do(cls):
+        CAFFE_LAYERS[type_name] = cls()
+        return cls
+    return _do
+
+
+class CaffeLayer:
+    """One caffe layer type: weight shapes + forward in jnp. Weight
+    layouts follow caffe (InnerProduct W is (num_output, dim) etc.) so
+    converted caffemodels drop in."""
+
+    def weight_shapes(self, cfg, in_shapes) -> List:
+        return []
+
+    def infer(self, cfg, in_shapes) -> List:
+        return [in_shapes[0]]
+
+    def forward(self, cfg, inputs, weights, is_train, rng):
+        raise NotImplementedError
+
+
+@register_caffe_layer("InnerProduct")
+class _InnerProduct(CaffeLayer):
+    def _dim(self, in_shape):
+        return int(np.prod(in_shape[1:]))
+
+    def weight_shapes(self, cfg, in_shapes):
+        p = cfg.get("inner_product_param", {})
+        n = int(p.get("num_output"))
+        shapes = [(n, self._dim(in_shapes[0]))]
+        if p.get("bias_term", True):
+            shapes.append((n,))
+        return shapes
+
+    def infer(self, cfg, in_shapes):
+        n = int(cfg.get("inner_product_param", {}).get("num_output"))
+        return [(in_shapes[0][0], n)]
+
+    def forward(self, cfg, inputs, weights, is_train, rng):
+        x = inputs[0].reshape(inputs[0].shape[0], -1)
+        out = x @ weights[0].T
+        if len(weights) > 1:
+            out = out + weights[1]
+        return [out]
+
+
+class _Elementwise(CaffeLayer):
+    fn = None
+
+    def forward(self, cfg, inputs, weights, is_train, rng):
+        return [type(self).fn(inputs[0])]
+
+
+@register_caffe_layer("TanH")
+class _TanH(_Elementwise):
+    fn = staticmethod(lambda x: _jnp().tanh(x))
+
+
+@register_caffe_layer("Sigmoid")
+class _Sigmoid(_Elementwise):
+    fn = staticmethod(lambda x: _jax().nn.sigmoid(x))
+
+
+@register_caffe_layer("ReLU")
+class _ReLU(_Elementwise):
+    fn = staticmethod(lambda x: _jnp().maximum(x, 0))
+
+
+@register_caffe_layer("AbsVal")
+class _AbsVal(_Elementwise):
+    fn = staticmethod(lambda x: _jnp().abs(x))
+
+
+@register_caffe_layer("Softmax")
+class _Softmax(CaffeLayer):
+    def forward(self, cfg, inputs, weights, is_train, rng):
+        return [_jax().nn.softmax(inputs[0], axis=1)]
+
+
+@register_caffe_layer("Dropout")
+class _Dropout(CaffeLayer):
+    def forward(self, cfg, inputs, weights, is_train, rng):
+        ratio = float(cfg.get("dropout_param", {})
+                      .get("dropout_ratio", 0.5))
+        if not is_train or ratio <= 0 or rng is None:
+            return [inputs[0]]
+        jax = _jax()
+        keep = 1.0 - ratio
+        mask = jax.random.bernoulli(rng, keep, inputs[0].shape)
+        return [_jnp().where(mask, inputs[0] / keep, 0)]
+
+
+def _pair(p, key, default=0):
+    v = p.get(key, p.get(key + "_h", default))
+    return int(v)
+
+
+@register_caffe_layer("Pooling")
+class _Pooling(CaffeLayer):
+    def _params(self, cfg):
+        p = cfg.get("pooling_param", {})
+        k = _pair(p, "kernel_size", 2)
+        s = _pair(p, "stride", 1)
+        pad = _pair(p, "pad", 0)
+        mode = str(p.get("pool", "MAX")).upper()
+        return k, s, pad, mode
+
+    @staticmethod
+    def _pooled(dim, k, s, pad):
+        """caffe pooling_layer.cpp: ceil-mode dims, then clip any window
+        that would start entirely inside the padding."""
+        out = int(np.ceil((dim + 2 * pad - k) / float(s))) + 1
+        if pad > 0 and (out - 1) * s >= dim + pad:
+            out -= 1
+        return out
+
+    def infer(self, cfg, in_shapes):
+        k, s, pad, _ = self._params(cfg)
+        n, c, h, w = in_shapes[0]
+        return [(n, c, self._pooled(h, k, s, pad),
+                 self._pooled(w, k, s, pad))]
+
+    def forward(self, cfg, inputs, weights, is_train, rng):
+        jnp = _jnp()
+        lax = _jax().lax
+        k, s, pad, mode = self._params(cfg)
+        x = inputs[0]
+        n, c, h, w = x.shape
+        oh = self._pooled(h, k, s, pad)
+        ow = self._pooled(w, k, s, pad)
+        # pad so every (possibly partial) window fits; padding is -inf
+        # for MAX (never wins: the clip rule guarantees a real cell in
+        # each window) and 0 for AVE (doesn't perturb the sum)
+        eh = max(pad, (oh - 1) * s + k - h - pad)
+        ew = max(pad, (ow - 1) * s + k - w - pad)
+        if mode == "AVE":
+            init, op, fill = 0.0, lax.add, 0.0
+        else:
+            init, op, fill = -jnp.inf, lax.max, -jnp.inf
+        xp = jnp.pad(x, ((0, 0), (0, 0), (pad, eh), (pad, ew)),
+                     constant_values=fill)
+        out = lax.reduce_window(xp, init, op, (1, 1, k, k), (1, 1, s, s),
+                                "valid")
+        if mode == "AVE":
+            # caffe divides by the window area clipped to the padded
+            # image extent [0, dim + 2*pad) capped at dim + pad on the
+            # far side (pool_size in pooling_layer.cpp)
+            area_h = np.minimum(np.arange(oh) * s + k, h + 2 * pad) \
+                - np.arange(oh) * s
+            area_w = np.minimum(np.arange(ow) * s + k, w + 2 * pad) \
+                - np.arange(ow) * s
+            area = jnp.asarray(np.outer(area_h, area_w),
+                               dtype=out.dtype)
+            out = out / area[None, None]
+        return [out]
+
+
+@register_caffe_layer("Convolution")
+class _Convolution(CaffeLayer):
+    def _params(self, cfg):
+        p = cfg.get("convolution_param", {})
+        return (int(p.get("num_output")), _pair(p, "kernel_size", 1),
+                _pair(p, "stride", 1), _pair(p, "pad", 0),
+                int(p.get("group", 1)), p.get("bias_term", True))
+
+    def weight_shapes(self, cfg, in_shapes):
+        n_out, k, _, _, group, bias = self._params(cfg)
+        c = in_shapes[0][1]
+        shapes = [(n_out, c // group, k, k)]
+        if bias:
+            shapes.append((n_out,))
+        return shapes
+
+    def infer(self, cfg, in_shapes):
+        n_out, k, s, pad, _, _ = self._params(cfg)
+        n, c, h, w = in_shapes[0]
+        oh = (h + 2 * pad - k) // s + 1
+        ow = (w + 2 * pad - k) // s + 1
+        return [(n, n_out, oh, ow)]
+
+    def forward(self, cfg, inputs, weights, is_train, rng):
+        lax = _jax().lax
+        _, k, s, pad, group, bias = self._params(cfg)
+        out = lax.conv_general_dilated(
+            inputs[0], weights[0], window_strides=(s, s),
+            padding=[(pad, pad), (pad, pad)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=group)
+        if bias and len(weights) > 1:
+            out = out + weights[1].reshape(1, -1, 1, 1)
+        return [out]
+
+
+@register_caffe_layer("EuclideanLoss")
+class _EuclideanLoss(CaffeLayer):
+    def infer(self, cfg, in_shapes):
+        return [(1,)]
+
+    def forward(self, cfg, inputs, weights, is_train, rng):
+        jnp = _jnp()
+        d = inputs[0] - inputs[1].reshape(inputs[0].shape)
+        return [jnp.sum(d * d)[None] / (2.0 * inputs[0].shape[0])]
+
+
+@register_caffe_layer("SoftmaxWithLoss")
+class _SoftmaxWithLoss(CaffeLayer):
+    def infer(self, cfg, in_shapes):
+        return [(1,)]
+
+    def forward(self, cfg, inputs, weights, is_train, rng):
+        jax = _jax()
+        jnp = _jnp()
+        lp = jax.nn.log_softmax(inputs[0], axis=1)
+        labels = inputs[1].astype(jnp.int32).reshape(-1)
+        n = inputs[0].shape[0]
+        picked = lp[jnp.arange(n), labels]
+        return [-picked.sum()[None] / n]
+
+
+# ---------------------------------------------------------------------------
+# the symbolic operators
+# ---------------------------------------------------------------------------
+def _single_layer_cfg(prototxt: str) -> Dict:
+    cfg = parse_prototxt(prototxt)
+    if isinstance(cfg, list):
+        raise MXNetError(
+            "CaffeOp/CaffeLoss take exactly ONE layer{...} block per node "
+            "(got %d); split the net into one CaffeOp per layer like the "
+            "reference plugin" % len(cfg))
+    return cfg
+
+
+def _layer(cfg):
+    ltype = cfg.get("type")
+    layer = CAFFE_LAYERS.get(ltype)
+    if layer is None:
+        raise MXNetError(
+            "CaffeOp: no emulation for layer type %r (known: %s); register "
+            "one with mxnet_tpu.plugins.caffe_op.register_caffe_layer"
+            % (ltype, sorted(CAFFE_LAYERS)))
+    return layer
+
+
+@register_op("CaffeOp")
+class CaffeOp(Operator):
+    """reference plugin/caffe/caffe_op-inl.h: run a caffe layer as a
+    symbol node. Inputs data_0..data_{num_data-1}, then num_weight
+    trainable blobs in caffe layout."""
+
+    name_hint = "caffeop"
+    PARAMS = {
+        "prototxt": Param(str, "layer{}"),
+        "num_data": Param(int, 1),
+        "num_weight": Param(int, 0),
+        "num_out": Param(int, 1),
+    }
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._cfg = _single_layer_cfg(self.prototxt)
+
+    def list_arguments(self):
+        # reference naming (caffe_op-inl.h:222-231): data_i, then
+        # "0_weight" and "i_bias" for the remaining blobs — which also
+        # routes them to the right Initializer rules
+        args = ["data_%d" % i for i in range(self.num_data)]
+        for i in range(self.num_weight):
+            args.append("%d_weight" % i if i == 0 else "%d_bias" % i)
+        return args
+
+    def list_outputs(self):
+        return ["output"] if self.num_out == 1 \
+            else ["output%d" % i for i in range(self.num_out)]
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[:self.num_data]
+        if any(s is None for s in data):
+            raise MXNetError("CaffeOp: data shape unknown")
+        layer = _layer(self._cfg)
+        wshapes = layer.weight_shapes(self._cfg, data)
+        if len(wshapes) != self.num_weight:
+            raise MXNetError(
+                "CaffeOp: layer %s has %d weight blobs, num_weight=%d"
+                % (self._cfg.get("type"), len(wshapes), self.num_weight))
+        out = layer.infer(self._cfg, data)
+        if len(out) != self.num_out:
+            raise MXNetError("CaffeOp: layer produces %d outputs, "
+                             "num_out=%d" % (len(out), self.num_out))
+        return list(data) + wshapes, out, []
+
+    def apply(self, ctx, inputs, aux):
+        layer = _layer(self._cfg)
+        data = list(inputs[:self.num_data])
+        weights = list(inputs[self.num_data:])
+        return layer.forward(self._cfg, data, weights, ctx.is_train,
+                             ctx.rng), []
+
+
+@register_op("CaffeLoss")
+class CaffeLoss(Operator):
+    """reference plugin/caffe/caffe_loss-inl.h: a caffe loss layer;
+    backward seeds the loss top-diff with grad_scale (ibid.:153)."""
+
+    name_hint = "caffeloss"
+    PARAMS = {
+        "prototxt": Param(str, "layer{}"),
+        "num_data": Param(int, 2),
+        "num_out": Param(int, 1),
+        "grad_scale": Param(float, 1.0),
+    }
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._cfg = _single_layer_cfg(self.prototxt)
+        if self.num_data != 2 or self.num_out != 1:
+            raise MXNetError(
+                "CaffeLoss: this bridge supports num_data=2 (data, label) "
+                "and num_out=1; got num_data=%d num_out=%d"
+                % (self.num_data, self.num_out))
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("CaffeLoss: data shape unknown")
+        label = in_shapes[1] or (data[0],)
+        layer = _layer(self._cfg)
+        out = layer.infer(self._cfg, [data, label])
+        return [data, label], out, []
+
+    def apply(self, ctx, inputs, aux):
+        jax = _jax()
+        layer = _layer(self._cfg)
+        cfg = self._cfg
+        scale = self.grad_scale
+
+        @jax.custom_vjp
+        def f(data, label):
+            return layer.forward(cfg, [data, label], [], ctx.is_train,
+                                 ctx.rng)[0]
+
+        def f_fwd(data, label):
+            return f(data, label), (data, label)
+
+        def f_bwd(res, g):
+            data, label = res
+            # reference CaffeLoss: top diff is grad_scale, head grads
+            # ignored (caffe_loss-inl.h:153)
+            grad = jax.grad(
+                lambda d: layer.forward(cfg, [d, label], [], True,
+                                        None)[0].sum())(data)
+            return grad * scale, _jnp().zeros_like(label)
+
+        f.defvjp(f_fwd, f_bwd)
+        return [f(inputs[0], inputs[1])], []

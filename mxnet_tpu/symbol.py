@@ -246,6 +246,7 @@ class Symbol:
         # fixpoint forward propagation with write-back into variables
         # (reference StaticGraph::InferNodeShapes iterates to fixpoint,
         # static_graph.cc:59)
+        last_err: Optional[MXNetError] = None
         for _ in range(3):
             changed = False
             for node in nodes:
@@ -254,7 +255,10 @@ class Symbol:
                 in_shapes = [shapes[src.uid][i] for src, i in node.inputs]
                 try:
                     in_filled, out_filled, aux = node.op.infer_shape(in_shapes)
-                except MXNetError:
+                except MXNetError as e:
+                    # may just mean "inputs not known yet" mid-fixpoint;
+                    # keep the message for the final diagnostic
+                    last_err = e
                     continue
                 for (src, i), s in zip(node.inputs, in_filled):
                     if s is not None and shapes[src.uid][i] != tuple(s):
@@ -285,7 +289,10 @@ class Symbol:
                 raise MXNetError("infer_shape incomplete; unknown args: %s"
                                  % missing)
             if any(s is None for s in out_shapes):
-                raise MXNetError("infer_shape could not infer outputs")
+                raise MXNetError(
+                    "infer_shape could not infer outputs%s"
+                    % (" (last node error: %s)" % last_err
+                       if last_err is not None else ""))
         return arg_shapes, out_shapes, aux_list
 
     def infer_type(self, *args, **kwargs):

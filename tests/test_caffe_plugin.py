@@ -1,0 +1,201 @@
+"""Caffe plugin bridge (reference plugin/caffe): CaffeOp/CaffeLoss with
+the reference's prototxt-driven parameterization, emulated layer zoo
+validated against numpy closed forms and trained end-to-end."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.plugins.caffe_op import parse_prototxt
+
+
+def test_parse_prototxt():
+    cfg = parse_prototxt(
+        'layer{type:"InnerProduct" inner_product_param{num_output: 128} }')
+    assert cfg["type"] == "InnerProduct"
+    assert cfg["inner_product_param"]["num_output"] == 128
+    cfg = parse_prototxt('layer{type:"Pooling" pooling_param{pool: MAX '
+                         'kernel_size: 2 stride: 2}}')
+    assert cfg["pooling_param"]["pool"] == "MAX"
+    cfg = parse_prototxt('layer{type:"Dropout" '
+                         'dropout_param{dropout_ratio: 0.25}}')
+    assert cfg["dropout_param"]["dropout_ratio"] == 0.25
+
+
+def test_caffe_innerproduct_matches_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 6).astype(np.float32)
+    w = rng.randn(3, 6).astype(np.float32)   # caffe layout (out, in)
+    b = rng.randn(3).astype(np.float32)
+    s = sym.CaffeOp(data_0=sym.Variable("data_0"), num_weight=2, name="ip",
+                    prototxt='layer{type:"InnerProduct" '
+                             'inner_product_param{num_output: 3}}')
+    arg_shapes, out_shapes, _ = s.infer_shape(data_0=(4, 6))
+    assert out_shapes[0] == (4, 3)
+    assert arg_shapes[1] == (3, 6) and arg_shapes[2] == (3,)
+    args = {"data_0": mx.nd.array(x), "ip_0_weight": mx.nd.array(w),
+            "ip_1_bias": mx.nd.array(b)}
+    ex = s.bind(mx.cpu(), args, grad_req="null")
+    ex.forward(is_train=False)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), x @ w.T + b,
+                               rtol=1e-5)
+
+
+def test_caffe_activations_and_softmax():
+    rng = np.random.RandomState(1)
+    x = rng.randn(3, 5).astype(np.float32)
+    for ltype, fn in [("TanH", np.tanh),
+                      ("ReLU", lambda v: np.maximum(v, 0)),
+                      ("Sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+                      ("AbsVal", np.abs)]:
+        s = sym.CaffeOp(data_0=sym.Variable("data_0"),
+                        prototxt='layer{type:"%s"}' % ltype)
+        ex = s.bind(mx.cpu(), {"data_0": mx.nd.array(x)}, grad_req="null")
+        ex.forward(is_train=False)
+        np.testing.assert_allclose(ex.outputs[0].asnumpy(), fn(x),
+                                   rtol=1e-5, err_msg=ltype)
+    s = sym.CaffeOp(data_0=sym.Variable("data_0"),
+                    prototxt='layer{type:"Softmax"}')
+    ex = s.bind(mx.cpu(), {"data_0": mx.nd.array(x)}, grad_req="null")
+    ex.forward(is_train=False)
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(),
+                               e / e.sum(axis=1, keepdims=True), rtol=1e-5)
+
+
+def test_caffe_pooling_and_convolution():
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    s = sym.CaffeOp(data_0=sym.Variable("data_0"),
+                    prototxt='layer{type:"Pooling" pooling_param{'
+                             'pool: MAX kernel_size: 2 stride: 2}}')
+    _, out_shapes, _ = s.infer_shape(data_0=(1, 2, 6, 6))
+    assert out_shapes[0] == (1, 2, 3, 3)
+    ex = s.bind(mx.cpu(), {"data_0": mx.nd.array(x)}, grad_req="null")
+    ex.forward(is_train=False)
+    expected = x.reshape(1, 2, 3, 2, 3, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), expected, rtol=1e-6)
+
+    w = rng.randn(4, 2, 3, 3).astype(np.float32)
+    s = sym.CaffeOp(data_0=sym.Variable("data_0"), num_weight=2, name="cv",
+                    prototxt='layer{type:"Convolution" convolution_param{'
+                             'num_output: 4 kernel_size: 3 pad: 1}}')
+    arg_shapes, out_shapes, _ = s.infer_shape(data_0=(1, 2, 6, 6))
+    assert arg_shapes[1] == (4, 2, 3, 3)
+    assert out_shapes[0] == (1, 4, 6, 6)
+    # cross-check against the native Convolution op
+    ref = sym.Convolution(data=sym.Variable("data"), kernel=(3, 3),
+                          num_filter=4, pad=(1, 1), no_bias=True,
+                          name="ref")
+    ex_ref = ref.bind(mx.cpu(), {"data": mx.nd.array(x),
+                                 "ref_weight": mx.nd.array(w)},
+                      grad_req="null")
+    ex_ref.forward(is_train=False)
+    b = np.zeros(4, np.float32)
+    ex = s.bind(mx.cpu(), {"data_0": mx.nd.array(x),
+                           "cv_0_weight": mx.nd.array(w),
+                           "cv_1_bias": mx.nd.array(b)}, grad_req="null")
+    ex.forward(is_train=False)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(),
+                               ex_ref.outputs[0].asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_caffe_loss_gradient():
+    """CaffeLoss(SoftmaxWithLoss): loss value and grad_scale-seeded
+    gradient (reference caffe_loss-inl.h:153)."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(6, 4).astype(np.float32)
+    label = rng.randint(0, 4, 6).astype(np.float32)
+    s = sym.CaffeLoss(data=sym.Variable("data"), label=sym.Variable("label"),
+                      grad_scale=2.0,
+                      prototxt='layer{type:"SoftmaxWithLoss"}')
+    args = {"data": mx.nd.array(x), "label": mx.nd.array(label)}
+    grads = {"data": mx.nd.zeros((6, 4))}
+    ex = s.bind(mx.cpu(), args, args_grad=grads,
+                grad_req={"data": "write", "label": "null"})
+    ex.forward(is_train=True)
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    p = e / e.sum(axis=1, keepdims=True)
+    expected_loss = -np.log(p[np.arange(6), label.astype(int)]).mean()
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), [expected_loss],
+                               rtol=1e-5)
+    ex.backward()
+    onehot = np.eye(4)[label.astype(int)]
+    np.testing.assert_allclose(grads["data"].asnumpy(),
+                               2.0 * (p - onehot) / 6, rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_caffe_mlp_trains():
+    """The README's caffe_net.py MLP: CaffeOp InnerProduct + TanH stack
+    with SoftmaxOutput learns a separable task."""
+    rng = np.random.RandomState(4)
+    n = 200
+    y = rng.randint(0, 2, n).astype(np.float32)
+    X = (rng.randn(n, 8).astype(np.float32) * 0.5 + y[:, None])
+
+    data = sym.Variable("data")
+    fc1 = sym.CaffeOp(data_0=data, num_weight=2, name="fc1",
+                      prototxt='layer{type:"InnerProduct" '
+                               'inner_product_param{num_output: 16}}')
+    act1 = sym.CaffeOp(data_0=fc1, prototxt='layer{type:"TanH"}')
+    fc2 = sym.CaffeOp(data_0=act1, num_weight=2, name="fc2",
+                      prototxt='layer{type:"InnerProduct" '
+                               'inner_product_param{num_output: 2}}')
+    net = sym.SoftmaxOutput(data=fc2, name="softmax")
+
+    mod = mx.mod.Module(net, label_names=["softmax_label"])
+    it = mx.io.NDArrayIter(X, y, batch_size=20, shuffle=False,
+                           label_name="softmax_label")
+    mod.fit(it, num_epoch=10, optimizer_params={"learning_rate": 0.2})
+    score = dict(mod.score(mx.io.NDArrayIter(X, y, batch_size=20,
+                                             label_name="softmax_label"),
+                           "acc"))
+    assert score["accuracy"] > 0.95, score
+
+
+def test_caffe_pooling_pad_clip():
+    """caffe's pad-clip rule: (pooled-1)*stride >= dim+pad drops the
+    window that would start entirely inside padding."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(1, 1, 5, 5).astype(np.float32)
+    s = sym.CaffeOp(data_0=sym.Variable("data_0"),
+                    prototxt='layer{type:"Pooling" pooling_param{'
+                             'pool: MAX kernel_size: 2 stride: 2 pad: 1}}')
+    _, out_shapes, _ = s.infer_shape(data_0=(1, 1, 5, 5))
+    assert out_shapes[0] == (1, 1, 3, 3)        # caffe clips 4 -> 3
+    ex = s.bind(mx.cpu(), {"data_0": mx.nd.array(x)}, grad_req="null")
+    ex.forward(is_train=False)
+    out = ex.outputs[0].asnumpy()
+    assert np.isfinite(out).all()               # no -inf rows
+    # AVE divides edge windows by the caffe (padded-extent) area
+    s = sym.CaffeOp(data_0=sym.Variable("data_0"),
+                    prototxt='layer{type:"Pooling" pooling_param{'
+                             'pool: AVE kernel_size: 3 stride: 2}}')
+    ex = s.bind(mx.cpu(), {"data_0": mx.nd.array(x)}, grad_req="null")
+    ex.forward(is_train=False)
+    out = ex.outputs[0].asnumpy()
+    # output 2x2? h=5,k=3,s=2,pad=0 -> ceil((5-3)/2)+1 = 2 ... exact grid
+    np.testing.assert_allclose(out[0, 0, 0, 0], x[0, 0, :3, :3].mean(),
+                               rtol=1e-5)
+
+
+def test_caffe_prototxt_comments_and_floats():
+    from mxnet_tpu.plugins.caffe_op import parse_prototxt
+    cfg = parse_prototxt('layer{type:"Dropout" # from caffenet\n'
+                         'dropout_param{dropout_ratio: .5}}')
+    assert cfg["dropout_param"]["dropout_ratio"] == 0.5
+
+
+def test_caffe_multi_layer_prototxt_rejected():
+    with pytest.raises(mx.base.MXNetError, match="ONE layer"):
+        sym.CaffeOp(data_0=sym.Variable("d"),
+                    prototxt='layer{type:"TanH"} layer{type:"ReLU"}')
+
+
+def test_caffe_unknown_layer_errors():
+    s = sym.CaffeOp(data_0=sym.Variable("d"),
+                    prototxt='layer{type:"FancyNewLayer"}')
+    with pytest.raises(mx.base.MXNetError, match="no emulation"):
+        s.infer_shape(d=(2, 3))
