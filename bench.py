@@ -2,17 +2,28 @@
 
 Prints ONE JSON line:
   {"metric": "resnet50_train_imgs_per_sec", "value": N, "unit": "img/s",
-   "vs_baseline": N}
+   "vs_baseline": N, "step_time_ms": N, "tflops_model": N,
+   "tflops_xla": N, "mfu_pct": N, "chip": "...", "compute_dtype": "..."}
 
 Baseline: the reference publishes no in-tree ResNet-50 number
 (BASELINE.md); the closest per-GPU proxy is ImageNet Inception-BN on
 Titan X, batch 128: 1,281,167 img / 10,666 s ~= 120 img/s/GPU
 (example/image-classification/README.md:245-253). vs_baseline =
 ours / 120.
+
+MFU accounting: tflops_model uses the standard analytic cost (ResNet-50
+forward ~= 4.1 GFLOPs/img at 224x224, training ~= 3x forward), the
+convention of the "How to Scale Your Model" MFU definition; tflops_xla
+uses XLA's own cost analysis of the compiled step (counts every HLO
+flop, so it runs higher). mfu_pct = tflops_model / chip bf16 peak.
+
+Set MXNET_TPU_BENCH_TRACE=<dir> to capture a jax profiler trace of the
+timed steps (one trace per round for the perf log).
 """
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -20,9 +31,37 @@ import numpy as np
 
 BASELINE_IMGS_PER_SEC = 120.0  # reference TitanX per-GPU Inception-BN proxy
 
+# analytic training cost per image: 4.089 GFLOPs fwd (He et al. tables,
+# 224x224) x3 for fwd+bwd
+RESNET50_TRAIN_GFLOPS_PER_IMG = 4.089 * 3
+
+# bf16 peak TFLOP/s by device kind substring
+CHIP_PEAK_TFLOPS = {
+    "v5 lite": 197.0,   # v5e
+    "v5litepod": 197.0,
+    "v5p": 459.0,
+    "v4": 275.0,
+    "v6 lite": 918.0,   # v6e / Trillium
+    "v6e": 918.0,
+    "v3": 123.0,
+    "v2": 45.0,
+}
+
+
+def _chip_peak(device_kind: str):
+    kind = device_kind.lower()
+    for key, peak in CHIP_PEAK_TFLOPS.items():
+        if key in kind:
+            return peak
+    return None
+
 
 def main():
     import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        # the axon site hook overrides the env at import; re-apply it so
+        # JAX_PLATFORMS=cpu smoke runs work off-TPU
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     import mxnet_tpu as mx
     from mxnet_tpu import models
@@ -33,7 +72,7 @@ def main():
     batch = 256 if on_accel else 8
     image = 224 if on_accel else 32
     num_classes = 1000 if on_accel else 16
-    steps = 10 if on_accel else 2
+    steps = 20 if on_accel else 2
 
     net = models.get_resnet50(num_classes=num_classes,
                               small_input=not on_accel)
@@ -64,8 +103,6 @@ def main():
 
     # bf16 activations/matmuls with f32 master weights — the idiomatic
     # TPU precision (MXU native); override with MXNET_TPU_BENCH_DTYPE
-    import os
-
     import jax.numpy as jnp
     dtype_name = os.environ.get("MXNET_TPU_BENCH_DTYPE",
                                 "bfloat16" if on_accel else "float32")
@@ -76,6 +113,17 @@ def main():
     # donate params/aux so XLA reuses their HBM buffers across steps
     jit_step = jax.jit(step, donate_argnums=(0, 2))
     key = jax.random.PRNGKey(0)
+
+    # XLA's own flop count of the compiled whole-graph train step
+    xla_flops = 0.0
+    try:
+        cost = jit_step.lower(params, data, aux, key).compile() \
+            .cost_analysis()
+        if isinstance(cost, (list, tuple)):   # older jax: one per device
+            cost = cost[0] if cost else {}
+        xla_flops = float((cost or {}).get("flops", 0.0))
+    except Exception:
+        pass
 
     def _force(tree):
         # fetch a scalar: block_until_ready alone can under-synchronize
@@ -89,21 +137,41 @@ def main():
                                     jax.random.fold_in(key, steps + 1))
     _force(params)
 
+    trace_dir = os.environ.get("MXNET_TPU_BENCH_TRACE")
+    if trace_dir:
+        jax.profiler.start_trace(trace_dir)
     tic = time.time()
     for i in range(steps):
         outputs, params, aux = jit_step(params, data, aux,
                                         jax.random.fold_in(key, i))
     _force(params)
     elapsed = time.time() - tic
+    if trace_dir:
+        jax.profiler.stop_trace()
 
     imgs_per_sec = batch * steps / elapsed
+    step_ms = elapsed / steps * 1000.0
+    tflops_model = imgs_per_sec * RESNET50_TRAIN_GFLOPS_PER_IMG / 1e3 \
+        if image == 224 else 0.0
+    tflops_xla = xla_flops * steps / elapsed / 1e12
+    peak = _chip_peak(getattr(devices[0], "device_kind", "")) \
+        if on_accel else None
     result = {
         "metric": "resnet50_train_imgs_per_sec",
         "value": round(imgs_per_sec, 2),
         "unit": "img/s",
         "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
         "compute_dtype": dtype_name,
+        "batch": batch,
+        "step_time_ms": round(step_ms, 2),
+        "tflops_model": round(tflops_model, 1),
+        "tflops_xla": round(tflops_xla, 1),
+        "chip": getattr(devices[0], "device_kind", devices[0].platform),
     }
+    if peak and tflops_model:
+        result["mfu_pct"] = round(100.0 * tflops_model / peak, 1)
+    if peak and tflops_xla:
+        result["mfu_pct_xla"] = round(100.0 * tflops_xla / peak, 1)
     print(json.dumps(result))
 
 
