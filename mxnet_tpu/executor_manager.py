@@ -97,3 +97,15 @@ class DataParallelExecutorManager:
 
     def update_metric(self, metric, labels):
         self.execgrp.update_metric(metric, labels)
+
+    @property
+    def curr_execgrp(self):
+        """reference executor_manager.py:327: the group serving the
+        current bucket; one group here (no bucketing at this layer)."""
+        return self.execgrp
+
+    def get_outputs(self):
+        """Merged outputs of the last forward (reference collects and
+        concatenates per-device outputs; the mesh-sharded executor
+        already holds the full batch)."""
+        return self.execgrp.get_outputs()

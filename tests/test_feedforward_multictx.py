@@ -1,0 +1,122 @@
+"""FeedForward multi-context behavior (VERDICT weak #4): the legacy
+estimator API over several devices must match single-device training —
+the reference's multi_lenet.py near-identical-weights contract — and the
+executor_manager compat layer must drive training."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.executor_manager import (DataParallelExecutorManager,
+                                        _split_input_slice)
+
+
+def _task(n=192, d=6, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 2, n).astype(np.float32)
+    X = (rng.randn(n, d).astype(np.float32) * 0.5 + y[:, None])
+    return X, y
+
+
+def _net():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.FullyConnected(data=net, num_hidden=2, name="fc2")
+    return sym.SoftmaxOutput(data=net, name="softmax")
+
+
+def _train(ctx, X, y, epochs=5):
+    mx.random.seed(0)   # deterministic init for cross-run equivalence
+    np.random.seed(0)   # NDArrayIter shuffles via the global numpy RNG
+    model = mx.model.FeedForward.create(
+        _net(), X=X, y=y, ctx=ctx, num_epoch=epochs, learning_rate=0.2,
+        numpy_batch_size=32, initializer=mx.init.Uniform(0.07))
+    return model
+
+
+def test_feedforward_multi_context_trains():
+    X, y = _task()
+    model = _train([mx.cpu(0), mx.cpu(1)], X, y)
+    pred = model.predict(X)
+    acc = (pred.argmax(axis=1) == y).mean()
+    assert acc > 0.95, acc
+
+
+def test_feedforward_multi_vs_single_context_equivalence():
+    """Synchronous DP over 2 devices must produce the same weights as
+    one device seeing the full batch (grads are summed either way)."""
+    X, y = _task()
+    m1 = _train(mx.cpu(), X, y, epochs=3)
+    m2 = _train([mx.cpu(0), mx.cpu(1)], X, y, epochs=3)
+    a1, _ = m1.arg_params, m1.aux_params
+    a2, _ = m2.arg_params, m2.aux_params
+    for k in a1:
+        np.testing.assert_allclose(a1[k].asnumpy(), a2[k].asnumpy(),
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_feedforward_four_contexts_predict_consistency():
+    X, y = _task()
+    model = _train([mx.cpu(i) for i in range(4)], X, y)
+    p4 = model.predict(X)
+    # prediction through a single-device rebind matches
+    model2 = mx.model.FeedForward(_net(), ctx=mx.cpu(),
+                                  arg_params=model.arg_params,
+                                  aux_params=model.aux_params)
+    p1 = model2.predict(X)
+    np.testing.assert_allclose(p4, p1, rtol=1e-5, atol=1e-6)
+
+
+def test_split_input_slice():
+    slices = _split_input_slice(10, [1.0, 1.0])
+    assert slices == [slice(0, 5), slice(5, 10)]
+    slices = _split_input_slice(9, [2.0, 1.0])
+    assert slices[0] == slice(0, 6) and slices[1] == slice(6, 9)
+    total = sum(s.stop - s.start for s in _split_input_slice(7, [1, 1, 1]))
+    assert total == 7
+
+
+def test_executor_manager_training_loop():
+    """The reference-era training loop over DataParallelExecutorManager:
+    install params, forward/backward, update via grad arrays."""
+    X, y = _task(n=64)
+    it = mx.io.NDArrayIter(X, y, batch_size=16, label_name="softmax_label")
+    net = _net()
+    arg_names = net.list_arguments()
+    param_names = [n for n in arg_names
+                   if n not in ("data", "softmax_label")]
+    mgr = DataParallelExecutorManager(net, [mx.cpu(0), mx.cpu(1)], it,
+                                      arg_names=arg_names,
+                                      param_names=param_names,
+                                      aux_names=net.list_auxiliary_states())
+    rng = np.random.RandomState(1)
+    arg_params = {}
+    arg_shapes, _, _ = net.infer_shape(data=(16, 6))
+    for n_, s_ in zip(arg_names, arg_shapes):
+        if n_ in param_names:
+            arg_params[n_] = mx.nd.array(
+                (rng.randn(*s_) * 0.1).astype(np.float32))
+    mgr.set_params(arg_params, {})
+
+    for epoch in range(4):
+        it.reset()
+        for batch in it:
+            mgr.load_data_batch(batch)
+            mgr.forward(is_train=True)
+            mgr.backward()
+            for name, block, grads in zip(mgr.param_names, mgr.param_arrays,
+                                          mgr.grad_arrays):
+                for w, g in zip(block, grads):
+                    w[:] = w.asnumpy() - 0.05 * g.asnumpy()
+    assert mgr.curr_execgrp is mgr.execgrp
+    it.reset()
+    correct = total = 0
+    for batch in it:
+        mgr.load_data_batch(batch)
+        mgr.forward(is_train=False)
+        outs = mgr.get_outputs()
+        pred = outs[0].asnumpy()
+        correct += (pred.argmax(axis=1) ==
+                    batch.label[0].asnumpy()).sum()
+        total += pred.shape[0]
+    assert correct / total > 0.9, correct / total
