@@ -1,0 +1,65 @@
+package ml.mxnet_tpu
+
+/**
+ * Native method table over libmxnet_tpu_jni.so (the JNI glue in
+ * native/src/main/native/mxnet_tpu_jni.c, itself over the C ABI in
+ * include/mxnet_tpu/c_api.h).
+ *
+ * Parity target: the reference scala-package's LibInfo
+ * (scala-package/core/src/main/scala/ml/dmlc/mxnet/LibInfo.scala).
+ * Handles are jlong; tensors cross as Array[Float] (row-major).
+ */
+private[mxnet_tpu] class LibInfo {
+  // NDArray
+  @native def ndCreate(shape: Array[Int], devType: Int, devId: Int): Long
+  @native def ndFree(handle: Long): Unit
+  @native def ndSet(handle: Long, data: Array[Float]): Unit
+  @native def ndGet(handle: Long): Array[Float]
+  @native def ndShape(handle: Long): Array[Int]
+
+  // Symbol
+  @native def symCreateFromJSON(json: String): Long
+  @native def symToJSON(handle: Long): String
+  @native def symFree(handle: Long): Unit
+  @native def symListArguments(handle: Long): Array[String]
+  @native def symListOutputs(handle: Long): Array[String]
+  @native def symInferArgSizes(handle: Long, keys: Array[String],
+                               indptr: Array[Int],
+                               shapeData: Array[Int]): Array[Int]
+
+  // Executor
+  @native def execSimpleBind(symHandle: Long, devType: Int, devId: Int,
+                             keys: Array[String], indptr: Array[Int],
+                             shapeData: Array[Int],
+                             forTraining: Int): Long
+  @native def execSetArg(handle: Long, name: String,
+                         data: Array[Float]): Unit
+  @native def execSetAux(handle: Long, name: String,
+                         data: Array[Float]): Unit
+  @native def execForward(handle: Long, isTrain: Int): Unit
+  @native def execBackward(handle: Long): Unit
+  @native def execGetOutput(handle: Long, index: Int,
+                            size: Int): Array[Float]
+  @native def execGetGrad(handle: Long, name: String,
+                          size: Int): Array[Float]
+  @native def execFree(handle: Long): Unit
+
+  // KVStore (distributed training; Spark workers call these)
+  @native def kvCreate(kvType: String): Long
+  @native def kvRank(handle: Long): Int
+  @native def kvNumWorkers(handle: Long): Int
+  @native def kvInit(handle: Long, key: Int, ndHandle: Long): Unit
+  @native def kvPush(handle: Long, key: Int, ndHandle: Long,
+                     priority: Int): Unit
+  @native def kvPull(handle: Long, key: Int, ndHandle: Long,
+                     priority: Int): Unit
+  @native def kvBarrier(handle: Long): Unit
+  @native def kvFree(handle: Long): Unit
+}
+
+object LibInfo {
+  lazy val lib: LibInfo = {
+    System.loadLibrary("mxnet_tpu_jni")
+    new LibInfo
+  }
+}

@@ -1,0 +1,149 @@
+package ml.mxnet_tpu
+
+import scala.collection.mutable
+
+/**
+ * Scala frontend classes over the JNI table, mirroring the reference
+ * scala-package's user API (ml.dmlc.mxnet.{NDArray, Symbol, Executor,
+ * FeedForward}) on the TPU runtime ABI. Row-major shapes everywhere,
+ * like the reference Scala binding (unlike the R/Matlab bindings there
+ * is no layout flip: JVM arrays are row-major already).
+ */
+object Context {
+  val CPU = 1
+  val TPU = 2
+}
+
+class NDArray private[mxnet_tpu] (private[mxnet_tpu] val handle: Long)
+    extends AutoCloseable {
+  def shape: Array[Int] = LibInfo.lib.ndShape(handle)
+  def set(data: Array[Float]): NDArray = {
+    LibInfo.lib.ndSet(handle, data); this
+  }
+  def toArray: Array[Float] = LibInfo.lib.ndGet(handle)
+  override def close(): Unit = LibInfo.lib.ndFree(handle)
+}
+
+object NDArray {
+  def zeros(shape: Array[Int], devType: Int = Context.CPU,
+            devId: Int = 0): NDArray =
+    new NDArray(LibInfo.lib.ndCreate(shape, devType, devId))
+
+  def array(data: Array[Float], shape: Array[Int]): NDArray =
+    zeros(shape).set(data)
+}
+
+class Symbol private[mxnet_tpu] (private[mxnet_tpu] val handle: Long)
+    extends AutoCloseable {
+  def toJson: String = LibInfo.lib.symToJSON(handle)
+  def listArguments: Array[String] = LibInfo.lib.symListArguments(handle)
+  def listOutputs: Array[String] = LibInfo.lib.symListOutputs(handle)
+
+  /** Per-argument element counts given named input shapes. */
+  def inferArgSizes(shapes: Map[String, Array[Int]]): Map[String, Int] = {
+    val keys = shapes.keys.toArray
+    val indptr = mutable.ArrayBuffer(0)
+    val data = mutable.ArrayBuffer[Int]()
+    for (k <- keys) {
+      data ++= shapes(k)
+      indptr += data.length
+    }
+    val sizes = LibInfo.lib.symInferArgSizes(handle, keys, indptr.toArray,
+                                             data.toArray)
+    listArguments.zip(sizes).toMap
+  }
+
+  /** simple_bind with named input shapes (row-major). */
+  def simpleBind(shapes: Map[String, Array[Int]],
+                 forTraining: Boolean = false,
+                 devType: Int = Context.CPU, devId: Int = 0): Executor = {
+    val keys = shapes.keys.toArray
+    val indptr = mutable.ArrayBuffer(0)
+    val data = mutable.ArrayBuffer[Int]()
+    for (k <- keys) {
+      data ++= shapes(k)
+      indptr += data.length
+    }
+    new Executor(LibInfo.lib.execSimpleBind(
+      handle, devType, devId, keys, indptr.toArray, data.toArray,
+      if (forTraining) 1 else 0), this)
+  }
+
+  override def close(): Unit = LibInfo.lib.symFree(handle)
+}
+
+object Symbol {
+  def loadJson(json: String): Symbol =
+    new Symbol(LibInfo.lib.symCreateFromJSON(json))
+
+  def load(path: String): Symbol = {
+    val src = scala.io.Source.fromFile(path)
+    try loadJson(src.mkString) finally src.close()
+  }
+}
+
+class Executor private[mxnet_tpu] (private[mxnet_tpu] val handle: Long,
+                                   val symbol: Symbol)
+    extends AutoCloseable {
+  def setArg(name: String, data: Array[Float]): Unit =
+    LibInfo.lib.execSetArg(handle, name, data)
+  def setAux(name: String, data: Array[Float]): Unit =
+    LibInfo.lib.execSetAux(handle, name, data)
+  def forward(isTrain: Boolean = false): Unit =
+    LibInfo.lib.execForward(handle, if (isTrain) 1 else 0)
+  def backward(): Unit = LibInfo.lib.execBackward(handle)
+  def getOutput(index: Int, size: Int): Array[Float] =
+    LibInfo.lib.execGetOutput(handle, index, size)
+  def getGrad(name: String, size: Int): Array[Float] =
+    LibInfo.lib.execGetGrad(handle, name, size)
+  override def close(): Unit = LibInfo.lib.execFree(handle)
+}
+
+/** KVStore for synchronous distributed training (reference
+ *  ml.dmlc.mxnet.KVStore); "dist_sync" inside a Spark task joins the
+ *  job's collective group. */
+class KVStore private[mxnet_tpu] (private[mxnet_tpu] val handle: Long)
+    extends AutoCloseable {
+  def rank: Int = LibInfo.lib.kvRank(handle)
+  def numWorkers: Int = LibInfo.lib.kvNumWorkers(handle)
+  def init(key: Int, value: NDArray): Unit =
+    LibInfo.lib.kvInit(handle, key, value.handle)
+  def push(key: Int, value: NDArray, priority: Int = 0): Unit =
+    LibInfo.lib.kvPush(handle, key, value.handle, priority)
+  def pull(key: Int, out: NDArray, priority: Int = 0): Unit =
+    LibInfo.lib.kvPull(handle, key, out.handle, priority)
+  def barrier(): Unit = LibInfo.lib.kvBarrier(handle)
+  override def close(): Unit = LibInfo.lib.kvFree(handle)
+}
+
+object KVStore {
+  def create(kvType: String = "local"): KVStore =
+    new KVStore(LibInfo.lib.kvCreate(kvType))
+}
+
+/**
+ * Checkpoint-backed predictor + SGD stepper (the reference
+ * FeedForward.load / predict workflow; same file layout:
+ * prefix-symbol.json + prefix-%04d.params read through the native
+ * NDArray container loader is left to the caller via Symbol.load +
+ * Executor.setArg, as in the Perl/R bindings' train_step demos).
+ */
+object Model {
+  /** One synchronous SGD step on a bound training executor. */
+  def sgdStep(exec: Executor, params: Map[String, Array[Float]],
+              lr: Float): Map[String, Array[Float]] = {
+    exec.forward(isTrain = true)
+    exec.backward()
+    params.map { case (name, value) =>
+      val grad = exec.getGrad(name, value.length)
+      val updated = new Array[Float](value.length)
+      var i = 0
+      while (i < value.length) {
+        updated(i) = value(i) - lr * grad(i)
+        i += 1
+      }
+      exec.setArg(name, updated)
+      name -> updated
+    }
+  }
+}

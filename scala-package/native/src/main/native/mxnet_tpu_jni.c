@@ -1,0 +1,365 @@
+/*
+ * JNI glue between the Scala frontend and the framework's C ABI.
+ *
+ * Parity target: the reference scala-package's native layer
+ * (scala-package/native/src/main/native/ml_dmlc_mxnet_native_c_api.cc —
+ * hand-written JNI over include/mxnet/c_api.h). Fresh implementation
+ * over include/mxnet_tpu/c_api.h: handles cross as jlong, tensors as
+ * jfloatArray, names as jobjectArray of String.
+ *
+ * Built with the JDK's jni.h by the sbt/maven native build (see
+ * ../../../../README.md); the repository CI compiles it against a stub
+ * jni.h for a syntax/ABI-usage gate (tests/test_scala_package.py).
+ */
+#include <jni.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <mxnet_tpu/c_api.h>
+
+#define JNIFN(ret, name) \
+  JNIEXPORT ret JNICALL Java_ml_mxnet_1tpu_LibInfo_##name
+
+static void throw_mx(JNIEnv *env) {
+  jclass cls = (*env)->FindClass(env, "java/lang/RuntimeException");
+  (*env)->ThrowNew(env, cls, MXGetLastError());
+}
+
+/* ---- NDArray ---------------------------------------------------------- */
+
+JNIFN(jlong, ndCreate)(JNIEnv *env, jobject obj, jintArray jshape,
+                       jint devType, jint devId) {
+  jsize ndim = (*env)->GetArrayLength(env, jshape);
+  jint *dims = (*env)->GetIntArrayElements(env, jshape, NULL);
+  mx_uint *cdims = (mx_uint *)malloc(ndim * sizeof(mx_uint));
+  for (jsize i = 0; i < ndim; ++i) cdims[i] = (mx_uint)dims[i];
+  (*env)->ReleaseIntArrayElements(env, jshape, dims, JNI_ABORT);
+  NDArrayHandle h = NULL;
+  int rc = MXNDArrayCreate(cdims, (mx_uint)ndim, devType, devId, &h);
+  free(cdims);
+  if (rc != 0) { throw_mx(env); return 0; }
+  return (jlong)(intptr_t)h;
+}
+
+JNIFN(void, ndFree)(JNIEnv *env, jobject obj, jlong handle) {
+  MXNDArrayFree((NDArrayHandle)(intptr_t)handle);
+}
+
+JNIFN(void, ndSet)(JNIEnv *env, jobject obj, jlong handle,
+                   jfloatArray jdata) {
+  jsize n = (*env)->GetArrayLength(env, jdata);
+  jfloat *data = (*env)->GetFloatArrayElements(env, jdata, NULL);
+  int rc = MXNDArraySyncCopyFromCPU((NDArrayHandle)(intptr_t)handle,
+                                    (const mx_float *)data, (mx_uint)n);
+  (*env)->ReleaseFloatArrayElements(env, jdata, data, JNI_ABORT);
+  if (rc != 0) throw_mx(env);
+}
+
+JNIFN(jfloatArray, ndGet)(JNIEnv *env, jobject obj, jlong handle) {
+  mx_uint ndim = 0;
+  const mx_uint *dims = NULL;
+  if (MXNDArrayGetShape((NDArrayHandle)(intptr_t)handle, &ndim,
+                        &dims) != 0) {
+    throw_mx(env);
+    return NULL;
+  }
+  mx_uint n = 1;
+  for (mx_uint i = 0; i < ndim; ++i) n *= dims[i];
+  float *buf = (float *)malloc(n * sizeof(float));
+  if (MXNDArraySyncCopyToCPU((NDArrayHandle)(intptr_t)handle, buf,
+                             n) != 0) {
+    free(buf);
+    throw_mx(env);
+    return NULL;
+  }
+  jfloatArray out = (*env)->NewFloatArray(env, (jsize)n);
+  (*env)->SetFloatArrayRegion(env, out, 0, (jsize)n, buf);
+  free(buf);
+  return out;
+}
+
+JNIFN(jintArray, ndShape)(JNIEnv *env, jobject obj, jlong handle) {
+  mx_uint ndim = 0;
+  const mx_uint *dims = NULL;
+  if (MXNDArrayGetShape((NDArrayHandle)(intptr_t)handle, &ndim,
+                        &dims) != 0) {
+    throw_mx(env);
+    return NULL;
+  }
+  jintArray out = (*env)->NewIntArray(env, (jsize)ndim);
+  jint *tmp = (jint *)malloc(ndim * sizeof(jint));
+  for (mx_uint i = 0; i < ndim; ++i) tmp[i] = (jint)dims[i];
+  (*env)->SetIntArrayRegion(env, out, 0, (jsize)ndim, tmp);
+  free(tmp);
+  return out;
+}
+
+/* ---- Symbol ----------------------------------------------------------- */
+
+JNIFN(jlong, symCreateFromJSON)(JNIEnv *env, jobject obj, jstring jjson) {
+  const char *json = (*env)->GetStringUTFChars(env, jjson, NULL);
+  SymbolHandle h = NULL;
+  int rc = MXSymbolCreateFromJSON(json, &h);
+  (*env)->ReleaseStringUTFChars(env, jjson, json);
+  if (rc != 0) { throw_mx(env); return 0; }
+  return (jlong)(intptr_t)h;
+}
+
+JNIFN(jstring, symToJSON)(JNIEnv *env, jobject obj, jlong handle) {
+  const char *json = NULL;
+  if (MXSymbolSaveToJSON((SymbolHandle)(intptr_t)handle, &json) != 0) {
+    throw_mx(env);
+    return NULL;
+  }
+  return (*env)->NewStringUTF(env, json);
+}
+
+JNIFN(void, symFree)(JNIEnv *env, jobject obj, jlong handle) {
+  MXSymbolFree((SymbolHandle)(intptr_t)handle);
+}
+
+static jobjectArray strs_to_java(JNIEnv *env, mx_uint n,
+                                 const char **strs) {
+  jclass cls = (*env)->FindClass(env, "java/lang/String");
+  jobjectArray out = (*env)->NewObjectArray(env, (jsize)n, cls, NULL);
+  for (mx_uint i = 0; i < n; ++i)
+    (*env)->SetObjectArrayElement(env, out, (jsize)i,
+                                  (*env)->NewStringUTF(env, strs[i]));
+  return out;
+}
+
+JNIFN(jobjectArray, symListArguments)(JNIEnv *env, jobject obj,
+                                      jlong handle) {
+  mx_uint n = 0;
+  const char **names = NULL;
+  if (MXSymbolListArguments((SymbolHandle)(intptr_t)handle, &n,
+                            &names) != 0) {
+    throw_mx(env);
+    return NULL;
+  }
+  return strs_to_java(env, n, names);
+}
+
+JNIFN(jobjectArray, symListOutputs)(JNIEnv *env, jobject obj,
+                                    jlong handle) {
+  mx_uint n = 0;
+  const char **names = NULL;
+  if (MXSymbolListOutputs((SymbolHandle)(intptr_t)handle, &n,
+                          &names) != 0) {
+    throw_mx(env);
+    return NULL;
+  }
+  return strs_to_java(env, n, names);
+}
+
+JNIFN(jintArray, symInferArgSizes)(JNIEnv *env, jobject obj,
+                                   jlong handle, jobjectArray jkeys,
+                                   jintArray jindptr,
+                                   jintArray jshapeData) {
+  jsize nk = (*env)->GetArrayLength(env, jkeys);
+  const char **keys = (const char **)malloc(nk * sizeof(char *));
+  jstring *jstrs = (jstring *)malloc(nk * sizeof(jstring));
+  for (jsize i = 0; i < nk; ++i) {
+    jstrs[i] = (jstring)(*env)->GetObjectArrayElement(env, jkeys, i);
+    keys[i] = (*env)->GetStringUTFChars(env, jstrs[i], NULL);
+  }
+  jsize ni = (*env)->GetArrayLength(env, jindptr);
+  jsize nd = (*env)->GetArrayLength(env, jshapeData);
+  jint *indptr = (*env)->GetIntArrayElements(env, jindptr, NULL);
+  jint *sdata = (*env)->GetIntArrayElements(env, jshapeData, NULL);
+  mx_uint *cind = (mx_uint *)malloc(ni * sizeof(mx_uint));
+  mx_uint *cdata = (mx_uint *)malloc(nd * sizeof(mx_uint));
+  for (jsize i = 0; i < ni; ++i) cind[i] = (mx_uint)indptr[i];
+  for (jsize i = 0; i < nd; ++i) cdata[i] = (mx_uint)sdata[i];
+  mx_uint in_n = 0, out_n = 0;
+  const mx_uint *in_ndim = NULL, *out_ndim = NULL;
+  const mx_uint **in_data = NULL, **out_data = NULL;
+  int rc = MXSymbolInferShape((SymbolHandle)(intptr_t)handle,
+                              (mx_uint)nk, keys, cind, cdata,
+                              &in_n, &in_ndim, &in_data,
+                              &out_n, &out_ndim, &out_data);
+  for (jsize i = 0; i < nk; ++i)
+    (*env)->ReleaseStringUTFChars(env, jstrs[i], keys[i]);
+  free(keys); free(jstrs); free(cind); free(cdata);
+  (*env)->ReleaseIntArrayElements(env, jindptr, indptr, JNI_ABORT);
+  (*env)->ReleaseIntArrayElements(env, jshapeData, sdata, JNI_ABORT);
+  if (rc != 0) { throw_mx(env); return NULL; }
+  jint *sizes = (jint *)malloc(in_n * sizeof(jint));
+  for (mx_uint i = 0; i < in_n; ++i) {
+    jint prod = 1;
+    for (mx_uint d = 0; d < in_ndim[i]; ++d)
+      prod *= (jint)in_data[i][d];
+    sizes[i] = prod;
+  }
+  jintArray out = (*env)->NewIntArray(env, (jsize)in_n);
+  (*env)->SetIntArrayRegion(env, out, 0, (jsize)in_n, sizes);
+  free(sizes);
+  return out;
+}
+
+/* ---- Executor --------------------------------------------------------- */
+
+/* keys: input names; indptr/shapeData: csr shapes (row-major dims) */
+JNIFN(jlong, execSimpleBind)(JNIEnv *env, jobject obj, jlong symHandle,
+                             jint devType, jint devId, jobjectArray jkeys,
+                             jintArray jindptr, jintArray jshapeData,
+                             jint forTraining) {
+  jsize nk = (*env)->GetArrayLength(env, jkeys);
+  const char **keys = (const char **)malloc(nk * sizeof(char *));
+  jstring *jstrs = (jstring *)malloc(nk * sizeof(jstring));
+  for (jsize i = 0; i < nk; ++i) {
+    jstrs[i] = (jstring)(*env)->GetObjectArrayElement(env, jkeys, i);
+    keys[i] = (*env)->GetStringUTFChars(env, jstrs[i], NULL);
+  }
+  jsize ni = (*env)->GetArrayLength(env, jindptr);
+  jsize nd = (*env)->GetArrayLength(env, jshapeData);
+  jint *indptr = (*env)->GetIntArrayElements(env, jindptr, NULL);
+  jint *sdata = (*env)->GetIntArrayElements(env, jshapeData, NULL);
+  mx_uint *cind = (mx_uint *)malloc(ni * sizeof(mx_uint));
+  mx_uint *cdata = (mx_uint *)malloc(nd * sizeof(mx_uint));
+  for (jsize i = 0; i < ni; ++i) cind[i] = (mx_uint)indptr[i];
+  for (jsize i = 0; i < nd; ++i) cdata[i] = (mx_uint)sdata[i];
+  ExecutorHandle h = NULL;
+  int rc = MXExecutorSimpleBind((SymbolHandle)(intptr_t)symHandle, devType,
+                                devId, (mx_uint)nk, keys, cind, cdata,
+                                forTraining, &h);
+  for (jsize i = 0; i < nk; ++i)
+    (*env)->ReleaseStringUTFChars(env, jstrs[i], keys[i]);
+  free(keys); free(jstrs); free(cind); free(cdata);
+  (*env)->ReleaseIntArrayElements(env, jindptr, indptr, JNI_ABORT);
+  (*env)->ReleaseIntArrayElements(env, jshapeData, sdata, JNI_ABORT);
+  if (rc != 0) { throw_mx(env); return 0; }
+  return (jlong)(intptr_t)h;
+}
+
+JNIFN(void, execSetArg)(JNIEnv *env, jobject obj, jlong handle,
+                        jstring jname, jfloatArray jdata) {
+  const char *name = (*env)->GetStringUTFChars(env, jname, NULL);
+  jsize n = (*env)->GetArrayLength(env, jdata);
+  jfloat *data = (*env)->GetFloatArrayElements(env, jdata, NULL);
+  int rc = MXExecutorSetArg((ExecutorHandle)(intptr_t)handle, name,
+                            (const mx_float *)data, (mx_uint)n);
+  (*env)->ReleaseFloatArrayElements(env, jdata, data, JNI_ABORT);
+  (*env)->ReleaseStringUTFChars(env, jname, name);
+  if (rc != 0) throw_mx(env);
+}
+
+JNIFN(void, execSetAux)(JNIEnv *env, jobject obj, jlong handle,
+                        jstring jname, jfloatArray jdata) {
+  const char *name = (*env)->GetStringUTFChars(env, jname, NULL);
+  jsize n = (*env)->GetArrayLength(env, jdata);
+  jfloat *data = (*env)->GetFloatArrayElements(env, jdata, NULL);
+  int rc = MXExecutorSetAux((ExecutorHandle)(intptr_t)handle, name,
+                            (const mx_float *)data, (mx_uint)n);
+  (*env)->ReleaseFloatArrayElements(env, jdata, data, JNI_ABORT);
+  (*env)->ReleaseStringUTFChars(env, jname, name);
+  if (rc != 0) throw_mx(env);
+}
+
+JNIFN(void, execForward)(JNIEnv *env, jobject obj, jlong handle,
+                         jint isTrain) {
+  if (MXExecutorForward((ExecutorHandle)(intptr_t)handle, isTrain) != 0)
+    throw_mx(env);
+}
+
+JNIFN(void, execBackward)(JNIEnv *env, jobject obj, jlong handle) {
+  if (MXExecutorBackward((ExecutorHandle)(intptr_t)handle) != 0)
+    throw_mx(env);
+}
+
+JNIFN(jfloatArray, execGetOutput)(JNIEnv *env, jobject obj, jlong handle,
+                                  jint index, jint size) {
+  float *buf = (float *)malloc((size_t)size * sizeof(float));
+  if (MXExecutorGetOutput((ExecutorHandle)(intptr_t)handle,
+                          (mx_uint)index, buf, (mx_uint)size) != 0) {
+    free(buf);
+    throw_mx(env);
+    return NULL;
+  }
+  jfloatArray out = (*env)->NewFloatArray(env, size);
+  (*env)->SetFloatArrayRegion(env, out, 0, size, buf);
+  free(buf);
+  return out;
+}
+
+JNIFN(jfloatArray, execGetGrad)(JNIEnv *env, jobject obj, jlong handle,
+                                jstring jname, jint size) {
+  const char *name = (*env)->GetStringUTFChars(env, jname, NULL);
+  float *buf = (float *)malloc((size_t)size * sizeof(float));
+  int rc = MXExecutorGetGrad((ExecutorHandle)(intptr_t)handle, name, buf,
+                             (mx_uint)size);
+  (*env)->ReleaseStringUTFChars(env, jname, name);
+  if (rc != 0) {
+    free(buf);
+    throw_mx(env);
+    return NULL;
+  }
+  jfloatArray out = (*env)->NewFloatArray(env, size);
+  (*env)->SetFloatArrayRegion(env, out, 0, size, buf);
+  free(buf);
+  return out;
+}
+
+JNIFN(void, execFree)(JNIEnv *env, jobject obj, jlong handle) {
+  MXExecutorFree((ExecutorHandle)(intptr_t)handle);
+}
+
+/* ---- KVStore (dist training from Spark workers) ----------------------- */
+
+JNIFN(jlong, kvCreate)(JNIEnv *env, jobject obj, jstring jtype) {
+  const char *type = (*env)->GetStringUTFChars(env, jtype, NULL);
+  KVStoreHandle h = NULL;
+  int rc = MXKVStoreCreate(type, &h);
+  (*env)->ReleaseStringUTFChars(env, jtype, type);
+  if (rc != 0) { throw_mx(env); return 0; }
+  return (jlong)(intptr_t)h;
+}
+
+JNIFN(jint, kvRank)(JNIEnv *env, jobject obj, jlong handle) {
+  int rank = 0;
+  if (MXKVStoreGetRank((KVStoreHandle)(intptr_t)handle, &rank) != 0)
+    throw_mx(env);
+  return rank;
+}
+
+JNIFN(jint, kvNumWorkers)(JNIEnv *env, jobject obj, jlong handle) {
+  int size = 0;
+  if (MXKVStoreGetGroupSize((KVStoreHandle)(intptr_t)handle, &size) != 0)
+    throw_mx(env);
+  return size;
+}
+
+JNIFN(void, kvInit)(JNIEnv *env, jobject obj, jlong handle, jint key,
+                    jlong ndHandle) {
+  int k = key;
+  NDArrayHandle v = (NDArrayHandle)(intptr_t)ndHandle;
+  if (MXKVStoreInit((KVStoreHandle)(intptr_t)handle, 1, &k, &v) != 0)
+    throw_mx(env);
+}
+
+JNIFN(void, kvPush)(JNIEnv *env, jobject obj, jlong handle, jint key,
+                    jlong ndHandle, jint priority) {
+  int k = key;
+  NDArrayHandle v = (NDArrayHandle)(intptr_t)ndHandle;
+  if (MXKVStorePush((KVStoreHandle)(intptr_t)handle, 1, &k, &v,
+                    priority) != 0)
+    throw_mx(env);
+}
+
+JNIFN(void, kvPull)(JNIEnv *env, jobject obj, jlong handle, jint key,
+                    jlong ndHandle, jint priority) {
+  int k = key;
+  NDArrayHandle v = (NDArrayHandle)(intptr_t)ndHandle;
+  if (MXKVStorePull((KVStoreHandle)(intptr_t)handle, 1, &k, &v,
+                    priority) != 0)
+    throw_mx(env);
+}
+
+JNIFN(void, kvBarrier)(JNIEnv *env, jobject obj, jlong handle) {
+  if (MXKVStoreBarrier((KVStoreHandle)(intptr_t)handle) != 0)
+    throw_mx(env);
+}
+
+JNIFN(void, kvFree)(JNIEnv *env, jobject obj, jlong handle) {
+  MXKVStoreFree((KVStoreHandle)(intptr_t)handle);
+}
