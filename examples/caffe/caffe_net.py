@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Train MNIST through caffe layers (reference example/caffe/caffe_net.py):
+the network is built entirely from sym.CaffeOp prototxt strings.
+
+Uses idx-format MNIST from --data-dir when present, otherwise renders a
+synthetic digit dataset to disk first (tools/make_mnist_synth.py)."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def get_mlp():
+    data = sym.Variable("data")
+    fc1 = sym.CaffeOp(data_0=data, num_weight=2, name="fc1",
+                      prototxt='layer{type:"InnerProduct" '
+                               'inner_product_param{num_output: 128}}')
+    act1 = sym.CaffeOp(data_0=fc1, prototxt='layer{type:"TanH"}')
+    fc2 = sym.CaffeOp(data_0=act1, num_weight=2, name="fc2",
+                      prototxt='layer{type:"InnerProduct" '
+                               'inner_product_param{num_output: 64}}')
+    act2 = sym.CaffeOp(data_0=fc2, prototxt='layer{type:"TanH"}')
+    fc3 = sym.CaffeOp(data_0=act2, num_weight=2, name="fc3",
+                      prototxt='layer{type:"InnerProduct" '
+                               'inner_product_param{num_output: 10}}')
+    return sym.SoftmaxOutput(data=fc3, name="softmax")
+
+
+def get_lenet():
+    """LeNet with caffe conv/pool layers (reference caffe_net.py)."""
+    data = sym.Variable("data")
+    conv1 = sym.CaffeOp(data_0=data, num_weight=2, name="conv1",
+                        prototxt='layer{type:"Convolution" '
+                                 'convolution_param{num_output: 20 '
+                                 'kernel_size: 5}}')
+    pool1 = sym.CaffeOp(data_0=conv1,
+                        prototxt='layer{type:"Pooling" pooling_param{'
+                                 'pool: MAX kernel_size: 2 stride: 2}}')
+    conv2 = sym.CaffeOp(data_0=pool1, num_weight=2, name="conv2",
+                        prototxt='layer{type:"Convolution" '
+                                 'convolution_param{num_output: 50 '
+                                 'kernel_size: 5}}')
+    pool2 = sym.CaffeOp(data_0=conv2,
+                        prototxt='layer{type:"Pooling" pooling_param{'
+                                 'pool: MAX kernel_size: 2 stride: 2}}')
+    fc1 = sym.CaffeOp(data_0=sym.Flatten(data=pool2), num_weight=2,
+                      name="fc1",
+                      prototxt='layer{type:"InnerProduct" '
+                               'inner_product_param{num_output: 500}}')
+    act = sym.CaffeOp(data_0=fc1, prototxt='layer{type:"TanH"}')
+    fc2 = sym.CaffeOp(data_0=act, num_weight=2, name="fc2",
+                      prototxt='layer{type:"InnerProduct" '
+                               'inner_product_param{num_output: 10}}')
+    return sym.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def main():
+    parser = argparse.ArgumentParser(description="caffe-layer mnist")
+    parser.add_argument("--network", default="mlp",
+                        choices=["mlp", "lenet"])
+    parser.add_argument("--data-dir", default="mnist/")
+    parser.add_argument("--batch-size", type=int, default=100)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--num-epochs", type=int, default=10)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    train_img = os.path.join(args.data_dir, "train-images-idx3-ubyte")
+    if not os.path.exists(train_img):
+        logging.warning("no MNIST in %s; rendering a synthetic dataset",
+                        args.data_dir)
+        from tools.make_mnist_synth import generate
+        generate(args.data_dir, 8000, 1000)
+
+    flat = args.network == "mlp"
+    train = mx.io.MNISTIter(
+        image=train_img,
+        label=os.path.join(args.data_dir, "train-labels-idx1-ubyte"),
+        batch_size=args.batch_size, shuffle=True, flat=flat)
+    val = mx.io.MNISTIter(
+        image=os.path.join(args.data_dir, "t10k-images-idx3-ubyte"),
+        label=os.path.join(args.data_dir, "t10k-labels-idx1-ubyte"),
+        batch_size=args.batch_size, flat=flat)
+
+    net = get_mlp() if args.network == "mlp" else get_lenet()
+    mod = mx.mod.Module(net)
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+    acc = mod.score(val, "acc")[0][1]
+    print("Final validation accuracy: %.4f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -39,8 +39,9 @@ class Symbol private[mxnet_tpu] (private[mxnet_tpu] val handle: Long)
   def listArguments: Array[String] = LibInfo.lib.symListArguments(handle)
   def listOutputs: Array[String] = LibInfo.lib.symListOutputs(handle)
 
-  /** Per-argument element counts given named input shapes. */
-  def inferArgSizes(shapes: Map[String, Array[Int]]): Map[String, Int] = {
+  /** CSR packing of named shapes for the C ABI. */
+  private def packShapes(shapes: Map[String, Array[Int]])
+      : (Array[String], Array[Int], Array[Int]) = {
     val keys = shapes.keys.toArray
     val indptr = mutable.ArrayBuffer(0)
     val data = mutable.ArrayBuffer[Int]()
@@ -48,8 +49,13 @@ class Symbol private[mxnet_tpu] (private[mxnet_tpu] val handle: Long)
       data ++= shapes(k)
       indptr += data.length
     }
-    val sizes = LibInfo.lib.symInferArgSizes(handle, keys, indptr.toArray,
-                                             data.toArray)
+    (keys, indptr.toArray, data.toArray)
+  }
+
+  /** Per-argument element counts given named input shapes. */
+  def inferArgSizes(shapes: Map[String, Array[Int]]): Map[String, Int] = {
+    val (keys, indptr, data) = packShapes(shapes)
+    val sizes = LibInfo.lib.symInferArgSizes(handle, keys, indptr, data)
     listArguments.zip(sizes).toMap
   }
 
@@ -57,15 +63,9 @@ class Symbol private[mxnet_tpu] (private[mxnet_tpu] val handle: Long)
   def simpleBind(shapes: Map[String, Array[Int]],
                  forTraining: Boolean = false,
                  devType: Int = Context.CPU, devId: Int = 0): Executor = {
-    val keys = shapes.keys.toArray
-    val indptr = mutable.ArrayBuffer(0)
-    val data = mutable.ArrayBuffer[Int]()
-    for (k <- keys) {
-      data ++= shapes(k)
-      indptr += data.length
-    }
+    val (keys, indptr, data) = packShapes(shapes)
     new Executor(LibInfo.lib.execSimpleBind(
-      handle, devType, devId, keys, indptr.toArray, data.toArray,
+      handle, devType, devId, keys, indptr, data,
       if (forTraining) 1 else 0), this)
   }
 

@@ -1,6 +1,6 @@
 package ml.mxnet_tpu.spark
 
-import ml.mxnet_tpu.{Executor, KVStore, Model, NDArray, Symbol}
+import ml.mxnet_tpu.{Executor, KVStore, NDArray, Symbol}
 
 /**
  * Spark integration (reference scala-package/spark: MXNet.scala trains
@@ -10,12 +10,16 @@ import ml.mxnet_tpu.{Executor, KVStore, Model, NDArray, Symbol}
  * a jax.distributed collective group via the dist_sync kvstore (the
  * coordinator address comes from MXTPU_COORDINATOR, set per job), and
  * gradients ride XLA collectives exactly like tools/launch.py workers.
- * The trainer is deliberately the same few steps as the reference's
- * MXNet.fit: partition the data, run a synchronous SGD loop per task,
- * return the (identical) rank-0 weights.
  *
- * Structural sketch — compiles against spark-core but, like the
- * reference's spark module, is exercised only inside a real cluster:
+ * Collective discipline: every rank must run the SAME number of
+ * push/pull rounds, so an epoch is exactly `epochSize` steps on every
+ * rank, each rank cycling its local partition (Spark gives no
+ * equal-partition guarantee; deriving steps from partition length would
+ * desynchronize the collectives and hang the job).
+ *
+ * Usage from a Spark driver (spark-core on the deployment classpath;
+ * this module is validated structurally in CI, like the reference's
+ * spark module which also only ran inside a real cluster):
  *
  * {{{
  * val mx = new MXNetTPUSpark()
@@ -23,83 +27,86 @@ import ml.mxnet_tpu.{Executor, KVStore, Model, NDArray, Symbol}
  *   .setDimension(784)          // feature width of each row
  *   .setBatchSize(128)
  *   .setNumEpoch(10)
+ *   .setEpochSize(50)           // collective steps per epoch, all ranks
  *   .setLearningRate(0.05f)
- * val model = mx.fit(sc, labeledPoints)
+ * val weights = data.repartition(numWorkers).mapPartitions { part =>
+ *   Iterator(mx.trainPartition(part.map(r => (r.label, r.features))))
+ * }.collect().head              // all ranks return identical weights
  * }}}
  */
 class MXNetTPUSpark extends Serializable {
   private var symbolJson: String = _
   private var batchSize: Int = 128
   private var numEpoch: Int = 10
+  private var epochSize: Int = 0
   private var learningRate: Float = 0.01f
   private var dimension: Int = 0
 
   def setSymbolJson(json: String): this.type = { symbolJson = json; this }
   def setBatchSize(b: Int): this.type = { batchSize = b; this }
   def setNumEpoch(n: Int): this.type = { numEpoch = n; this }
+  def setEpochSize(n: Int): this.type = { epochSize = n; this }
   def setLearningRate(lr: Float): this.type = { learningRate = lr; this }
   def setDimension(d: Int): this.type = { dimension = d; this }
 
-  /**
-   * Train on an RDD[(label, features)]. Uses the type as a structural
-   * dependency only so the module compiles without spark on the
-   * classpath at CI time; in a deployment this is
-   * org.apache.spark.rdd.RDD[(Float, Array[Float])].
-   */
-  def fitPartitions(
-      partitions: Iterator[Iterator[(Float, Array[Float])]])
-      : Map[String, Array[Float]] = {
-    var result: Map[String, Array[Float]] = Map.empty
-    partitions.foreach { part =>
-      result = trainPartition(part)
-    }
-    result
-  }
-
   /** The per-task body the reference ran inside mapPartitions:
    *  synchronous data parallelism — every step pushes local gradients
-   *  into the dist_sync kvstore (which sums them across workers over
-   *  XLA collectives) and pulls the reduced result back before the
-   *  update, so all ranks hold identical weights throughout. */
+   *  into the dist_sync kvstore (summed across workers over XLA
+   *  collectives) and pulls the reduced result back before the update,
+   *  so all ranks hold identical weights throughout. */
   def trainPartition(rows: Iterator[(Float, Array[Float])])
       : Map[String, Array[Float]] = {
     require(dimension > 0, "call setDimension(d) with the feature width")
+    require(epochSize > 0,
+            "call setEpochSize(n): all ranks must agree on the number " +
+            "of collective steps per epoch")
     val kv = KVStore.create("dist_sync")
     try {
       val sym = Symbol.loadJson(symbolJson)
       val data = rows.toArray
+      require(data.length >= batchSize,
+              s"partition has ${data.length} rows < batchSize $batchSize")
       val exec = sym.simpleBind(
         Map("data" -> Array(batchSize, dimension)), forTraining = true)
       try {
-        var params = initParams(sym, exec)
+        var params = initParams(sym, exec, kv)
         val keyOf = params.keys.toArray.sorted.zipWithIndex.toMap
-        for ((name, key) <- keyOf)   // rank-0 values broadcast on init
-          kv.init(key, NDArray.array(params(name),
-                                     Array(params(name).length)))
+        // the push sums gradients over workers and the loss sums over
+        // the local batch: normalize like module.py's
+        // rescale_grad = 1 / (batch_size * num_workers)
+        val rescale = 1.0f / (batchSize * kv.numWorkers)
+        var cursor = 0
+        def nextBatch(): Array[(Float, Array[Float])] = {
+          val out = Array.tabulate(batchSize) { i =>
+            data((cursor + i) % data.length)
+          }
+          cursor = (cursor + batchSize) % data.length
+          out
+        }
         for (_ <- 0 until numEpoch) {
-          data.grouped(batchSize).foreach { batch =>
-            if (batch.length == batchSize) {
-              exec.setArg("data", batch.flatMap(_._2))
-              exec.setArg("softmax_label", batch.map(_._1))
-              exec.forward(isTrain = true)
-              exec.backward()
-              params = params.map { case (name, value) =>
-                val gnd = NDArray.array(exec.getGrad(name, value.length),
-                                        Array(value.length))
-                try {
-                  kv.push(keyOf(name), gnd)   // summed across workers
-                  kv.pull(keyOf(name), gnd)
-                  val reduced = gnd.toArray
-                  val updated = new Array[Float](value.length)
-                  var i = 0
-                  while (i < value.length) {
-                    updated(i) = value(i) - learningRate * reduced(i)
-                    i += 1
-                  }
-                  exec.setArg(name, updated)
-                  name -> updated
-                } finally gnd.close()
-              }
+          for (_ <- 0 until epochSize) {
+            val batch = nextBatch()
+            exec.setArg("data", batch.flatMap(_._2))
+            exec.setArg("softmax_label", batch.map(_._1))
+            exec.forward(isTrain = true)
+            exec.backward()
+            params = params.map { case (name, value) =>
+              val gnd = NDArray.array(exec.getGrad(name, value.length),
+                                      Array(value.length))
+              try {
+                kv.push(keyOf(name), gnd)   // summed across workers
+                kv.pull(keyOf(name), gnd)
+                val reduced = gnd.toArray
+                val updated = new Array[Float](value.length)
+                var i = 0
+                while (i < value.length) {
+                  updated(i) = value(i) -
+                    learningRate * rescale * reduced(i)
+                  i += 1
+                }
+                exec.setArg(name, updated)
+                name -> updated
+              } finally gnd.close()
             }
           }
           kv.barrier()
@@ -109,20 +116,23 @@ class MXNetTPUSpark extends Serializable {
     } finally kv.close()
   }
 
-  private def initParams(sym: Symbol, exec: Executor)
+  private def initParams(sym: Symbol, exec: Executor, kv: KVStore)
       : Map[String, Array[Float]] = {
     val rng = new scala.util.Random(0)
     val sizes = sym.inferArgSizes(
       Map("data" -> Array(batchSize, dimension)))
-    sym.listArguments
+    val paramNames = sym.listArguments
       .filterNot(n => n == "data" || n.endsWith("label"))
-      .map { name =>
-        // same seed on every rank -> identical init, as the reference's
-        // kvstore init broadcast guarantees
-        val values =
-          Array.fill(sizes(name))((rng.nextFloat() - 0.5f) * 0.1f)
-        exec.setArg(name, values)
-        name -> values
-      }.toMap
+    val keyOf = paramNames.sorted.zipWithIndex.toMap
+    paramNames.map { name =>
+      // same seed on every rank -> identical init; kv.init registers
+      // the key so later push/pull rounds are well-defined
+      val values =
+        Array.fill(sizes(name))((rng.nextFloat() - 0.5f) * 0.1f)
+      val nd = NDArray.array(values, Array(values.length))
+      try kv.init(keyOf(name), nd) finally nd.close()
+      exec.setArg(name, values)
+      name -> values
+    }.toMap
   }
 }
