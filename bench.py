@@ -56,11 +56,63 @@ def _chip_peak(device_kind: str):
     return None
 
 
+def _accelerator_reachable(timeout_s: int = 240) -> bool:
+    """Probe the default (accelerator) backend in a subprocess: a wedged
+    TPU tunnel makes `import jax` + device init (or, worse, the first
+    real dispatch — a half-alive tunnel answers device enumeration but
+    never completes a computation) block forever, which would leave the
+    driver with no bench line at all. So the probe must EXECUTE a tiny
+    jitted computation, not just list devices. The probe child can be
+    killed; the parent then falls back to CPU."""
+    import subprocess
+    import tempfile
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    # no pipes: a wedged backend can leave helper processes holding the
+    # child's stdio open, which blocks subprocess.run's pipe drain even
+    # after the timeout kill — write the verdict to a file instead
+    probe_src = (
+        "import jax, jax.numpy as jnp\n"
+        "plat = jax.devices()[0].platform\n"
+        "val = float(jax.jit(lambda x: (x * 2).sum())(jnp.ones(128)))\n"
+        "assert val == 256.0, val\n"
+        "open({path!r}, 'w').write(plat)\n")
+    with tempfile.NamedTemporaryFile("r", suffix=".probe") as f:
+        child = subprocess.Popen(
+            [sys.executable, "-c", probe_src.format(path=f.name)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env)
+        try:
+            rc = child.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            child.kill()
+            child.wait()
+            return False
+        platform = f.read().strip()
+    return rc == 0 and platform not in ("", "cpu")
+
+
 def main():
+    if not os.environ.get("JAX_PLATFORMS") \
+            and not _accelerator_reachable():
+        # re-exec in a fresh interpreter: forcing CPU after the platform
+        # plugin has loaded does not stick (same recipe as
+        # __graft_entry__._dryrun_in_subprocess / tests/conftest.py)
+        import subprocess
+        sys.stderr.write("bench.py: accelerator unreachable; "
+                         "falling back to CPU\n")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        here = os.path.dirname(os.path.abspath(__file__))
+        code = ("import sys; sys.path.insert(0, %r); "
+                "import jax; jax.config.update('jax_platforms', 'cpu'); "
+                "import bench; bench.main()" % here)
+        sys.exit(subprocess.call([sys.executable, "-c", code], env=env,
+                                 cwd=here))
+
     import jax
     if os.environ.get("JAX_PLATFORMS"):
         # the axon site hook overrides the env at import; re-apply it so
-        # JAX_PLATFORMS=cpu smoke runs work off-TPU
+        # JAX_PLATFORMS=cpu runs work off-TPU
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     import mxnet_tpu as mx
