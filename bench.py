@@ -121,10 +121,12 @@ def main():
 
     devices = jax.devices()
     on_accel = devices[0].platform != "cpu"
-    batch = 256 if on_accel else 8
+    batch = int(os.environ.get("MXNET_TPU_BENCH_BATCH",
+                               256 if on_accel else 8))
     image = 224 if on_accel else 32
     num_classes = 1000 if on_accel else 16
-    steps = 20 if on_accel else 2
+    steps = int(os.environ.get("MXNET_TPU_BENCH_STEPS",
+                               20 if on_accel else 2))
 
     net = models.get_resnet50(num_classes=num_classes,
                               small_input=not on_accel)

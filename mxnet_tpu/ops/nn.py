@@ -54,18 +54,15 @@ class FullyConnected(Operator):
         return shapes, [(n, self.num_hidden)], []
 
     def apply(self, ctx, inputs, aux):
+        # XLA is the measured fast path: the Pallas fused_linear kernel
+        # benched 0.1-1.0x of the XLA dot on a v5e across 256..8192 sizes
+        # (tools/bench_pallas.py, table in docs/pallas.md), so the former
+        # MXNET_TPU_PALLAS gate was retired. The kernels remain available
+        # explicitly via ops.pallas_kernels / rtc.
         jnp = _jnp()
         data = inputs[0]
         w = inputs[1]
         x = data.reshape((data.shape[0], -1))
-        from ..base import getenv
-
-        if getenv("MXNET_TPU_PALLAS", False):
-            from .pallas_kernels import fused_linear
-
-            out = fused_linear(x, w, None if self.no_bias else inputs[2])
-            if out is not None:
-                return [out], []
         out = jnp.dot(x, w.T)
         if not self.no_bias:
             out = out + inputs[2]
@@ -375,27 +372,34 @@ class BatchNorm(Operator):
         if use_batch_stats:
             # statistics in f32 even under bf16 mixed precision: a batch
             # mean over 1e5+ elements accumulated in bf16 loses the
-            # moving averages (standard TPU mixed-precision practice)
+            # moving averages (standard TPU mixed-precision practice).
+            # One-pass form (var = E[x^2] - E[x]^2): both reductions read
+            # x once and XLA fuses them into a single multi-output reduce
+            # over the conv output — the two-pass (x - mean)^2 form
+            # materializes the centered activations and dominated the
+            # ResNet step (the conv MXU work is the minority of the time).
             x32 = x.astype(jnp.promote_types(x.dtype, jnp.float32))
             mean = jnp.mean(x32, axis=axes)
-            var = jnp.mean(jnp.square(x32 - mean.reshape(bshape)),
-                           axis=axes)
+            meansq = jnp.mean(jnp.square(x32), axis=axes)
+            var = jnp.maximum(meansq - jnp.square(mean), 0.0)
             m = self.momentum
             new_mean = moving_mean * m + jax.lax.stop_gradient(
                 mean.astype(moving_mean.dtype)) * (1 - m)
             new_var = moving_var * m + jax.lax.stop_gradient(
                 var.astype(moving_var.dtype)) * (1 - m)
             new_aux = [new_mean, new_var]
-            mean = mean.astype(x.dtype)
-            var = var.astype(x.dtype)
         else:
-            mean = jax.lax.stop_gradient(moving_mean).astype(x.dtype)
-            var = jax.lax.stop_gradient(moving_var).astype(x.dtype)
+            mean = jax.lax.stop_gradient(moving_mean)
+            var = jax.lax.stop_gradient(moving_var)
             new_aux = [moving_mean, moving_var]
-        inv = jax.lax.rsqrt(var.reshape(bshape) + jnp.asarray(
-            self.eps, x.dtype))
-        out = (x - mean.reshape(bshape)) * inv * gamma.reshape(bshape) \
-            + beta.reshape(bshape)
+        # fold the affine into one per-channel scale/shift applied to x in
+        # its own dtype: a single fused multiply-add pass instead of
+        # subtract/normalize/scale/shift chains
+        inv = jax.lax.rsqrt(var + self.eps)
+        scale = (gamma.astype(inv.dtype) * inv).astype(x.dtype)
+        shift = (beta.astype(inv.dtype) - mean * gamma.astype(inv.dtype)
+                 * inv).astype(x.dtype)
+        out = x * scale.reshape(bshape) + shift.reshape(bshape)
         return [out], new_aux
 
 
