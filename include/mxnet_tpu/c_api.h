@@ -32,8 +32,57 @@ typedef void *DataIterCreator;
 typedef void *DataIterHandle;
 typedef void *KVStoreHandle;
 typedef void *RecordIOHandle;
+typedef void *RtcHandle;
+typedef void *OptimizerCreator;
+typedef void *OptimizerHandle;
 typedef uint32_t mx_uint;
 typedef float mx_float;
+
+/*! \brief Executor monitor callback: (output name, value, user handle).
+ * Reference ExecutorMonitorCallback. */
+typedef void (*ExecutorMonitorCallback)(const char *name, NDArrayHandle arr,
+                                        void *handle);
+/*! \brief KVStore server command controller (reference
+ * MXKVStoreServerController). */
+typedef void (*MXKVStoreServerController)(int head, const char *body,
+                                          void *controller_handle);
+
+/*! \brief C custom operator callbacks (reference CustomOpInfo /
+ * CustomOpPropInfo / CustomOpPropCreator, include/mxnet/c_api.h:96-133).
+ * ptrs are NDArrayHandles; tags: 0 = in_data, 1 = out_data, 2 = aux,
+ * 3 = in_grad, 4 = out_grad. */
+struct CustomOpInfo {
+  int (*forward)(int size, void **ptrs, int *tags, const int *reqs,
+                 int is_train, void *state);
+  int (*backward)(int size, void **ptrs, int *tags, const int *reqs,
+                  int is_train, void *state);
+  int (*del)(void *state);
+  void *p_forward;
+  void *p_backward;
+  void *p_del;
+};
+
+struct CustomOpPropInfo {
+  int (*list_arguments)(char ***args, void *state);
+  int (*list_outputs)(char ***outputs, void *state);
+  int (*infer_shape)(int num_input, int *ndims, unsigned **shapes,
+                     void *state);
+  int (*create_operator)(const char *ctx, int num_inputs, unsigned **shapes,
+                         int *ndims, int *dtypes, struct CustomOpInfo *ret,
+                         void *state);
+  int (*list_auxiliary_states)(char ***aux, void *state);
+  int (*del)(void *state);
+  void *p_list_arguments;
+  void *p_list_outputs;
+  void *p_infer_shape;
+  void *p_create_operator;
+  void *p_list_auxiliary_states;
+  void *p_del;
+};
+
+typedef int (*CustomOpPropCreator)(const char *op_type, const int num_kwargs,
+                                   const char **keys, const char **values,
+                                   struct CustomOpPropInfo *ret);
 
 /*! \brief KVStore updater: key, pushed value, stored value (mutate via
  * MXNDArraySyncCopyFromCPU), user handle. Reference MXKVStoreUpdater. */
@@ -86,6 +135,29 @@ int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype);
 /*! \brief Wrap a CPython mxnet_tpu NDArray object (PyObject*) into a C
  * handle (increfs). Internal bridge for callback plumbing. */
 int MXTPUNDArrayWrapPyObject(void *py_ndarray, NDArrayHandle *out);
+/*! \brief Empty handle; filled by ops that allocate their output
+ * (reference MXNDArrayCreateNone). */
+int MXNDArrayCreateNone(NDArrayHandle *out);
+/*! \brief Index axis 0: out = handle[idx] (rank reduced by one). */
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out);
+/*! \brief Host pointer to the array's f32 data. Divergence from the
+ * reference (which returned the live CPU buffer): device arrays are
+ * immutable here, so this is a cached host COPY, valid until the next
+ * call on this handle or Free; writes do not propagate back. */
+int MXNDArrayGetData(NDArrayHandle handle, mx_float **out_pdata);
+/*! \brief Serialize one array to the container byte format (buffer owned
+ * by the handle, valid until next call/Free). */
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                          const char **out_buf);
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                              NDArrayHandle *out);
+int MXNDArrayWaitToRead(NDArrayHandle handle);
+int MXNDArrayWaitToWrite(NDArrayHandle handle);
+/*! \brief Seed the global PRNG (reference MXRandomSeed). */
+int MXRandomSeed(int seed);
+/*! \brief Drain the engine before process exit (reference
+ * MXNotifyShutdown). */
+int MXNotifyShutdown(void);
 
 /* ---- NDArray function registry (reference c_api.cc:366-445) ----------- */
 
@@ -106,6 +178,15 @@ int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
 /*! \brief result written into mutate_vars[0]. */
 int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
                  const mx_float *scalar_args, NDArrayHandle *mutate_vars);
+/*! \brief MXFuncInvoke with extra string kwargs (reference
+ * MXFuncInvokeEx). */
+int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                   const mx_float *scalar_args, NDArrayHandle *mutate_vars,
+                   int num_params, const char **param_keys,
+                   const char **param_vals);
+/*! \brief Register a C custom operator usable as sym.Custom(...,
+ * op_type=<op_type>) from every frontend (reference MXCustomOpRegister). */
+int MXCustomOpRegister(const char *op_type, CustomOpPropCreator creator);
 
 /* ---- Symbol ----------------------------------------------------------- */
 
@@ -179,6 +260,36 @@ int MXSymbolInferType(SymbolHandle handle, mx_uint num_args,
                       mx_uint *in_type_size, const int **in_type_data,
                       mx_uint *out_type_size, const int **out_type_data,
                       mx_uint *aux_type_size, const int **aux_type_data);
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname);
+/*! \brief Name of a single-output symbol; *success 0 for groups. */
+int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success);
+/*! \brief Human-readable graph dump (reference Symbol::Print). */
+int MXSymbolPrint(SymbolHandle symbol, const char **out_str);
+/*! \brief Gradient symbol wrt the named arguments (reference
+ * MXSymbolGrad / Symbol::Grad). */
+int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt, const char **wrt,
+                 SymbolHandle *out);
+/*! \brief Shape inference that tolerates unknowns: unknown entries come
+ * back 0-dim; *complete is 1 when everything resolved (reference
+ * MXSymbolInferShapePartial). Also returns aux shapes. */
+int MXSymbolInferShapePartial(SymbolHandle handle, mx_uint num_args,
+                              const char **keys, const mx_uint *arg_ind_ptr,
+                              const mx_uint *arg_shape_data,
+                              mx_uint *in_shape_size,
+                              const mx_uint **in_shape_ndim,
+                              const mx_uint ***in_shape_data,
+                              mx_uint *out_shape_size,
+                              const mx_uint **out_shape_ndim,
+                              const mx_uint ***out_shape_data,
+                              mx_uint *aux_shape_size,
+                              const mx_uint **aux_shape_ndim,
+                              const mx_uint ***aux_shape_data,
+                              int *complete);
+/*! \brief Attributes of the symbol's own node only, flattened
+ * [k0,v0,...] (reference MXSymbolListAttrShallow). */
+int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                            const char ***out);
 
 /* ---- Data iterators (reference c_api.cc:1110-1197) -------------------- */
 
@@ -226,6 +337,23 @@ int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle, int do_barrier);
 int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id, int *number);
 int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_head,
                                    const char *cmd_body);
+/*! \brief Set process-role environment (DMLC_ROLE etc.) before creating
+ * a dist kvstore (reference MXInitPSEnv). */
+int MXInitPSEnv(mx_uint num_vars, const char **keys, const char **vals);
+/*! \brief Role predicates from DMLC_ROLE (default: worker). The TPU
+ * dist design has no separate server/scheduler processes — every rank
+ * is a worker over XLA collectives — so IsServerNode/IsSchedulerNode
+ * return 0 unless the env says otherwise (docs/distributed.md). */
+int MXKVStoreIsWorkerNode(int *ret);
+int MXKVStoreIsServerNode(int *ret);
+int MXKVStoreIsSchedulerNode(int *ret);
+/*! \brief Install `controller` as the command handler and return.
+ * Divergence from the reference (which blocked a dedicated server
+ * process): there is no server tier here, so commands sent with
+ * MXKVStoreSendCommmandToServers dispatch to the controller in-process. */
+int MXKVStoreRunServer(KVStoreHandle handle,
+                       MXKVStoreServerController controller,
+                       void *controller_handle);
 
 /* ---- RecordIO (reference MXRecordIO*) --------------------------------- */
 
@@ -239,6 +367,42 @@ int MXRecordIOReaderFree(RecordIOHandle handle);
  * the handle, valid until the next read/Free. */
 int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char **buf,
                                size_t *size);
+/*! \brief Seek to a byte offset previously returned by Tell (pointer-to-
+ * handle signature kept for reference parity). */
+int MXRecordIOReaderSeek(RecordIOHandle *handle, size_t pos);
+/*! \brief Current byte offset of the writer (pair with ReaderSeek for
+ * indexed access). */
+int MXRecordIOWriterTell(RecordIOHandle *handle, size_t *pos);
+
+/* ---- Optimizer (reference MXOptimizer*) ------------------------------- */
+
+/*! \brief Look up a registered optimizer by name ("sgd", "adam", ...). */
+int MXOptimizerFindCreator(const char *key, OptimizerCreator *out);
+/*! \brief Instantiate with string kwargs (momentum, rescale_grad, ...). */
+int MXOptimizerCreateOptimizer(OptimizerCreator creator, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               OptimizerHandle *out);
+int MXOptimizerFree(OptimizerHandle handle);
+/*! \brief In-place weight update with per-call lr/wd; per-index state
+ * (momentum etc.) lives on the handle. */
+int MXOptimizerUpdate(OptimizerHandle handle, int index, NDArrayHandle weight,
+                      NDArrayHandle grad, mx_float lr, mx_float wd);
+
+/* ---- Rtc: runtime-compiled Pallas kernels (reference MXRtc*) ---------- */
+
+/*! \brief Compile a named Pallas kernel (see mxnet_tpu.rtc.Rtc): body
+ * sees <name>_ref refs for each input/output. */
+int MXRtcCreate(const char *name, mx_uint num_input, mx_uint num_output,
+                const char **input_names, const char **output_names,
+                NDArrayHandle *inputs, NDArrayHandle *outputs,
+                const char *kernel, RtcHandle *out);
+/*! \brief Run on new arrays; grid/block dims accepted for reference API
+ * parity (Pallas/XLA choose the schedule). */
+int MXRtcPush(RtcHandle handle, mx_uint num_input, mx_uint num_output,
+              NDArrayHandle *inputs, NDArrayHandle *outputs,
+              mx_uint gridDimX, mx_uint gridDimY, mx_uint gridDimZ,
+              mx_uint blockDimX, mx_uint blockDimY, mx_uint blockDimZ);
+int MXRtcFree(RtcHandle handle);
 
 /* ---- Executor --------------------------------------------------------- */
 
@@ -271,6 +435,42 @@ int MXExecutorGetOutput(ExecutorHandle handle, mx_uint index,
 int MXExecutorGetGrad(ExecutorHandle handle, const char *name,
                       mx_float *data, mx_uint size);
 int MXExecutorFree(ExecutorHandle handle);
+/*! \brief Full bind with caller-provided argument/gradient/aux arrays
+ * (reference MXExecutorBind). grad_req_type: 0=null 1=write 2=inplace
+ * 3=addto; arg_grad_store entries may be NULL for unneeded grads.
+ * Results are written back into the passed NDArray handles after each
+ * forward/backward. */
+int MXExecutorBind(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                   mx_uint len, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                   mx_uint aux_states_len, NDArrayHandle *aux_states,
+                   ExecutorHandle *out);
+/*! \brief Bind with a group->context map (reference MXExecutorBindX):
+ * map keys are ctx_group attr values, mapped to (dev_type, dev_id). */
+int MXExecutorBindX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                    mx_uint num_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    mx_uint len, NDArrayHandle *in_args,
+                    NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                    mx_uint aux_states_len, NDArrayHandle *aux_states,
+                    ExecutorHandle *out);
+/*! \brief BindX plus a shared executor whose memory pool is reused
+ * (reference MXExecutorBindEX; here XLA owns buffers, so shared_exec
+ * only seeds bucketing-style shape reuse and may be NULL). */
+int MXExecutorBindEX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                     mx_uint num_map_keys, const char **map_keys,
+                     const int *map_dev_types, const int *map_dev_ids,
+                     mx_uint len, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                     mx_uint aux_states_len, NDArrayHandle *aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle *out);
+/*! \brief Allocation/graph dump (reference GraphExecutor::Print). */
+int MXExecutorPrint(ExecutorHandle handle, const char **out_str);
+/*! \brief Install a per-output monitor callback run on every
+ * forward/backward (reference MXExecutorSetMonitorCallback). */
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 ExecutorMonitorCallback callback,
+                                 void *callback_handle);
 
 #ifdef __cplusplus
 }

@@ -163,7 +163,12 @@ class KVStore:
             multihost_utils.sync_global_devices("kvstore_barrier")
 
     def send_command_to_servers(self, head: int, body: str):
-        pass  # no server tier on TPU; optimizer runs worker-side
+        # no server tier on TPU; optimizer runs worker-side. When a
+        # controller was installed (MXKVStoreRunServer / the reference's
+        # serialized-optimizer command channel) dispatch to it in-process.
+        controller = getattr(self, "_controller", None)
+        if controller is not None:
+            controller(int(head), body)
 
     def num_dead_node(self, node_id: int = 0) -> int:
         """Count of failed peers (reference ``KVStore::get_num_dead_node``,

@@ -553,7 +553,11 @@ def save(fname: str, data) -> None:
 def load(fname: str):
     """Load NDArrays saved by :func:`save`. Returns list or dict."""
     with open(fname, "rb") as f:
-        magic, _, n = struct.unpack("<QQQ", f.read(24))
+        header = f.read(24)
+        if len(header) < 24:
+            raise MXNetError("invalid NDArray file %s: truncated header"
+                             % fname)
+        magic, _, n = struct.unpack("<QQQ", header)
         if magic != _MAGIC:
             raise MXNetError("invalid NDArray file %s" % fname)
         arrays = []

@@ -88,6 +88,9 @@ class MXRecordIO:
             off = self._lib.mxtpu_recio_write(self._h, data, len(buf))
             if off < 0:
                 raise MXNetError("write failed on %s" % self.uri)
+            # keep tell() working on the native handle: next record starts
+            # after the 8-byte header + payload + padding
+            self._offset = off + 8 + len(buf) + (4 - len(buf) % 4) % 4
             return off
         off = self.fp.tell()
         self.fp.write(struct.pack("<II", _MAGIC, len(buf) & _LENGTH_MASK))
@@ -100,8 +103,10 @@ class MXRecordIO:
     def tell(self) -> int:
         if self.fp is not None:
             return self.fp.tell()
-        raise MXNetError("tell() unsupported on the native handle; "
-                         "use the offset returned by write()")
+        if self.writable:
+            return getattr(self, "_offset", 0)
+        raise MXNetError("tell() unsupported on the native read handle; "
+                         "use record offsets from the writer")
 
     def seek(self, offset: int):
         if self._h is not None:

@@ -66,19 +66,32 @@ struct ShapeList {
 struct NDArrayRec {
   PyObject *arr = nullptr;
   std::vector<mx_uint> shape;
+  std::string raw;            /* MXNDArraySaveRawBytes buffer */
+  std::vector<mx_float> host; /* MXNDArrayGetData host copy */
 };
 
 struct SymbolRec {
   PyObject *sym = nullptr;
   std::string json;
   std::string attr_val;
-  StrList args, outputs, aux, attr_list;
-  ShapeList in_shapes, out_shapes;
+  std::string name;           /* MXSymbolGetName */
+  std::string print_str;      /* MXSymbolPrint */
+  StrList args, outputs, aux, attr_list, attr_shallow;
+  ShapeList in_shapes, out_shapes, aux_shapes;
   std::vector<int> in_ids, out_ids, aux_ids;  /* MXSymbolInferType */
 };
 
 struct ExecRec {
   PyObject *exe = nullptr; /* mxnet_tpu Executor */
+  std::string print_str;   /* MXExecutorPrint */
+};
+
+struct OptimizerRec {
+  PyObject *opt = nullptr; /* capi_helpers._COptimizer */
+};
+
+struct RtcRec {
+  PyObject *rtc = nullptr; /* mxnet_tpu.rtc.Rtc */
 };
 
 PyObject *shape_tuple(const mx_uint *dims, mx_uint n) {
@@ -508,6 +521,7 @@ int MXExecutorFree(ExecutorHandle handle) {
   return 0;
 }
 
+
 }  /* extern "C" */
 
 /* ======================================================================
@@ -687,6 +701,34 @@ int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype) {
   return 0;
 }
 
+/* Handle -> PyObject with a proper error (instead of a crash) for
+ * empty handles from MXNDArrayCreateNone. */
+static PyObject *arr_of(NDArrayHandle h) {
+  NDArrayRec *rec = static_cast<NDArrayRec *>(h);
+  if (!rec || !rec->arr) {
+    set_error("empty NDArray handle (MXNDArrayCreateNone) used where an "
+              "allocated array is required");
+    return nullptr;
+  }
+  return rec->arr;
+}
+
+/* Fill an empty handle with a freshly produced array (CreateNone
+ * contract: ops that allocate their output complete the handle). */
+static void fill_empty_rec(NDArrayRec *rec, PyObject *arr) {
+  rec->arr = arr;  /* takes the reference */
+  rec->shape.clear();
+  PyObject *shape = PyObject_GetAttrString(arr, "shape");
+  if (shape) {
+    for (Py_ssize_t d = 0; d < PyTuple_Size(shape); ++d)
+      rec->shape.push_back(
+          (mx_uint)PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shape, d)));
+    Py_DECREF(shape);
+  } else {
+    PyErr_Clear();
+  }
+}
+
 int MXTPUNDArrayWrapPyObject(void *py_ndarray, NDArrayHandle *out) {
   GIL gil;
   PyObject *arr = static_cast<PyObject *>(py_ndarray);
@@ -773,15 +815,17 @@ int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
   mx_uint n_use = info->n_use, n_scalar = info->n_scalar;
   PyObject *uses = PyList_New(n_use);
   for (mx_uint i = 0; i < n_use; ++i) {
-    PyObject *a = static_cast<NDArrayRec *>(use_vars[i])->arr;
+    PyObject *a = arr_of(use_vars[i]);
+    if (!a) { Py_DECREF(uses); return -1; }
     Py_INCREF(a);
     PyList_SET_ITEM(uses, i, a);
   }
   PyObject *scalars = PyList_New(n_scalar);
   for (mx_uint i = 0; i < n_scalar; ++i)
     PyList_SET_ITEM(scalars, i, PyFloat_FromDouble(scalar_args[i]));
+  NDArrayRec *mrec = static_cast<NDArrayRec *>(mutate_vars[0]);
   PyObject *muts = PyList_New(1);
-  PyObject *m = static_cast<NDArrayRec *>(mutate_vars[0])->arr;
+  PyObject *m = mrec->arr ? mrec->arr : Py_None;
   Py_INCREF(m);
   PyList_SET_ITEM(muts, 0, m);
   PyObject *r = call_helper("func_invoke", "(sOOO)", fname->c_str(), uses,
@@ -790,6 +834,10 @@ int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
   Py_DECREF(scalars);
   Py_DECREF(muts);
   if (!r) return -1;
+  if (!mrec->arr && r != Py_None) {
+    Py_INCREF(r);           /* helper returned the allocated result */
+    fill_empty_rec(mrec, r);
+  }
   Py_DECREF(r);
   return 0;
 }
@@ -1371,6 +1419,615 @@ int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char **buf,
   Py_DECREF(bytes);
   *buf = rec->buf.data();
   *size = rec->buf.size();
+  return 0;
+}
+
+
+/* ---- Round-2 breadth: NDArray extras ---------------------------------- */
+
+int MXNDArrayCreateNone(NDArrayHandle *out) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  NDArrayRec *rec = new NDArrayRec();  /* arr == nullptr until filled */
+  *out = rec;
+  return 0;
+}
+
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out) {
+  GIL gil;
+  NDArrayRec *rec = static_cast<NDArrayRec *>(handle);
+  if (!arr_of(handle)) return -1;
+  return wrap_result_ndarray(
+      call_helper("ndarray_at", "(OI)", rec->arr, idx), out);
+}
+
+int MXNDArrayGetData(NDArrayHandle handle, mx_float **out_pdata) {
+  GIL gil;
+  NDArrayRec *rec = static_cast<NDArrayRec *>(handle);
+  if (!arr_of(handle)) return -1;
+  PyObject *bytes = call_helper("ndarray_bytes", "(O)", rec->arr);
+  if (!bytes) return -1;
+  size_t n = (size_t)PyBytes_Size(bytes) / sizeof(mx_float);
+  rec->host.resize(n);
+  std::memcpy(rec->host.data(), PyBytes_AsString(bytes),
+              n * sizeof(mx_float));
+  Py_DECREF(bytes);
+  *out_pdata = rec->host.data();
+  return 0;
+}
+
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                          const char **out_buf) {
+  GIL gil;
+  NDArrayRec *rec = static_cast<NDArrayRec *>(handle);
+  if (!arr_of(handle)) return -1;
+  PyObject *bytes = call_helper("ndarray_save_raw", "(O)", rec->arr);
+  if (!bytes) return -1;
+  rec->raw.assign(PyBytes_AsString(bytes), (size_t)PyBytes_Size(bytes));
+  Py_DECREF(bytes);
+  *out_size = rec->raw.size();
+  *out_buf = rec->raw.data();
+  return 0;
+}
+
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                              NDArrayHandle *out) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *mv = PyMemoryView_FromMemory(
+      const_cast<char *>(static_cast<const char *>(buf)), (Py_ssize_t)size,
+      PyBUF_READ);
+  if (!mv) { set_error_from_python(); return -1; }
+  PyObject *arr = call_helper("ndarray_load_raw", "(O)", mv);
+  Py_DECREF(mv);
+  return wrap_result_ndarray(arr, out);
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  GIL gil;
+  NDArrayRec *rec = static_cast<NDArrayRec *>(handle);
+  if (!arr_of(handle)) return -1;
+  PyObject *r = call_helper("ndarray_wait_to_read", "(O)", rec->arr);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitToWrite(NDArrayHandle handle) {
+  GIL gil;
+  NDArrayRec *rec = static_cast<NDArrayRec *>(handle);
+  if (!arr_of(handle)) return -1;
+  PyObject *r = call_helper("ndarray_wait_to_write", "(O)", rec->arr);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRandomSeed(int seed) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *r = call_helper("random_seed", "(i)", seed);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNotifyShutdown(void) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *r = call_helper("notify_shutdown", "()");
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                   const mx_float *scalar_args, NDArrayHandle *mutate_vars,
+                   int num_params, const char **param_keys,
+                   const char **param_vals) {
+  GIL gil;
+  OpInfoRec *info = func_info_rec(fun);
+  if (!info) return -1;
+  PyObject *use = PyList_New(info->n_use);
+  for (mx_uint i = 0; i < info->n_use; ++i) {
+    PyObject *a = arr_of(use_vars[i]);
+    if (!a) { Py_DECREF(use); return -1; }
+    Py_INCREF(a);
+    PyList_SET_ITEM(use, i, a);
+  }
+  PyObject *scal = PyList_New(info->n_scalar);
+  for (mx_uint i = 0; i < info->n_scalar; ++i)
+    PyList_SET_ITEM(scal, i, PyFloat_FromDouble(scalar_args[i]));
+  NDArrayRec *mrec = static_cast<NDArrayRec *>(mutate_vars[0]);
+  PyObject *mut = PyList_New(1);
+  PyObject *m0 = mrec->arr ? mrec->arr : Py_None;
+  Py_INCREF(m0);
+  PyList_SET_ITEM(mut, 0, m0);
+  PyObject *keys = PyList_New(num_params);
+  PyObject *vals = PyList_New(num_params);
+  for (int i = 0; i < num_params; ++i) {
+    PyList_SET_ITEM(keys, i, PyUnicode_FromString(param_keys[i]));
+    PyList_SET_ITEM(vals, i, PyUnicode_FromString(param_vals[i]));
+  }
+  PyObject *r = call_helper("func_invoke_ex", "(sOOOOO)", info->name.c_str(),
+                            use, scal, mut, keys, vals);
+  Py_DECREF(use);
+  Py_DECREF(scal);
+  Py_DECREF(mut);
+  Py_DECREF(keys);
+  Py_DECREF(vals);
+  if (!r) return -1;
+  if (!mrec->arr && r != Py_None) {
+    Py_INCREF(r);
+    fill_empty_rec(mrec, r);
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- Round-2 breadth: Symbol ------------------------------------------ */
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *sym = call_helper("symbol_from_file", "(s)", fname);
+  if (!sym) return -1;
+  SymbolRec *rec = new SymbolRec();
+  rec->sym = sym;
+  *out = rec;
+  return 0;
+}
+
+int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname) {
+  GIL gil;
+  SymbolRec *rec = static_cast<SymbolRec *>(symbol);
+  PyObject *r = call_helper("symbol_save_to_file", "(Os)", rec->sym, fname);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success) {
+  GIL gil;
+  SymbolRec *rec = static_cast<SymbolRec *>(symbol);
+  PyObject *r = call_helper("symbol_name", "(O)", rec->sym);
+  if (!r) return -1;
+  if (r == Py_None) {
+    rec->name.clear();
+    *success = 0;
+  } else {
+    rec->name = PyUnicode_AsUTF8(r);
+    *success = 1;
+  }
+  Py_DECREF(r);
+  *out = rec->name.c_str();
+  return 0;
+}
+
+int MXSymbolPrint(SymbolHandle symbol, const char **out_str) {
+  GIL gil;
+  SymbolRec *rec = static_cast<SymbolRec *>(symbol);
+  PyObject *r = call_helper("symbol_print", "(O)", rec->sym);
+  if (!r) return -1;
+  rec->print_str = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out_str = rec->print_str.c_str();
+  return 0;
+}
+
+int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt, const char **wrt,
+                 SymbolHandle *out) {
+  GIL gil;
+  SymbolRec *rec = static_cast<SymbolRec *>(sym);
+  PyObject *lst = PyList_New(num_wrt);
+  for (mx_uint i = 0; i < num_wrt; ++i)
+    PyList_SET_ITEM(lst, i, PyUnicode_FromString(wrt[i]));
+  PyObject *g = call_helper("symbol_grad", "(OO)", rec->sym, lst);
+  Py_DECREF(lst);
+  if (!g) return -1;
+  SymbolRec *grec = new SymbolRec();
+  grec->sym = g;
+  *out = grec;
+  return 0;
+}
+
+int MXSymbolInferShapePartial(SymbolHandle handle, mx_uint num_args,
+                              const char **keys, const mx_uint *arg_ind_ptr,
+                              const mx_uint *arg_shape_data,
+                              mx_uint *in_shape_size,
+                              const mx_uint **in_shape_ndim,
+                              const mx_uint ***in_shape_data,
+                              mx_uint *out_shape_size,
+                              const mx_uint **out_shape_ndim,
+                              const mx_uint ***out_shape_data,
+                              mx_uint *aux_shape_size,
+                              const mx_uint **aux_shape_ndim,
+                              const mx_uint ***aux_shape_data,
+                              int *complete) {
+  GIL gil;
+  SymbolRec *rec = static_cast<SymbolRec *>(handle);
+  PyObject *shapes = shape_dict(num_args, keys, arg_ind_ptr, arg_shape_data);
+  PyObject *r = call_helper("symbol_infer_shape_partial", "(OO)", rec->sym,
+                            shapes);
+  Py_DECREF(shapes);
+  if (!r) return -1;
+  rec->in_shapes.fill(PyTuple_GET_ITEM(r, 0));
+  rec->out_shapes.fill(PyTuple_GET_ITEM(r, 1));
+  rec->aux_shapes.fill(PyTuple_GET_ITEM(r, 2));
+  *complete = PyObject_IsTrue(PyTuple_GET_ITEM(r, 3));
+  Py_DECREF(r);
+  *in_shape_size = (mx_uint)rec->in_shapes.shapes.size();
+  *in_shape_ndim = rec->in_shapes.ndims.data();
+  *in_shape_data = rec->in_shapes.ptrs.data();
+  *out_shape_size = (mx_uint)rec->out_shapes.shapes.size();
+  *out_shape_ndim = rec->out_shapes.ndims.data();
+  *out_shape_data = rec->out_shapes.ptrs.data();
+  *aux_shape_size = (mx_uint)rec->aux_shapes.shapes.size();
+  *aux_shape_ndim = rec->aux_shapes.ndims.data();
+  *aux_shape_data = rec->aux_shapes.ptrs.data();
+  return 0;
+}
+
+int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                            const char ***out) {
+  GIL gil;
+  SymbolRec *rec = static_cast<SymbolRec *>(symbol);
+  PyObject *r = call_helper("symbol_list_attr_shallow", "(O)", rec->sym);
+  if (!r) return -1;
+  *out = rec->attr_shallow.fill(r);
+  *out_size = (mx_uint)(rec->attr_shallow.store.size() / 2);
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- Round-2 breadth: Executor bind family ---------------------------- */
+
+static int executor_bind_impl(SymbolHandle symbol_handle, int dev_type,
+                              int dev_id, mx_uint num_map_keys,
+                              const char **map_keys, const int *map_dev_types,
+                              const int *map_dev_ids, mx_uint len,
+                              NDArrayHandle *in_args,
+                              NDArrayHandle *arg_grad_store,
+                              mx_uint *grad_req_type, mx_uint aux_states_len,
+                              NDArrayHandle *aux_states,
+                              ExecutorHandle shared_exec,
+                              ExecutorHandle *out) {
+  GIL gil;
+  SymbolRec *srec = static_cast<SymbolRec *>(symbol_handle);
+  PyObject *gkeys = PyList_New(num_map_keys);
+  PyObject *gtypes = PyList_New(num_map_keys);
+  PyObject *gids = PyList_New(num_map_keys);
+  for (mx_uint i = 0; i < num_map_keys; ++i) {
+    PyList_SET_ITEM(gkeys, i, PyUnicode_FromString(map_keys[i]));
+    PyList_SET_ITEM(gtypes, i, PyLong_FromLong(map_dev_types[i]));
+    PyList_SET_ITEM(gids, i, PyLong_FromLong(map_dev_ids[i]));
+  }
+  PyObject *args = PyList_New(len);
+  PyObject *grads = PyList_New(len);
+  PyObject *reqs = PyList_New(len);
+  for (mx_uint i = 0; i < len; ++i) {
+    PyObject *a = arr_of(in_args[i]);
+    if (!a) { Py_DECREF(args); Py_DECREF(grads); Py_DECREF(reqs);
+              Py_DECREF(gkeys); Py_DECREF(gtypes); Py_DECREF(gids);
+              return -1; }
+    Py_INCREF(a);
+    PyList_SET_ITEM(args, i, a);
+    if (arg_grad_store && arg_grad_store[i]) {
+      PyObject *g = static_cast<NDArrayRec *>(arg_grad_store[i])->arr;
+      Py_INCREF(g);
+      PyList_SET_ITEM(grads, i, g);
+    } else {
+      Py_INCREF(Py_None);
+      PyList_SET_ITEM(grads, i, Py_None);
+    }
+    PyList_SET_ITEM(reqs, i,
+                    PyLong_FromLong(grad_req_type ? grad_req_type[i] : 0));
+  }
+  PyObject *aux = PyList_New(aux_states_len);
+  for (mx_uint i = 0; i < aux_states_len; ++i) {
+    PyObject *a = static_cast<NDArrayRec *>(aux_states[i])->arr;
+    Py_INCREF(a);
+    PyList_SET_ITEM(aux, i, a);
+  }
+  PyObject *shared = Py_None;
+  if (shared_exec) shared = static_cast<ExecRec *>(shared_exec)->exe;
+  PyObject *exe = call_helper("executor_bind", "(OiiOOOOOOOO)", srec->sym,
+                              dev_type, dev_id, gkeys, gtypes, gids, args,
+                              grads, reqs, aux, shared);
+  Py_DECREF(gkeys); Py_DECREF(gtypes); Py_DECREF(gids);
+  Py_DECREF(args); Py_DECREF(grads); Py_DECREF(reqs); Py_DECREF(aux);
+  if (!exe) return -1;
+  ExecRec *rec = new ExecRec();
+  rec->exe = exe;
+  *out = rec;
+  return 0;
+}
+
+int MXExecutorBind(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                   mx_uint len, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                   mx_uint aux_states_len, NDArrayHandle *aux_states,
+                   ExecutorHandle *out) {
+  return executor_bind_impl(symbol_handle, dev_type, dev_id, 0, nullptr,
+                            nullptr, nullptr, len, in_args, arg_grad_store,
+                            grad_req_type, aux_states_len, aux_states,
+                            nullptr, out);
+}
+
+int MXExecutorBindX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                    mx_uint num_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    mx_uint len, NDArrayHandle *in_args,
+                    NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                    mx_uint aux_states_len, NDArrayHandle *aux_states,
+                    ExecutorHandle *out) {
+  return executor_bind_impl(symbol_handle, dev_type, dev_id, num_map_keys,
+                            map_keys, map_dev_types, map_dev_ids, len,
+                            in_args, arg_grad_store, grad_req_type,
+                            aux_states_len, aux_states, nullptr, out);
+}
+
+int MXExecutorBindEX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                     mx_uint num_map_keys, const char **map_keys,
+                     const int *map_dev_types, const int *map_dev_ids,
+                     mx_uint len, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                     mx_uint aux_states_len, NDArrayHandle *aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle *out) {
+  return executor_bind_impl(symbol_handle, dev_type, dev_id, num_map_keys,
+                            map_keys, map_dev_types, map_dev_ids, len,
+                            in_args, arg_grad_store, grad_req_type,
+                            aux_states_len, aux_states, shared_exec, out);
+}
+
+int MXExecutorPrint(ExecutorHandle handle, const char **out_str) {
+  GIL gil;
+  ExecRec *rec = static_cast<ExecRec *>(handle);
+  PyObject *r = call_helper("executor_print", "(O)", rec->exe);
+  if (!r) return -1;
+  rec->print_str = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  *out_str = rec->print_str.c_str();
+  return 0;
+}
+
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 ExecutorMonitorCallback callback,
+                                 void *callback_handle) {
+  GIL gil;
+  ExecRec *rec = static_cast<ExecRec *>(handle);
+  std::string lib = self_lib_path();
+  if (lib.empty()) {
+    set_error("cannot locate own shared library for monitor bridge");
+    return -1;
+  }
+  PyObject *r = call_helper(
+      "executor_set_monitor_callback", "(OKKs)", rec->exe,
+      (unsigned long long)(uintptr_t)callback,
+      (unsigned long long)(uintptr_t)callback_handle, lib.c_str());
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- Round-2 breadth: Optimizer --------------------------------------- */
+
+static std::map<std::string, std::string> g_opt_creators;
+
+int MXOptimizerFindCreator(const char *key, OptimizerCreator *out) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *r = call_helper("optimizer_find_creator", "(s)", key);
+  if (!r) return -1;
+  std::string canonical = PyUnicode_AsUTF8(r);
+  Py_DECREF(r);
+  auto it = g_opt_creators.emplace(canonical, canonical).first;
+  *out = const_cast<char *>(it->second.c_str());
+  return 0;
+}
+
+int MXOptimizerCreateOptimizer(OptimizerCreator creator, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               OptimizerHandle *out) {
+  GIL gil;
+  PyObject *pkeys = PyList_New(num_param);
+  PyObject *pvals = PyList_New(num_param);
+  for (mx_uint i = 0; i < num_param; ++i) {
+    PyList_SET_ITEM(pkeys, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(pvals, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject *opt = call_helper("optimizer_create", "(sOO)",
+                              static_cast<const char *>(creator), pkeys,
+                              pvals);
+  Py_DECREF(pkeys);
+  Py_DECREF(pvals);
+  if (!opt) return -1;
+  OptimizerRec *rec = new OptimizerRec();
+  rec->opt = opt;
+  *out = rec;
+  return 0;
+}
+
+int MXOptimizerFree(OptimizerHandle handle) {
+  GIL gil;
+  OptimizerRec *rec = static_cast<OptimizerRec *>(handle);
+  Py_XDECREF(rec->opt);
+  delete rec;
+  return 0;
+}
+
+int MXOptimizerUpdate(OptimizerHandle handle, int index, NDArrayHandle weight,
+                      NDArrayHandle grad, mx_float lr, mx_float wd) {
+  GIL gil;
+  OptimizerRec *rec = static_cast<OptimizerRec *>(handle);
+  PyObject *w = arr_of(weight);
+  PyObject *g = arr_of(grad);
+  if (!w || !g) return -1;
+  PyObject *r = call_helper(
+      "optimizer_update", "(OiOOff)", rec->opt, index, w, g,
+      (double)lr, (double)wd);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- Round-2 breadth: Rtc --------------------------------------------- */
+
+int MXRtcCreate(const char *name, mx_uint num_input, mx_uint num_output,
+                const char **input_names, const char **output_names,
+                NDArrayHandle *inputs, NDArrayHandle *outputs,
+                const char *kernel, RtcHandle *out) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *in_names = PyList_New(num_input);
+  PyObject *ins = PyList_New(num_input);
+  for (mx_uint i = 0; i < num_input; ++i) {
+    PyList_SET_ITEM(in_names, i, PyUnicode_FromString(input_names[i]));
+    PyObject *a = static_cast<NDArrayRec *>(inputs[i])->arr;
+    Py_INCREF(a);
+    PyList_SET_ITEM(ins, i, a);
+  }
+  PyObject *out_names = PyList_New(num_output);
+  PyObject *outs = PyList_New(num_output);
+  for (mx_uint i = 0; i < num_output; ++i) {
+    PyList_SET_ITEM(out_names, i, PyUnicode_FromString(output_names[i]));
+    PyObject *a = static_cast<NDArrayRec *>(outputs[i])->arr;
+    Py_INCREF(a);
+    PyList_SET_ITEM(outs, i, a);
+  }
+  PyObject *rtc = call_helper("rtc_create", "(sOOOOs)", name, in_names,
+                              out_names, ins, outs, kernel);
+  Py_DECREF(in_names); Py_DECREF(ins);
+  Py_DECREF(out_names); Py_DECREF(outs);
+  if (!rtc) return -1;
+  RtcRec *rec = new RtcRec();
+  rec->rtc = rtc;
+  *out = rec;
+  return 0;
+}
+
+int MXRtcPush(RtcHandle handle, mx_uint num_input, mx_uint num_output,
+              NDArrayHandle *inputs, NDArrayHandle *outputs,
+              mx_uint gridDimX, mx_uint gridDimY, mx_uint gridDimZ,
+              mx_uint blockDimX, mx_uint blockDimY, mx_uint blockDimZ) {
+  GIL gil;
+  RtcRec *rec = static_cast<RtcRec *>(handle);
+  PyObject *ins = PyList_New(num_input);
+  for (mx_uint i = 0; i < num_input; ++i) {
+    PyObject *a = static_cast<NDArrayRec *>(inputs[i])->arr;
+    Py_INCREF(a);
+    PyList_SET_ITEM(ins, i, a);
+  }
+  PyObject *outs = PyList_New(num_output);
+  for (mx_uint i = 0; i < num_output; ++i) {
+    PyObject *a = static_cast<NDArrayRec *>(outputs[i])->arr;
+    Py_INCREF(a);
+    PyList_SET_ITEM(outs, i, a);
+  }
+  PyObject *grid = Py_BuildValue("(III)", gridDimX, gridDimY, gridDimZ);
+  PyObject *block = Py_BuildValue("(III)", blockDimX, blockDimY, blockDimZ);
+  PyObject *r = call_helper("rtc_push", "(OOOOO)", rec->rtc, ins, outs,
+                            grid, block);
+  Py_DECREF(ins); Py_DECREF(outs); Py_DECREF(grid); Py_DECREF(block);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRtcFree(RtcHandle handle) {
+  GIL gil;
+  RtcRec *rec = static_cast<RtcRec *>(handle);
+  Py_XDECREF(rec->rtc);
+  delete rec;
+  return 0;
+}
+
+/* ---- Round-2 breadth: KVStore roles / server / PS env ----------------- */
+
+int MXInitPSEnv(mx_uint num_vars, const char **keys, const char **vals) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *pkeys = PyList_New(num_vars);
+  PyObject *pvals = PyList_New(num_vars);
+  for (mx_uint i = 0; i < num_vars; ++i) {
+    PyList_SET_ITEM(pkeys, i, PyUnicode_FromString(keys[i]));
+    PyList_SET_ITEM(pvals, i, PyUnicode_FromString(vals[i]));
+  }
+  PyObject *r = call_helper("init_ps_env", "(OO)", pkeys, pvals);
+  Py_DECREF(pkeys);
+  Py_DECREF(pvals);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+static int role_predicate(const char *which, int *ret) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  PyObject *r = call_helper("kv_role", "(s)", which);
+  if (!r) return -1;
+  *ret = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreIsWorkerNode(int *ret) { return role_predicate("worker", ret); }
+int MXKVStoreIsServerNode(int *ret) { return role_predicate("server", ret); }
+int MXKVStoreIsSchedulerNode(int *ret) {
+  return role_predicate("scheduler", ret);
+}
+
+int MXKVStoreRunServer(KVStoreHandle handle,
+                       MXKVStoreServerController controller,
+                       void *controller_handle) {
+  GIL gil;
+  KVRec *rec = static_cast<KVRec *>(handle);
+  PyObject *r = call_helper(
+      "kv_run_server", "(OKK)", rec->kv,
+      (unsigned long long)(uintptr_t)controller,
+      (unsigned long long)(uintptr_t)controller_handle);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- Round-2 breadth: RecordIO seek/tell ------------------------------ */
+
+int MXRecordIOReaderSeek(RecordIOHandle *handle, size_t pos) {
+  GIL gil;
+  RecIORec *rec = *reinterpret_cast<RecIORec **>(handle);
+  PyObject *r = call_helper("recordio_seek", "(OK)", rec->rec,
+                            (unsigned long long)pos);
+  if (!r) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXRecordIOWriterTell(RecordIOHandle *handle, size_t *pos) {
+  GIL gil;
+  RecIORec *rec = *reinterpret_cast<RecIORec **>(handle);
+  PyObject *r = call_helper("recordio_tell", "(O)", rec->rec);
+  if (!r) return -1;
+  *pos = (size_t)PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ---- Round-2 breadth: C custom operators ------------------------------ */
+
+int MXCustomOpRegister(const char *op_type, CustomOpPropCreator creator) {
+  if (!ensure_interpreter()) return -1;
+  GIL gil;
+  std::string lib = self_lib_path();
+  if (lib.empty()) {
+    set_error("cannot locate own shared library for custom-op bridge");
+    return -1;
+  }
+  PyObject *r = call_helper("custom_op_register", "(sKs)", op_type,
+                            (unsigned long long)(uintptr_t)creator,
+                            lib.c_str());
+  if (!r) return -1;
+  Py_DECREF(r);
   return 0;
 }
 

@@ -1,0 +1,596 @@
+"""Round-2 C ABI breadth: the reference C API functions added on top of
+the round-1 subset — NDArray extras (At/GetData/raw bytes/waits), symbol
+file IO / name / print / grad / partial shape inference, the full
+executor bind family + monitor callback, the optimizer C surface, Rtc,
+KVStore role predicates / RunServer, RecordIO seek/tell, FuncInvokeEx,
+and MXCustomOpRegister driven end-to-end through sym.Custom.
+
+Reference analogue: include/mxnet/c_api.h (~110 functions) /
+src/c_api/c_api.cc:116-1338.
+"""
+import ctypes
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+LIB = os.path.join(REPO, "mxnet_tpu", "_native", "libmxtpu_predict.so")
+
+
+def _lib():
+    if not shutil.which("make"):
+        pytest.skip("no make toolchain")
+    r = subprocess.run(["make", "-C", REPO, "predict"], capture_output=True,
+                       text=True)
+    if r.returncode != 0 or not os.path.exists(LIB):
+        pytest.skip("c api build failed: %s" % r.stderr[-500:])
+    lib = ctypes.CDLL(LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _fptr(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _make_array(lib, np_arr):
+    np_arr = np.ascontiguousarray(np_arr, dtype=np.float32)
+    h = ctypes.c_void_p()
+    shape = (ctypes.c_uint32 * np_arr.ndim)(*np_arr.shape)
+    assert lib.MXNDArrayCreate(shape, np_arr.ndim, 1, 0,
+                               ctypes.byref(h)) == 0, lib.MXGetLastError()
+    flat = np_arr.ravel()
+    assert lib.MXNDArraySyncCopyFromCPU(h, _fptr(flat), flat.size) == 0
+    return h
+
+
+def _read_array(lib, h, shape):
+    if isinstance(h, int):   # c_void_p-array indexing yields raw ints,
+        h = ctypes.c_void_p(h)   # which ctypes would truncate to C int
+    out = np.zeros(int(np.prod(shape)), dtype=np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(h, _fptr(out), out.size) == 0, \
+        lib.MXGetLastError()
+    return out.reshape(shape)
+
+
+def test_ndarray_extras(tmp_path):
+    lib = _lib()
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    h = _make_array(lib, x)
+
+    assert lib.MXNDArrayWaitToRead(h) == 0
+    assert lib.MXNDArrayWaitToWrite(h) == 0
+
+    # At: row indexing drops the leading axis
+    row = ctypes.c_void_p()
+    assert lib.MXNDArrayAt(h, 1, ctypes.byref(row)) == 0, lib.MXGetLastError()
+    np.testing.assert_array_equal(_read_array(lib, row, (4,)), x[1])
+    assert lib.MXNDArrayFree(row) == 0
+
+    # GetData: host view of the floats
+    pdata = ctypes.POINTER(ctypes.c_float)()
+    assert lib.MXNDArrayGetData(h, ctypes.byref(pdata)) == 0
+    np.testing.assert_array_equal(
+        np.array([pdata[i] for i in range(12)], np.float32).reshape(3, 4), x)
+
+    # raw byte round-trip
+    size = ctypes.c_size_t()
+    buf = ctypes.POINTER(ctypes.c_char)()
+    assert lib.MXNDArraySaveRawBytes(h, ctypes.byref(size),
+                                     ctypes.byref(buf)) == 0
+    blob = ctypes.string_at(buf, size.value)
+    h2 = ctypes.c_void_p()
+    assert lib.MXNDArrayLoadFromRawBytes(blob, len(blob),
+                                         ctypes.byref(h2)) == 0, \
+        lib.MXGetLastError()
+    np.testing.assert_array_equal(_read_array(lib, h2, (3, 4)), x)
+    assert lib.MXNDArrayFree(h2) == 0
+
+    # CreateNone: empty handle is completed by an allocating invoke and
+    # rejected (not crashed on) by functions needing an allocated array
+    none_h = ctypes.c_void_p()
+    assert lib.MXNDArrayCreateNone(ctypes.byref(none_h)) == 0
+    assert lib.MXNDArrayWaitToRead(none_h) == -1  # clean error, no crash
+    fh = ctypes.c_void_p()
+    assert lib.MXGetFunction(b"_mul_scalar", ctypes.byref(fh)) == 0
+    use = (ctypes.c_void_p * 1)(h)
+    mut = (ctypes.c_void_p * 1)(none_h)
+    scal = (ctypes.c_float * 1)(3.0)
+    assert lib.MXFuncInvoke(fh, use, scal, mut) == 0, lib.MXGetLastError()
+    np.testing.assert_allclose(_read_array(lib, none_h, (3, 4)), x * 3.0)
+    assert lib.MXNDArrayFree(none_h) == 0
+
+    assert lib.MXRandomSeed(7) == 0
+    assert lib.MXNotifyShutdown() == 0
+    assert lib.MXNDArrayFree(h) == 0
+
+
+def _mlp_json():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    act = mx.sym.Activation(fc, act_type="relu", name="relu")
+    return act.tojson(), act
+
+
+def test_symbol_file_name_print_attr(tmp_path):
+    lib = _lib()
+    json_str, _ = _mlp_json()
+    h = ctypes.c_void_p()
+    assert lib.MXSymbolCreateFromJSON(json_str.encode(),
+                                      ctypes.byref(h)) == 0
+
+    fname = str(tmp_path / "net.json").encode()
+    assert lib.MXSymbolSaveToFile(h, fname) == 0
+    h2 = ctypes.c_void_p()
+    assert lib.MXSymbolCreateFromFile(fname, ctypes.byref(h2)) == 0, \
+        lib.MXGetLastError()
+
+    name = ctypes.c_char_p()
+    success = ctypes.c_int()
+    assert lib.MXSymbolGetName(h2, ctypes.byref(name),
+                               ctypes.byref(success)) == 0
+    assert success.value == 1 and name.value == b"relu"
+
+    out_str = ctypes.c_char_p()
+    assert lib.MXSymbolPrint(h2, ctypes.byref(out_str)) == 0
+    dump = out_str.value.decode()
+    assert "Variable:data" in dump and "relu" in dump
+
+    assert lib.MXSymbolSetAttr(h2, b"ctx_group", b"dev1") == 0
+    n = ctypes.c_uint32()
+    flat = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXSymbolListAttrShallow(h2, ctypes.byref(n),
+                                       ctypes.byref(flat)) == 0
+    pairs = {flat[2 * i]: flat[2 * i + 1] for i in range(n.value)}
+    assert pairs.get(b"ctx_group") == b"dev1"
+    lib.MXSymbolFree(h)
+    lib.MXSymbolFree(h2)
+
+
+def test_symbol_infer_shape_partial():
+    lib = _lib()
+    json_str, _ = _mlp_json()
+    h = ctypes.c_void_p()
+    assert lib.MXSymbolCreateFromJSON(json_str.encode(),
+                                      ctypes.byref(h)) == 0
+
+    def run(keys_shapes):
+        keys = (ctypes.c_char_p * len(keys_shapes))(
+            *[k.encode() for k, _ in keys_shapes])
+        ind = [0]
+        flat = []
+        for _, s in keys_shapes:
+            flat.extend(s)
+            ind.append(len(flat))
+        ind_arr = (ctypes.c_uint32 * len(ind))(*ind)
+        data_arr = (ctypes.c_uint32 * max(len(flat), 1))(*flat or [0])
+        sizes = [ctypes.c_uint32() for _ in range(3)]
+        ndims = [ctypes.POINTER(ctypes.c_uint32)() for _ in range(3)]
+        datas = [ctypes.POINTER(ctypes.POINTER(ctypes.c_uint32))()
+                 for _ in range(3)]
+        complete = ctypes.c_int()
+        assert lib.MXSymbolInferShapePartial(
+            h, len(keys_shapes), keys, ind_arr, data_arr,
+            ctypes.byref(sizes[0]), ctypes.byref(ndims[0]),
+            ctypes.byref(datas[0]),
+            ctypes.byref(sizes[1]), ctypes.byref(ndims[1]),
+            ctypes.byref(datas[1]),
+            ctypes.byref(sizes[2]), ctypes.byref(ndims[2]),
+            ctypes.byref(datas[2]), ctypes.byref(complete)) == 0, \
+            lib.MXGetLastError()
+        args = [tuple(datas[0][i][d] for d in range(ndims[0][i]))
+                for i in range(sizes[0].value)]
+        outs = [tuple(datas[1][i][d] for d in range(ndims[1][i]))
+                for i in range(sizes[1].value)]
+        return args, outs, complete.value
+
+    # nothing known: weight/bias stay unknown, incomplete
+    args, outs, complete = run([])
+    assert complete == 0
+    # data known: everything resolves
+    args, outs, complete = run([("data", (2, 5))])
+    assert complete == 1
+    assert (2, 3) in outs and (3, 5) in args
+
+
+def test_symbol_grad_matches_python():
+    lib = _lib()
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=2, no_bias=True, name="fc")
+    h = ctypes.c_void_p()
+    assert lib.MXSymbolCreateFromJSON(fc.tojson().encode(),
+                                      ctypes.byref(h)) == 0
+    wrt = (ctypes.c_char_p * 1)(b"fc_weight")
+    gh = ctypes.c_void_p()
+    assert lib.MXSymbolGrad(h, 1, wrt, ctypes.byref(gh)) == 0, \
+        lib.MXGetLastError()
+    # bind the grad symbol through MXExecutorSimpleBind and check values
+    keys = (ctypes.c_char_p * 1)(b"data")
+    ind = (ctypes.c_uint32 * 2)(0, 2)
+    shp = (ctypes.c_uint32 * 2)(4, 3)
+    eh = ctypes.c_void_p()
+    assert lib.MXExecutorSimpleBind(gh, 1, 0, 1, keys, ind, shp, 0,
+                                    ctypes.byref(eh)) == 0, \
+        lib.MXGetLastError()
+    x = np.random.RandomState(0).rand(4, 3).astype(np.float32)
+    w = np.random.RandomState(1).rand(2, 3).astype(np.float32)
+    assert lib.MXExecutorSetArg(eh, b"data", _fptr(x), x.size) == 0
+    assert lib.MXExecutorSetArg(eh, b"fc_weight", _fptr(w), w.size) == 0
+    assert lib.MXExecutorForward(eh, 0) == 0, lib.MXGetLastError()
+    out = np.zeros(6, dtype=np.float32)
+    assert lib.MXExecutorGetOutput(eh, 0, _fptr(out), 6) == 0
+    # d(sum(x @ w.T))/dw = ones(4,2).T @ x
+    np.testing.assert_allclose(out.reshape(2, 3), np.ones((4, 2)).T @ x,
+                               rtol=2e-2)
+    lib.MXExecutorFree(eh)
+    lib.MXSymbolFree(h)
+    lib.MXSymbolFree(gh)
+
+
+def test_executor_bind_family_and_monitor():
+    lib = _lib()
+    json_str, sym = _mlp_json()
+    h = ctypes.c_void_p()
+    assert lib.MXSymbolCreateFromJSON(json_str.encode(),
+                                      ctypes.byref(h)) == 0
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 5).astype(np.float32)
+    w = rs.rand(3, 5).astype(np.float32)
+    b = np.zeros(3, np.float32)
+    arrs = [x, w, b]
+    handles = (ctypes.c_void_p * 3)(*[_make_array(lib, a) for a in arrs])
+    grads = (ctypes.c_void_p * 3)(
+        *[_make_array(lib, np.zeros_like(a)) for a in arrs])
+    reqs = (ctypes.c_uint32 * 3)(1, 1, 1)
+
+    eh = ctypes.c_void_p()
+    assert lib.MXExecutorBind(h, 1, 0, 3, handles, grads, reqs, 0, None,
+                              ctypes.byref(eh)) == 0, lib.MXGetLastError()
+
+    # monitor callback fires per internal output on forward
+    seen = []
+    cb_t = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                            ctypes.c_void_p)
+
+    @cb_t
+    def monitor(name, arr_handle, user):
+        seen.append(name.decode())
+
+    assert lib.MXExecutorSetMonitorCallback(eh, monitor, None) == 0, \
+        lib.MXGetLastError()
+
+    assert lib.MXExecutorForward(eh, 1) == 0, lib.MXGetLastError()
+    out = np.zeros(6, dtype=np.float32)
+    assert lib.MXExecutorGetOutput(eh, 0, _fptr(out), 6) == 0
+    expected = np.maximum(x @ w.T + b, 0)
+    np.testing.assert_allclose(out.reshape(2, 3), expected, rtol=2e-2)
+    assert any("fc" in s for s in seen) and any("relu" in s for s in seen)
+
+    assert lib.MXExecutorBackward(eh) == 0, lib.MXGetLastError()
+    gw = _read_array(lib, grads[1], (3, 5))
+    mask = (x @ w.T + b > 0).astype(np.float32)
+    np.testing.assert_allclose(gw, mask.T @ x, rtol=2e-2, atol=1e-4)
+
+    # the gradients also flow back into the arrays passed at bind time
+    out_str = ctypes.c_char_p()
+    assert lib.MXExecutorPrint(eh, ctypes.byref(out_str)) == 0
+    assert b"fc" in out_str.value
+    lib.MXExecutorFree(eh)
+
+    # BindX/BindEX accept group2ctx maps (single-device here)
+    map_keys = (ctypes.c_char_p * 1)(b"dev1")
+    map_types = (ctypes.c_int * 1)(1)
+    map_ids = (ctypes.c_int * 1)(0)
+    eh2 = ctypes.c_void_p()
+    assert lib.MXExecutorBindX(h, 1, 0, 1, map_keys, map_types, map_ids,
+                               3, handles, grads, reqs, 0, None,
+                               ctypes.byref(eh2)) == 0, lib.MXGetLastError()
+    eh3 = ctypes.c_void_p()
+    assert lib.MXExecutorBindEX(h, 1, 0, 1, map_keys, map_types, map_ids,
+                                3, handles, grads, reqs, 0, None, eh2,
+                                ctypes.byref(eh3)) == 0, lib.MXGetLastError()
+    lib.MXExecutorFree(eh3)
+    lib.MXExecutorFree(eh2)
+    lib.MXSymbolFree(h)
+
+
+def test_optimizer_c_surface():
+    lib = _lib()
+    creator = ctypes.c_void_p()
+    assert lib.MXOptimizerFindCreator(b"sgd", ctypes.byref(creator)) == 0, \
+        lib.MXGetLastError()
+    keys = (ctypes.c_char_p * 1)(b"momentum")
+    vals = (ctypes.c_char_p * 1)(b"0.9")
+    oh = ctypes.c_void_p()
+    assert lib.MXOptimizerCreateOptimizer(creator, 1, keys, vals,
+                                          ctypes.byref(oh)) == 0, \
+        lib.MXGetLastError()
+
+    w = np.ones(4, np.float32)
+    g = np.full(4, 0.5, np.float32)
+    wh = _make_array(lib, w)
+    gh = _make_array(lib, g)
+    lr, wd = 0.1, 0.0
+    assert lib.MXOptimizerUpdate(oh, 0, wh, gh, ctypes.c_float(lr),
+                                 ctypes.c_float(wd)) == 0, \
+        lib.MXGetLastError()
+    got1 = _read_array(lib, wh, (4,))
+    # first step: mom = -lr*g
+    np.testing.assert_allclose(got1, w - lr * g, rtol=1e-5)
+    assert lib.MXOptimizerUpdate(oh, 0, wh, gh, ctypes.c_float(lr),
+                                 ctypes.c_float(wd)) == 0
+    got2 = _read_array(lib, wh, (4,))
+    mom = -lr * g
+    mom = 0.9 * mom - lr * g
+    np.testing.assert_allclose(got2, got1 + mom, rtol=1e-5)
+    assert lib.MXOptimizerFree(oh) == 0
+    lib.MXNDArrayFree(wh)
+    lib.MXNDArrayFree(gh)
+
+    bad = ctypes.c_void_p()
+    assert lib.MXOptimizerFindCreator(b"nonexistent-opt",
+                                      ctypes.byref(bad)) == -1
+
+
+def test_rtc_c_surface():
+    lib = _lib()
+    a = _make_array(lib, np.arange(8, dtype=np.float32))
+    out = _make_array(lib, np.zeros(8, dtype=np.float32))
+    in_names = (ctypes.c_char_p * 1)(b"x")
+    out_names = (ctypes.c_char_p * 1)(b"y")
+    ins = (ctypes.c_void_p * 1)(a)
+    outs = (ctypes.c_void_p * 1)(out)
+    kernel = b"y_ref[...] = x_ref[...] * 2.0 + 1.0"
+    rh = ctypes.c_void_p()
+    assert lib.MXRtcCreate(b"double_plus", 1, 1, in_names, out_names,
+                           ins, outs, kernel, ctypes.byref(rh)) == 0, \
+        lib.MXGetLastError()
+    assert lib.MXRtcPush(rh, 1, 1, ins, outs, 1, 1, 1, 1, 1, 1) == 0, \
+        lib.MXGetLastError()
+    np.testing.assert_allclose(_read_array(lib, out, (8,)),
+                               np.arange(8) * 2.0 + 1.0)
+    assert lib.MXRtcFree(rh) == 0
+    lib.MXNDArrayFree(a)
+    lib.MXNDArrayFree(out)
+
+
+def test_kvstore_roles_and_run_server():
+    lib = _lib()
+    keys = (ctypes.c_char_p * 1)(b"MXTPU_TEST_PS_VAR")
+    vals = (ctypes.c_char_p * 1)(b"42")
+    assert lib.MXInitPSEnv(1, keys, vals) == 0
+    assert os.environ.get("MXTPU_TEST_PS_VAR") == "42"
+
+    ret = ctypes.c_int()
+    assert lib.MXKVStoreIsWorkerNode(ctypes.byref(ret)) == 0
+    assert ret.value == 1  # default role
+    assert lib.MXKVStoreIsServerNode(ctypes.byref(ret)) == 0
+    assert ret.value == 0
+    assert lib.MXKVStoreIsSchedulerNode(ctypes.byref(ret)) == 0
+    assert ret.value == 0
+
+    kh = ctypes.c_void_p()
+    assert lib.MXKVStoreCreate(b"local", ctypes.byref(kh)) == 0
+    got = []
+    ctrl_t = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_char_p,
+                              ctypes.c_void_p)
+
+    @ctrl_t
+    def controller(head, body, user):
+        got.append((head, body.decode()))
+
+    assert lib.MXKVStoreRunServer(kh, controller, None) == 0, \
+        lib.MXGetLastError()
+    assert lib.MXKVStoreSendCommmandToServers(kh, 3, b"lr=0.01") == 0
+    assert got == [(3, "lr=0.01")]
+    lib.MXKVStoreFree(kh)
+
+
+def test_recordio_tell_seek(tmp_path):
+    lib = _lib()
+    uri = str(tmp_path / "r.rec").encode()
+    wh = ctypes.c_void_p()
+    assert lib.MXRecordIOWriterCreate(uri, ctypes.byref(wh)) == 0
+    positions = []
+    for payload in (b"first", b"second", b"third"):
+        pos = ctypes.c_size_t()
+        assert lib.MXRecordIOWriterTell(ctypes.byref(wh),
+                                        ctypes.byref(pos)) == 0
+        positions.append(pos.value)
+        assert lib.MXRecordIOWriterWriteRecord(wh, payload, len(payload)) == 0
+    assert lib.MXRecordIOWriterFree(wh) == 0
+
+    rh = ctypes.c_void_p()
+    assert lib.MXRecordIOReaderCreate(uri, ctypes.byref(rh)) == 0
+    assert lib.MXRecordIOReaderSeek(ctypes.byref(rh), positions[1]) == 0
+    buf = ctypes.POINTER(ctypes.c_char)()
+    size = ctypes.c_size_t()
+    assert lib.MXRecordIOReaderReadRecord(rh, ctypes.byref(buf),
+                                          ctypes.byref(size)) == 0
+    assert ctypes.string_at(buf, size.value) == b"second"
+    assert lib.MXRecordIOReaderFree(rh) == 0
+
+
+def test_func_invoke_ex():
+    lib = _lib()
+    fh = ctypes.c_void_p()
+    assert lib.MXGetFunction(b"_plus_scalar", ctypes.byref(fh)) == 0
+    a = _make_array(lib, np.arange(4, dtype=np.float32))
+    out = _make_array(lib, np.zeros(4, dtype=np.float32))
+    use = (ctypes.c_void_p * 1)(a)
+    mut = (ctypes.c_void_p * 1)(out)
+    scal = (ctypes.c_float * 1)(2.0)
+    assert lib.MXFuncInvokeEx(fh, use, scal, mut, 0, None, None) == 0, \
+        lib.MXGetLastError()
+    np.testing.assert_allclose(_read_array(lib, out, (4,)),
+                               np.arange(4) + 2.0)
+    # unknown kwargs are rejected like the reference param parser
+    keys = (ctypes.c_char_p * 1)(b"bogus")
+    vals = (ctypes.c_char_p * 1)(b"1")
+    assert lib.MXFuncInvokeEx(fh, use, scal, mut, 1, keys, vals) == -1
+    lib.MXNDArrayFree(a)
+    lib.MXNDArrayFree(out)
+
+
+def test_custom_op_register_end_to_end():
+    """A C-ABI custom op (creator + forward/backward callbacks handed over
+    as function pointers) registered via MXCustomOpRegister and executed
+    through sym.Custom, gradients included."""
+    lib = _lib()
+
+    fwd_t = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int,
+                             ctypes.POINTER(ctypes.c_void_p),
+                             ctypes.POINTER(ctypes.c_int),
+                             ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+                             ctypes.c_void_p)
+    del_t = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
+    strlist_t = ctypes.CFUNCTYPE(ctypes.c_int,
+                                 ctypes.POINTER(ctypes.POINTER(
+                                     ctypes.c_char_p)), ctypes.c_void_p)
+    shape_t = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_int,
+                               ctypes.POINTER(ctypes.c_int),
+                               ctypes.POINTER(ctypes.POINTER(ctypes.c_uint)),
+                               ctypes.c_void_p)
+
+    class OpInfo(ctypes.Structure):
+        _fields_ = [("forward", fwd_t), ("backward", fwd_t), ("del_", del_t),
+                    ("p_forward", ctypes.c_void_p),
+                    ("p_backward", ctypes.c_void_p),
+                    ("p_del", ctypes.c_void_p)]
+
+    create_t = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint)),
+                                ctypes.POINTER(ctypes.c_int),
+                                ctypes.POINTER(ctypes.c_int),
+                                ctypes.POINTER(OpInfo), ctypes.c_void_p)
+
+    class PropInfo(ctypes.Structure):
+        _fields_ = [("list_arguments", strlist_t),
+                    ("list_outputs", strlist_t),
+                    ("infer_shape", shape_t),
+                    ("create_operator", create_t),
+                    ("list_auxiliary_states", strlist_t),
+                    ("del_", del_t),
+                    ("p_list_arguments", ctypes.c_void_p),
+                    ("p_list_outputs", ctypes.c_void_p),
+                    ("p_infer_shape", ctypes.c_void_p),
+                    ("p_create_operator", ctypes.c_void_p),
+                    ("p_list_auxiliary_states", ctypes.c_void_p),
+                    ("p_del", ctypes.c_void_p)]
+
+    creator_t = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                                 ctypes.POINTER(ctypes.c_char_p),
+                                 ctypes.POINTER(ctypes.c_char_p),
+                                 ctypes.POINTER(PropInfo))
+
+    keep = []  # keep every callback/buffer alive for the op's lifetime
+
+    arg_names = (ctypes.c_char_p * 2)(b"data", None)
+    out_names = (ctypes.c_char_p * 2)(b"output", None)
+    aux_names = (ctypes.c_char_p * 1)(None)
+
+    @strlist_t
+    def list_args(out, state):
+        ctypes.cast(out, ctypes.POINTER(ctypes.c_void_p))[0] = \
+            ctypes.cast(arg_names, ctypes.c_void_p)
+        return 1
+
+    @strlist_t
+    def list_outs(out, state):
+        ctypes.cast(out, ctypes.POINTER(ctypes.c_void_p))[0] = \
+            ctypes.cast(out_names, ctypes.c_void_p)
+        return 1
+
+    @strlist_t
+    def list_aux(out, state):
+        ctypes.cast(out, ctypes.POINTER(ctypes.c_void_p))[0] = \
+            ctypes.cast(aux_names, ctypes.c_void_p)
+        return 1
+
+    @shape_t
+    def infer_shape(num, ndims, shapes, state):
+        # output shape = input shape (already in slot 0); copy to slot 1
+        ndims[1] = ndims[0]
+        shapes[1] = shapes[0]
+        return 1
+
+    def _copy_to_host(handle):
+        ndim = ctypes.c_uint32()
+        pshape = ctypes.POINTER(ctypes.c_uint32)()
+        lib.MXNDArrayGetShape(handle, ctypes.byref(ndim),
+                              ctypes.byref(pshape))
+        shape = tuple(pshape[i] for i in range(ndim.value))
+        return _read_array(lib, handle, shape)
+
+    def _copy_from_host(handle, arr):
+        flat = np.ascontiguousarray(arr, np.float32).ravel()
+        assert lib.MXNDArraySyncCopyFromCPU(handle, _fptr(flat),
+                                            flat.size) == 0
+
+    @fwd_t
+    def forward(size, ptrs, tags, reqs, is_train, state):
+        by_tag = {}
+        for i in range(size):
+            by_tag.setdefault(tags[i], []).append(
+                ctypes.c_void_p(ptrs[i]))
+        x = _copy_to_host(by_tag[0][0])
+        _copy_from_host(by_tag[1][0], x * 2.0)  # y = 2x
+        return 1
+
+    @fwd_t
+    def backward(size, ptrs, tags, reqs, is_train, state):
+        by_tag = {}
+        for i in range(size):
+            by_tag.setdefault(tags[i], []).append(
+                ctypes.c_void_p(ptrs[i]))
+        dy = _copy_to_host(by_tag[4][0])
+        _copy_from_host(by_tag[3][0], dy * 2.0)  # dx = 2*dy
+        return 1
+
+    @del_t
+    def deleter(state):
+        return 1
+
+    @create_t
+    def create_operator(ctx, num_inputs, shapes, ndims, dtypes, ret, state):
+        ret[0].forward = forward
+        ret[0].backward = backward
+        ret[0].del_ = deleter
+        return 1
+
+    @creator_t
+    def creator(op_type, num_kwargs, keys, vals, ret):
+        ret[0].list_arguments = list_args
+        ret[0].list_outputs = list_outs
+        ret[0].list_auxiliary_states = list_aux
+        ret[0].infer_shape = infer_shape
+        ret[0].create_operator = create_operator
+        ret[0].del_ = deleter
+        return 1
+
+    keep.extend([list_args, list_outs, list_aux, infer_shape, forward,
+                 backward, deleter, create_operator, creator, arg_names,
+                 out_names, aux_names])
+
+    assert lib.MXCustomOpRegister(b"cdouble", creator) == 0, \
+        lib.MXGetLastError()
+
+    # drive it through the Python frontend like any registered custom op
+    data = mx.sym.Variable("data")
+    net = mx.sym.Custom(data, op_type="cdouble", name="cd")
+    exe = net.simple_bind(mx.cpu(), data=(2, 3))
+    x = np.random.RandomState(0).rand(2, 3).astype(np.float32)
+    exe.arg_dict["data"][:] = x
+    exe.forward(is_train=True)
+    out = exe.outputs[0].asnumpy()
+    np.testing.assert_allclose(out, x * 2.0, rtol=1e-5)
+    exe.backward([mx.nd.array(np.ones((2, 3), np.float32))])
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
+                               np.full((2, 3), 2.0), rtol=1e-5)
+    keep.clear()

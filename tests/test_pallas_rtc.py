@@ -63,22 +63,20 @@ def test_fused_linear_misaligned_falls_back():
     assert fused_linear(x, w) is None
 
 
-def test_fc_op_pallas_path():
-    os.environ["MXNET_TPU_PALLAS"] = "1"
-    try:
-        data = sym.Variable("data")
-        fc = sym.FullyConnected(data=data, num_hidden=128, name="fc")
-        rng = np.random.RandomState(0)
-        x = rng.randn(128, 256).astype(np.float32)
-        w = rng.randn(128, 256).astype(np.float32)
-        b = rng.randn(128).astype(np.float32)
-        ex = fc.bind(mx.cpu(), {"data": mx.nd.array(x),
-                                "fc_weight": mx.nd.array(w),
-                                "fc_bias": mx.nd.array(b)}, grad_req="null")
-        out = ex.forward()[0].asnumpy()
-        np.testing.assert_allclose(out, x @ w.T + b, rtol=1e-4, atol=1e-4)
-    finally:
-        del os.environ["MXNET_TPU_PALLAS"]
+def test_fused_linear_matches_fc():
+    """fused_linear stays correct even though the FC hot path is XLA
+    (the MXNET_TPU_PALLAS gate was retired on measured data —
+    docs/pallas.md)."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(128, 256).astype(np.float32)
+    w = rng.randn(128, 256).astype(np.float32)
+    b = rng.randn(128).astype(np.float32)
+    out = fused_linear(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+    assert out is not None
+    np.testing.assert_allclose(np.asarray(out), x @ w.T + b, rtol=1e-4,
+                               atol=1e-3)
 
 
 def test_rtc_kernel():
