@@ -655,35 +655,24 @@ def ndarray_at(arr, idx):
 
 
 def ndarray_save_raw(arr):
-    """Single-array container bytes (reference NDArray::Save raw form)."""
-    import os
-    import tempfile
+    """Single-array container bytes (reference NDArray::Save raw form) —
+    in-memory, no filesystem round-trip (this is the per-array transport
+    primitive for C frontends)."""
+    import io as _io
 
     from . import ndarray as nd
 
-    fd, path = tempfile.mkstemp(suffix=".ndraw")
-    os.close(fd)
-    try:
-        nd.save(path, [arr])
-        with open(path, "rb") as f:
-            return f.read()
-    finally:
-        os.unlink(path)
+    buf = _io.BytesIO()
+    nd.save_to_stream(buf, [arr])
+    return buf.getvalue()
 
 
 def ndarray_load_raw(blob):
-    import os
-    import tempfile
+    import io as _io
 
     from . import ndarray as nd
 
-    fd, path = tempfile.mkstemp(suffix=".ndraw")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            f.write(bytes(blob))
-        arrs = nd.load(path)
-    finally:
-        os.unlink(path)
+    arrs = nd.load_from_stream(_io.BytesIO(bytes(blob)), "<raw bytes>")
     if len(arrs) != 1:
         raise MXNetError("raw bytes hold %d arrays, expected 1" % len(arrs))
     return arrs[0]

@@ -520,8 +520,9 @@ def choose_element_0index(lhs: NDArray, rhs: NDArray) -> NDArray:
 _MAGIC = 0x54505541525241  # "TPUARRA"
 
 
-def save(fname: str, data) -> None:
-    """Save a list or str-keyed dict of NDArrays to a binary container."""
+def save_to_stream(f, data) -> None:
+    """Write the container to an open binary file object (used by both
+    :func:`save` and the C ABI's raw-bytes functions)."""
     if isinstance(data, dict):
         names = list(data.keys())
         arrays = [data[k] for k in names]
@@ -532,50 +533,60 @@ def save(fname: str, data) -> None:
         names, arrays = [], [data]
     else:
         raise MXNetError("save expects NDArray, list or dict of NDArray")
-    with open(fname, "wb") as f:
-        f.write(struct.pack("<QQQ", _MAGIC, 0, len(arrays)))
-        for arr in arrays:
-            np_arr = arr.asnumpy()
-            dtype_id = DTYPE_NP_TO_ID[np.dtype(np_arr.dtype)]
-            f.write(struct.pack("<I", np_arr.ndim))
-            f.write(struct.pack("<%dq" % np_arr.ndim, *np_arr.shape))
-            f.write(struct.pack("<I", dtype_id))
-            raw = np_arr.tobytes()
-            f.write(struct.pack("<Q", len(raw)))
-            f.write(raw)
-        f.write(struct.pack("<Q", len(names)))
-        for name in names:
-            b = name.encode("utf-8")
-            f.write(struct.pack("<Q", len(b)))
-            f.write(b)
+    f.write(struct.pack("<QQQ", _MAGIC, 0, len(arrays)))
+    for arr in arrays:
+        np_arr = arr.asnumpy()
+        dtype_id = DTYPE_NP_TO_ID[np.dtype(np_arr.dtype)]
+        f.write(struct.pack("<I", np_arr.ndim))
+        f.write(struct.pack("<%dq" % np_arr.ndim, *np_arr.shape))
+        f.write(struct.pack("<I", dtype_id))
+        raw = np_arr.tobytes()
+        f.write(struct.pack("<Q", len(raw)))
+        f.write(raw)
+    f.write(struct.pack("<Q", len(names)))
+    for name in names:
+        b = name.encode("utf-8")
+        f.write(struct.pack("<Q", len(b)))
+        f.write(b)
 
 
-def load(fname: str):
-    """Load NDArrays saved by :func:`save`. Returns list or dict."""
-    with open(fname, "rb") as f:
-        header = f.read(24)
-        if len(header) < 24:
-            raise MXNetError("invalid NDArray file %s: truncated header"
-                             % fname)
-        magic, _, n = struct.unpack("<QQQ", header)
-        if magic != _MAGIC:
-            raise MXNetError("invalid NDArray file %s" % fname)
-        arrays = []
-        for _ in range(n):
-            ndim, = struct.unpack("<I", f.read(4))
-            shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) if ndim else ()
-            dtype_id, = struct.unpack("<I", f.read(4))
-            nbytes, = struct.unpack("<Q", f.read(8))
-            raw = f.read(nbytes)
-            arr = np.frombuffer(raw, dtype=DTYPE_ID_TO_NP[dtype_id]).reshape(shape)
-            arrays.append(array(arr, dtype=arr.dtype))
-        n_names, = struct.unpack("<Q", f.read(8))
-        names = []
-        for _ in range(n_names):
-            ln, = struct.unpack("<Q", f.read(8))
-            names.append(f.read(ln).decode("utf-8"))
+def load_from_stream(f, what: str = "<stream>"):
+    """Read a container from an open binary file object; returns list or
+    dict like :func:`load`."""
+    header = f.read(24)
+    if len(header) < 24:
+        raise MXNetError("invalid NDArray file %s: truncated header" % what)
+    magic, _, n = struct.unpack("<QQQ", header)
+    if magic != _MAGIC:
+        raise MXNetError("invalid NDArray file %s" % what)
+    arrays = []
+    for _ in range(n):
+        ndim, = struct.unpack("<I", f.read(4))
+        shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) if ndim else ()
+        dtype_id, = struct.unpack("<I", f.read(4))
+        nbytes, = struct.unpack("<Q", f.read(8))
+        raw = f.read(nbytes)
+        arr = np.frombuffer(raw, dtype=DTYPE_ID_TO_NP[dtype_id]).reshape(shape)
+        arrays.append(array(arr, dtype=arr.dtype))
+    n_names, = struct.unpack("<Q", f.read(8))
+    names = []
+    for _ in range(n_names):
+        ln, = struct.unpack("<Q", f.read(8))
+        names.append(f.read(ln).decode("utf-8"))
     if names:
         if len(names) != len(arrays):
             raise MXNetError("corrupt NDArray file: name/array count mismatch")
         return dict(zip(names, arrays))
     return arrays
+
+
+def save(fname: str, data) -> None:
+    """Save a list or str-keyed dict of NDArrays to a binary container."""
+    with open(fname, "wb") as f:
+        save_to_stream(f, data)
+
+
+def load(fname: str):
+    """Load NDArrays saved by :func:`save`. Returns list or dict."""
+    with open(fname, "rb") as f:
+        return load_from_stream(f, fname)

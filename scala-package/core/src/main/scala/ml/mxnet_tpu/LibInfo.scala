@@ -44,6 +44,18 @@ private[mxnet_tpu] class LibInfo {
                           size: Int): Array[Float]
   @native def execFree(handle: Long): Unit
 
+  // Round-2 surface: symbol file IO / grad / print, optimizer, misc
+  @native def randomSeed(seed: Int): Unit
+  @native def symCreateFromFile(path: String): Long
+  @native def symSaveToFile(handle: Long, path: String): Unit
+  @native def symGrad(handle: Long, wrt: Array[String]): Long
+  @native def symPrint(handle: Long): String
+  @native def optCreate(name: String, keys: Array[String],
+                        vals: Array[String]): Long
+  @native def optUpdate(handle: Long, index: Int, weight: Long,
+                        grad: Long, lr: Float, wd: Float): Unit
+  @native def optFree(handle: Long): Unit
+
   // KVStore (distributed training; Spark workers call these)
   @native def kvCreate(kvType: String): Long
   @native def kvRank(handle: Long): Int

@@ -38,6 +38,11 @@ class Symbol private[mxnet_tpu] (private[mxnet_tpu] val handle: Long)
   def toJson: String = LibInfo.lib.symToJSON(handle)
   def listArguments: Array[String] = LibInfo.lib.symListArguments(handle)
   def listOutputs: Array[String] = LibInfo.lib.symListOutputs(handle)
+  def save(path: String): Unit = LibInfo.lib.symSaveToFile(handle, path)
+  /** Gradient symbol wrt the named arguments (MXSymbolGrad). */
+  def grad(wrt: Array[String]): Symbol =
+    new Symbol(LibInfo.lib.symGrad(handle, wrt))
+  def debugStr: String = LibInfo.lib.symPrint(handle)
 
   /** CSR packing of named shapes for the C ABI. */
   private def packShapes(shapes: Map[String, Array[Int]])
@@ -76,10 +81,30 @@ object Symbol {
   def loadJson(json: String): Symbol =
     new Symbol(LibInfo.lib.symCreateFromJSON(json))
 
-  def load(path: String): Symbol = {
-    val src = scala.io.Source.fromFile(path)
-    try loadJson(src.mkString) finally src.close()
-  }
+  def load(path: String): Symbol =
+    new Symbol(LibInfo.lib.symCreateFromFile(path))
+}
+
+/** Registered optimizer over the C surface (reference
+ *  ml.dmlc.mxnet.Optimizer): per-index state (momentum etc.) lives on
+ *  the native handle; lr/wd are per-call like MXOptimizerUpdate. */
+class Optimizer private[mxnet_tpu] (private[mxnet_tpu] val handle: Long)
+    extends AutoCloseable {
+  def update(index: Int, weight: NDArray, grad: NDArray, lr: Float,
+             wd: Float = 0.0f): Unit =
+    LibInfo.lib.optUpdate(handle, index, weight.handle, grad.handle, lr, wd)
+  override def close(): Unit = LibInfo.lib.optFree(handle)
+}
+
+object Optimizer {
+  def create(name: String, params: Map[String, String] = Map.empty)
+      : Optimizer =
+    new Optimizer(LibInfo.lib.optCreate(
+      name, params.keys.toArray, params.values.toArray))
+}
+
+object Random {
+  def seed(s: Int): Unit = LibInfo.lib.randomSeed(s)
 }
 
 class Executor private[mxnet_tpu] (private[mxnet_tpu] val handle: Long,

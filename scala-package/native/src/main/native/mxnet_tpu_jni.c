@@ -363,3 +363,101 @@ JNIFN(void, kvBarrier)(JNIEnv *env, jobject obj, jlong handle) {
 JNIFN(void, kvFree)(JNIEnv *env, jobject obj, jlong handle) {
   MXKVStoreFree((KVStoreHandle)(intptr_t)handle);
 }
+
+/* ---- Round-2 surface: symbol file IO / grad, optimizer, misc ---------- */
+
+JNIFN(void, randomSeed)(JNIEnv *env, jobject obj, jint seed) {
+  if (MXRandomSeed((int)seed) != 0) throw_mx(env);
+}
+
+JNIFN(jlong, symCreateFromFile)(JNIEnv *env, jobject obj, jstring jpath) {
+  const char *path = (*env)->GetStringUTFChars(env, jpath, NULL);
+  SymbolHandle h = NULL;
+  int rc = MXSymbolCreateFromFile(path, &h);
+  (*env)->ReleaseStringUTFChars(env, jpath, path);
+  if (rc != 0) { throw_mx(env); return 0; }
+  return (jlong)(intptr_t)h;
+}
+
+JNIFN(void, symSaveToFile)(JNIEnv *env, jobject obj, jlong handle,
+                           jstring jpath) {
+  const char *path = (*env)->GetStringUTFChars(env, jpath, NULL);
+  int rc = MXSymbolSaveToFile((SymbolHandle)(intptr_t)handle, path);
+  (*env)->ReleaseStringUTFChars(env, jpath, path);
+  if (rc != 0) throw_mx(env);
+}
+
+JNIFN(jlong, symGrad)(JNIEnv *env, jobject obj, jlong handle,
+                      jobjectArray jwrt) {
+  jsize n = (*env)->GetArrayLength(env, jwrt);
+  const char **wrt = (const char **)malloc(n * sizeof(char *));
+  for (jsize i = 0; i < n; ++i) {
+    jstring s = (jstring)(*env)->GetObjectArrayElement(env, jwrt, i);
+    wrt[i] = (*env)->GetStringUTFChars(env, s, NULL);
+  }
+  SymbolHandle out = NULL;
+  int rc = MXSymbolGrad((SymbolHandle)(intptr_t)handle, (mx_uint)n, wrt,
+                        &out);
+  for (jsize i = 0; i < n; ++i) {
+    jstring s = (jstring)(*env)->GetObjectArrayElement(env, jwrt, i);
+    (*env)->ReleaseStringUTFChars(env, s, wrt[i]);
+  }
+  free(wrt);
+  if (rc != 0) { throw_mx(env); return 0; }
+  return (jlong)(intptr_t)out;
+}
+
+JNIFN(jstring, symPrint)(JNIEnv *env, jobject obj, jlong handle) {
+  const char *s = NULL;
+  if (MXSymbolPrint((SymbolHandle)(intptr_t)handle, &s) != 0) {
+    throw_mx(env);
+    return NULL;
+  }
+  return (*env)->NewStringUTF(env, s);
+}
+
+JNIFN(jlong, optCreate)(JNIEnv *env, jobject obj, jstring jname,
+                        jobjectArray jkeys, jobjectArray jvals) {
+  const char *name = (*env)->GetStringUTFChars(env, jname, NULL);
+  OptimizerCreator creator = NULL;
+  if (MXOptimizerFindCreator(name, &creator) != 0) {
+    (*env)->ReleaseStringUTFChars(env, jname, name);
+    throw_mx(env);
+    return 0;
+  }
+  (*env)->ReleaseStringUTFChars(env, jname, name);
+  jsize n = (*env)->GetArrayLength(env, jkeys);
+  const char **keys = (const char **)malloc(n * sizeof(char *));
+  const char **vals = (const char **)malloc(n * sizeof(char *));
+  for (jsize i = 0; i < n; ++i) {
+    jstring k = (jstring)(*env)->GetObjectArrayElement(env, jkeys, i);
+    jstring v = (jstring)(*env)->GetObjectArrayElement(env, jvals, i);
+    keys[i] = (*env)->GetStringUTFChars(env, k, NULL);
+    vals[i] = (*env)->GetStringUTFChars(env, v, NULL);
+  }
+  OptimizerHandle h = NULL;
+  int rc = MXOptimizerCreateOptimizer(creator, (mx_uint)n, keys, vals, &h);
+  for (jsize i = 0; i < n; ++i) {
+    jstring k = (jstring)(*env)->GetObjectArrayElement(env, jkeys, i);
+    jstring v = (jstring)(*env)->GetObjectArrayElement(env, jvals, i);
+    (*env)->ReleaseStringUTFChars(env, k, keys[i]);
+    (*env)->ReleaseStringUTFChars(env, v, vals[i]);
+  }
+  free(keys);
+  free(vals);
+  if (rc != 0) { throw_mx(env); return 0; }
+  return (jlong)(intptr_t)h;
+}
+
+JNIFN(void, optUpdate)(JNIEnv *env, jobject obj, jlong handle, jint index,
+                       jlong weight, jlong grad, jfloat lr, jfloat wd) {
+  if (MXOptimizerUpdate((OptimizerHandle)(intptr_t)handle, (int)index,
+                        (NDArrayHandle)(intptr_t)weight,
+                        (NDArrayHandle)(intptr_t)grad, (mx_float)lr,
+                        (mx_float)wd) != 0)
+    throw_mx(env);
+}
+
+JNIFN(void, optFree)(JNIEnv *env, jobject obj, jlong handle) {
+  MXOptimizerFree((OptimizerHandle)(intptr_t)handle);
+}

@@ -1712,10 +1712,17 @@ static int executor_bind_impl(SymbolHandle symbol_handle, int dev_type,
               return -1; }
     Py_INCREF(a);
     PyList_SET_ITEM(args, i, a);
-    if (arg_grad_store && arg_grad_store[i]) {
+    if (arg_grad_store && arg_grad_store[i] &&
+        static_cast<NDArrayRec *>(arg_grad_store[i])->arr) {
       PyObject *g = static_cast<NDArrayRec *>(arg_grad_store[i])->arr;
       Py_INCREF(g);
       PyList_SET_ITEM(grads, i, g);
+    } else if (arg_grad_store && arg_grad_store[i]) {
+      /* empty CreateNone handle: clean error, not a crash */
+      Py_DECREF(gkeys); Py_DECREF(gtypes); Py_DECREF(gids);
+      Py_DECREF(args); Py_DECREF(grads); Py_DECREF(reqs);
+      arr_of(arg_grad_store[i]);
+      return -1;
     } else {
       Py_INCREF(Py_None);
       PyList_SET_ITEM(grads, i, Py_None);
@@ -1725,7 +1732,12 @@ static int executor_bind_impl(SymbolHandle symbol_handle, int dev_type,
   }
   PyObject *aux = PyList_New(aux_states_len);
   for (mx_uint i = 0; i < aux_states_len; ++i) {
-    PyObject *a = static_cast<NDArrayRec *>(aux_states[i])->arr;
+    PyObject *a = arr_of(aux_states[i]);
+    if (!a) {
+      Py_DECREF(gkeys); Py_DECREF(gtypes); Py_DECREF(gids);
+      Py_DECREF(args); Py_DECREF(grads); Py_DECREF(reqs); Py_DECREF(aux);
+      return -1;
+    }
     Py_INCREF(a);
     PyList_SET_ITEM(aux, i, a);
   }
@@ -1883,7 +1895,8 @@ int MXRtcCreate(const char *name, mx_uint num_input, mx_uint num_output,
   PyObject *ins = PyList_New(num_input);
   for (mx_uint i = 0; i < num_input; ++i) {
     PyList_SET_ITEM(in_names, i, PyUnicode_FromString(input_names[i]));
-    PyObject *a = static_cast<NDArrayRec *>(inputs[i])->arr;
+    PyObject *a = arr_of(inputs[i]);
+    if (!a) { Py_DECREF(in_names); Py_DECREF(ins); return -1; }
     Py_INCREF(a);
     PyList_SET_ITEM(ins, i, a);
   }
@@ -1891,7 +1904,12 @@ int MXRtcCreate(const char *name, mx_uint num_input, mx_uint num_output,
   PyObject *outs = PyList_New(num_output);
   for (mx_uint i = 0; i < num_output; ++i) {
     PyList_SET_ITEM(out_names, i, PyUnicode_FromString(output_names[i]));
-    PyObject *a = static_cast<NDArrayRec *>(outputs[i])->arr;
+    PyObject *a = arr_of(outputs[i]);
+    if (!a) {
+      Py_DECREF(in_names); Py_DECREF(ins);
+      Py_DECREF(out_names); Py_DECREF(outs);
+      return -1;
+    }
     Py_INCREF(a);
     PyList_SET_ITEM(outs, i, a);
   }
@@ -1914,13 +1932,15 @@ int MXRtcPush(RtcHandle handle, mx_uint num_input, mx_uint num_output,
   RtcRec *rec = static_cast<RtcRec *>(handle);
   PyObject *ins = PyList_New(num_input);
   for (mx_uint i = 0; i < num_input; ++i) {
-    PyObject *a = static_cast<NDArrayRec *>(inputs[i])->arr;
+    PyObject *a = arr_of(inputs[i]);
+    if (!a) { Py_DECREF(ins); return -1; }
     Py_INCREF(a);
     PyList_SET_ITEM(ins, i, a);
   }
   PyObject *outs = PyList_New(num_output);
   for (mx_uint i = 0; i < num_output; ++i) {
-    PyObject *a = static_cast<NDArrayRec *>(outputs[i])->arr;
+    PyObject *a = arr_of(outputs[i]);
+    if (!a) { Py_DECREF(ins); Py_DECREF(outs); return -1; }
     Py_INCREF(a);
     PyList_SET_ITEM(outs, i, a);
   }

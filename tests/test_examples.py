@@ -1,0 +1,36 @@
+"""Example scripts run end-to-end (reference example/ tree): each is a
+subprocess on the CPU platform with its own converge/behavior assertion
+(FGSM accuracy drop, autoencoder mse drop, GAN mode distance, sorted
+digits, trigram detection, SVM accuracy, NCE retrieval, module
+walkthrough, embedded torch block). A failing assertion inside the
+script fails the test."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+EXAMPLES = [
+    ("adversary/fgsm.py", "FGSM OK"),
+    ("autoencoder/autoencoder.py", "autoencoder OK"),
+    ("gan/gan_toy.py", "GAN OK"),
+    ("bi_lstm_sort/bi_lstm_sort.py", "bi-LSTM sort OK"),
+    ("cnn_text_classification/text_cnn.py", "text CNN OK"),
+    ("svm_mnist/svm_toy.py", "SVM outputs OK"),
+    ("nce_loss/toy_nce.py", "NCE OK"),
+    ("module_api/module_howto.py", "module howto OK"),
+    ("torch_plugin/torch_module_example.py", "torch plugin OK"),
+]
+
+
+@pytest.mark.parametrize("script,expect",
+                         EXAMPLES, ids=[s for s, _ in EXAMPLES])
+def test_example(script, expect):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script)],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    assert expect in r.stdout, r.stdout[-2000:]
