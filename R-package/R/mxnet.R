@@ -86,11 +86,27 @@ mx.symbol.Variable <- function(name) structure(
 mx.symbol.load.json <- function(json.str) structure(
   list(handle = .Call(mxr_sym_from_json, json.str)), class = "MXSymbol")
 
-mx.symbol.load <- function(filename)
-  mx.symbol.load.json(paste(readLines(filename), collapse = "\n"))
+mx.symbol.load <- function(filename) structure(
+  list(handle = .Call(mxr_sym_from_file, filename)), class = "MXSymbol")
 
 mx.symbol.save <- function(symbol, filename) {
-  writeLines(.Call(mxr_sym_to_json, symbol$handle), filename)
+  .Call(mxr_sym_save_file, symbol$handle, filename)
+  invisible(NULL)
+}
+
+# Gradient symbol wrt the named arguments (MXSymbolGrad): a bindable
+# symbol whose outputs are d(sum(outputs))/d(arg).
+mx.symbol.grad <- function(symbol, wrt) structure(
+  list(handle = .Call(mxr_sym_grad, symbol$handle, as.character(wrt))),
+  class = "MXSymbol")
+
+print.MXSymbol <- function(x, ...) {
+  cat(.Call(mxr_sym_print, x$handle), "\n")
+  invisible(x)
+}
+
+mx.set.seed <- function(seed) {
+  .Call(mxr_random_seed, as.integer(seed))
   invisible(NULL)
 }
 
@@ -283,4 +299,22 @@ mx.model.sgd.step <- function(executor, params, learning.rate = 0.01) {
     mx.exec.set.arg(executor, name, params[[name]])
   }
   params
+}
+
+
+# Registered optimizer over the C surface (MXOptimizerCreateOptimizer):
+# per-index state lives on the native handle, lr/wd are per-call.
+mx.opt.create <- function(name, ...) {
+  params <- list(...)
+  structure(list(handle = .Call(mxr_opt_create, name,
+                                as.character(names(params)),
+                                as.character(unlist(params)))),
+            class = "MXOptimizer")
+}
+
+mx.opt.update <- function(optimizer, index, weight, grad,
+                          learning.rate = 0.01, wd = 0.0) {
+  .Call(mxr_opt_update, optimizer$handle, as.integer(index),
+        weight$handle, grad$handle, learning.rate, wd)
+  invisible(NULL)
 }

@@ -420,6 +420,76 @@ SEXP mxr_exec_get_aux(SEXP ptr, SEXP name, SEXP size) {
   return out;
 }
 
+/* ---- Round-2 surface: symbol grad/file IO, optimizer, seed ------------ */
+
+static void optimizer_finalizer(SEXP ptr) {
+  OptimizerHandle h = R_ExternalPtrAddr(ptr);
+  if (h) { MXOptimizerFree(h); R_ClearExternalPtr(ptr); }
+}
+
+/* mxr_sym_grad(extptr, wrt_charvec) -> extptr */
+SEXP mxr_sym_grad(SEXP ptr, SEXP wrt) {
+  mx_uint n = (mx_uint)Rf_length(wrt);
+  const char **names = (const char **)R_alloc(n, sizeof(char *));
+  for (mx_uint i = 0; i < n; ++i)
+    names[i] = CHAR(STRING_ELT(wrt, i));
+  SymbolHandle out;
+  chk(MXSymbolGrad(R_ExternalPtrAddr(ptr), n, names, &out));
+  return wrap_handle(out, symbol_finalizer);
+}
+
+/* mxr_sym_save_file(extptr, path) */
+SEXP mxr_sym_save_file(SEXP ptr, SEXP path) {
+  chk(MXSymbolSaveToFile(R_ExternalPtrAddr(ptr),
+                         CHAR(STRING_ELT(path, 0))));
+  return R_NilValue;
+}
+
+/* mxr_sym_from_file(path) -> extptr */
+SEXP mxr_sym_from_file(SEXP path) {
+  SymbolHandle h;
+  chk(MXSymbolCreateFromFile(CHAR(STRING_ELT(path, 0)), &h));
+  return wrap_handle(h, symbol_finalizer);
+}
+
+/* mxr_sym_print(extptr) -> character */
+SEXP mxr_sym_print(SEXP ptr) {
+  const char *s;
+  chk(MXSymbolPrint(R_ExternalPtrAddr(ptr), &s));
+  return Rf_mkString(s);
+}
+
+/* mxr_opt_create(name, keys_charvec, vals_charvec) -> extptr */
+SEXP mxr_opt_create(SEXP name, SEXP keys, SEXP vals) {
+  OptimizerCreator creator;
+  chk(MXOptimizerFindCreator(CHAR(STRING_ELT(name, 0)), &creator));
+  mx_uint n = (mx_uint)Rf_length(keys);
+  const char **ck = (const char **)R_alloc(n, sizeof(char *));
+  const char **cv = (const char **)R_alloc(n, sizeof(char *));
+  for (mx_uint i = 0; i < n; ++i) {
+    ck[i] = CHAR(STRING_ELT(keys, i));
+    cv[i] = CHAR(STRING_ELT(vals, i));
+  }
+  OptimizerHandle h;
+  chk(MXOptimizerCreateOptimizer(creator, n, ck, cv, &h));
+  return wrap_handle(h, optimizer_finalizer);
+}
+
+/* mxr_opt_update(opt, index, weight_nd, grad_nd, lr, wd) */
+SEXP mxr_opt_update(SEXP opt, SEXP index, SEXP weight, SEXP grad, SEXP lr,
+                    SEXP wd) {
+  chk(MXOptimizerUpdate(R_ExternalPtrAddr(opt), Rf_asInteger(index),
+                        R_ExternalPtrAddr(weight), R_ExternalPtrAddr(grad),
+                        (mx_float)Rf_asReal(lr), (mx_float)Rf_asReal(wd)));
+  return R_NilValue;
+}
+
+/* mxr_random_seed(seed) */
+SEXP mxr_random_seed(SEXP seed) {
+  chk(MXRandomSeed(Rf_asInteger(seed)));
+  return R_NilValue;
+}
+
 /* ---- registration ----------------------------------------------------- */
 
 static const R_CallMethodDef call_methods[] = {
@@ -447,6 +517,13 @@ static const R_CallMethodDef call_methods[] = {
   {"mxr_exec_get_grad", (DL_FUNC)&mxr_exec_get_grad, 3},
   {"mxr_exec_set_aux", (DL_FUNC)&mxr_exec_set_aux, 3},
   {"mxr_exec_get_aux", (DL_FUNC)&mxr_exec_get_aux, 3},
+  {"mxr_sym_grad", (DL_FUNC)&mxr_sym_grad, 2},
+  {"mxr_sym_save_file", (DL_FUNC)&mxr_sym_save_file, 2},
+  {"mxr_sym_from_file", (DL_FUNC)&mxr_sym_from_file, 1},
+  {"mxr_sym_print", (DL_FUNC)&mxr_sym_print, 1},
+  {"mxr_opt_create", (DL_FUNC)&mxr_opt_create, 3},
+  {"mxr_opt_update", (DL_FUNC)&mxr_opt_update, 6},
+  {"mxr_random_seed", (DL_FUNC)&mxr_random_seed, 1},
   {NULL, NULL, 0}
 };
 

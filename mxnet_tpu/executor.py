@@ -172,6 +172,7 @@ class Executor:
         self._outputs: Optional[List[NDArray]] = None
         self._train_pending = False
         self._monitor_callback = None
+        self._monitor_pending = False
         self._step = 0
         self._base_key = None
 
@@ -270,26 +271,58 @@ class Executor:
         # form of the same trick.
         do_mirror = getenv("MXNET_BACKWARD_DO_MIRROR", False)
 
-        @jax.jit
+        def zero_cotangent(x):
+            # vjp cotangents must be float0 for non-differentiable
+            # (integer/bool) primal outputs — a plain zeros_like would
+            # make jax.vjp reject graphs with integer internals (Cast)
+            import jax.numpy as jnp
+
+            if jnp.issubdtype(x.dtype, jnp.inexact):
+                return jnp.zeros_like(x)
+            return np.zeros(x.shape, jax.dtypes.float0)
+
+        def make_fwd_bwd(want_internals):
+            # one builder for the plain and the monitored training step:
+            # with want_internals the SAME fused fwd+bwd also emits every
+            # internal output, so a monitored batch costs one forward
+            # (the naive monitor-forward-then-train scheme doubled it)
+            @jax.jit
+            def step(args, aux, key, head_grads):
+                garr = [args[i] for i in grad_idx]
+
+                def f(garr):
+                    full = list(args)
+                    for pos, i in enumerate(grad_idx):
+                        full[i] = garr[pos]
+                    # casts live inside the vjp'd fn: gradients come back
+                    # in the arrays' own (full) precision automatically
+                    return run_graph(full, aux, key, True,
+                                     want_internals=want_internals)
+
+                if do_mirror:
+                    f = jax.checkpoint(f)
+                res, vjp = jax.vjp(f, garr)
+                # zero cotangents for everything but the heads
+                cts = (head_grads,) + tuple(
+                    jax.tree_util.tree_map(zero_cotangent, r)
+                    for r in res[1:])
+                grads, = vjp(cts)
+                return res + (grads,)
+
+            return step
+
+        _fwd_bwd_plain = make_fwd_bwd(False)
+        _fwd_bwd_mon = make_fwd_bwd(True)
+
         def fwd_bwd(args, aux, key, head_grads):
-            garr = [args[i] for i in grad_idx]
-
-            def f(garr):
-                full = list(args)
-                for pos, i in enumerate(grad_idx):
-                    full[i] = garr[pos]
-                # casts live inside the vjp'd fn: gradients come back in
-                # the arrays' own (full) precision automatically
-                outs, aux_out = run_graph(full, aux, key, True)
-                return outs, aux_out
-
-            if do_mirror:
-                f = jax.checkpoint(f)
-            (outs, aux_out), vjp = jax.vjp(f, garr, has_aux=False)
-            # vjp of (outs, aux_out): zero cotangent for aux_out
-            zero_aux = [jax.numpy.zeros_like(a) for a in aux_out]
-            grads, = vjp((head_grads, zero_aux))
+            outs, aux_out, grads = _fwd_bwd_plain(args, aux, key,
+                                                  head_grads)
             return outs, grads, aux_out
+
+        def fwd_bwd_monitor(args, aux, key, head_grads):
+            outs, aux_out, internals, grads = _fwd_bwd_mon(
+                args, aux, key, head_grads)
+            return outs, grads, aux_out, internals
 
         @jax.jit
         def fwd_monitor(args, aux, key):
@@ -299,6 +332,7 @@ class Executor:
         self._fwd_train = fwd_train
         self._fwd_bwd = fwd_bwd
         self._fwd_monitor = fwd_monitor
+        self._fwd_bwd_monitor = fwd_bwd_monitor
 
     # ------------------------------------------------------------------
     # execution
@@ -332,8 +366,9 @@ class Executor:
             # work of every fit() iteration.
             self._train_pending = True
             self._outputs = None
-            if self._monitor_callback is not None:
-                self._run_monitor()
+            # monitoring is deferred into the fused fwd+bwd (or the lazy
+            # outputs fetch) so the forward runs exactly once per batch
+            self._monitor_pending = self._monitor_callback is not None
             return None
         self._train_pending = False
         outs = self._fwd_infer(self._arg_data(), self._aux_data(),
@@ -367,8 +402,13 @@ class Executor:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             heads = [g._data for g in out_grads]
-        outs, grads, aux_out = self._fwd_bwd(
-            self._arg_data(), self._aux_data(), self._last_key, heads)
+        if self._monitor_pending:
+            outs, grads, aux_out, internals = self._fwd_bwd_monitor(
+                self._arg_data(), self._aux_data(), self._last_key, heads)
+            self._emit_monitor(internals)
+        else:
+            outs, grads, aux_out = self._fwd_bwd(
+                self._arg_data(), self._aux_data(), self._last_key, heads)
         self._set_outputs(outs)
         self._train_pending = False
         for pos, i in enumerate(self._grad_idx):
@@ -396,8 +436,13 @@ class Executor:
     def outputs(self) -> List[NDArray]:
         if self._outputs is None:
             if self._train_pending:
-                outs, aux_out = self._fwd_train(
-                    self._arg_data(), self._aux_data(), self._last_key)
+                if self._monitor_pending:
+                    outs, _, internals = self._fwd_monitor(
+                        self._arg_data(), self._aux_data(), self._last_key)
+                    self._emit_monitor(internals)
+                else:
+                    outs, _ = self._fwd_train(
+                        self._arg_data(), self._aux_data(), self._last_key)
                 self._set_outputs(outs)
             else:
                 raise MXNetError("no forward has been run")
@@ -413,9 +458,8 @@ class Executor:
     def set_monitor_callback(self, callback: Callable[[str, NDArray], None]):
         self._monitor_callback = callback
 
-    def _run_monitor(self):
-        outs, _, internals = self._fwd_monitor(
-            self._arg_data(), self._aux_data(), self._last_key)
+    def _emit_monitor(self, internals):
+        self._monitor_pending = False
         for name, value in internals.items():
             self._monitor_callback(name, NDArray(value, ctx=self._ctx))
 

@@ -1,0 +1,67 @@
+"""Amalgamation (reference amalgamation/mxnet_predict0.cc): the
+generated single-file loader runs an exported bundle in a process with
+NO mxnet_tpu on the path — only jax + numpy — and matches the in-
+framework predictor's output."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+import mxnet_tpu as mx
+from tools.amalgamation import amalgamate
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_amalgamated_loader_standalone(tmp_path):
+    # build + export a small model
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    args = {"fc_weight": mx.nd.array(rng.randn(4, 6).astype(np.float32)),
+            "fc_bias": mx.nd.array(np.zeros(4, np.float32))}
+    blob = mx.export.export_model(net, args, {}, {"data": (2, 6)})
+    bundle = tmp_path / "model.mxtpu"
+    bundle.write_bytes(blob)
+
+    x = rng.rand(2, 6).astype(np.float32)
+    ref_pred = mx.export.ExportedPredictor(blob)
+    ref_pred.set_input("data", x)
+    ref_pred.forward()
+    expected = ref_pred.get_output(0)
+
+    # generate the single-file module and run it in a clean interpreter
+    # whose sys.path does NOT contain the repo (so `import mxnet_tpu`
+    # would fail — proving self-containedness)
+    module_path = tmp_path / "mxnet_tpu_predict.py"
+    module_path.write_text(amalgamate())
+    np.save(tmp_path / "x.npy", x)
+    driver = tmp_path / "driver.py"
+    driver.write_text(textwrap.dedent("""
+        import sys
+        sys.path = [p for p in sys.path if p not in (%r, '')]
+        try:
+            import mxnet_tpu
+            raise SystemExit("repo leaked into path")
+        except ImportError:
+            pass
+        import numpy as np
+        from mxnet_tpu_predict import ExportedPredictor
+        p = ExportedPredictor(%r)
+        p.set_input("data", np.load(%r))
+        p.forward()
+        np.save(%r, p.get_output(0))
+        print("STANDALONE OK")
+    """ % (REPO, str(bundle), str(tmp_path / "x.npy"),
+           str(tmp_path / "y.npy"))))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(tmp_path))
+    r = subprocess.run([sys.executable, str(driver)], cwd=str(tmp_path),
+                       capture_output=True, text=True, timeout=300,
+                       env=env)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "STANDALONE OK" in r.stdout
+    got = np.load(tmp_path / "y.npy")
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)

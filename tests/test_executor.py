@@ -80,14 +80,45 @@ def test_executor_reshape():
 
 
 def test_monitor_callback():
+    """Monitor emission happens when the computation actually runs: the
+    train forward is lazy, so internals arrive with backward() (fused —
+    one forward per monitored batch) or with the lazy .outputs fetch."""
     net = _net()
     ex = net.simple_bind(ctx=mx.cpu(), data=(2, 4))
     ex.arg_dict["data"][:] = np.random.randn(2, 4)
     seen = []
     ex.set_monitor_callback(lambda name, arr: seen.append(name))
     ex.forward(is_train=True)
+    ex.backward()
     assert any("fc_output" in n for n in seen)
     assert any("sm_output" in n for n in seen)
+    # gradients still computed alongside the monitored internals
+    assert ex.grad_dict["fc_weight"].asnumpy().shape == (3, 4)
+
+    # forward-only train step: internals arrive with the outputs fetch
+    seen.clear()
+    ex.forward(is_train=True)
+    assert not seen
+    _ = ex.outputs
+    assert any("fc_output" in n for n in seen)
+
+
+def test_monitor_with_integer_internals():
+    """Integer-dtype internals (Cast) need float0 cotangents in the
+    monitored fused fwd+bwd — a plain zeros_like would make jax.vjp
+    reject the graph."""
+    data = mx.sym.Variable("data")
+    casted = mx.sym.Cast(data, dtype="int32", name="c")
+    back = mx.sym.Cast(casted, dtype="float32", name="b")
+    fc = mx.sym.FullyConnected(back, num_hidden=2, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, name="softmax")
+    ex = out.simple_bind(mx.cpu(), data=(2, 3))
+    ex.arg_dict["data"][:] = np.random.rand(2, 3) * 5
+    seen = []
+    ex.set_monitor_callback(lambda n, a: seen.append(n))
+    ex.forward(is_train=True)
+    ex.backward()
+    assert any("c_output" in n for n in seen)
 
 
 def test_copy_params_from():

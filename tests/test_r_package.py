@@ -38,6 +38,7 @@ const char *CHAR(SEXP);
 int *INTEGER(SEXP); double *REAL(SEXP);
 int Rf_length(SEXP); R_xlen_t Rf_xlength(SEXP);
 int Rf_asInteger(SEXP);
+double Rf_asReal(SEXP);
 SEXP Rf_setAttrib(SEXP, SEXP, SEXP); SEXP Rf_getAttrib(SEXP, SEXP);
 SEXP PROTECT(SEXP); void UNPROTECT(int);
 void Rf_error(const char*, ...);
