@@ -22,6 +22,8 @@ EXAMPLES = [
     ("nce_loss/toy_nce.py", "NCE OK"),
     ("module_api/module_howto.py", "module howto OK"),
     ("torch_plugin/torch_module_example.py", "torch plugin OK"),
+    ("fcn_xs/fcn_toy.py", "FCN OK"),
+    ("dqn/dqn_gridworld.py", "DQN OK"),
 ]
 
 
