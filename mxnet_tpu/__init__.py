@@ -42,6 +42,7 @@ from . import metric          # noqa: E402
 from . import lr_scheduler    # noqa: E402
 from . import io              # noqa: E402
 from . import recordio        # noqa: E402
+from . import filesystem      # noqa: E402
 from . import kvstore         # noqa: E402
 from . import kvstore as kv   # noqa: E402
 from . import callback        # noqa: E402

@@ -581,12 +581,18 @@ def load_from_stream(f, what: str = "<stream>"):
 
 
 def save(fname: str, data) -> None:
-    """Save a list or str-keyed dict of NDArrays to a binary container."""
-    with open(fname, "wb") as f:
+    """Save a list or str-keyed dict of NDArrays to a binary container.
+    ``fname`` may be a URI (``mem://``, registered schemes) — reference
+    dmlc::Stream S3/HDFS dispatch (see :mod:`mxnet_tpu.filesystem`)."""
+    from .filesystem import open_uri
+
+    with open_uri(fname, "wb") as f:
         save_to_stream(f, data)
 
 
 def load(fname: str):
     """Load NDArrays saved by :func:`save`. Returns list or dict."""
-    with open(fname, "rb") as f:
+    from .filesystem import open_uri
+
+    with open_uri(fname, "rb") as f:
         return load_from_stream(f, fname)

@@ -47,6 +47,15 @@ class MXRecordIO:
         self.writable = self.flag == "w"
         if self.flag not in ("r", "w"):
             raise MXNetError("invalid flag %s" % self.flag)
+        from .filesystem import open_uri, scheme_of
+
+        if scheme_of(self.uri) is not None:
+            # URI scheme (mem://, registered s3:// etc.): the native
+            # codec only reads local files, so take the python path over
+            # the filesystem layer (reference: dmlc::Stream dispatch)
+            self.fp = open_uri(self.uri, "wb" if self.writable else "rb")
+            self._h = None
+            return
         if self._lib is not None:
             if self.writable:
                 self._h = self._lib.mxtpu_recio_writer_open(
@@ -157,20 +166,29 @@ class MXIndexedRecordIO(MXRecordIO):
         self.keys = []
         self.key_type = key_type
         super().__init__(uri, flag)
-        if not self.writable and os.path.isfile(idx_path):
-            with open(idx_path) as fin:
-                for line in fin:
+        from .filesystem import exists as fs_exists, open_uri
+
+        if not self.writable and fs_exists(idx_path):
+            with open_uri(idx_path, "rb") as fin:
+                for line in fin.read().decode().splitlines():
+                    if not line.strip():
+                        continue
                     key, off = line.strip().split("\t")
                     key = key_type(key)
                     self.idx[key] = int(off)
                     self.keys.append(key)
 
     def close(self):
-        if self.writable and self.idx:
-            with open(self.idx_path, "w") as fout:
-                for key in self.keys:
-                    fout.write("%s\t%d\n" % (key, self.idx[key]))
+        # commit the record stream before the index: a failing idx write
+        # must not lose the records
         super().close()
+        if self.writable and self.idx:
+            from .filesystem import open_uri
+
+            with open_uri(self.idx_path, "wb") as fout:
+                for key in self.keys:
+                    fout.write(("%s\t%d\n"
+                                % (key, self.idx[key])).encode())
 
     def seek(self, idx):
         MXRecordIO.seek(self, self.idx[idx])

@@ -531,9 +531,11 @@ class Symbol:
                                          if n.is_variable],
                            "heads": heads}, indent=2)
 
-    def save(self, fname: str):
-        with open(fname, "w") as f:
-            f.write(self.tojson())
+    def save(self, fname):
+        from .filesystem import open_uri
+
+        with open_uri(fname, "wb") as f:
+            f.write(self.tojson().encode("utf-8"))
 
     # -- binding -----------------------------------------------------------
     def simple_bind(self, ctx, grad_req="write", type_dict=None, **kwargs):
@@ -627,9 +629,11 @@ def load_json(json_str: str) -> Symbol:
     return Symbol(outputs)
 
 
-def load(fname: str) -> Symbol:
-    with open(fname) as f:
-        return load_json(f.read())
+def load(fname) -> Symbol:
+    from .filesystem import open_uri
+
+    with open_uri(fname, "rb") as f:
+        return load_json(f.read().decode("utf-8"))
 
 
 def _create(op_name: str, *args, **kwargs) -> Symbol:

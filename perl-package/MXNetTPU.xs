@@ -227,3 +227,149 @@ mxtpu_executor_free(exe)
     IV exe
   CODE:
     MXExecutorFree(INT2PTR(ExecutorHandle, exe));
+
+IV
+mxtpu_symbol_grad(sym, ...)
+    IV sym
+  CODE:
+    /* remaining stack items are wrt argument names */
+    mx_uint n = (mx_uint)(items - 1);
+    const char **wrt = (const char **)malloc(n * sizeof(char *));
+    mx_uint i;
+    for (i = 0; i < n; ++i) wrt[i] = SvPV_nolen(ST(1 + i));
+    SymbolHandle out;
+    int rc = MXSymbolGrad(INT2PTR(SymbolHandle, sym), n, wrt, &out);
+    free(wrt);
+    croak_on(aTHX_ rc, "MXSymbolGrad");
+    RETVAL = PTR2IV(out);
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu_symbol_save(sym, fname)
+    IV sym
+    const char *fname
+  CODE:
+    croak_on(aTHX_ MXSymbolSaveToFile(INT2PTR(SymbolHandle, sym), fname),
+             "MXSymbolSaveToFile");
+
+IV
+mxtpu_symbol_load(fname)
+    const char *fname
+  CODE:
+    SymbolHandle h;
+    croak_on(aTHX_ MXSymbolCreateFromFile(fname, &h),
+             "MXSymbolCreateFromFile");
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+IV
+mxtpu_optimizer_create(name, ...)
+    const char *name
+  CODE:
+    /* remaining stack items are key,value string pairs */
+    if ((items - 1) % 2 != 0)
+      croak("optimizer_create: odd number of key/value items");
+    OptimizerCreator creator;
+    croak_on(aTHX_ MXOptimizerFindCreator(name, &creator),
+             "MXOptimizerFindCreator");
+    mx_uint n = (mx_uint)((items - 1) / 2);
+    const char **keys = (const char **)malloc(n * sizeof(char *));
+    const char **vals = (const char **)malloc(n * sizeof(char *));
+    mx_uint i;
+    for (i = 0; i < n; ++i) {
+      keys[i] = SvPV_nolen(ST(1 + 2 * i));
+      vals[i] = SvPV_nolen(ST(2 + 2 * i));
+    }
+    OptimizerHandle h;
+    int rc = MXOptimizerCreateOptimizer(creator, n, keys, vals, &h);
+    free(keys);
+    free(vals);
+    croak_on(aTHX_ rc, "MXOptimizerCreateOptimizer");
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu_optimizer_update(opt, index, weight, grad, lr, wd)
+    IV opt
+    IV index
+    IV weight
+    IV grad
+    double lr
+    double wd
+  CODE:
+    croak_on(aTHX_ MXOptimizerUpdate(INT2PTR(OptimizerHandle, opt),
+                                     (int)index,
+                                     INT2PTR(NDArrayHandle, weight),
+                                     INT2PTR(NDArrayHandle, grad),
+                                     (mx_float)lr, (mx_float)wd),
+             "MXOptimizerUpdate");
+
+void
+mxtpu_optimizer_free(opt)
+    IV opt
+  CODE:
+    MXOptimizerFree(INT2PTR(OptimizerHandle, opt));
+
+void
+mxtpu_random_seed(seed)
+    IV seed
+  CODE:
+    croak_on(aTHX_ MXRandomSeed((int)seed), "MXRandomSeed");
+
+IV
+mxtpu_nd_create(packed, ...)
+    SV *packed
+  CODE:
+    /* packed float data + shape dims on the stack */
+    mx_uint ndim = (mx_uint)(items - 1);
+    if (ndim == 0) croak("nd_create: shape required");
+    mx_uint *dims = (mx_uint *)malloc(ndim * sizeof(mx_uint));
+    mx_uint i, size = 1;
+    for (i = 0; i < ndim; ++i) {
+      dims[i] = (mx_uint)SvIV(ST(1 + i));
+      size *= dims[i];
+    }
+    STRLEN len;
+    const char *buf = SvPV(packed, len);
+    if (len != size * sizeof(mx_float)) {
+      free(dims);
+      croak("nd_create: packed %lu bytes, shape wants %lu",
+            (unsigned long)len, (unsigned long)(size * sizeof(mx_float)));
+    }
+    NDArrayHandle h;
+    int rc = MXNDArrayCreate(dims, ndim, 1, 0, &h);
+    free(dims);
+    croak_on(aTHX_ rc, "MXNDArrayCreate");
+    croak_on(aTHX_ MXNDArraySyncCopyFromCPU(h, (const mx_float *)buf,
+                                            size),
+             "MXNDArraySyncCopyFromCPU");
+    RETVAL = PTR2IV(h);
+  OUTPUT:
+    RETVAL
+
+SV *
+mxtpu_nd_values(handle, size)
+    IV handle
+    IV size
+  CODE:
+    SV *buf = newSV((STRLEN)size * sizeof(mx_float));
+    SvPOK_on(buf);
+    SvCUR_set(buf, (STRLEN)size * sizeof(mx_float));
+    if (MXNDArraySyncCopyToCPU(INT2PTR(NDArrayHandle, handle),
+                               (mx_float *)SvPVX(buf),
+                               (mx_uint)size) != 0) {
+      SvREFCNT_dec(buf);
+      croak("MXNDArraySyncCopyToCPU failed: %s", MXGetLastError());
+    }
+    RETVAL = buf;
+  OUTPUT:
+    RETVAL
+
+void
+mxtpu_nd_free(handle)
+    IV handle
+  CODE:
+    MXNDArrayFree(INT2PTR(NDArrayHandle, handle));

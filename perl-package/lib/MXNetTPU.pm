@@ -29,11 +29,20 @@ sub load_json {
 
 sub load {
     my ($class, $fname) = @_;
-    open my $fh, '<', $fname or die "cannot open $fname: $!";
-    local $/;
-    my $json = <$fh>;
-    close $fh;
-    return $class->load_json($json);
+    my $h = MXNetTPU::symbol_load($fname);
+    return bless { handle => $h }, $class;
+}
+
+sub save {
+    my ($self, $fname) = @_;
+    MXNetTPU::symbol_save($self->{handle}, $fname);
+}
+
+# Gradient symbol wrt the named arguments (MXSymbolGrad)
+sub grad {
+    my ($self, @wrt) = @_;
+    my $h = MXNetTPU::symbol_grad($self->{handle}, @wrt);
+    return bless { handle => $h }, ref($self);
 }
 
 sub tojson { MXNetTPU::symbol_to_json($_[0]{handle}) }
@@ -91,6 +100,25 @@ sub get_grad {
 
 sub DESTROY { MXNetTPU::executor_free($_[0]{handle}) if $_[0]{handle} }
 
+
+# Registered optimizer over the C surface (MXOptimizerCreateOptimizer):
+# per-index state lives on the native handle; lr/wd are per-call.
+package MXNetTPU::Optimizer;
+
+sub create {
+    my ($class, $name, %params) = @_;
+    my $h = MXNetTPU::optimizer_create($name, %params);
+    return bless { handle => $h }, $class;
+}
+
+sub update {
+    my ($self, $index, $weight, $grad, $lr, $wd) = @_;
+    MXNetTPU::optimizer_update($self->{handle}, $index, $weight, $grad,
+                               $lr, $wd // 0.0);
+}
+
+sub DESTROY { MXNetTPU::optimizer_free($_[0]{handle}) if $_[0]{handle} }
+
 # ---------------------------------------------------------------------------
 package MXNetTPU::NDArray;
 
@@ -101,6 +129,24 @@ sub load_params {
     my %pairs = MXNetTPU::nd_load($fname);
     return \%pairs;
 }
+
+# Device array from a Perl list (f32, cpu): used with the optimizer
+# surface, which takes NDArray handles.
+sub from_list {
+    my ($class, $values, $shape) = @_;
+    $shape //= [scalar @$values];
+    my $h = MXNetTPU::nd_create(pack("f*", @$values), @$shape);
+    my $n = 1; $n *= $_ for @$shape;
+    return bless { handle => $h, size => $n }, $class;
+}
+
+sub values {
+    my ($self) = @_;
+    return unpack("f*", MXNetTPU::nd_values($self->{handle},
+                                            $self->{size}));
+}
+
+sub DESTROY { MXNetTPU::nd_free($_[0]{handle}) if $_[0]{handle} }
 
 1;
 __END__

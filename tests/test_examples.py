@@ -24,6 +24,8 @@ EXAMPLES = [
     ("torch_plugin/torch_module_example.py", "torch plugin OK"),
     ("fcn_xs/fcn_toy.py", "FCN OK"),
     ("dqn/dqn_gridworld.py", "DQN OK"),
+    ("stochastic_depth/sd_toy.py", "stochastic depth OK"),
+    ("finetune/finetune_toy.py", "finetune OK"),
 ]
 
 

@@ -86,3 +86,60 @@ def test_perl_error_path(tmp_path):
         capture_output=True, text=True, env=env, timeout=120)
     assert r.returncode != 0
     assert "MXSymbolCreateFromJSON failed" in r.stderr
+
+
+def test_perl_round2_surface(tmp_path):
+    """The round-2 XS functions: symbol save/load-from-file, grad,
+    optimizer create/update (momentum math checked numerically),
+    random_seed, and the odd-kv-count croak."""
+    _build()
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=2, no_bias=True, name="fc")
+    json_path = tmp_path / "net.json"
+    script = tmp_path / "round2.pl"
+    script.write_text(r"""
+use strict; use warnings;
+use lib "%(lib)s", "%(blib)s"; use MXNetTPU;
+MXNetTPU::random_seed(11);
+
+my $sym = MXNetTPU::Symbol->load_json(do {
+    local $/; open my $fh, '<', $ARGV[0] or die; <$fh> });
+$sym->save("%(tmp)s/resaved.json");
+my $back = MXNetTPU::Symbol->load("%(tmp)s/resaved.json");
+print "args=", join(",", $back->list_arguments), "\n";
+
+my $g = $sym->grad("fc_weight");
+print "gargs=", join(",", $g->list_arguments), "\n";
+
+# optimizer: sgd with momentum on a 4-element weight, grad all 0.5
+my $w = MXNetTPU::NDArray->from_list([1, 1, 1, 1]);
+my $grad = MXNetTPU::NDArray->from_list([0.5, 0.5, 0.5, 0.5]);
+my $opt = MXNetTPU::Optimizer->create("sgd", momentum => "0.9");
+$opt->update(0, $w->{handle}, $grad->{handle}, 0.1, 0.0);
+$opt->update(0, $w->{handle}, $grad->{handle}, 0.1, 0.0);
+print "w=", join(",", $w->values), "\n";
+
+my $died = eval { MXNetTPU::optimizer_create("sgd", "momentum"); 1 } ? 0 : 1;
+print "odd_kv_croaks=$died\n";
+""" % {"lib": os.path.join(REPO, "perl-package", "lib"),
+       "blib": os.path.join(REPO, "perl-package", "blib"),
+       "tmp": str(tmp_path)})
+    json_path.write_text(net.tojson())
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(["perl", str(script), str(json_path)],
+                       capture_output=True, text=True, env=env,
+                       timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    out = dict(line.split("=", 1)
+               for line in r.stdout.strip().splitlines())
+    assert out["args"] == "data,fc_weight"
+    assert out["gargs"] == "data,fc_weight"
+    # two momentum-SGD steps: w1 = 1 - .05; mom2 = .9*(-.05) - .05
+    np.testing.assert_allclose(
+        [float(v) for v in out["w"].split(",")],
+        np.full(4, 1.0 - 0.05 + (0.9 * -0.05 - 0.05)), rtol=1e-5)
+    assert out["odd_kv_croaks"] == "1"

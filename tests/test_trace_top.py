@@ -1,0 +1,53 @@
+"""tools/trace_top.py on a synthetic chrome trace: device-track
+filtering, prefix grouping, per-step division."""
+import gzip
+import json
+import os
+
+from tools.trace_top import aggregate, device_pids, find_trace_file, \
+    load_events
+
+
+def _trace(tmp_path):
+    events = [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 7, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        # device ops: two steps of the same program
+        {"ph": "X", "pid": 3, "name": "fusion.12", "dur": 1000.0},
+        {"ph": "X", "pid": 3, "name": "fusion.13", "dur": 3000.0},
+        {"ph": "X", "pid": 3, "name": "multiply_reduce_fusion.2",
+         "dur": 2000.0},
+        {"ph": "X", "pid": 3, "name": "jit_step(123)", "dur": 9000.0},
+        {"ph": "X", "pid": 3, "name": "7", "dur": 9000.0},  # step marker
+        # host event must be excluded
+        {"ph": "X", "pid": 7, "name": "np.asarray", "dur": 50000.0},
+    ]
+    run = tmp_path / "plugins" / "profile" / "2026_01_01"
+    run.mkdir(parents=True)
+    f = run / "vm.trace.json.gz"
+    with gzip.open(f, "wt") as fh:
+        json.dump({"traceEvents": events}, fh)
+    return tmp_path
+
+
+def test_aggregate_groups_and_filters(tmp_path):
+    root = _trace(tmp_path)
+    trace_file = find_trace_file(str(root))
+    assert trace_file.endswith(".trace.json.gz")
+    events = load_events(trace_file)
+    dev, names = device_pids(events)
+    assert dev == {3}
+
+    rows, total_ms = aggregate(events, steps=2, by_op=False)
+    table = {name: (ms, n) for ms, share, n, name in rows}
+    # jit_step + numeric markers + host events excluded
+    assert set(table) == {"fusion", "multiply_reduce_fusion"}
+    ms, n = table["fusion"]
+    assert n == 2 and abs(ms - (4000.0 / 2 / 1e3)) < 1e-9
+    assert abs(total_ms - (6000.0 / 2 / 1e3)) < 1e-9
+
+    rows_op, _ = aggregate(events, steps=2, by_op=True)
+    assert {name for _, _, _, name in rows_op} == {
+        "fusion.12", "fusion.13", "multiply_reduce_fusion.2"}

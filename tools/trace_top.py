@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Aggregate a jax profiler trace into a per-op device-time table.
+
+The workflow that found the round-2 BatchNorm win (docs/performance.md):
+
+    MXNET_TPU_BENCH_TRACE=/tmp/t python bench.py
+    python tools/trace_top.py /tmp/t            # or the .trace.json.gz
+
+Reads the chrome-trace JSON the profiler writes
+(``<dir>/plugins/profile/<run>/*.trace.json.gz``), filters complete
+events on device tracks, and prints total ms/step by HLO fusion-name
+prefix (``--by-op`` for individual ops). This needs no tensorboard —
+the profile plugin's converters are not required.
+
+Reference analogue: the reference had no trace profiler (SURVEY.md §5);
+its observability was Monitor + Speedometer + parse_log. This tool is
+the TPU-native extension of that family.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+
+def find_trace_file(path: str) -> str:
+    if os.path.isfile(path):
+        return path
+    hits = sorted(glob.glob(os.path.join(
+        path, "plugins", "profile", "*", "*.trace.json.gz")))
+    if not hits:
+        hits = sorted(glob.glob(os.path.join(path, "*.trace.json.gz")))
+    if not hits:
+        raise SystemExit("no *.trace.json.gz under %s" % path)
+    return hits[-1]  # newest run
+
+
+def load_events(trace_file: str):
+    opener = gzip.open if trace_file.endswith(".gz") else open
+    with opener(trace_file, "rt") as f:
+        return json.load(f)["traceEvents"]
+
+
+def device_pids(events):
+    """pids whose process_name metadata looks like an accelerator."""
+    pids = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pids[e["pid"]] = e["args"].get("name", "")
+    dev = {p for p, n in pids.items()
+           if "TPU" in n or "GPU" in n or "device" in n.lower()}
+    # CPU-only traces: fall back to every non-host pid, else all
+    if not dev:
+        dev = {p for p, n in pids.items() if "host" not in n.lower()} \
+            or set(pids)
+    return dev, pids
+
+
+def aggregate(events, steps: int, by_op: bool):
+    dev, _ = device_pids(events)
+    agg = collections.defaultdict(float)
+    count = collections.Counter()
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev:
+            continue
+        name = e.get("name", "")
+        # skip the enclosing program event and bare step-number markers
+        if name.startswith("jit_") or re.fullmatch(r"\d+", name):
+            continue
+        key = name if by_op else re.sub(r"[.\d]+$", "", name)
+        dur = e.get("dur", 0.0)
+        agg[key] += dur
+        count[key] += 1
+        total += dur
+    rows = [(v / steps / 1e3, 100.0 * v / total if total else 0.0,
+             count[k], k) for k, v in agg.items()]
+    rows.sort(reverse=True)
+    return rows, total / steps / 1e3
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="per-op device-time table from a jax profiler trace")
+    ap.add_argument("trace", help="trace dir or .trace.json.gz file")
+    ap.add_argument("--steps", type=int, default=1,
+                    help="divide totals by this many steps")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--by-op", action="store_true",
+                    help="individual HLO ops instead of name-prefix groups")
+    args = ap.parse_args(argv)
+
+    events = load_events(find_trace_file(args.trace))
+    rows, total_ms = aggregate(events, args.steps, args.by_op)
+    print("device op time: %.2f ms/step over %d steps"
+          % (total_ms, args.steps))
+    print("%10s %7s %6s  %s" % ("ms/step", "share", "count", "op"))
+    for ms, share, n, name in rows[:args.top]:
+        print("%10.2f %6.1f%% %6d  %s" % (ms, share, n, name))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
