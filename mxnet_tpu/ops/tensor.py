@@ -161,6 +161,40 @@ _def_unary("sin", lambda x: _jnp().sin(x))
 _def_unary("negative", lambda x: -x)
 
 
+@register_op("clip")
+class Clip(Operator):
+    """reference SimpleOp clip: elementwise clamp to [a_min, a_max]
+    (registered for both NDArray and symbolic use, operator_util.h)."""
+
+    name_hint = "clip"
+    PARAMS = {"a_min": Param(float, REQUIRED), "a_max": Param(float, REQUIRED)}
+
+    def apply(self, ctx, inputs, aux):
+        return [_jnp().clip(inputs[0], self.a_min, self.a_max)], []
+
+
+@register_op("argmax_channel")
+class ArgmaxChannel(Operator):
+    """reference SimpleOp argmax_channel: argmax over axis 1, output
+    (batch,) float indices (used by metrics on multi-channel outputs)."""
+
+    name_hint = "argmax_channel"
+
+    def infer_shape(self, in_shapes):
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("argmax_channel: data shape unknown")
+        if len(data) < 2:
+            raise MXNetError("argmax_channel needs >=2 dims, got %s"
+                             % (data,))
+        return [data], [(data[0],) + tuple(data[2:])], []
+
+    def apply(self, ctx, inputs, aux):
+        jax = _jax()
+        x = jax.lax.stop_gradient(inputs[0])
+        return [_jnp().argmax(x, axis=1).astype(inputs[0].dtype)], []
+
+
 @register_op("smooth_l1")
 class SmoothL1(Operator):
     """reference smooth_l1_unary-inl.h: f(x)=0.5(sx)^2/|x|<1/s^2 else |x|-0.5/s^2."""

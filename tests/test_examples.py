@@ -26,6 +26,7 @@ EXAMPLES = [
     ("dqn/dqn_gridworld.py", "DQN OK"),
     ("stochastic_depth/sd_toy.py", "stochastic depth OK"),
     ("finetune/finetune_toy.py", "finetune OK"),
+    ("long_context/ring_attention_demo.py", "ring attention OK"),
 ]
 
 

@@ -610,3 +610,33 @@ def test_expand_dims():
         outs, _ = _run(s, {"a": x}, grad_req="null")
         np.testing.assert_allclose(outs[0], np.expand_dims(x, axis),
                                    rtol=1e-6)
+
+
+def test_clip_symbol():
+    """reference SimpleOp clip as a symbol (round-2 registry gap)."""
+    d = mx.sym.Variable("data")
+    c = mx.sym.clip(d, a_min=-1.0, a_max=1.0)
+    ex = c.simple_bind(mx.cpu(), data=(2, 3), grad_req="write")
+    x = np.array([[-2, 0, 2], [0.5, -0.5, 3]], np.float32)
+    ex.arg_dict["data"][:] = x
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(),
+                               np.clip(x, -1, 1))
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               [[0, 1, 0], [1, 1, 0]])
+
+
+def test_argmax_channel_symbol():
+    d = mx.sym.Variable("data")
+    a = mx.sym.argmax_channel(d)
+    ex = a.simple_bind(mx.cpu(), data=(2, 3))
+    ex.arg_dict["data"][:] = np.array([[1, 5, 2], [9, 0, 1]], np.float32)
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [1, 0])
+    # spatial variant: argmax over channel axis keeps trailing dims
+    s = mx.sym.argmax_channel(mx.sym.Variable("x"))
+    ex2 = s.simple_bind(mx.cpu(), x=(2, 4, 3))
+    v = np.random.RandomState(0).rand(2, 4, 3).astype(np.float32)
+    ex2.arg_dict["x"][:] = v
+    np.testing.assert_allclose(ex2.forward()[0].asnumpy(),
+                               v.argmax(axis=1))
