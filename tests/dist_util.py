@@ -50,17 +50,36 @@ def fill(template: str, tmp_path) -> str:
 
 def launch(tmp_path, script_text: str, port: int, extra_env=None,
            timeout: int = 300, n_workers: int = 2):
-    """Write the worker script and run it under tools/launch.py."""
+    """Write the worker script and run it under tools/launch.py. Runs in
+    its own process group so a timeout kills the whole worker tree — a
+    bare subprocess timeout would SIGKILL only launch.py, leaking
+    workers blocked in collectives and holding the coordinator port."""
+    import signal
+
     script = tmp_path / "worker.py"
     script.write_text(script_text)
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     env.update(extra_env or {})
-    return subprocess.run(
+    proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "tools", "launch.py"),
          "-n", str(n_workers), "--coordinator", "127.0.0.1:%d" % port,
          sys.executable, str(script)],
-        capture_output=True, text=True, env=env, timeout=timeout)
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, signal.SIGTERM)
+        try:
+            stdout, stderr = proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            os.killpg(proc.pid, signal.SIGKILL)
+            stdout, stderr = proc.communicate()
+        raise subprocess.TimeoutExpired(proc.args, timeout, stdout,
+                                        stderr)
+    return subprocess.CompletedProcess(proc.args, proc.returncode,
+                                       stdout, stderr)
 
 
 def maybe_skip_unavailable(out, progressed: bool):

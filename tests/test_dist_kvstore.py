@@ -3,6 +3,7 @@ tests/nightly/dist_sync_kvstore.py via launch.py local launcher): fork 2
 worker processes on this machine, assert exact arithmetic of synced
 push/pull."""
 import numpy as np
+import pytest
 
 from dist_util import (REPO, TRAIN_PREAMBLE, fill, launch,
                        maybe_skip_unavailable)
@@ -79,4 +80,25 @@ def test_dist_sync_training_two_processes(tmp_path):
     w1 = np.load(tmp_path / "w_1.npy")
     np.testing.assert_allclose(w0, w1, rtol=1e-5, atol=1e-6)
     for r in range(2):
+        assert (tmp_path / ("trained_%d" % r)).read_text() == "pass"
+
+
+@pytest.mark.nightly
+def test_dist_sync_training_four_processes(tmp_path):
+    """Scale-out variant of the dist_sync training gate: 4 workers in the
+    collective group (reference nightly ran launch.py -n 4), same
+    accuracy + cross-worker weight-equality requirements."""
+    # smaller per-worker shards see fewer updates: give the 4-way run
+    # more epochs to clear the same accuracy gate
+    worker = TRAIN_WORKER.replace("num_epoch=6", "num_epoch=16")
+    assert worker != TRAIN_WORKER, "epoch override no longer matches"
+    out = launch(tmp_path, fill(worker, tmp_path), 13361,
+                 n_workers=4, timeout=420)
+    maybe_skip_unavailable(out, (tmp_path / "trained_0").exists())
+    assert out.returncode == 0, (out.stdout[-800:], out.stderr[-800:])
+    w0 = np.load(tmp_path / "w_0.npy")
+    for r in range(1, 4):
+        np.testing.assert_allclose(w0, np.load(tmp_path / ("w_%d.npy" % r)),
+                                   rtol=1e-5, atol=1e-6)
+    for r in range(4):
         assert (tmp_path / ("trained_%d" % r)).read_text() == "pass"

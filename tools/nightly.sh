@@ -1,0 +1,22 @@
+#!/bin/sh
+# Nightly gate runner (reference tests/nightly/test_all.sh): the
+# convergence / distributed / recovery tiers, then the accelerator
+# consistency sweep and the benchmark when a chip answers.
+#
+# Usage: sh tools/nightly.sh
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== nightly gates (MNIST convergence, dist_sync 4-proc, recovery) =="
+python -m pytest tests/ -m nightly -q
+
+echo "== dist_sync 2-proc tier (kvstore arithmetic + training) =="
+python -m pytest tests/test_dist_kvstore.py -q
+
+echo "== accelerator tier (skips when no chip is reachable) =="
+python -m pytest tests/test_tpu_consistency.py -q
+
+echo "== benchmark (falls back to CPU when the chip is unreachable) =="
+python bench.py
+
+echo "nightly: all gates green"
