@@ -1,0 +1,47 @@
+"""``import mxnet`` compatibility alias.
+
+Scripts written against the reference frontend (``import mxnet as mx``)
+run against this framework unchanged: every reference module name
+(``mx.symbol``/``mx.sym``, ``mx.ndarray``/``mx.nd``, ``mx.io``,
+``mx.model``, ``mx.module``/``mx.mod``, ``mx.kvstore``/``mx.kv``, and
+the rest of the frontend) resolves to the mxnet_tpu implementation,
+including ``from mxnet.foo import bar`` imports (sys.modules entries
+are registered for every module below).
+
+The reference's GPU contexts map to TPU devices: ``mx.gpu(i)`` is the
+accelerator context (mxnet_tpu.context.gpu is an alias of tpu).
+"""
+import importlib
+import sys
+
+import mxnet_tpu as _m
+
+# everything mxnet_tpu exports at top level (FeedForward, NDArray,
+# Symbol, Monitor, cpu/gpu/tpu, Context, MXNetError, the nd/sym/init/
+# kv/mod/viz short aliases, ...)
+from mxnet_tpu import *  # noqa: F401,F403
+
+__version__ = _m.__version__
+
+# one list drives both the attribute aliases and the sys.modules
+# registration, so `import mxnet.X` and `from mxnet.X import y` work for
+# every reference frontend module (python/mxnet/*.py) — long name first,
+# then the short aliases the reference __init__ exposed
+_MODULES = [
+    "attribute", "base", "callback", "context", "engine", "executor",
+    "executor_manager", "filesystem", "initializer", "io", "kvstore",
+    "lr_scheduler", "metric", "model", "module", "monitor", "name",
+    "ndarray", "operator", "optimizer", "random", "recordio", "rtc",
+    "symbol", "test_utils", "visualization",
+]
+_SHORT = {"nd": "ndarray", "sym": "symbol", "init": "initializer",
+          "kv": "kvstore", "mod": "module", "viz": "visualization"}
+
+for _name in _MODULES:
+    _mod_obj = importlib.import_module("mxnet_tpu." + _name)
+    globals()[_name] = _mod_obj
+    sys.modules["mxnet." + _name] = _mod_obj
+for _alias, _target in _SHORT.items():
+    _mod_obj = sys.modules["mxnet." + _target]
+    globals()[_alias] = _mod_obj
+    sys.modules["mxnet." + _alias] = _mod_obj

@@ -1,0 +1,55 @@
+"""`import mxnet` compatibility alias: reference-style scripts run
+against this framework unchanged (module names, `from mxnet.x import y`
+forms, the FeedForward workflow, checkpointing by prefix)."""
+import numpy as np
+
+import mxnet as mx
+from mxnet.symbol import Variable
+from mxnet import io as mio
+from mxnet import ndarray as mnd
+
+
+def _build():
+    net = Variable("data")
+    net = mx.symbol.FullyConnected(data=net, name="fc1", num_hidden=16)
+    net = mx.symbol.Activation(data=net, name="relu1", act_type="relu")
+    net = mx.symbol.FullyConnected(data=net, name="fc2", num_hidden=2)
+    return mx.symbol.SoftmaxOutput(data=net, name="softmax")
+
+
+def test_alias_modules_are_mxnet_tpu():
+    import mxnet_tpu
+
+    assert mx.nd is mxnet_tpu.ndarray
+    assert mx.sym is mxnet_tpu.symbol
+    assert mx.mod is mxnet_tpu.module
+    assert mnd is mxnet_tpu.ndarray
+    assert mx.kv.create("local").type == "local"
+    # reference gpu contexts resolve to the accelerator context
+    assert mx.gpu(0) == mx.tpu(0)
+
+
+def test_reference_style_training_script(tmp_path):
+    """The reference's python-howto flavor: build with mx.symbol.*,
+    group outputs, train with FeedForward, checkpoint, reload."""
+    out = _build()
+    fc1 = out.get_internals()["fc1_output"]
+    group = mx.symbol.Group([fc1, out])
+    assert group.list_outputs() == ["fc1_output", "softmax_output"]
+
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, 2, 128).astype(np.float32)
+    X = (rng.randn(128, 6) + y[:, None] * 1.5).astype(np.float32)
+    model = mx.model.FeedForward.create(
+        out, X=mio.NDArrayIter(X, y, batch_size=32, shuffle=True),
+        num_epoch=20, learning_rate=0.3)
+    acc = (model.predict(mio.NDArrayIter(X, batch_size=32))
+           .argmax(axis=1) == y).mean()
+    assert acc > 0.9, acc
+
+    prefix = str(tmp_path / "compat")
+    model.save(prefix, 20)
+    again = mx.model.FeedForward.load(prefix, 20)
+    np.testing.assert_array_equal(
+        again.predict(mio.NDArrayIter(X, batch_size=32)),
+        model.predict(mio.NDArrayIter(X, batch_size=32)))
