@@ -32,7 +32,7 @@ _MODULES = [
     "executor_manager", "filesystem", "initializer", "io", "kvstore",
     "lr_scheduler", "metric", "model", "module", "monitor", "name",
     "ndarray", "operator", "optimizer", "random", "recordio", "rtc",
-    "symbol", "test_utils", "visualization",
+    "symbol", "test_utils", "visualization", "profiler", "export",
 ]
 _SHORT = {"nd": "ndarray", "sym": "symbol", "init": "initializer",
           "kv": "kvstore", "mod": "module", "viz": "visualization"}

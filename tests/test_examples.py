@@ -27,6 +27,7 @@ EXAMPLES = [
     ("stochastic_depth/sd_toy.py", "stochastic depth OK"),
     ("finetune/finetune_toy.py", "finetune OK"),
     ("long_context/ring_attention_demo.py", "ring attention OK"),
+    ("bayesian_methods/sgld_toy.py", "SGLD OK"),
 ]
 
 
