@@ -118,6 +118,33 @@ def _bump_versions(mutable_vars: Iterable[Var]):
         v.version += 1
 
 
+_ENGINE_INFO = None
+
+
+def _engine_info_enabled():
+    global _ENGINE_INFO
+    if _ENGINE_INFO is None:   # read once like the reference's dmlc::GetEnv
+        from .base import getenv
+
+        _ENGINE_INFO = bool(getenv("MXNET_ENGINE_INFO", False))
+    return _ENGINE_INFO
+
+
+def _log_push(engine, fn, const_vars, mutable_vars, priority, prop):
+    """Per-op engine logging (reference MXNET_ENGINE_INFO,
+    src/engine/threaded_engine.h:253,288-301): one line per pushed op
+    with its dependency sets — the first tool the reference docs
+    recommended for debugging engine-ordering problems."""
+    import logging
+
+    logging.getLogger("mxnet_tpu.engine").info(
+        "%s push %s const=%s mutable=%s priority=%d prop=%s",
+        type(engine).__name__,
+        getattr(fn, "__qualname__", getattr(fn, "__name__", repr(fn))),
+        [id(v) % 100000 for v in const_vars],
+        [id(v) % 100000 for v in mutable_vars], priority, prop)
+
+
 class XLAEngine(Engine):
     """Default engine: run host closures inline; XLA's async dispatch queue
     provides device-side overlap (the reference's per-device worker streams,
@@ -126,6 +153,8 @@ class XLAEngine(Engine):
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
              prop="normal"):
         _check_duplicates(const_vars, mutable_vars)
+        if _engine_info_enabled():
+            _log_push(self, fn, const_vars, mutable_vars, priority, prop)
         fn()
         _bump_versions(mutable_vars)
 
@@ -148,6 +177,8 @@ class NaiveEngine(Engine):
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
              prop="normal"):
         _check_duplicates(const_vars, mutable_vars)
+        if _engine_info_enabled():
+            _log_push(self, fn, const_vars, mutable_vars, priority, prop)
         ret = fn()
         _bump_versions(mutable_vars)
         _block_on(ret)
@@ -255,9 +286,12 @@ class ThreadedEngine(Engine):
     # -- scheduling --------------------------------------------------------
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
              prop="normal"):
-        _check_duplicates(const_vars, mutable_vars)
+        # materialize first: logging must not consume one-shot iterables
         const_vars = list(const_vars)
         mutable_vars = list(mutable_vars)
+        _check_duplicates(const_vars, mutable_vars)
+        if _engine_info_enabled():
+            _log_push(self, fn, const_vars, mutable_vars, priority, prop)
         opr = _OprBlock(fn, const_vars, mutable_vars, priority,
                         next(self._seq), prop)
         with self._pending_lock:
@@ -421,6 +455,8 @@ class NativeThreadedEngine(Engine):
         ctypes = self._ctypes
 
         _check_duplicates(const_vars, mutable_vars)
+        if _engine_info_enabled():
+            _log_push(self, fn, const_vars, mutable_vars, priority, prop)
         token = next(self._token)
         with self._pending_lock:
             self._pending[token] = fn

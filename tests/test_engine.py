@@ -212,3 +212,23 @@ def test_pooled_engine_zero_io_workers_falls_through():
     eng.wait_for_all()   # must not deadlock
     assert ran == ["io"]
     eng.stop()
+
+
+def test_engine_info_logging(caplog):
+    """MXNET_ENGINE_INFO=1 logs one line per pushed op (reference
+    threaded_engine.h engine-op logging)."""
+    import logging
+
+    from mxnet_tpu import engine as eng
+
+    old = eng._ENGINE_INFO
+    eng._ENGINE_INFO = True
+    try:
+        e = eng.NaiveEngine()
+        v = e.new_variable()
+        with caplog.at_level(logging.INFO, logger="mxnet_tpu.engine"):
+            e.push(lambda: None, mutable_vars=[v])
+        assert any("NaiveEngine push" in r.getMessage()
+                   for r in caplog.records if r.name == "mxnet_tpu.engine")
+    finally:
+        eng._ENGINE_INFO = old

@@ -28,6 +28,7 @@ EXAMPLES = [
     ("finetune/finetune_toy.py", "finetune OK"),
     ("long_context/ring_attention_demo.py", "ring attention OK"),
     ("bayesian_methods/sgld_toy.py", "SGLD OK"),
+    ("dec/dec_toy.py", "DEC OK"),
 ]
 
 
