@@ -39,7 +39,7 @@ from . import random as _random
 __all__ = ["Executor", "make_graph_eval"]
 
 
-def make_graph_eval(symbol, node_device=None):
+def make_graph_eval(symbol, node_device=None, remat=False):
     """Build the pure graph-eval function for a symbol.
 
     Returns ``(eval_graph, n_aux)`` where
@@ -53,7 +53,20 @@ def make_graph_eval(symbol, node_device=None):
     of a placed node are ``device_put`` to its device inside the single
     jitted program, so XLA emits the cross-device transfers — and their
     reverse transfers in the backward pass — in one compiled computation.
+
+    ``remat=True`` is the memonger design behind the reference's
+    ``MXNET_BACKWARD_DO_MIRROR`` (``static_graph.cc:395-439``): the topo
+    order is split into ~sqrt(N) segments and each segment evaluates
+    under ``jax.checkpoint``, so the backward pass stores only segment
+    BOUNDARY activations and recomputes inside each segment — sublinear
+    activation memory for chain-like graphs. (Wrapping the whole
+    function in one checkpoint would save nothing: the recompute would
+    materialize every activation again at once.) Internals-mode calls
+    fall back to the unsegmented path (monitoring wants every tensor
+    live anyway).
     """
+    import math
+
     import jax
 
     nodes = symbol._topo()
@@ -74,37 +87,113 @@ def make_graph_eval(symbol, node_device=None):
     n_aux = slot
     out_index = [(n.uid, i) for n, i in symbol._outputs]
 
+    def _eval_nodes(node_list, env, aux_out, key, is_train,
+                    internals=None):
+        """Evaluate op nodes into env (uid -> outputs list) in place."""
+        for n in node_list:
+            ins = [env[src.uid][i] for src, i in n.inputs]
+            if node_device is not None:
+                dev = node_device(n)
+                if dev is not None:
+                    ins = [jax.device_put(x, dev) for x in ins]
+            slots = aux_slots.get(n.uid, [])
+            aux_in = [aux_out[s] for s in slots]
+            rng = jax.random.fold_in(key, n.uid) if key is not None else None
+            octx = OpContext(is_train, rng)
+            outs, new_aux = n.op.apply(octx, ins, aux_in)
+            for s, a in zip(slots, new_aux):
+                aux_out[s] = a
+            env[n.uid] = list(outs)
+            if internals is not None:
+                for oi, o in enumerate(outs):
+                    oname = "%s_%s" % (n.name, n.op.list_outputs()[oi])
+                    internals[oname] = o
+
     def eval_graph(arg_list, aux_list, key, is_train, want_internals=False):
         env = {}
         aux_out = list(aux_list)
-        internals = {}
+        internals = {} if want_internals else None
         for n in nodes:
             if n.is_variable:
                 env[n.uid] = [arg_list[arg_index[n.uid]]]
-            else:
-                ins = [env[src.uid][i] for src, i in n.inputs]
-                if node_device is not None:
-                    dev = node_device(n)
-                    if dev is not None:
-                        ins = [jax.device_put(x, dev) for x in ins]
-                slots = aux_slots.get(n.uid, [])
-                aux_in = [aux_out[s] for s in slots]
-                rng = jax.random.fold_in(key, n.uid) if key is not None else None
-                octx = OpContext(is_train, rng)
-                outs, new_aux = n.op.apply(octx, ins, aux_in)
-                for s, a in zip(slots, new_aux):
-                    aux_out[s] = a
-                env[n.uid] = list(outs)
-                if want_internals:
-                    for oi, o in enumerate(outs):
-                        oname = "%s_%s" % (n.name, n.op.list_outputs()[oi])
-                        internals[oname] = o
+        _eval_nodes([n for n in nodes if not n.is_variable], env, aux_out,
+                    key, is_train, internals)
         outputs = [env[uid][i] for uid, i in out_index]
         if want_internals:
             return outputs, aux_out, internals
         return outputs, aux_out
 
-    return eval_graph, n_aux
+    if not remat:
+        return eval_graph, n_aux
+
+    # ---- segmented remat (memonger / sqrt schedule) -------------------
+    op_nodes = [n for n in nodes if not n.is_variable]
+    n_seg = max(2, int(math.isqrt(len(op_nodes))))
+    seg_size = max(1, (len(op_nodes) + n_seg - 1) // n_seg)
+    segments = [op_nodes[i:i + seg_size]
+                for i in range(0, len(op_nodes), seg_size)]
+
+    # static plan: which (uid, out_idx) values cross each segment
+    # boundary (consumed by a later segment or by the graph outputs)
+    seg_of = {}
+    for si, seg in enumerate(segments):
+        for n in seg:
+            seg_of[n.uid] = si
+    # for each segment: values it must emit = those it produces that a
+    # later segment or the graph outputs consume. Variables are never
+    # segment outputs — they sit in the caller's store for the duration.
+    consumed_later = [set() for _ in segments]
+    for si, seg in enumerate(segments):
+        for n in seg:
+            for src, i in n.inputs:
+                src_seg = seg_of.get(src.uid, -1)  # -1: a variable
+                if 0 <= src_seg < si:
+                    consumed_later[src_seg].add((src.uid, i))
+    for uid, i in out_index:
+        src_seg = seg_of.get(uid, -1)
+        if src_seg >= 0:
+            consumed_later[src_seg].add((uid, i))
+
+    plans = []
+    for si, seg in enumerate(segments):
+        in_keys = sorted(
+            {(src.uid, i) for n in seg for src, i in n.inputs
+             if seg_of.get(src.uid, -1) != si},
+            key=lambda k: (k[0], k[1]))
+        out_keys = sorted(consumed_later[si], key=lambda k: (k[0], k[1]))
+        plans.append((seg, in_keys, out_keys))
+
+    def eval_graph_remat(arg_list, aux_list, key, is_train,
+                         want_internals=False):
+        if want_internals:
+            return eval_graph(arg_list, aux_list, key, is_train,
+                              want_internals=True)
+        store = {}
+        for n in nodes:
+            if n.is_variable:
+                store[(n.uid, 0)] = arg_list[arg_index[n.uid]]
+        aux_state = list(aux_list)
+        for seg, in_keys, out_keys in plans:
+            def seg_fn(in_vals, aux_vals, _seg=seg, _in=in_keys,
+                       _out=out_keys):
+                # boundary values keyed as {uid: {out_idx: val}} — both
+                # dict and the list envs produced by _eval_nodes support
+                # the env[uid][i] indexing the node loop uses
+                env = {}
+                for (uid, i), v in zip(_in, in_vals):
+                    env.setdefault(uid, {})[i] = v
+                aux_out = list(aux_vals)
+                _eval_nodes(_seg, env, aux_out, key, is_train)
+                return [env[uid][i] for uid, i in _out], aux_out
+
+            in_vals = [store[k] for k in in_keys]
+            out_vals, aux_state = jax.checkpoint(seg_fn)(in_vals,
+                                                         aux_state)
+            store.update(zip(out_keys, out_vals))
+        outputs = [store[(uid, i)] for uid, i in out_index]
+        return outputs, aux_state
+
+    return eval_graph_remat, n_aux
 
 
 _UNSET = object()  # distinguishes "not passed" from explicit None
@@ -208,7 +297,11 @@ class Executor:
                 group = n.attrs.get("ctx_group")
                 return group2dev.get(group)
 
-        eval_graph, self._n_aux = make_graph_eval(self._symbol, node_device)
+        # MXNET_BACKWARD_DO_MIRROR (reference static_graph.cc:395-439
+        # memonger mirroring): segmented remat — see make_graph_eval
+        do_mirror = getenv("MXNET_BACKWARD_DO_MIRROR", False)
+        eval_graph, self._n_aux = make_graph_eval(self._symbol, node_device,
+                                                  remat=do_mirror)
         self._eval_graph = eval_graph
 
         grad_idx = [i for i, n in enumerate(self.arg_names)
@@ -265,12 +358,6 @@ class Executor:
         def fwd_train(args, aux, key):
             return run_graph(args, aux, key, True)
 
-        # MXNET_BACKWARD_DO_MIRROR (reference static_graph.cc:395-439
-        # memonger mirroring): trade FLOPs for memory by rematerializing
-        # the forward during backward — jax.checkpoint is the XLA-native
-        # form of the same trick.
-        do_mirror = getenv("MXNET_BACKWARD_DO_MIRROR", False)
-
         def zero_cotangent(x):
             # vjp cotangents must be float0 for non-differentiable
             # (integer/bool) primal outputs — a plain zeros_like would
@@ -299,8 +386,6 @@ class Executor:
                     return run_graph(full, aux, key, True,
                                      want_internals=want_internals)
 
-                if do_mirror:
-                    f = jax.checkpoint(f)
                 res, vjp = jax.vjp(f, garr)
                 # zero cotangents for everything but the heads
                 cts = (head_grads,) + tuple(

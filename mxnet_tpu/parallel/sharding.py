@@ -95,9 +95,13 @@ def build_sgd_train_step(symbol, data_names: Sequence[str],
     import jax
     import jax.numpy as jnp
 
+    from ..base import getenv
     from ..executor import make_graph_eval
 
-    eval_graph, n_aux = make_graph_eval(symbol)
+    # MXNET_BACKWARD_DO_MIRROR (reference memonger mirroring): segmented
+    # remat inside the graph eval — see make_graph_eval(remat=True)
+    eval_graph, n_aux = make_graph_eval(
+        symbol, remat=getenv("MXNET_BACKWARD_DO_MIRROR", False))
     arg_names = symbol.list_arguments()
     label_set = set(label_names)
     input_names = set(data_names) | label_set

@@ -29,6 +29,7 @@ EXAMPLES = [
     ("long_context/ring_attention_demo.py", "ring attention OK"),
     ("bayesian_methods/sgld_toy.py", "SGLD OK"),
     ("dec/dec_toy.py", "DEC OK"),
+    ("memcost/memcost.py", "memcost OK"),
 ]
 
 
