@@ -30,6 +30,7 @@ EXAMPLES = [
     ("bayesian_methods/sgld_toy.py", "SGLD OK"),
     ("dec/dec_toy.py", "DEC OK"),
     ("memcost/memcost.py", "memcost OK"),
+    ("nmt/seq2seq_attention.py", "NMT OK"),
 ]
 
 
