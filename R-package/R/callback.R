@@ -1,0 +1,25 @@
+# Training callbacks (reference R-package/R/callback.R): epoch/batch
+# callbacks receive (iteration, nbatch, env) where env carries the
+# metric state; returning FALSE from an epoch callback stops training.
+
+mx.callback.log.train.metric <- function(period, logger = NULL) {
+  function(iteration, nbatch, env) {
+    if (nbatch %% period == 0 && !is.null(env$metric)) {
+      res <- env$metric$get(env$train.metric.state)
+      cat(sprintf("Batch [%d] Train-%s=%f\n", nbatch, res$name, res$value))
+      if (!is.null(logger)) logger(iteration, nbatch, res)
+    }
+    TRUE
+  }
+}
+
+mx.callback.save.checkpoint <- function(prefix, period = 1) {
+  function(iteration, nbatch, env) {
+    if (iteration %% period == 0 && !is.null(env$model)) {
+      mx.model.save(env$model, prefix, iteration)
+      cat(sprintf("Model checkpoint saved to %s-%04d.params\n",
+                  prefix, iteration))
+    }
+    TRUE
+  }
+}

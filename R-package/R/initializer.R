@@ -1,0 +1,48 @@
+# Weight initializers (reference R-package/R/initializer.R): each
+# mx.init.* returns function(name, shape) -> R array. Shapes arrive in
+# the package's R (column-major) convention: shape[length(shape)] is the
+# C leading dim, so fan.out = last element, fan.in = prod of the rest —
+# mirroring the reference's colmajor convention.
+
+mx.init.internal.default <- function(name, shape) {
+  if (grepl("bias$", name) || grepl("beta$", name)) return(array(0, dim = shape))
+  if (grepl("gamma$", name)) return(array(1, dim = shape))
+  NULL                                     # NULL: weight -> caller's rule
+}
+
+mx.init.uniform <- function(scale = 0.07) {
+  function(name, shape) {
+    fixed <- mx.init.internal.default(name, shape)
+    if (!is.null(fixed)) return(fixed)
+    array(runif(prod(shape), -scale, scale), dim = shape)
+  }
+}
+
+mx.init.normal <- function(sd = 0.01) {
+  function(name, shape) {
+    fixed <- mx.init.internal.default(name, shape)
+    if (!is.null(fixed)) return(fixed)
+    array(rnorm(prod(shape), 0, sd), dim = shape)
+  }
+}
+
+mx.init.Xavier <- function(rnd_type = "uniform", factor_type = "avg",
+                           magnitude = 3) {
+  function(name, shape) {
+    fixed <- mx.init.internal.default(name, shape)
+    if (!is.null(fixed)) return(fixed)
+    n <- length(shape)
+    fan.out <- shape[[n]]
+    fan.in <- prod(shape[-n])
+    factor <- switch(factor_type,
+                     avg = (fan.in + fan.out) / 2,
+                     "in" = fan.in,
+                     out = fan.out,
+                     stop("mx.init.Xavier: bad factor_type"))
+    scale <- sqrt(magnitude / factor)
+    if (identical(rnd_type, "uniform"))
+      array(runif(prod(shape), -scale, scale), dim = shape)
+    else
+      array(rnorm(prod(shape), 0, scale), dim = shape)
+  }
+}

@@ -1,0 +1,47 @@
+# Array data iterator (reference R-package/R/io.R mx.io.arrayiter +
+# model.R mx.model.init.iter): batches an in-memory dataset with
+# shuffling and wrap-around padding of the last batch. The package's
+# internal layout is colmajor — X dim = (feature..., nsample), batch
+# axis LAST — so a batch slice is contiguous in R.
+
+mx.io.arrayiter <- function(data, label = NULL, batch.size = 128,
+                            shuffle = FALSE) {
+  data <- as.array(data)
+  if (is.null(dim(data))) dim(data) <- length(data)
+  ndim <- length(dim(data))
+  n <- dim(data)[[ndim]]
+  env <- new.env(parent = emptyenv())
+  env$order <- seq_len(n)
+  env$cursor <- 0L
+
+  take <- function(x, idx) {
+    if (is.null(x)) return(NULL)
+    if (is.null(dim(x)) || length(dim(x)) == 1) return(x[idx])
+    # index the last (sample) axis, keeping the rest
+    do.call(`[`, c(list(x), rep(list(quote(expr = )), length(dim(x)) - 1),
+                   list(idx), drop = FALSE))
+  }
+
+  list(
+    batch.size = batch.size,
+    num.data = n,
+    reset = function() {
+      env$cursor <- 0L
+      if (shuffle) env$order <- sample(n)
+      invisible(NULL)
+    },
+    iter.next = function() {
+      env$cursor <- env$cursor + batch.size
+      env$cursor - batch.size < n
+    },
+    value = function() {
+      lo <- env$cursor - batch.size + 1L
+      idx <- lo:(lo + batch.size - 1L)
+      pad <- sum(idx > n)
+      idx[idx > n] <- idx[idx > n] - n    # wrap-around pad
+      list(data = take(data, env$order[idx]),
+           label = take(label, env$order[idx]),
+           pad = pad)
+    }
+  )
+}

@@ -1,0 +1,18 @@
+# Learning-rate schedulers (reference R-package/R/lr_scheduler.R /
+# python lr_scheduler.py): a scheduler maps the update count to a lr.
+
+mx.lr_scheduler.FactorScheduler <- function(step, factor_val = 1,
+                                            stop_factor_lr = 1e-8) {
+  function(base.lr, num.update) {
+    lr <- base.lr * factor_val ^ (num.update %/% step)
+    max(lr, stop_factor_lr)
+  }
+}
+
+mx.lr_scheduler.MultiFactorScheduler <- function(steps, factor_val = 1,
+                                                 stop_factor_lr = 1e-8) {
+  function(base.lr, num.update) {
+    lr <- base.lr * factor_val ^ sum(num.update >= steps)
+    max(lr, stop_factor_lr)
+  }
+}

@@ -1,0 +1,34 @@
+# Evaluation metrics (reference R-package/R/metric.R): a metric is a
+# list(init, update, get) built by mx.metric.custom. Predictions use the
+# package's colmajor convention: pred dim = (nclass, batch), label is a
+# length-batch vector of 0-based class ids (matching the C runtime).
+
+mx.metric.custom <- function(name, feval) {
+  list(
+    name = name,
+    init = function() list(sum = 0, n = 0),
+    update = function(label, pred, state) {
+      state$sum <- state$sum + feval(label, pred)
+      state$n <- state$n + 1
+      state
+    },
+    get = function(state) list(name = name, value = state$sum / max(state$n, 1))
+  )
+}
+
+mx.metric.accuracy <- mx.metric.custom("accuracy", function(label, pred) {
+  guess <- max.col(t(pred)) - 1           # pred (nclass, batch) colmajor
+  mean(guess == as.vector(label))
+})
+
+mx.metric.mse <- mx.metric.custom("mse", function(label, pred) {
+  mean((as.vector(label) - as.vector(pred))^2)
+})
+
+mx.metric.rmse <- mx.metric.custom("rmse", function(label, pred) {
+  sqrt(mean((as.vector(label) - as.vector(pred))^2))
+})
+
+mx.metric.mae <- mx.metric.custom("mae", function(label, pred) {
+  mean(abs(as.vector(label) - as.vector(pred)))
+})

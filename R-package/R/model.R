@@ -1,0 +1,191 @@
+# FeedForward model training API (reference R-package/R/model.R:1-562):
+# mx.model.FeedForward.create drives the full loop — infer shapes, init
+# params, bind one executor, per batch set data/label + forward +
+# backward + updater, per epoch metric/eval/callback — and returns an
+# MXFeedForwardModel(symbol, arg.params, aux.params) usable by
+# predict() and mx.model.save/load.
+#
+# Layout: the package's internal convention is colmajor — X dim =
+# (feature..., nsample) in R, which crosses the ABI as C
+# (nsample, feature...). array.layout = "rowmajor" transposes matrices
+# on the way in, "auto" guesses like the reference
+# (mx.model.select.layout.train, model.R:285-307).
+
+mx.model.check.arguments <- function(symbol) {
+  data <- NULL
+  label <- NULL
+  for (nm in arguments.MXSymbol(symbol)) {
+    if (endsWith(nm, "data")) {
+      if (!is.null(data)) stop("model must have exactly one data argument")
+      data <- nm
+    }
+    if (endsWith(nm, "label")) {
+      if (!is.null(label)) stop("model must have exactly one label argument")
+      label <- nm
+    }
+  }
+  if (is.null(data) || is.null(label))
+    stop("model needs one data and one label argument")
+  c(data, label)
+}
+
+mx.model.select.layout.train <- function(X, array.layout = "auto") {
+  if (identical(array.layout, "auto")) {
+    # heuristic as in the reference: more columns than rows usually
+    # means (feature, nsample) already
+    array.layout <- if (!is.null(dim(X)) && length(dim(X)) == 2 &&
+                        nrow(X) > ncol(X)) "rowmajor" else "colmajor"
+  }
+  if (identical(array.layout, "rowmajor") && length(dim(X)) == 2) X <- t(X)
+  X
+}
+
+mx.model.init.params <- function(symbol, input.shape, initializer) {
+  shapes <- mx.symbol.infer.shape(symbol, data = input.shape)
+  if (is.null(shapes)) stop("cannot infer shapes from input.shape")
+  arg.names <- arguments.MXSymbol(symbol)
+  arg.params <- list()
+  for (i in seq_along(arg.names)) {
+    nm <- arg.names[[i]]
+    if (nm %in% c("data") || endsWith(nm, "label")) next
+    arg.params[[nm]] <- initializer(nm, shapes$arg.shapes[[i]])
+  }
+  aux.params <- list()
+  aux.names <- names(shapes$aux.shapes)
+  for (i in seq_along(shapes$aux.shapes)) {
+    nm <- if (!is.null(aux.names)) aux.names[[i]] else sprintf("aux%d", i)
+    # moving variances start at 1, everything else at 0 (runtime rule)
+    init.val <- if (grepl("var$", nm)) 1 else 0
+    aux.params[[nm]] <- array(init.val, dim = shapes$aux.shapes[[i]])
+  }
+  list(arg.params = arg.params, aux.params = aux.params,
+       shapes = shapes, arg.names = arg.names)
+}
+
+mx.model.FeedForward.create <- function(
+    symbol, X, y = NULL, ctx = mx.cpu(), num.round = 10,
+    array.batch.size = 128, optimizer = "sgd",
+    initializer = mx.init.uniform(0.01), eval.data = NULL,
+    eval.metric = mx.metric.accuracy, epoch.end.callback = NULL,
+    batch.end.callback = NULL, array.layout = "auto", verbose = TRUE, ...) {
+  names2 <- mx.model.check.arguments(symbol)
+  data.name <- names2[[1]]
+  label.name <- names2[[2]]
+
+  X <- mx.model.select.layout.train(X, array.layout)
+  iter <- mx.io.arrayiter(X, y, batch.size = array.batch.size,
+                          shuffle = TRUE)
+
+  dshape <- dim(X)
+  input.shape <- c(dshape[-length(dshape)], array.batch.size)
+  init <- mx.model.init.params(symbol, input.shape, initializer)
+  arg.params <- init$arg.params
+  aux.params <- init$aux.params
+  shapes <- init$shapes
+  arg.names <- init$arg.names
+  shape.of <- function(nm) shapes$arg.shapes[[match(nm, arg.names)]]
+
+  exec.args <- list(symbol = symbol, ctx = ctx, grad.req = "write")
+  exec.args[[data.name]] <- input.shape
+  executor <- do.call(mx.simple.bind, exec.args)
+  for (nm in names(arg.params)) mx.exec.set.arg(executor, nm, arg.params[[nm]])
+  for (nm in names(aux.params)) mx.exec.set.aux(executor, nm, aux.params[[nm]])
+
+  updater <- mx.opt.create.updater(optimizer, ...)
+  out.shape <- shapes$out.shapes[[1]]
+  env <- new.env()
+  env$metric <- eval.metric
+
+  for (iteration in seq_len(num.round)) {
+    iter$reset()
+    env$train.metric.state <- eval.metric$init()
+    nbatch <- 0
+    while (iter$iter.next()) {
+      batch <- iter$value()
+      nbatch <- nbatch + 1
+      mx.exec.set.arg(executor, data.name, batch$data)
+      mx.exec.set.arg(executor, label.name, batch$label)
+      mx.exec.forward(executor, is.train = TRUE)
+      mx.exec.backward(executor)
+      for (nm in names(arg.params)) {
+        grad <- mx.exec.get.grad(executor, nm, dim(arg.params[[nm]]))
+        arg.params[[nm]] <- updater(nm, arg.params[[nm]], grad)
+        mx.exec.set.arg(executor, nm, arg.params[[nm]])
+      }
+      pred <- mx.exec.get.output(executor, 1L, out.shape)
+      env$train.metric.state <- eval.metric$update(
+        batch$label, pred, env$train.metric.state)
+      if (!is.null(batch.end.callback))
+        batch.end.callback(iteration, nbatch, env)
+    }
+    res <- eval.metric$get(env$train.metric.state)
+    if (verbose)
+      cat(sprintf("Epoch [%d] Train-%s=%f\n", iteration, res$name, res$value))
+
+    if (!is.null(eval.data)) {
+      eval.state <- eval.metric$init()
+      eval.data$reset()
+      while (eval.data$iter.next()) {
+        batch <- eval.data$value()
+        mx.exec.set.arg(executor, data.name, batch$data)
+        mx.exec.forward(executor, is.train = FALSE)
+        pred <- mx.exec.get.output(executor, 1L, out.shape)
+        eval.state <- eval.metric$update(batch$label, pred, eval.state)
+      }
+      res <- eval.metric$get(eval.state)
+      if (verbose)
+        cat(sprintf("Epoch [%d] Validation-%s=%f\n",
+                    iteration, res$name, res$value))
+    }
+
+    for (nm in names(aux.params))          # pull updated moving stats
+      aux.params[[nm]] <- mx.exec.get.aux(executor, nm,
+                                          dim(aux.params[[nm]]))
+    env$model <- structure(list(symbol = symbol, arg.params = arg.params,
+                                aux.params = aux.params),
+                           class = "MXFeedForwardModel")
+    if (!is.null(epoch.end.callback))
+      if (identical(epoch.end.callback(iteration, 0, env), FALSE)) break
+  }
+  env$model
+}
+
+# Save in the reference checkpoint layout (<prefix>-symbol.json +
+# <prefix>-%04d.params with arg:/aux: key prefixes) so R-written
+# checkpoints load from Python and vice versa.
+mx.model.save <- function(model, prefix, iteration) {
+  mx.symbol.save(model$symbol, sprintf("%s-symbol.json", prefix))
+  all <- list()
+  for (nm in names(model$arg.params))
+    all[[paste0("arg:", nm)]] <- mx.nd.array(model$arg.params[[nm]])
+  for (nm in names(model$aux.params))
+    all[[paste0("aux:", nm)]] <- mx.nd.array(model$aux.params[[nm]])
+  mx.nd.save(all, sprintf("%s-%04d.params", prefix, iteration))
+  invisible(NULL)
+}
+
+# mx.mlp convenience wrapper (reference R-package/R/mlp.R): build a
+# softmax MLP and train it in one call.
+mx.mlp <- function(data, label, hidden_node = 1, out_node = 2,
+                   dropout = NULL, activation = "relu",
+                   out_activation = "softmax", ...) {
+  net <- mx.symbol.Variable("data")
+  i <- 1
+  for (h in hidden_node) {
+    net <- mx.symbol.create("FullyConnected", data = net, num_hidden = h,
+                            name = sprintf("fc%d", i))
+    net <- mx.symbol.create("Activation", data = net,
+                            act_type = activation,
+                            name = sprintf("act%d", i))
+    if (!is.null(dropout))
+      net <- mx.symbol.create("Dropout", data = net, p = dropout,
+                              name = sprintf("drop%d", i))
+    i <- i + 1
+  }
+  net <- mx.symbol.create("FullyConnected", data = net,
+                          num_hidden = out_node, name = "fc_out")
+  if (!identical(out_activation, "softmax"))
+    stop("mx.mlp: only softmax output supported")
+  net <- mx.symbol.create("SoftmaxOutput", data = net, name = "softmax")
+  mx.model.FeedForward.create(net, X = data, y = label, ...)
+}

@@ -131,6 +131,7 @@ mx.symbol.infer.shape <- function(symbol, ...) {
                as.integer(ind), data)
   res$arg.shapes <- lapply(res$arg.shapes, rev)
   res$out.shapes <- lapply(res$out.shapes, rev)
+  res$aux.shapes <- lapply(res$aux.shapes, rev)
   res
 }
 

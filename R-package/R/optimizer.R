@@ -1,0 +1,36 @@
+# R-side optimizers (reference R-package/R/optimizer.R): mx.opt.sgd
+# returns an updater closure carrying per-weight momentum state; the
+# lr schedule is consulted per update. (The C-backed per-handle
+# optimizer surface, mx.opt.create/mx.opt.update, lives in mxnet.R.)
+
+mx.opt.sgd <- function(learning.rate = 0.01, momentum = 0,
+                       wd = 0, clip_gradient = NULL,
+                       lr_scheduler = NULL, rescale.grad = 1) {
+  state <- new.env(parent = emptyenv())
+  state$mom <- list()
+  state$num.update <- 0
+  function(name, weight, grad) {
+    state$num.update <- state$num.update + 1
+    lr <- if (is.null(lr_scheduler)) learning.rate
+          else lr_scheduler(learning.rate, state$num.update)
+    g <- grad * rescale.grad
+    if (!is.null(clip_gradient))
+      g <- pmin(pmax(g, -clip_gradient), clip_gradient)
+    g <- g + wd * weight
+    if (momentum > 0) {
+      m <- state$mom[[name]]
+      if (is.null(m)) m <- array(0, dim = dim(weight))
+      m <- momentum * m - lr * g
+      state$mom[[name]] <- m
+      weight + m
+    } else {
+      weight - lr * g
+    }
+  }
+}
+
+mx.opt.create.updater <- function(optimizer = "sgd", ...) {
+  switch(optimizer,
+         sgd = mx.opt.sgd(...),
+         stop("mx.opt.create.updater: unknown optimizer ", optimizer))
+}

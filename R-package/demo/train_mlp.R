@@ -1,0 +1,21 @@
+# Train an MLP with the full FeedForward API (reference
+# R-package demo scope: mx.mlp on a two-class dataset).
+require(mxnet.tpu)
+
+set.seed(42)
+n <- 400
+X <- cbind(matrix(rnorm(n * 2, -1), ncol = 2),
+           matrix(rnorm(n * 2, +1), ncol = 2))  # (2, 2n) colmajor-ish toy
+X <- matrix(rnorm(800 * 5), nrow = 800, ncol = 5)
+y <- as.numeric(X[, 1] + X[, 2] > 0)
+
+model <- mx.mlp(X, y, hidden_node = 16, out_node = 2,
+                num.round = 10, array.batch.size = 64,
+                learning.rate = 0.1, momentum = 0.9,
+                initializer = mx.init.uniform(0.5),
+                eval.metric = mx.metric.accuracy,
+                array.layout = "rowmajor")
+
+pred <- predict(model, t(X[1:64, ]))
+cat("predicted dim:", dim(pred), "\n")
+mx.model.save(model, "mlp_demo", 10)

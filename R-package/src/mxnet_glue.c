@@ -284,7 +284,10 @@ SEXP mxr_sym_compose(SEXP ptr, SEXP name, SEXP keys, SEXP args) {
 }
 
 /* mxr_sym_infer_shape(sym, keys, ind_ptr, shape_data) ->
- *   list(arg.shapes=list, out.shapes=list) */
+ *   list(arg.shapes=list, out.shapes=list, aux.shapes=named list)
+ * Uses the Partial variant of the ABI because it also surfaces aux
+ * shapes (BatchNorm moving stats) which mx.model needs; complete==0 is
+ * an error here, matching the strict MXSymbolInferShape contract. */
 SEXP mxr_sym_infer_shape(SEXP ptr, SEXP keys, SEXP ind, SEXP data) {
   mx_uint nk = (mx_uint)Rf_length(keys);
   const char **ck = (const char **)R_alloc(nk ? nk : 1, sizeof(char *));
@@ -299,12 +302,16 @@ SEXP mxr_sym_infer_shape(SEXP ptr, SEXP keys, SEXP ind, SEXP data) {
     cind[i] = (mx_uint)INTEGER(ind)[i];
   for (int i = 0; i < Rf_length(data); ++i)
     cdata[i] = (mx_uint)INTEGER(data)[i];
-  mx_uint in_n, out_n;
-  const mx_uint *in_ndim, *out_ndim;
-  const mx_uint **in_data, **out_data;
-  chk(MXSymbolInferShape(R_ExternalPtrAddr(ptr), nk, ck, cind, cdata,
-                         &in_n, &in_ndim, &in_data,
-                         &out_n, &out_ndim, &out_data));
+  mx_uint in_n, out_n, aux_n;
+  const mx_uint *in_ndim, *out_ndim, *aux_ndim;
+  const mx_uint **in_data, **out_data, **aux_data;
+  int complete;
+  chk(MXSymbolInferShapePartial(R_ExternalPtrAddr(ptr), nk, ck, cind,
+                                cdata, &in_n, &in_ndim, &in_data,
+                                &out_n, &out_ndim, &out_data,
+                                &aux_n, &aux_ndim, &aux_data, &complete));
+  if (!complete)
+    Rf_error("mxnet_tpu: infer_shape incomplete (free data shape?)");
   SEXP arg_shapes = PROTECT(Rf_allocVector(VECSXP, in_n));
   for (mx_uint i = 0; i < in_n; ++i) {
     SEXP s = PROTECT(Rf_allocVector(INTSXP, in_ndim[i]));
@@ -321,14 +328,35 @@ SEXP mxr_sym_infer_shape(SEXP ptr, SEXP keys, SEXP ind, SEXP data) {
     SET_VECTOR_ELT(out_shapes, i, s);
     UNPROTECT(1);
   }
-  SEXP res = PROTECT(Rf_allocVector(VECSXP, 2));
+  SEXP aux_shapes = PROTECT(Rf_allocVector(VECSXP, aux_n));
+  for (mx_uint i = 0; i < aux_n; ++i) {
+    SEXP s = PROTECT(Rf_allocVector(INTSXP, aux_ndim[i]));
+    for (mx_uint j = 0; j < aux_ndim[i]; ++j)
+      INTEGER(s)[j] = (int)aux_data[i][j];
+    SET_VECTOR_ELT(aux_shapes, i, s);
+    UNPROTECT(1);
+  }
+  mx_uint aux_name_n;
+  const char **aux_names;
+  chk(MXSymbolListAuxiliaryStates(R_ExternalPtrAddr(ptr), &aux_name_n,
+                                  &aux_names));
+  if (aux_name_n == aux_n) {
+    SEXP anm = PROTECT(Rf_allocVector(STRSXP, aux_n));
+    for (mx_uint i = 0; i < aux_n; ++i)
+      SET_STRING_ELT(anm, i, Rf_mkChar(aux_names[i]));
+    Rf_setAttrib(aux_shapes, R_NamesSymbol, anm);
+    UNPROTECT(1);
+  }
+  SEXP res = PROTECT(Rf_allocVector(VECSXP, 3));
   SET_VECTOR_ELT(res, 0, arg_shapes);
   SET_VECTOR_ELT(res, 1, out_shapes);
-  SEXP nm = PROTECT(Rf_allocVector(STRSXP, 2));
+  SET_VECTOR_ELT(res, 2, aux_shapes);
+  SEXP nm = PROTECT(Rf_allocVector(STRSXP, 3));
   SET_STRING_ELT(nm, 0, Rf_mkChar("arg.shapes"));
   SET_STRING_ELT(nm, 1, Rf_mkChar("out.shapes"));
+  SET_STRING_ELT(nm, 2, Rf_mkChar("aux.shapes"));
   Rf_setAttrib(res, R_NamesSymbol, nm);
-  UNPROTECT(4);
+  UNPROTECT(5);
   return res;
 }
 

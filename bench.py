@@ -91,24 +91,159 @@ def _accelerator_reachable(timeout_s: int = 240) -> bool:
     return rc == 0 and platform not in ("", "cpu")
 
 
+def _run_child(env_overrides, timeout_s):
+    """Run the inner bench in a fresh interpreter; return the parsed
+    JSON result dict, or None on crash/timeout/unparseable output.
+
+    The child's stdio goes to files, not pipes: a wedged TPU backend
+    leaves helper processes holding the child's fds open, which would
+    block a pipe drain even after the timeout kill."""
+    import subprocess
+    import tempfile
+    env = dict(os.environ)
+    env.update(env_overrides)
+    env["MXNET_TPU_BENCH_INNER"] = "1"
+    here = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.NamedTemporaryFile("r", suffix=".bench.out") as out, \
+            tempfile.NamedTemporaryFile("r", suffix=".bench.err") as err:
+        with open(out.name, "w") as out_w, open(err.name, "w") as err_w:
+            child = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)],
+                stdout=out_w, stderr=err_w, env=env, cwd=here)
+            try:
+                rc = child.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                child.kill()
+                child.wait()
+                sys.stderr.write(
+                    "bench.py: bench child timed out after %ds\n" % timeout_s)
+                return None
+        errtxt = err.read()
+        if errtxt:
+            sys.stderr.write(errtxt[-4000:])
+        if rc != 0:
+            sys.stderr.write("bench.py: bench child exited rc=%d\n" % rc)
+            return None
+        for line in out.read().splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except ValueError:
+                    pass
+    sys.stderr.write("bench.py: bench child printed no JSON line\n")
+    return None
+
+
 def main():
-    if not os.environ.get("JAX_PLATFORMS") \
-            and not _accelerator_reachable():
-        # re-exec in a fresh interpreter: forcing CPU after the platform
-        # plugin has loaded does not stick (same recipe as
-        # __graft_entry__._dryrun_in_subprocess / tests/conftest.py)
-        import subprocess
+    """Orchestrator. Never imports jax itself, so a wedged accelerator
+    backend cannot crash or hang the process that owns the one JSON
+    perf line the driver records (round-2 postmortem: the probe passed
+    against a half-alive tunnel, then backend init crashed the main
+    process and the round's perf record was a stack trace)."""
+    # NOTE: this environment exports JAX_PLATFORMS=axon globally (the
+    # tunnel platform), so "env var present" must NOT mean "skip the
+    # orchestration" — that was the round-2 failure: the guard saw a
+    # truthy JAX_PLATFORMS, ran the bench in-process, and a half-alive
+    # tunnel turned the perf record into a stack trace. Only an explicit
+    # cpu platform (or the inner-child marker) runs in-process.
+    if os.environ.get("MXNET_TPU_BENCH_INNER") \
+            or os.environ.get("JAX_PLATFORMS") == "cpu":
+        return _bench()
+
+    timeout_s = int(os.environ.get("MXNET_TPU_BENCH_TIMEOUT", 2400))
+    result = None
+    if _accelerator_reachable():
+        result = _run_child({}, timeout_s)
+        if result is None:
+            sys.stderr.write("bench.py: accelerator bench failed; "
+                             "falling back to CPU\n")
+    else:
         sys.stderr.write("bench.py: accelerator unreachable; "
                          "falling back to CPU\n")
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        here = os.path.dirname(os.path.abspath(__file__))
-        code = ("import sys; sys.path.insert(0, %r); "
-                "import jax; jax.config.update('jax_platforms', 'cpu'); "
-                "import bench; bench.main()" % here)
-        sys.exit(subprocess.call([sys.executable, "-c", code], env=env,
-                                 cwd=here))
+    if result is None:
+        result = _run_child({"JAX_PLATFORMS": "cpu"},
+                            min(timeout_s, 1200))
+    if result is None:
+        # last-ditch backstop: the record must still parse
+        result = {"metric": "resnet50_train_imgs_per_sec", "value": 0.0,
+                  "unit": "img/s", "vs_baseline": 0.0,
+                  "platform": "bench-failed"}
+        try:
+            with open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    ".bench_cache.json")) as f:
+                result["last_accelerator_result"] = json.load(f)
+        except (OSError, ValueError):
+            pass
+    print(json.dumps(result))
 
+
+def _bench_recordio(jit_step, params, aux, key, batch, image, num_classes,
+                    steps, rec_env):
+    """Opt-in end-to-end tier (MXNET_TPU_BENCH_INPUT=1 or =path.rec):
+    the same train step fed from ImageRecordIter — recordio decode +
+    augment + H2D included — so the pipeline-vs-compute gap is measured,
+    not guessed. Returns extra result fields."""
+    import tempfile
+
+    import jax
+    from mxnet_tpu import io as mio
+
+    if os.path.isfile(rec_env):
+        rec = rec_env
+    else:
+        here = os.path.dirname(os.path.abspath(__file__))
+        sys.path.insert(0, os.path.join(here, "tools"))
+        from pipeline_bench import make_synthetic_rec
+        tmp = tempfile.mkdtemp(prefix="bench_rec_")
+        rec = os.path.join(tmp, "synth.rec")
+        make_synthetic_rec(rec, max(2 * batch, 128), image)
+    threads = int(os.environ.get("MXNET_TPU_BENCH_THREADS",
+                                 os.cpu_count() or 1))
+    it = mio.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, image, image), batch_size=batch,
+        preprocess_threads=threads, rand_crop=True, rand_mirror=True,
+        scale=1.0 / 255.0)
+
+    def batches():
+        while True:
+            for b in it:
+                yield b
+            it.reset()
+
+    gen = batches()
+
+    # input-only rate (decode+augment, host side)
+    n, tic = 0, time.time()
+    while time.time() - tic < 3.0:
+        b = next(gen)
+        _ = b.data[0].asnumpy().ravel()[0]
+        n += batch
+    input_rate = n / (time.time() - tic)
+
+    # end-to-end: iterator -> device -> train step
+    b = next(gen)
+    data = {"data": b.data[0]._data.astype(np.float32),
+            "softmax_label": b.label[0]._data.astype(np.float32)}
+    _, params, aux = jit_step(params, data, aux, key)
+    jax.block_until_ready(params)
+    e2e_steps = max(4, steps // 2)
+    tic = time.time()
+    for i in range(e2e_steps):
+        b = next(gen)
+        data = {"data": b.data[0]._data.astype(np.float32),
+                "softmax_label": b.label[0]._data.astype(np.float32)}
+        _, params, aux = jit_step(params, data, aux,
+                                  jax.random.fold_in(key, 1000 + i))
+    jax.block_until_ready(params)
+    e2e_rate = batch * e2e_steps / (time.time() - tic)
+    return {"input_imgs_per_sec": round(input_rate, 1),
+            "e2e_imgs_per_sec": round(e2e_rate, 1),
+            "preprocess_threads": threads}
+
+
+def _bench():
     import jax
     if os.environ.get("JAX_PLATFORMS"):
         # the axon site hook overrides the env at import; re-apply it so
@@ -226,6 +361,11 @@ def main():
         result["mfu_pct"] = round(100.0 * tflops_model / peak, 1)
     if peak and tflops_xla:
         result["mfu_pct_xla"] = round(100.0 * tflops_xla / peak, 1)
+
+    rec_env = os.environ.get("MXNET_TPU_BENCH_INPUT")
+    if rec_env:
+        result.update(_bench_recordio(jit_step, params, aux, key, batch,
+                                      image, num_classes, steps, rec_env))
 
     # .bench_cache.json is deliberately git-TRACKED: the end-of-round
     # snapshot then preserves the last real on-chip measurement even

@@ -138,7 +138,10 @@ int MXTPUNDArrayWrapPyObject(void *py_ndarray, NDArrayHandle *out);
 /*! \brief Empty handle; filled by ops that allocate their output
  * (reference MXNDArrayCreateNone). */
 int MXNDArrayCreateNone(NDArrayHandle *out);
-/*! \brief Index axis 0: out = handle[idx] (rank reduced by one). */
+/*! \brief Index axis 0: out = handle[idx] (rank reduced by one).
+ * Divergence from the reference (NDArray::At returned a chunk-sharing
+ * view): device arrays are immutable here, so the result is an
+ * INDEPENDENT COPY — writes through it do not propagate back. */
 int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out);
 /*! \brief Host pointer to the array's f32 data. Divergence from the
  * reference (which returned the live CPU buffer): device arrays are
@@ -466,8 +469,11 @@ int MXExecutorBindEX(SymbolHandle symbol_handle, int dev_type, int dev_id,
                      ExecutorHandle shared_exec, ExecutorHandle *out);
 /*! \brief Allocation/graph dump (reference GraphExecutor::Print). */
 int MXExecutorPrint(ExecutorHandle handle, const char **out_str);
-/*! \brief Install a per-output monitor callback run on every
- * forward/backward (reference MXExecutorSetMonitorCallback). */
+/*! \brief Install a per-output monitor callback run once per training
+ * batch (reference MXExecutorSetMonitorCallback). Ownership of the
+ * NDArrayHandle passed to the callback transfers to the callee, which
+ * must release it with MXNDArrayFree (reference convention:
+ * graph_executor.cc hands the frontend a freshly allocated NDArray). */
 int MXExecutorSetMonitorCallback(ExecutorHandle handle,
                                  ExecutorMonitorCallback callback,
                                  void *callback_handle);

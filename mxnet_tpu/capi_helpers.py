@@ -785,7 +785,12 @@ def executor_print(exe):
 def executor_set_monitor_callback(exe, fnptr, user_handle, libpath):
     """Install a C monitor callback: void(const char*, NDArrayHandle,
     void*) — reference MXExecutorSetMonitorCallback; same re-entry
-    recipe as kv_set_updater."""
+    recipe as kv_set_updater.
+
+    Ownership: the NDArray handle is TRANSFERRED to the callback, which
+    must release it with MXNDArrayFree — the reference convention
+    (graph_executor.cc allocates a fresh NDArray per monitored output
+    and the frontend frees it)."""
     import ctypes
 
     lib = ctypes.CDLL(libpath)
@@ -794,16 +799,11 @@ def executor_set_monitor_callback(exe, fnptr, user_handle, libpath):
     cb = cb_t(fnptr)
     wrap = lib.MXTPUNDArrayWrapPyObject
     wrap.argtypes = [ctypes.py_object, ctypes.POINTER(ctypes.c_void_p)]
-    free_fn = lib.MXNDArrayFree
-    free_fn.argtypes = [ctypes.c_void_p]
 
     def monitor(name, arr):
         h = ctypes.c_void_p()
         wrap(arr, ctypes.byref(h))
-        try:
-            cb(name.encode(), h, ctypes.c_void_p(user_handle))
-        finally:
-            free_fn(h)
+        cb(name.encode(), h, ctypes.c_void_p(user_handle))
 
     exe._c_monitor_refs = (cb, lib)
     exe.set_monitor_callback(monitor)

@@ -261,7 +261,6 @@ class Executor:
         self._outputs: Optional[List[NDArray]] = None
         self._train_pending = False
         self._monitor_callback = None
-        self._monitor_pending = False
         self._step = 0
         self._base_key = None
 
@@ -452,8 +451,12 @@ class Executor:
             self._train_pending = True
             self._outputs = None
             # monitoring is deferred into the fused fwd+bwd (or the lazy
-            # outputs fetch) so the forward runs exactly once per batch
-            self._monitor_pending = self._monitor_callback is not None
+            # outputs fetch) so the forward runs exactly once per batch;
+            # whether to monitor is decided there, so a callback installed
+            # between forward and backward still sees this batch. The
+            # emitted flag keeps it once per batch even when .outputs is
+            # read before backward().
+            self._monitor_emitted = False
             return None
         self._train_pending = False
         outs = self._fwd_infer(self._arg_data(), self._aux_data(),
@@ -487,7 +490,8 @@ class Executor:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
             heads = [g._data for g in out_grads]
-        if self._monitor_pending:
+        if self._monitor_callback is not None \
+                and not getattr(self, "_monitor_emitted", False):
             outs, grads, aux_out, internals = self._fwd_bwd_monitor(
                 self._arg_data(), self._aux_data(), self._last_key, heads)
             self._emit_monitor(internals)
@@ -521,7 +525,8 @@ class Executor:
     def outputs(self) -> List[NDArray]:
         if self._outputs is None:
             if self._train_pending:
-                if self._monitor_pending:
+                if self._monitor_callback is not None \
+                        and not getattr(self, "_monitor_emitted", False):
                     outs, _, internals = self._fwd_monitor(
                         self._arg_data(), self._aux_data(), self._last_key)
                     self._emit_monitor(internals)
@@ -541,10 +546,15 @@ class Executor:
     # GraphExecutor::RunOps monitor hook, graph_executor.cc:937-951)
     # ------------------------------------------------------------------
     def set_monitor_callback(self, callback: Callable[[str, NDArray], None]):
+        """Install a per-internal-output callback. Semantics are
+        per-BATCH, not per-forward: emission happens inside the fused
+        fwd+bwd (or the lazy outputs fetch), so each training batch
+        fires the callbacks exactly once, and a callback installed
+        between forward and backward still observes that batch."""
         self._monitor_callback = callback
 
     def _emit_monitor(self, internals):
-        self._monitor_pending = False
+        self._monitor_emitted = True
         for name, value in internals.items():
             self._monitor_callback(name, NDArray(value, ctx=self._ctx))
 

@@ -478,6 +478,17 @@ class ImageRecordIter(DataIter):
 
         self.data_shape = tuple(data_shape)
         self.batch_size = batch_size
+        # decode-pool parameters (reference iter_image_recordio.cc:188-196
+        # decodes with an OMP pool sized by preprocess_threads; here a
+        # thread pool — PIL's JPEG codec and large-array numpy ufuncs
+        # release the GIL — plus futures-based batch read-ahead sized by
+        # prefetch_buffer so decode overlaps device compute)
+        self.preprocess_threads = max(1, int(preprocess_threads))
+        self.prefetch_buffer = max(1, int(prefetch_buffer))
+        self._pool = None
+        self._inflight = {}
+        self._epoch = 0
+        self._aug_seed = int(seed)
         self.rand_crop = rand_crop
         self.rand_mirror = rand_mirror
         self.resize = resize
@@ -551,7 +562,7 @@ class ImageRecordIter(DataIter):
                 rec = reader.read()
                 if rec is None:
                     break
-                img, _ = self._decode(rec)
+                img, _ = self._decode(rec, np.random.RandomState(0))
                 acc += img
                 count += 1
             reader.close()
@@ -580,28 +591,82 @@ class ImageRecordIter(DataIter):
 
     def reset(self):
         self.cursor = -self.batch_size
+        # augmentation draws are keyed by (epoch, record index), so each
+        # epoch re-augments differently (reference parser RNG keeps
+        # drawing across epochs) while staying reproducible and
+        # independent of the pool size
+        self._epoch += 1
+        # cancel read-ahead from the old epoch so the pool doesn't burn
+        # prefetch_buffer*batch_size decodes that will be discarded
+        for futs in self._inflight.values():
+            for f in futs:
+                f.cancel()
+        self._inflight.clear()
+        self._cache_cursor = None
 
     def iter_next(self):
         self.cursor += self.batch_size
         return self.cursor < self.num_data
 
-    def _affine_augment(self, img: np.ndarray) -> np.ndarray:
+    # -- decode pool -------------------------------------------------------
+    def _derive_rng(self, epoch: int, idx: int) -> np.random.RandomState:
+        """Per-(epoch, record) augmentation RNG: decode order (and thread
+        count) cannot change the augmentation a record receives."""
+        mixed = (self._aug_seed * 0x9E3779B1 + epoch * 1000003
+                 + idx * 2654435761) & 0xFFFFFFFF
+        return np.random.RandomState(mixed)
+
+    def _ensure_pool(self):
+        if self._pool is None and self.preprocess_threads > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.preprocess_threads,
+                thread_name_prefix="imgdec")
+        return self._pool
+
+    def _decode_at(self, epoch: int, idx: int):
+        return self._decode(self._records[idx % self.num_data],
+                            self._derive_rng(epoch, idx))
+
+    def _submit(self, cursor: int):
+        pool = self._pool
+        if pool is None or cursor in self._inflight:
+            return
+        ep = self._epoch
+        self._inflight[cursor] = [
+            pool.submit(self._decode_at, ep, i)
+            for i in range(cursor, cursor + self.batch_size)]
+
+    def _gather(self, cursor: int):
+        futs = self._inflight.pop(cursor, None)
+        if futs is not None:
+            return [f.result() for f in futs]
+        pool = self._ensure_pool()
+        idxs = range(cursor, cursor + self.batch_size)
+        if pool is not None:
+            ep = self._epoch
+            return list(pool.map(lambda i: self._decode_at(ep, i), idxs))
+        return [self._decode_at(self._epoch, i) for i in idxs]
+
+    def _affine_augment(self, img: np.ndarray,
+                        rng: np.random.RandomState) -> np.ndarray:
         """Rotation + shear (reference affine path,
         ``image_aug_default.cc:175-220``): forward matrix
         [[a - s*b, b + s*a], [-b, a]] about the image center, constant
         ``fill_value`` border. PIL wants the inverse (output->input) map."""
         angle = 0.0
         if self.max_rotate_angle > 0:
-            angle = float(self._rng.randint(-self.max_rotate_angle,
-                                            self.max_rotate_angle + 1))
+            angle = float(rng.randint(-self.max_rotate_angle,
+                                      self.max_rotate_angle + 1))
         if self.rotate > 0:
             angle = float(self.rotate)
         if self.rotate_list:
             angle = float(self.rotate_list[
-                self._rng.randint(len(self.rotate_list))])
+                rng.randint(len(self.rotate_list))])
         shear = 0.0
         if self.max_shear_ratio > 0:
-            shear = (self._rng.rand() * 2 - 1) * self.max_shear_ratio
+            shear = (rng.rand() * 2 - 1) * self.max_shear_ratio
         if angle == 0.0 and shear == 0.0:
             return img
         from PIL import Image
@@ -625,7 +690,8 @@ class ImageRecordIter(DataIter):
         out = np.asarray(pim).astype(np.float32)
         return out if out.ndim == 3 else out[:, :, None]
 
-    def _hsl_augment(self, img: np.ndarray) -> np.ndarray:
+    def _hsl_augment(self, img: np.ndarray,
+                     rng: np.random.RandomState) -> np.ndarray:
         """HSL color jitter (``image_aug_default.cc:269-300``): uniform
         offsets in [-random_h, random_h] etc.; H clamps to [0, 180] and
         S/L to [0, 255] exactly like the reference's limit[] table
@@ -633,9 +699,9 @@ class ImageRecordIter(DataIter):
         if not (self.random_h or self.random_s or self.random_l) \
                 or img.shape[2] != 3:
             return img
-        dh = (self._rng.rand() * 2 - 1) * self.random_h
-        ds = (self._rng.rand() * 2 - 1) * self.random_s
-        dl = (self._rng.rand() * 2 - 1) * self.random_l
+        dh = (rng.rand() * 2 - 1) * self.random_h
+        ds = (rng.rand() * 2 - 1) * self.random_s
+        dl = (rng.rand() * 2 - 1) * self.random_l
         eps = 1e-12
         rgb = np.clip(img, 0, 255) / 255.0
         r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
@@ -671,7 +737,8 @@ class ImageRecordIter(DataIter):
                         channel(hue - 1 / 3)], axis=-1)
         return (out * 255.0).astype(np.float32)
 
-    def _decode(self, rec: bytes) -> Tuple[np.ndarray, np.ndarray]:
+    def _decode(self, rec: bytes,
+                rng: np.random.RandomState) -> Tuple[np.ndarray, np.ndarray]:
         from . import recordio as rio
 
         header, img = rio.unpack_img(rec, iscolor=1 if self.data_shape[0] == 3 else 0)
@@ -690,7 +757,7 @@ class ImageRecordIter(DataIter):
                 (nw, nh))).astype(np.float32)
             if img.ndim == 2:
                 img = img[:, :, None]
-        img = self._affine_augment(img)
+        img = self._affine_augment(img, rng)
         if self.pad > 0:
             img = np.pad(img, ((self.pad, self.pad), (self.pad, self.pad),
                                (0, 0)), constant_values=float(self.fill_value))
@@ -705,31 +772,40 @@ class ImageRecordIter(DataIter):
                 img = img[:, :, None]
             ih, iw = h, w
         if self.rand_crop:
-            top = self._rng.randint(0, ih - h + 1)
-            left = self._rng.randint(0, iw - w + 1)
+            top = rng.randint(0, ih - h + 1)
+            left = rng.randint(0, iw - w + 1)
         else:
             top, left = (ih - h) // 2, (iw - w) // 2
         img = img[top:top + h, left:left + w]
-        if self.rand_mirror and self._rng.rand() < 0.5:
+        if self.rand_mirror and rng.rand() < 0.5:
             img = img[:, ::-1]
-        img = self._hsl_augment(img)
+        img = self._hsl_augment(img, rng)
         img = img.transpose(2, 0, 1)  # HWC -> CHW
-        if self.mean is not None:
-            img = img - self.mean
-        if self.scale != 1.0:
-            img = img * self.scale
+        # mean/scale are applied vectorized at batch level (_decode_batch)
         return img, label
 
     def _decode_batch(self):
         if getattr(self, "_cache_cursor", None) == self.cursor:
             return self._cache
-        imgs, labels = [], []
-        for i in range(self.cursor, self.cursor + self.batch_size):
-            img, label = self._decode(self._records[i % self.num_data])
-            imgs.append(img)
-            labels.append(label if self.label_width > 1
-                          else float(label.ravel()[0]))
-        self._cache = (np.stack(imgs), np.asarray(labels, dtype=np.float32))
+        results = self._gather(self.cursor)
+        if self._pool is not None:
+            # read-ahead: keep the pool decoding the next batches while
+            # the consumer computes on this one (reference PrefetcherIter
+            # + OMP parser overlap, iter_prefetcher.h)
+            for k in range(1, self.prefetch_buffer + 1):
+                nxt = self.cursor + k * self.batch_size
+                if nxt < self.num_data:
+                    self._submit(nxt)
+        imgs = np.stack([r[0] for r in results])
+        labels = [r[1] if self.label_width > 1 else float(r[1].ravel()[0])
+                  for r in results]
+        # one vectorized pass over the stacked batch beats per-image
+        # python-loop arithmetic for the bandwidth-bound normalize
+        if self.mean is not None:
+            imgs = imgs - self.mean
+        if self.scale != 1.0:
+            imgs = imgs * self.scale
+        self._cache = (imgs, np.asarray(labels, dtype=np.float32))
         self._cache_cursor = self.cursor
         return self._cache
 

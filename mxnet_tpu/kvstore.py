@@ -93,8 +93,13 @@ class KVStore:
             self._store[k] = v.copyto(v.context)
 
     def _reduce(self, vlist: List[NDArray]) -> NDArray:
-        """Sum a list of per-device arrays. XLA emits an ICI all-reduce when
-        the inputs are device-sharded (reference Comm::Reduce, comm.h)."""
+        """Sum a list of per-device arrays (reference Comm::Reduce,
+        comm.h): gather the inputs onto one device and add pairwise.
+        This host-driven path is only used for explicit kvstore
+        push/pull of unsharded arrays; the measured data-parallel
+        training path does NOT go through here — executor_group shards
+        the batch over a mesh and the in-step GSPMD all-reduce rides
+        ICI (parallel/sharding.py)."""
         import jax
         import jax.numpy as jnp
 

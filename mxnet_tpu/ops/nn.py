@@ -441,6 +441,9 @@ class SoftmaxOutput(Operator):
         "ignore_label": Param(float, -1.0),
         "multi_output": Param(bool, False),
         "use_ignore": Param(bool, False),
+        "preserve_shape": Param(bool, False,
+                                "softmax over the last axis of an N-d "
+                                "input with (shape[:-1]) labels"),
         "normalization": Param(str, "null", "null/batch/valid"),
     }
 
@@ -453,6 +456,11 @@ class SoftmaxOutput(Operator):
             raise MXNetError("SoftmaxOutput: data shape unknown")
         if self.multi_output:
             label = (data[0],) + tuple(data[2:])
+        elif self.preserve_shape:
+            # reference softmax_output-inl.h preserve_shape: softmax on
+            # the trailing axis, one label per leading position (the
+            # time-major RNN head: data (T, N, V), label (T, N))
+            label = tuple(data[:-1])
         else:
             label = (data[0],)
         return [data, label], [data], []

@@ -243,9 +243,30 @@ class Symbol:
                 (outs, aux_out), vjp = jax.vjp(
                     f, [inputs[i] for i in idx])
                 import jax.numpy as jnp
-                heads = [jnp.ones_like(o) for o in outs]
-                zero_aux = [jnp.zeros_like(a) for a in aux_out]
+                import numpy as np
+
+                def head_ct(x):
+                    # non-inexact heads (argmax_channel/Cast-to-int) take
+                    # float0 cotangents — same rule as the executor's
+                    # fused path (executor.py zero_cotangent); ones_like
+                    # would make jax.vjp reject the graph
+                    if jnp.issubdtype(x.dtype, jnp.inexact):
+                        return jnp.ones_like(x)
+                    return np.zeros(x.shape, jax.dtypes.float0)
+
+                def zero_ct(x):
+                    if jnp.issubdtype(x.dtype, jnp.inexact):
+                        return jnp.zeros_like(x)
+                    return np.zeros(x.shape, jax.dtypes.float0)
+
+                heads = [head_ct(o) for o in outs]
+                zero_aux = [zero_ct(a) for a in aux_out]
                 grads, = vjp((heads, zero_aux))
+                # integer wrt inputs come back as float0 zero-tangents;
+                # materialize them so downstream graph nodes see arrays
+                grads = [jnp.zeros(inputs[i].shape, inputs[i].dtype)
+                         if getattr(g, "dtype", None) == jax.dtypes.float0
+                         else g for g, i in zip(grads, idx)]
                 return list(grads), list(aux_out)
 
         name = NameManager.current().get(None, "grad")

@@ -1,0 +1,183 @@
+/* Executes the exact .Call sequence mx.model.FeedForward.create
+ * (R-package/R/model.R) drives, through the real mxnet_glue.c compiled
+ * against tests/r_shim.c — no R interpreter exists in this image, so
+ * this is the execution gate for the R frontend's native path
+ * (reference R-package trains MNIST in its own CI,
+ * R-package/tests/testthat).
+ *
+ * Sequence mirrored from model.R: build MLP symbol from the registry
+ * (mx.symbol.create -> mxr_sym_create_atomic + mxr_sym_compose), infer
+ * shapes (mxr_sym_infer_shape incl. aux.shapes), simple_bind, init
+ * params (mxr_exec_set_arg), then per batch: set data/label, forward,
+ * backward, get_grad, SGD-with-momentum update (optimizer.R math),
+ * set_arg; finally accuracy from mxr_exec_get_output.
+ *
+ * Prints "final_acc=<v>"; the pytest wrapper gates >= 0.9.
+ */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "Rinternals.h"
+
+/* glue entry points under test */
+SEXP mxr_sym_variable(SEXP name);
+SEXP mxr_sym_create_atomic(SEXP opname, SEXP keys, SEXP vals);
+SEXP mxr_sym_compose(SEXP ptr, SEXP name, SEXP keys, SEXP args);
+SEXP mxr_sym_infer_shape(SEXP ptr, SEXP keys, SEXP ind, SEXP data);
+SEXP mxr_sym_list_arguments(SEXP ptr);
+SEXP mxr_exec_simple_bind(SEXP sym, SEXP dev_type, SEXP dev_id, SEXP keys,
+                          SEXP ind, SEXP data, SEXP for_training);
+SEXP mxr_exec_set_arg(SEXP ptr, SEXP name, SEXP values);
+SEXP mxr_exec_forward(SEXP ptr, SEXP is_train);
+SEXP mxr_exec_backward(SEXP ptr);
+SEXP mxr_exec_get_output(SEXP ptr, SEXP index, SEXP size);
+SEXP mxr_exec_get_grad(SEXP ptr, SEXP name, SEXP size);
+SEXP mxr_random_seed(SEXP seed);
+
+#define BATCH 32
+#define NFEAT 5
+#define NHID 16
+#define NCLASS 2
+#define NSAMPLE 256
+#define ROUNDS 12
+
+static SEXP ints(int n, const int *v) {
+  SEXP s = Rf_allocVector(INTSXP, n);
+  for (int i = 0; i < n; ++i) INTEGER(s)[i] = v[i];
+  return s;
+}
+
+static SEXP int1(int v) { return ints(1, &v); }
+
+static SEXP reals(R_xlen_t n, const double *v) {
+  SEXP s = Rf_allocVector(REALSXP, n);
+  for (R_xlen_t i = 0; i < n; ++i) REAL(s)[i] = v[i];
+  return s;
+}
+
+static SEXP strs(int n, const char **v) {
+  SEXP s = Rf_allocVector(STRSXP, n);
+  for (int i = 0; i < n; ++i) SET_STRING_ELT(s, i, Rf_mkChar(v[i]));
+  return s;
+}
+
+static SEXP empty_strs(void) { return Rf_allocVector(STRSXP, 0); }
+
+/* mx.symbol.create("op", data=prev, <param>=..., name=...) */
+static SEXP atomic_op(const char *op, SEXP input, const char *name,
+                      const char **pkeys, const char **pvals, int np) {
+  SEXP h = mxr_sym_create_atomic(Rf_mkString(op), strs(np, pkeys),
+                                 strs(np, pvals));
+  const char *inkeys[] = {"data"};
+  SEXP args = Rf_allocVector(VECSXP, 1);
+  SET_VECTOR_ELT(args, 0, input);
+  mxr_sym_compose(h, Rf_mkString(name), strs(1, inkeys), args);
+  return h;
+}
+
+static double frand(unsigned *seed) {         /* xorshift uniform */
+  *seed ^= *seed << 13;
+  *seed ^= *seed >> 17;
+  *seed ^= *seed << 5;
+  return (double)(*seed % 1000003) / 1000003.0;
+}
+
+int main(void) {
+  mxr_random_seed(int1(7));
+
+  /* ---- symbol: data -> FC(16) -> relu -> FC(2) -> SoftmaxOutput ---- */
+  SEXP data = mxr_sym_variable(Rf_mkString("data"));
+  const char *k_hid[] = {"num_hidden"};
+  const char *v_hid1[] = {"16"};
+  SEXP fc1 = atomic_op("FullyConnected", data, "fc1", k_hid, v_hid1, 1);
+  const char *k_act[] = {"act_type"};
+  const char *v_act[] = {"relu"};
+  SEXP act = atomic_op("Activation", fc1, "act1", k_act, v_act, 1);
+  const char *v_hid2[] = {"2"};
+  SEXP fc2 = atomic_op("FullyConnected", act, "fc2", k_hid, v_hid2, 1);
+  SEXP net = atomic_op("SoftmaxOutput", fc2, "softmax", NULL, NULL, 0);
+
+  /* ---- infer shapes with data=(BATCH, NFEAT) (C-order, as the R side
+   * sends after rev()) ---- */
+  const char *shape_keys[] = {"data"};
+  int ind[] = {0, 2};
+  int sdata[] = {BATCH, NFEAT};
+  SEXP shapes = mxr_sym_infer_shape(net, strs(1, shape_keys),
+                                    ints(2, ind), ints(2, sdata));
+  SEXP arg_shapes = VECTOR_ELT(shapes, 0);
+  SEXP arg_names = mxr_sym_list_arguments(net);
+  int nargs = Rf_length(arg_names);
+
+  /* ---- simple_bind (grad.req = write) ---- */
+  SEXP exec = mxr_exec_simple_bind(net, int1(1), int1(0),
+                                   strs(1, shape_keys), ints(2, ind),
+                                   ints(2, sdata), int1(1));
+
+  /* ---- init params: uniform(-0.5, 0.5) on weights, zero biases ---- */
+  unsigned seed = 42;
+  double *params[16];
+  double *moms[16];
+  long psize[16];
+  for (int i = 0; i < nargs; ++i) {
+    const char *nm = CHAR(STRING_ELT(arg_names, i));
+    SEXP shp = VECTOR_ELT(arg_shapes, i);
+    long n = 1;
+    for (int j = 0; j < Rf_length(shp); ++j) n *= INTEGER(shp)[j];
+    psize[i] = n;
+    params[i] = calloc(n, sizeof(double));
+    moms[i] = calloc(n, sizeof(double));
+    if (strstr(nm, "weight"))
+      for (long j = 0; j < n; ++j) params[i][j] = frand(&seed) - 0.5;
+    if (strcmp(nm, "data") && strcmp(nm, "softmax_label"))
+      mxr_exec_set_arg(exec, Rf_mkString(nm), reals(n, params[i]));
+  }
+
+  /* ---- two-blob dataset ---- */
+  static double X[NSAMPLE][NFEAT];
+  static double y[NSAMPLE];
+  for (int i = 0; i < NSAMPLE; ++i) {
+    int cls = i % 2;
+    y[i] = cls;
+    for (int j = 0; j < NFEAT; ++j)
+      X[i][j] = (frand(&seed) - 0.5) + (cls ? 1.0 : -1.0) * 0.8;
+  }
+
+  const double lr = 0.1, momentum = 0.9;
+  double acc = 0.0;
+  for (int round = 0; round < ROUNDS; ++round) {
+    int correct = 0, seen = 0;
+    for (int start = 0; start + BATCH <= NSAMPLE; start += BATCH) {
+      mxr_exec_set_arg(exec, Rf_mkString("data"),
+                       reals(BATCH * NFEAT, &X[start][0]));
+      mxr_exec_set_arg(exec, Rf_mkString("softmax_label"),
+                       reals(BATCH, &y[start]));
+      mxr_exec_forward(exec, int1(1));
+      mxr_exec_backward(exec);
+      for (int i = 0; i < nargs; ++i) {
+        const char *nm = CHAR(STRING_ELT(arg_names, i));
+        if (!strcmp(nm, "data") || !strcmp(nm, "softmax_label")) continue;
+        SEXP g = mxr_exec_get_grad(exec, Rf_mkString(nm),
+                                   int1((int)psize[i]));
+        for (long j = 0; j < psize[i]; ++j) {   /* optimizer.R sgd math */
+          moms[i][j] = momentum * moms[i][j] - lr * REAL(g)[j];
+          params[i][j] += moms[i][j];
+        }
+        mxr_exec_set_arg(exec, Rf_mkString(nm),
+                         reals(psize[i], params[i]));
+      }
+      SEXP out = mxr_exec_get_output(exec, int1(0),
+                                     int1(BATCH * NCLASS));
+      for (int b = 0; b < BATCH; ++b) {
+        int guess = REAL(out)[b * NCLASS] > REAL(out)[b * NCLASS + 1]
+                        ? 0 : 1;
+        correct += (guess == (int)y[start + b]);
+        seen += 1;
+      }
+    }
+    acc = (double)correct / seen;
+  }
+  printf("final_acc=%f\n", acc);
+  return acc >= 0.9 ? 0 : 1;
+}

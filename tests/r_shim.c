@@ -1,0 +1,149 @@
+/* A real (minimal) implementation of the R C API subset that
+ * R-package/src/mxnet_glue.c consumes, so the glue can be EXECUTED in
+ * CI without an R interpreter (none exists in this image). SEXPs are
+ * heap records; PROTECT is identity; memory is deliberately leaked
+ * (driver-lifetime only). Together with tests/r_glue_train.c this
+ * upgrades the R tier from "compiles" to "the exact .Call surface the
+ * R training API drives runs a training loop end to end".
+ */
+#include <stdarg.h>
+#include <stddef.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "Rinternals.h"
+
+enum { NILSXP_ = 0, CHARSXP_ = 9, EXTPTRSXP_ = 22 };
+
+typedef struct attrib {
+  const char *key;
+  void *value;
+  struct attrib *next;
+} attrib;
+
+typedef struct sexp_rec {
+  int type;
+  R_xlen_t len;
+  int *ints;
+  double *reals;
+  struct sexp_rec **vec;    /* STRSXP / VECSXP elements */
+  char *str;                /* CHARSXP payload */
+  void *ptr;                /* external pointer address */
+  attrib *attribs;
+} sexp_rec;
+
+static sexp_rec nil_rec = {NILSXP_, 0, 0, 0, 0, 0, 0, 0};
+SEXP R_NilValue = &nil_rec;
+static sexp_rec names_sym = {CHARSXP_, 0, 0, 0, 0, (char *)"names", 0, 0};
+SEXP R_NamesSymbol = &names_sym;
+
+static sexp_rec *rec(int type, R_xlen_t n) {
+  sexp_rec *r = calloc(1, sizeof(sexp_rec));
+  r->type = type;
+  r->len = n;
+  if (type == INTSXP) r->ints = calloc(n ? n : 1, sizeof(int));
+  else if (type == REALSXP) r->reals = calloc(n ? n : 1, sizeof(double));
+  else if (type == STRSXP || type == VECSXP)
+    r->vec = calloc(n ? n : 1, sizeof(sexp_rec *));
+  return r;
+}
+
+SEXP Rf_allocVector(int type, R_xlen_t n) { return rec(type, n); }
+
+SEXP Rf_mkChar(const char *s) {
+  sexp_rec *r = rec(CHARSXP_, (R_xlen_t)strlen(s));
+  r->str = strdup(s);
+  return r;
+}
+
+SEXP Rf_mkString(const char *s) {
+  sexp_rec *r = rec(STRSXP, 1);
+  r->vec[0] = Rf_mkChar(s);
+  return r;
+}
+
+SEXP Rf_install(const char *s) { return Rf_mkChar(s); }
+
+void SET_STRING_ELT(SEXP v, R_xlen_t i, SEXP c) {
+  ((sexp_rec *)v)->vec[i] = (sexp_rec *)c;
+}
+SEXP STRING_ELT(SEXP v, R_xlen_t i) {
+  return ((sexp_rec *)v)->vec[i];
+}
+void SET_VECTOR_ELT(SEXP v, R_xlen_t i, SEXP x) {
+  ((sexp_rec *)v)->vec[i] = (sexp_rec *)x;
+}
+SEXP VECTOR_ELT(SEXP v, R_xlen_t i) { return ((sexp_rec *)v)->vec[i]; }
+
+const char *CHAR(SEXP c) { return ((sexp_rec *)c)->str; }
+int *INTEGER(SEXP v) { return ((sexp_rec *)v)->ints; }
+double *REAL(SEXP v) { return ((sexp_rec *)v)->reals; }
+
+int Rf_length(SEXP v) { return (int)((sexp_rec *)v)->len; }
+R_xlen_t Rf_xlength(SEXP v) { return ((sexp_rec *)v)->len; }
+
+int Rf_asInteger(SEXP v) {
+  sexp_rec *r = (sexp_rec *)v;
+  if (r->type == INTSXP) return r->ints[0];
+  if (r->type == REALSXP) return (int)r->reals[0];
+  return 0;
+}
+double Rf_asReal(SEXP v) {
+  sexp_rec *r = (sexp_rec *)v;
+  if (r->type == REALSXP) return r->reals[0];
+  if (r->type == INTSXP) return (double)r->ints[0];
+  return 0;
+}
+
+SEXP Rf_setAttrib(SEXP x, SEXP sym, SEXP val) {
+  sexp_rec *r = (sexp_rec *)x;
+  attrib *a = calloc(1, sizeof(attrib));
+  a->key = CHAR(sym);
+  a->value = val;
+  a->next = r->attribs;
+  r->attribs = a;
+  return x;
+}
+SEXP Rf_getAttrib(SEXP x, SEXP sym) {
+  for (attrib *a = ((sexp_rec *)x)->attribs; a; a = a->next)
+    if (strcmp(a->key, CHAR(sym)) == 0) return a->value;
+  return R_NilValue;
+}
+
+SEXP PROTECT(SEXP x) { return x; }
+void UNPROTECT(int n) { (void)n; }
+
+void Rf_error(const char *fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  fprintf(stderr, "Rf_error: ");
+  vfprintf(stderr, fmt, ap);
+  fprintf(stderr, "\n");
+  va_end(ap);
+  exit(2);
+}
+
+char *R_alloc(size_t n, int size) { return calloc(n ? n : 1, size); }
+
+SEXP R_MakeExternalPtr(void *p, SEXP tag, SEXP prot) {
+  (void)tag; (void)prot;
+  sexp_rec *r = rec(EXTPTRSXP_, 0);
+  r->ptr = p;
+  return r;
+}
+void *R_ExternalPtrAddr(SEXP x) { return ((sexp_rec *)x)->ptr; }
+void R_ClearExternalPtr(SEXP x) { ((sexp_rec *)x)->ptr = NULL; }
+void R_RegisterCFinalizerEx(SEXP x, R_CFinalizer_t fin, int onexit) {
+  (void)x; (void)fin; (void)onexit;   /* driver-lifetime objects */
+}
+
+int R_registerRoutines(DllInfo *info, const void *c, const R_CallMethodDef *call,
+                       const void *f, const void *e) {
+  (void)info; (void)c; (void)call; (void)f; (void)e;
+  return 0;
+}
+int R_useDynamicSymbols(DllInfo *info, int x) {
+  (void)info; (void)x;
+  return 0;
+}

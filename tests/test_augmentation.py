@@ -88,10 +88,11 @@ def test_hsl_lightness_direction(tmp_path):
     img = (rng.rand(8, 8, 3) * 100 + 50).astype(np.uint8)
     path = _make_rec(tmp_path, [img])
     it = _iter(path, random_l=50)
-    it._rng = type("R", (), {
+    stub = type("R", (), {
         "rand": staticmethod(lambda *a: np.float64(1.0)),   # dl = +50
         "randint": staticmethod(lambda *a, **k: 0),
         "shuffle": staticmethod(lambda x: None)})()
+    it._derive_rng = lambda epoch, idx: stub
     out = next(iter(it)).data[0].asnumpy()[0]
     base = img.astype(np.float32).transpose(2, 0, 1)
     assert out.mean() > base.mean() + 20.0
@@ -112,18 +113,18 @@ def test_hsl_roundtrip_matches_colorsys(tmp_path):
     (jitter forced to zero offsets but conversion path exercised)."""
     it = mio.ImageRecordIter.__new__(mio.ImageRecordIter)
     it.random_h, it.random_s, it.random_l = 180, 0, 0
-    it._rng = type("R", (), {
+    rng_half = type("R", (), {
         "rand": staticmethod(lambda *a: np.float64(0.5))})()  # dh = 0
     rng = np.random.RandomState(5)
     img = (rng.rand(6, 6, 3) * 255).astype(np.float32)
-    out = it._hsl_augment(img)
+    out = it._hsl_augment(img, rng_half)
     np.testing.assert_allclose(out, img, atol=1.0)
 
     # and a real hue shift agrees with colorsys applied pixelwise
     it.random_h = 90
-    it._rng = type("R", (), {
+    rng_one = type("R", (), {
         "rand": staticmethod(lambda *a: np.float64(1.0))})()  # dh = +90
-    out = it._hsl_augment(img)
+    out = it._hsl_augment(img, rng_one)
     i, j = 2, 3
     r, g, b = (img[i, j] / 255.0).tolist()
     h, l, s = colorsys.rgb_to_hls(r, g, b)

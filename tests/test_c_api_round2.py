@@ -261,6 +261,9 @@ def test_executor_bind_family_and_monitor():
     @cb_t
     def monitor(name, arr_handle, user):
         seen.append(name.decode())
+        # ownership of the handle transfers to the callback (reference
+        # convention) — the callee must free it
+        assert lib.MXNDArrayFree(ctypes.c_void_p(arr_handle)) == 0
 
     assert lib.MXExecutorSetMonitorCallback(eh, monitor, None) == 0, \
         lib.MXGetLastError()

@@ -31,6 +31,8 @@ EXAMPLES = [
     ("dec/dec_toy.py", "DEC OK"),
     ("memcost/memcost.py", "memcost OK"),
     ("nmt/seq2seq_attention.py", "NMT OK"),
+    ("neural_style/neural_style.py", "neural style OK"),
+    ("rnn_time_major/rnn_time_major.py", "rnn time major OK"),
 ]
 
 

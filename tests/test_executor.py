@@ -194,3 +194,57 @@ def test_segmented_remat_matches_plain():
     assert txt.count("optimization_barrier") > 0
     plain_txt = jax.jit(jax.grad(loss(plain))).lower(args).as_text()
     assert txt.count("stablehlo.dot") > plain_txt.count("stablehlo.dot")
+
+
+def test_monitor_installed_between_forward_and_backward():
+    """Per-batch monitor semantics: whether to monitor is decided at
+    emission time (backward / lazy outputs), so a callback installed
+    after forward(is_train=True) still observes that batch."""
+    net = _net()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 4))
+    ex.arg_dict["data"][:] = np.random.randn(2, 4)
+    ex.forward(is_train=True)
+    seen = []
+    ex.set_monitor_callback(lambda name, arr: seen.append(name))
+    ex.backward()
+    assert any("fc_output" in n for n in seen)
+
+
+def test_symbol_grad_with_integer_head():
+    """Symbol.grad over a base symbol whose outputs include a
+    non-differentiable (integer) head: float0 cotangents keep jax.vjp
+    happy (ADVICE r2); the float head still produces real gradients."""
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    fc = mx.sym.FullyConnected(data=data, weight=w, no_bias=True,
+                               num_hidden=3, name="fc")
+    ints = mx.sym.Cast(fc, dtype="int32", name="ci")
+    grp = mx.sym.Group([fc, ints])
+    gsym = grp.grad(["w"])
+    ex = gsym.simple_bind(mx.cpu(), data=(2, 4), w=(3, 4),
+                          grad_req="null")
+    x = np.random.rand(2, 4).astype(np.float32)
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["w"][:] = np.random.rand(3, 4).astype(np.float32)
+    out = ex.forward()[0].asnumpy()
+    # d(sum(fc))/dw = column sums of x broadcast over hidden rows;
+    # the integer head contributes nothing
+    expect = np.tile(x.sum(axis=0), (3, 1))
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_monitor_fires_once_when_outputs_read_before_backward():
+    """Reading .outputs between forward(is_train=True) and backward()
+    must not double-emit the batch's monitor callbacks (once-per-batch
+    contract of set_monitor_callback)."""
+    net = _net()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 4))
+    ex.arg_dict["data"][:] = np.random.randn(2, 4)
+    seen = []
+    ex.set_monitor_callback(lambda name, arr: seen.append(name))
+    ex.forward(is_train=True)
+    _ = ex.outputs            # lazy fetch emits this batch's internals
+    n_after_outputs = len(seen)
+    assert n_after_outputs > 0
+    ex.backward()
+    assert len(seen) == n_after_outputs, "backward re-emitted the batch"

@@ -1,0 +1,113 @@
+"""Multithreaded ImageRecordIter decode pool (reference
+src/io/iter_image_recordio.cc:188-196: OMP pool sized by
+preprocess_threads).
+
+Key invariants: augmentation is keyed by (epoch, record index) so the
+pool size can never change what a record looks like; read-ahead futures
+overlap decode with consumer compute; throughput tooling works.
+"""
+import os
+
+import numpy as np
+import pytest
+
+pytest.importorskip("PIL")
+
+import mxnet_tpu.io as mio
+import mxnet_tpu.recordio as rio
+
+
+def _make_rec(tmp_path, n=24, size=16, name="p.rec"):
+    path = str(tmp_path / name)
+    rng = np.random.RandomState(0)
+    w = rio.MXRecordIO(path, "w")
+    for i in range(n):
+        img = (rng.rand(size, size, 3) * 255).astype(np.uint8)
+        w.write(rio.pack_img(rio.IRHeader(0, float(i % 5), i, 0), img,
+                             quality=100, img_fmt=".png"))
+    w.close()
+    return path
+
+
+AUG = dict(rand_crop=True, rand_mirror=True, max_rotate_angle=15,
+           random_h=20, random_s=20, random_l=20, scale=1.0 / 255)
+
+
+def _epoch(it):
+    out = []
+    for b in it:
+        out.append((b.data[0].asnumpy().copy(), b.label[0].asnumpy().copy()))
+    return out
+
+
+def test_threaded_decode_matches_serial(tmp_path):
+    """Same seed, any pool size -> bit-identical batches: augmentation
+    draws derive from (epoch, record idx), not decode order."""
+    path = _make_rec(tmp_path)
+    a = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 12, 12),
+                            batch_size=8, preprocess_threads=1, seed=5, **AUG)
+    b = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 12, 12),
+                            batch_size=8, preprocess_threads=4, seed=5, **AUG)
+    for (da, la), (db, lb) in zip(_epoch(a), _epoch(b)):
+        np.testing.assert_array_equal(da, db)
+        np.testing.assert_array_equal(la, lb)
+
+
+def test_epochs_reaugment_but_reproducibly(tmp_path):
+    """reset() moves to a new augmentation epoch (reference parser RNG
+    keeps drawing across epochs); two identically-seeded iterators agree
+    epoch by epoch."""
+    path = _make_rec(tmp_path)
+    mk = lambda: mio.ImageRecordIter(
+        path_imgrec=path, data_shape=(3, 12, 12), batch_size=8,
+        preprocess_threads=2, seed=9, **AUG)
+    a, b = mk(), mk()
+    e1a = _epoch(a)
+    a.reset()
+    e2a = _epoch(a)
+    e1b = _epoch(b)
+    b.reset()
+    e2b = _epoch(b)
+    assert any(not np.array_equal(x[0], y[0]) for x, y in zip(e1a, e2a)), \
+        "epoch 2 should re-augment differently"
+    for (x, _), (y, _) in zip(e2a, e2b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_read_ahead_submits_futures(tmp_path):
+    path = _make_rec(tmp_path)
+    it = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 12, 12),
+                             batch_size=4, preprocess_threads=2,
+                             prefetch_buffer=2)
+    next(iter(it))
+    # after serving batch 0 (cursor 0), batches at cursors 4 and 8 are
+    # in flight on the pool
+    assert set(it._inflight.keys()) == {4, 8}
+    # and the prefetched result is the one served later
+    d = next(it).data[0].asnumpy()
+    assert d.shape == (4, 3, 12, 12)
+
+
+def test_preprocess_threads_one_uses_no_pool(tmp_path):
+    path = _make_rec(tmp_path)
+    it = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 12, 12),
+                             batch_size=4, preprocess_threads=1)
+    next(iter(it))
+    assert it._pool is None and not it._inflight
+
+
+def test_pipeline_bench_tool(tmp_path):
+    """The throughput tool runs end to end and reports a sane rate; on
+    any host the decode pipeline must comfortably beat the reference
+    CPU-era 100-200 img/s floor at small images."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "pipeline_bench", os.path.join(os.path.dirname(__file__), "..",
+                                       "tools", "pipeline_bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    results = mod.main(["--image", "32", "--num", "64", "--batch", "16",
+                        "--seconds", "1.0", "--threads", "1,2"])
+    assert len(results) == 2
+    assert all(r["value"] > 100 for r in results), results

@@ -13,6 +13,29 @@ python -m pytest tests/ -m nightly -q
 echo "== dist_sync 2-proc tier (kvstore arithmetic + training) =="
 python -m pytest tests/test_dist_kvstore.py -q
 
+echo "== frontend tier (R/Scala/Perl/Matlab must BUILD — skip = fail) =="
+# the unit suite tolerates a missing toolchain with pytest.skip; the
+# nightly gate does not: green here must mean the four non-Python
+# frontends actually compiled and ran against the C ABI
+for t in gcc perl; do
+    command -v "$t" >/dev/null 2>&1 || {
+        echo "nightly: required toolchain '$t' missing — frontend tier cannot certify"; exit 1; }
+done
+# no pipe: POSIX sh has no pipefail, and `pytest | tee` would let a
+# FAILING tier exit 0 through tee's status
+python -m pytest tests/test_r_package.py tests/test_scala_package.py \
+    tests/test_perl_frontend.py tests/test_matlab_package.py -q -rs \
+    > /tmp/nightly_frontend.log 2>&1 || {
+    cat /tmp/nightly_frontend.log
+    echo "nightly: frontend tests FAILED"
+    exit 1
+}
+cat /tmp/nightly_frontend.log
+if grep -E "[0-9]+ skipped" /tmp/nightly_frontend.log >/dev/null; then
+    echo "nightly: frontend tests SKIPPED — treating as failure"
+    exit 1
+fi
+
 echo "== accelerator tier (skips when no chip is reachable) =="
 python -m pytest tests/test_tpu_consistency.py -q
 
