@@ -21,7 +21,14 @@ __all__ = ["Optimizer", "SGD", "NAG", "SGLD", "Adam", "AdaGrad", "RMSProp",
            "AdaDelta", "Test", "create", "get_updater", "Updater"]
 
 _REG: Registry = Registry.get_registry("optimizer")
-register = _REG.register
+def register(name_or_cls=None, override: bool = False):
+    """Register an optimizer. Supports both the reference's bare-class
+    decorator form (``@mx.optimizer.register`` — name = class name
+    lowercased, speechSGD-style user optimizers) and the named form
+    (``@register("sgd")``)."""
+    if isinstance(name_or_cls, type):
+        return _REG.register(override=True)(name_or_cls)
+    return _REG.register(name_or_cls, override=override)
 
 
 

@@ -347,8 +347,17 @@ class Symbol:
         aux_shapes: Dict[int, List[tuple]] = {}
         for node in nodes:
             shapes[node.uid] = [None] * node.num_outputs()
-            if node.is_variable and node.name in known:
-                shapes[node.uid][0] = known[node.name]
+            if node.is_variable:
+                if node.name in known:
+                    shapes[node.uid][0] = known[node.name]
+                elif node.attrs.get("__shape__"):
+                    # Variable(shape=...) seeds inference (reference
+                    # mx.sym.Variable shape attr, e.g. the (1, H)
+                    # peephole biases in speech-demo's lstm_proj.py)
+                    shapes[node.uid][0] = tuple(
+                        int(v) for v in
+                        node.attrs["__shape__"].strip("()").split(",")
+                        if v.strip())
 
         # fixpoint forward propagation with write-back into variables
         # (reference StaticGraph::InferNodeShapes iterates to fixpoint,

@@ -56,6 +56,26 @@ private[mxnet_tpu] class LibInfo {
                         grad: Long, lr: Float, wd: Float): Unit
   @native def optFree(handle: Long): Unit
 
+  // Round-3 surface: registry symbol construction + shapes + aux +
+  // named-params container IO (the typed Module API sits on these)
+  @native def symCreateVariable(name: String): Long
+  @native def symListAtomic(): Array[String]
+  @native def symCreateAtomic(op: String, keys: Array[String],
+                              vals: Array[String]): Long
+  @native def symCompose(handle: Long, name: String, keys: Array[String],
+                         args: Array[Long]): Unit
+  @native def symListAuxiliary(handle: Long): Array[String]
+  @native def symInferShapes(handle: Long, keys: Array[String],
+                             indptr: Array[Int],
+                             shapeData: Array[Int]): Array[Int]
+  @native def execGetAux(handle: Long, name: String,
+                         size: Int): Array[Float]
+  @native def ndSave(path: String, names: Array[String],
+                     handles: Array[Long]): Unit
+  // element 0: Array[String] names; element 1: Array[Long] handles —
+  // one parse of the container, load record freed native-side
+  @native def ndLoad(path: String): Array[AnyRef]
+
   // KVStore (distributed training; Spark workers call these)
   @native def kvCreate(kvType: String): Long
   @native def kvRank(handle: Long): Int

@@ -64,6 +64,38 @@ class Symbol private[mxnet_tpu] (private[mxnet_tpu] val handle: Long)
     listArguments.zip(sizes).toMap
   }
 
+  def listAuxiliary: Array[String] = LibInfo.lib.symListAuxiliary(handle)
+
+  private def decodeShapes(flat: Array[Int]): Array[Array[Int]] = {
+    val n = flat(0)
+    val out = new Array[Array[Int]](n)
+    var p = 1
+    for (i <- 0 until n) {
+      val ndim = flat(p); p += 1
+      out(i) = flat.slice(p, p + ndim); p += ndim
+    }
+    out
+  }
+
+  /** Full shape inference (reference Symbol.inferShape): returns
+   *  (argShapes, outShapes, auxShapes) given named input shapes.
+   *  One native call carries all three sections back-to-back. */
+  def inferShapes(shapes: Map[String, Array[Int]])
+      : (Array[Array[Int]], Array[Array[Int]], Array[Array[Int]]) = {
+    val (keys, indptr, data) = packShapes(shapes)
+    val flat = LibInfo.lib.symInferShapes(handle, keys, indptr, data)
+    var p = 0
+    def section(): Array[Array[Int]] = {
+      val n = flat(p); p += 1
+      Array.fill(n) {
+        val ndim = flat(p); p += 1
+        val s = flat.slice(p, p + ndim); p += ndim
+        s
+      }
+    }
+    (section(), section(), section())
+  }
+
   /** simple_bind with named input shapes (row-major). */
   def simpleBind(shapes: Map[String, Array[Int]],
                  forTraining: Boolean = false,
@@ -83,6 +115,104 @@ object Symbol {
 
   def load(path: String): Symbol =
     new Symbol(LibInfo.lib.symCreateFromFile(path))
+
+  def Variable(name: String): Symbol =
+    new Symbol(LibInfo.lib.symCreateVariable(name))
+
+  def listOperators: Array[String] = LibInfo.lib.symListAtomic()
+
+  /** Registry-driven operator application (the reference generated
+   *  typed creators from the same enumeration at build time;
+   *  SymbolOps below provides the typed layer over this). */
+  def create(op: String, params: Map[String, String], name: String,
+             inputs: (String, Symbol)*): Symbol = {
+    val h = LibInfo.lib.symCreateAtomic(
+      op, params.keys.toArray, params.values.toArray)
+    try {
+      LibInfo.lib.symCompose(h, name, inputs.map(_._1).toArray,
+                             inputs.map(_._2.handle).toArray)
+    } catch {
+      case e: Throwable =>
+        LibInfo.lib.symFree(h)   // don't leak on bad compose
+        throw e
+    }
+    new Symbol(h)
+  }
+}
+
+/** Typed operator creators (reference scala-package generated these
+ *  from the registry at build time; the most-used subset is typed here
+ *  and `Symbol.create` reaches the rest of the registry). */
+object SymbolOps {
+  def FullyConnected(data: Symbol, numHidden: Int, name: String,
+                     noBias: Boolean = false): Symbol =
+    Symbol.create("FullyConnected",
+                  Map("num_hidden" -> numHidden.toString,
+                      "no_bias" -> noBias.toString),
+                  name, "data" -> data)
+
+  def Activation(data: Symbol, actType: String, name: String): Symbol =
+    Symbol.create("Activation", Map("act_type" -> actType), name,
+                  "data" -> data)
+
+  def Convolution(data: Symbol, numFilter: Int, kernel: (Int, Int),
+                  name: String, stride: (Int, Int) = (1, 1),
+                  pad: (Int, Int) = (0, 0)): Symbol =
+    Symbol.create(
+      "Convolution",
+      Map("num_filter" -> numFilter.toString,
+          "kernel" -> s"(${kernel._1}, ${kernel._2})",
+          "stride" -> s"(${stride._1}, ${stride._2})",
+          "pad" -> s"(${pad._1}, ${pad._2})"),
+      name, "data" -> data)
+
+  def Pooling(data: Symbol, kernel: (Int, Int), poolType: String,
+              name: String, stride: (Int, Int) = (1, 1)): Symbol =
+    Symbol.create(
+      "Pooling",
+      Map("kernel" -> s"(${kernel._1}, ${kernel._2})",
+          "pool_type" -> poolType,
+          "stride" -> s"(${stride._1}, ${stride._2})"),
+      name, "data" -> data)
+
+  def Flatten(data: Symbol, name: String): Symbol =
+    Symbol.create("Flatten", Map.empty, name, "data" -> data)
+
+  def BatchNorm(data: Symbol, name: String): Symbol =
+    Symbol.create("BatchNorm", Map.empty, name, "data" -> data)
+
+  def Dropout(data: Symbol, p: Float, name: String): Symbol =
+    Symbol.create("Dropout", Map("p" -> p.toString), name, "data" -> data)
+
+  def Embedding(data: Symbol, inputDim: Int, outputDim: Int,
+                name: String): Symbol =
+    Symbol.create("Embedding",
+                  Map("input_dim" -> inputDim.toString,
+                      "output_dim" -> outputDim.toString),
+                  name, "data" -> data)
+
+  def SoftmaxOutput(data: Symbol, name: String): Symbol =
+    Symbol.create("SoftmaxOutput", Map.empty, name, "data" -> data)
+
+  def LinearRegressionOutput(data: Symbol, label: Symbol,
+                             name: String): Symbol =
+    Symbol.create("LinearRegressionOutput", Map.empty, name,
+                  "data" -> data, "label" -> label)
+}
+
+object NDArrayIO {
+  /** Named-params container save/load (reference NDArray.save/load —
+   *  same binary layout as the Python side, so checkpoints cross). */
+  def save(path: String, arrays: Map[String, NDArray]): Unit =
+    LibInfo.lib.ndSave(path, arrays.keys.toArray,
+                       arrays.values.map(_.handle).toArray)
+
+  def load(path: String): Map[String, NDArray] = {
+    val pair = LibInfo.lib.ndLoad(path)
+    val names = pair(0).asInstanceOf[Array[String]]
+    val handles = pair(1).asInstanceOf[Array[Long]]
+    names.zip(handles.map(new NDArray(_))).toMap
+  }
 }
 
 /** Registered optimizer over the C surface (reference
@@ -121,6 +251,8 @@ class Executor private[mxnet_tpu] (private[mxnet_tpu] val handle: Long,
     LibInfo.lib.execGetOutput(handle, index, size)
   def getGrad(name: String, size: Int): Array[Float] =
     LibInfo.lib.execGetGrad(handle, name, size)
+  def getAux(name: String, size: Int): Array[Float] =
+    LibInfo.lib.execGetAux(handle, name, size)
   override def close(): Unit = LibInfo.lib.execFree(handle)
 }
 

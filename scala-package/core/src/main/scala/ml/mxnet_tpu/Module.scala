@@ -1,0 +1,261 @@
+package ml.mxnet_tpu
+
+import scala.collection.mutable
+
+/**
+ * Typed training API (reference scala-package
+ * ml.dmlc.mxnet.module.Module + io/metric/initializer/optimizer
+ * packages): DataIter -> Module.fit with initializer, optimizer and
+ * metric, plus checkpoint save/load in the reference's
+ * prefix-symbol.json / prefix-%04d.params layout (arg:/aux: key
+ * prefixes), interoperable with the Python and R frontends.
+ */
+case class DataBatch(data: Array[Float], label: Array[Float])
+
+trait DataIter {
+  def batchSize: Int
+  def reset(): Unit
+  def hasNext: Boolean
+  def next(): DataBatch
+}
+
+/** In-memory iterator (reference ml.dmlc.mxnet.io.NDArrayIter):
+ *  row-major data (numSamples x featureSize), wrap-around padding. */
+class NDArrayIter(data: Array[Array[Float]], label: Array[Float],
+                  val batchSize: Int, shuffle: Boolean = false,
+                  seed: Int = 0) extends DataIter {
+  private val rng = new scala.util.Random(seed)
+  private var order: Array[Int] = data.indices.toArray
+  private var cursor = 0
+
+  def reset(): Unit = {
+    cursor = 0
+    if (shuffle) order = rng.shuffle(data.indices.toList).toArray
+  }
+
+  def hasNext: Boolean = cursor < data.length
+
+  def next(): DataBatch = {
+    val idx = Array.tabulate(batchSize)(i => order((cursor + i) % data.length))
+    cursor += batchSize
+    DataBatch(idx.flatMap(data(_)), idx.map(label(_)))
+  }
+}
+
+trait EvalMetric {
+  def name: String
+  protected var sum = 0.0
+  protected var count = 0
+  def reset(): Unit = { sum = 0.0; count = 0 }
+  def get: (String, Double) = (name, if (count == 0) 0.0 else sum / count)
+  def update(label: Array[Float], pred: Array[Float], numClass: Int): Unit
+}
+
+class Accuracy extends EvalMetric {
+  val name = "accuracy"
+  def update(label: Array[Float], pred: Array[Float],
+             numClass: Int): Unit = {
+    for (i <- label.indices) {
+      val row = pred.slice(i * numClass, (i + 1) * numClass)
+      val guess = row.indices.maxBy(row(_))
+      if (guess == label(i).toInt) sum += 1
+      count += 1
+    }
+  }
+}
+
+class MSE extends EvalMetric {
+  val name = "mse"
+  def update(label: Array[Float], pred: Array[Float],
+             numClass: Int): Unit = {
+    for (i <- label.indices) {
+      val d = pred(i) - label(i)
+      sum += d * d
+      count += 1
+    }
+  }
+}
+
+trait Initializer {
+  def apply(name: String, size: Int, rng: scala.util.Random): Array[Float] =
+    if (name.endsWith("bias") || name.endsWith("beta"))
+      Array.fill(size)(0.0f)
+    else if (name.endsWith("gamma")) Array.fill(size)(1.0f)
+    else weights(size, rng)
+  protected def weights(size: Int, rng: scala.util.Random): Array[Float]
+}
+
+class Uniform(scale: Float = 0.07f) extends Initializer {
+  protected def weights(size: Int, rng: scala.util.Random): Array[Float] =
+    Array.fill(size)((rng.nextFloat() * 2 - 1) * scale)
+}
+
+class Normal(sigma: Float = 0.01f) extends Initializer {
+  protected def weights(size: Int, rng: scala.util.Random): Array[Float] =
+    Array.fill(size)(rng.nextGaussian().toFloat * sigma)
+}
+
+/** SGD with momentum (reference ml.dmlc.mxnet.optimizer.SGD): the
+ *  JVM-side mirror of python optimizer.py update rule. */
+class SGD(val learningRate: Float = 0.01f, val momentum: Float = 0.0f,
+          val wd: Float = 0.0f, val rescaleGrad: Float = 1.0f) {
+  private val mom = mutable.Map.empty[String, Array[Float]]
+  def update(name: String, weight: Array[Float],
+             grad: Array[Float]): Array[Float] = {
+    val m = mom.getOrElseUpdate(name, new Array[Float](weight.length))
+    val out = new Array[Float](weight.length)
+    var i = 0
+    while (i < weight.length) {
+      val g = grad(i) * rescaleGrad + wd * weight(i)
+      m(i) = momentum * m(i) - learningRate * g
+      out(i) = weight(i) + m(i)
+      i += 1
+    }
+    out
+  }
+}
+
+/**
+ * Single-device typed Module. `fit` drives the same loop as the
+ * reference Module.fit: per batch set data/label, fused
+ * forward+backward, SGD update of every parameter, metric update;
+ * per epoch metric reset + optional eval scoring.
+ */
+class Module(symbol: Symbol, dataName: String = "data",
+             labelName: String = "softmax_label",
+             devType: Int = Context.CPU, devId: Int = 0) {
+  private var exec: Executor = _
+  private var argShapes: Map[String, Array[Int]] = Map.empty
+  private var outSize = 0
+  private var numClass = 0
+  var argParams: Map[String, Array[Float]] = Map.empty
+  var auxParams: Map[String, Array[Float]] = Map.empty
+
+  def bind(dataShape: Array[Int]): this.type = {
+    val shapes = Map(dataName -> dataShape)
+    val (args, outs, auxs) = symbol.inferShapes(shapes)
+    argShapes = symbol.listArguments.zip(args).toMap
+    outSize = outs(0).product
+    numClass = outs(0).last
+    exec = symbol.simpleBind(shapes, forTraining = true, devType, devId)
+    this
+  }
+
+  def initParams(initializer: Initializer = new Uniform(0.07f),
+                 seed: Int = 0): this.type = {
+    val rng = new scala.util.Random(seed)
+    argParams = argShapes.collect {
+      case (name, shape)
+          if name != dataName && !name.endsWith("label") =>
+        name -> initializer(name, shape.product, rng)
+    }
+    argParams.foreach { case (n, v) => exec.setArg(n, v) }
+    val (_, _, auxShapes) = symbol.inferShapes(
+      Map(dataName -> argShapes(dataName)))
+    auxParams = symbol.listAuxiliary.zip(auxShapes.map { s =>
+      new Array[Float](s.product)
+    }).toMap
+    auxParams.foreach { case (n, v) =>
+      // moving variances start at 1 (runtime rule)
+      val init = if (n.endsWith("var")) v.map(_ => 1.0f) else v
+      exec.setAux(n, init)
+    }
+    this
+  }
+
+  def fit(train: DataIter, numEpoch: Int, optimizer: SGD,
+          metric: EvalMetric = new Accuracy,
+          evalData: Option[DataIter] = None,
+          verbose: Boolean = true): this.type = {
+    for (epoch <- 1 to numEpoch) {
+      train.reset()
+      metric.reset()
+      while (train.hasNext) {
+        val batch = train.next()
+        exec.setArg(dataName, batch.data)
+        exec.setArg(labelName, batch.label)
+        exec.forward(isTrain = true)
+        exec.backward()
+        argParams = argParams.map { case (name, value) =>
+          val grad = exec.getGrad(name, value.length)
+          val updated = optimizer.update(name, value, grad)
+          exec.setArg(name, updated)
+          name -> updated
+        }
+        metric.update(batch.label, exec.getOutput(0, outSize), numClass)
+      }
+      val (mname, mval) = metric.get
+      if (verbose)
+        println(f"Epoch [$epoch] Train-$mname=$mval%.4f")
+      evalData.foreach { ev =>
+        val (en, evv) = score(ev, new Accuracy)
+        if (verbose) println(f"Epoch [$epoch] Validation-$en=$evv%.4f")
+      }
+    }
+    auxParams = auxParams.map { case (n, v) =>
+      n -> exec.getAux(n, v.length)
+    }
+    this
+  }
+
+  def score(it: DataIter, metric: EvalMetric): (String, Double) = {
+    it.reset()
+    metric.reset()
+    while (it.hasNext) {
+      val batch = it.next()
+      exec.setArg(dataName, batch.data)
+      exec.forward(isTrain = false)
+      metric.update(batch.label, exec.getOutput(0, outSize), numClass)
+    }
+    metric.get
+  }
+
+  def predict(batch: Array[Float]): Array[Float] = {
+    exec.setArg(dataName, batch)
+    exec.forward(isTrain = false)
+    exec.getOutput(0, outSize)
+  }
+
+  /** Reference checkpoint layout: prefix-symbol.json +
+   *  prefix-%04d.params with arg:/aux: prefixes. */
+  def saveCheckpoint(prefix: String, epoch: Int): Unit = {
+    symbol.save(s"$prefix-symbol.json")
+    val named = argParams.map { case (n, v) =>
+      s"arg:$n" -> NDArray.array(v, Array(v.length))
+    } ++ auxParams.map { case (n, v) =>
+      s"aux:$n" -> NDArray.array(v, Array(v.length))
+    }
+    NDArrayIO.save(f"$prefix-$epoch%04d.params", named)
+    named.values.foreach(_.close())
+  }
+
+  def close(): Unit = if (exec != null) exec.close()
+}
+
+object Module {
+  def loadCheckpoint(prefix: String, epoch: Int,
+                     dataName: String = "data"): Module = {
+    val sym = Symbol.load(s"$prefix-symbol.json")
+    val mod = new Module(sym, dataName)
+    val loaded = NDArrayIO.load(f"$prefix-$epoch%04d.params")
+    mod.argParams = loaded.collect {
+      case (k, v) if k.startsWith("arg:") => k.drop(4) -> v.toArray
+    }
+    mod.auxParams = loaded.collect {
+      case (k, v) if k.startsWith("aux:") => k.drop(4) -> v.toArray
+    }
+    loaded.values.foreach(_.close())
+    mod
+  }
+}
+
+/** Estimator facade (reference ml.dmlc.mxnet.FeedForward). */
+object FeedForward {
+  def fit(symbol: Symbol, train: DataIter, dataShape: Array[Int],
+          numEpoch: Int = 10, learningRate: Float = 0.01f,
+          momentum: Float = 0.0f): Module =
+    new Module(symbol)
+      .bind(dataShape)
+      .initParams()
+      .fit(train, numEpoch, new SGD(learningRate, momentum))
+}

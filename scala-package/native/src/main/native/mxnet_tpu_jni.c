@@ -461,3 +461,277 @@ JNIFN(void, optUpdate)(JNIEnv *env, jobject obj, jlong handle, jint index,
 JNIFN(void, optFree)(JNIEnv *env, jobject obj, jlong handle) {
   MXOptimizerFree((OptimizerHandle)(intptr_t)handle);
 }
+
+/* ---- Registry symbol construction (round 3: typed Module API) --------- */
+
+JNIFN(jlong, symCreateVariable)(JNIEnv *env, jobject obj, jstring jname) {
+  const char *name = (*env)->GetStringUTFChars(env, jname, NULL);
+  SymbolHandle h = NULL;
+  int rc = MXSymbolCreateVariable(name, &h);
+  (*env)->ReleaseStringUTFChars(env, jname, name);
+  if (rc != 0) { throw_mx(env); return 0; }
+  return (jlong)(intptr_t)h;
+}
+
+JNIFN(jobjectArray, symListAtomic)(JNIEnv *env, jobject obj) {
+  mx_uint n = 0;
+  AtomicSymbolCreator *creators = NULL;
+  if (MXSymbolListAtomicSymbolCreators(&n, &creators) != 0) {
+    throw_mx(env);
+    return NULL;
+  }
+  const char **names = (const char **)malloc(n * sizeof(char *));
+  for (mx_uint i = 0; i < n; ++i)
+    if (MXSymbolGetAtomicSymbolName(creators[i], &names[i]) != 0) {
+      free(names);
+      throw_mx(env);
+      return NULL;
+    }
+  jobjectArray out = strs_to_java(env, n, names);
+  free(names);
+  return out;
+}
+
+/* one-time creator-name cache: creator lookup must not pay an
+ * O(registry) Python round-trip per operator creation */
+static mx_uint g_creator_count = 0;
+static AtomicSymbolCreator *g_creators = NULL;
+static const char **g_creator_names = NULL;
+
+static int ensure_creator_cache(void) {
+  if (g_creators != NULL) return 0;
+  mx_uint n = 0;
+  AtomicSymbolCreator *creators = NULL;
+  if (MXSymbolListAtomicSymbolCreators(&n, &creators) != 0) return -1;
+  const char **names = (const char **)malloc(n * sizeof(char *));
+  for (mx_uint i = 0; i < n; ++i)
+    if (MXSymbolGetAtomicSymbolName(creators[i], &names[i]) != 0) {
+      free(names);
+      return -1;
+    }
+  g_creator_count = n;
+  g_creators = creators;
+  g_creator_names = names;
+  return 0;
+}
+
+JNIFN(jlong, symCreateAtomic)(JNIEnv *env, jobject obj, jstring jop,
+                              jobjectArray jkeys, jobjectArray jvals) {
+  const char *op = (*env)->GetStringUTFChars(env, jop, NULL);
+  AtomicSymbolCreator creator = NULL;
+  if (ensure_creator_cache() != 0) {
+    (*env)->ReleaseStringUTFChars(env, jop, op);
+    throw_mx(env);
+    return 0;
+  }
+  for (mx_uint i = 0; i < g_creator_count && creator == NULL; ++i)
+    if (strcmp(g_creator_names[i], op) == 0)
+      creator = g_creators[i];
+  (*env)->ReleaseStringUTFChars(env, jop, op);
+  if (creator == NULL) {
+    jclass cls = (*env)->FindClass(env, "java/lang/RuntimeException");
+    (*env)->ThrowNew(env, cls, "unknown operator");
+    return 0;
+  }
+  jsize np = (*env)->GetArrayLength(env, jkeys);
+  const char **keys = (const char **)malloc((np ? np : 1) * sizeof(char *));
+  const char **vals = (const char **)malloc((np ? np : 1) * sizeof(char *));
+  for (jsize i = 0; i < np; ++i) {
+    jstring k = (jstring)(*env)->GetObjectArrayElement(env, jkeys, i);
+    jstring v = (jstring)(*env)->GetObjectArrayElement(env, jvals, i);
+    keys[i] = (*env)->GetStringUTFChars(env, k, NULL);
+    vals[i] = (*env)->GetStringUTFChars(env, v, NULL);
+  }
+  SymbolHandle h = NULL;
+  int rc = MXSymbolCreateAtomicSymbol(creator, (mx_uint)np, keys, vals, &h);
+  for (jsize i = 0; i < np; ++i) {
+    jstring k = (jstring)(*env)->GetObjectArrayElement(env, jkeys, i);
+    jstring v = (jstring)(*env)->GetObjectArrayElement(env, jvals, i);
+    (*env)->ReleaseStringUTFChars(env, k, keys[i]);
+    (*env)->ReleaseStringUTFChars(env, v, vals[i]);
+  }
+  free(keys);
+  free(vals);
+  if (rc != 0) { throw_mx(env); return 0; }
+  return (jlong)(intptr_t)h;
+}
+
+JNIFN(void, symCompose)(JNIEnv *env, jobject obj, jlong handle,
+                        jstring jname, jobjectArray jkeys,
+                        jlongArray jargs) {
+  const char *name = (*env)->GetStringUTFChars(env, jname, NULL);
+  jsize n = (*env)->GetArrayLength(env, jargs);
+  jsize nk = jkeys ? (*env)->GetArrayLength(env, jkeys) : 0;
+  jlong *args = (*env)->GetLongArrayElements(env, jargs, NULL);
+  SymbolHandle *handles =
+      (SymbolHandle *)malloc((n ? n : 1) * sizeof(SymbolHandle));
+  for (jsize i = 0; i < n; ++i)
+    handles[i] = (SymbolHandle)(intptr_t)args[i];
+  const char **keys = NULL;
+  if (nk > 0) {
+    keys = (const char **)malloc(nk * sizeof(char *));
+    for (jsize i = 0; i < nk; ++i) {
+      jstring k = (jstring)(*env)->GetObjectArrayElement(env, jkeys, i);
+      keys[i] = (*env)->GetStringUTFChars(env, k, NULL);
+    }
+  }
+  int rc = MXSymbolCompose((SymbolHandle)(intptr_t)handle, name,
+                           (mx_uint)n, keys, handles);
+  if (keys) {
+    for (jsize i = 0; i < nk; ++i) {
+      jstring k = (jstring)(*env)->GetObjectArrayElement(env, jkeys, i);
+      (*env)->ReleaseStringUTFChars(env, k, keys[i]);
+    }
+    free((void *)keys);
+  }
+  (*env)->ReleaseLongArrayElements(env, jargs, args, JNI_ABORT);
+  (*env)->ReleaseStringUTFChars(env, jname, name);
+  free(handles);
+  if (rc != 0) throw_mx(env);
+}
+
+JNIFN(jobjectArray, symListAuxiliary)(JNIEnv *env, jobject obj,
+                                      jlong handle) {
+  mx_uint n = 0;
+  const char **names = NULL;
+  if (MXSymbolListAuxiliaryStates((SymbolHandle)(intptr_t)handle, &n,
+                                  &names) != 0) {
+    throw_mx(env);
+    return NULL;
+  }
+  return strs_to_java(env, n, names);
+}
+
+/* Flattened shape inference: ONE native call returns all three
+ * sections back-to-back — [count, ndim_0, dims..., ...] for args,
+ * then outputs, then aux — so a Module bind runs inference once.
+ * Uses the Partial ABI entry because it also carries aux shapes
+ * (BatchNorm moving stats). */
+JNIFN(jintArray, symInferShapes)(JNIEnv *env, jobject obj, jlong handle,
+                                 jobjectArray jkeys, jintArray jindptr,
+                                 jintArray jshapeData) {
+  jsize nk = (*env)->GetArrayLength(env, jkeys);
+  const char **keys = (const char **)malloc((nk ? nk : 1) * sizeof(char *));
+  jstring *jstrs = (jstring *)malloc((nk ? nk : 1) * sizeof(jstring));
+  for (jsize i = 0; i < nk; ++i) {
+    jstrs[i] = (jstring)(*env)->GetObjectArrayElement(env, jkeys, i);
+    keys[i] = (*env)->GetStringUTFChars(env, jstrs[i], NULL);
+  }
+  jsize ni = (*env)->GetArrayLength(env, jindptr);
+  jsize nd = (*env)->GetArrayLength(env, jshapeData);
+  jint *indptr = (*env)->GetIntArrayElements(env, jindptr, NULL);
+  jint *sdata = (*env)->GetIntArrayElements(env, jshapeData, NULL);
+  mx_uint *cind = (mx_uint *)malloc((ni ? ni : 1) * sizeof(mx_uint));
+  mx_uint *cdata = (mx_uint *)malloc((nd ? nd : 1) * sizeof(mx_uint));
+  for (jsize i = 0; i < ni; ++i) cind[i] = (mx_uint)indptr[i];
+  for (jsize i = 0; i < nd; ++i) cdata[i] = (mx_uint)sdata[i];
+  mx_uint in_n = 0, out_n = 0, aux_n = 0;
+  const mx_uint *in_ndim = NULL, *out_ndim = NULL, *aux_ndim = NULL;
+  const mx_uint **in_data = NULL, **out_data = NULL, **aux_data = NULL;
+  int complete = 0;
+  int rc = MXSymbolInferShapePartial(
+      (SymbolHandle)(intptr_t)handle, (mx_uint)nk, keys, cind, cdata,
+      &in_n, &in_ndim, &in_data, &out_n, &out_ndim, &out_data,
+      &aux_n, &aux_ndim, &aux_data, &complete);
+  for (jsize i = 0; i < nk; ++i)
+    (*env)->ReleaseStringUTFChars(env, jstrs[i], keys[i]);
+  free(keys); free(jstrs); free(cind); free(cdata);
+  (*env)->ReleaseIntArrayElements(env, jindptr, indptr, JNI_ABORT);
+  (*env)->ReleaseIntArrayElements(env, jshapeData, sdata, JNI_ABORT);
+  if (rc != 0 || !complete) {
+    if (rc == 0) {
+      jclass cls = (*env)->FindClass(env, "java/lang/RuntimeException");
+      (*env)->ThrowNew(env, cls, "infer_shape incomplete");
+    } else {
+      throw_mx(env);
+    }
+    return NULL;
+  }
+  const mx_uint counts[3] = {in_n, out_n, aux_n};
+  const mx_uint *ndims[3] = {in_ndim, out_ndim, aux_ndim};
+  const mx_uint **datas[3] = {in_data, out_data, aux_data};
+  jsize total = 0;
+  for (int s = 0; s < 3; ++s) {
+    total += 1;
+    for (mx_uint i = 0; i < counts[s]; ++i)
+      total += 1 + (jsize)ndims[s][i];
+  }
+  jint *flat = (jint *)malloc(total * sizeof(jint));
+  jsize p = 0;
+  for (int s = 0; s < 3; ++s) {
+    flat[p++] = (jint)counts[s];
+    for (mx_uint i = 0; i < counts[s]; ++i) {
+      flat[p++] = (jint)ndims[s][i];
+      for (mx_uint d = 0; d < ndims[s][i]; ++d)
+        flat[p++] = (jint)datas[s][i][d];
+    }
+  }
+  jintArray out = (*env)->NewIntArray(env, total);
+  (*env)->SetIntArrayRegion(env, out, 0, total, flat);
+  free(flat);
+  return out;
+}
+
+JNIFN(jfloatArray, execGetAux)(JNIEnv *env, jobject obj, jlong handle,
+                               jstring jname, jint size) {
+  const char *name = (*env)->GetStringUTFChars(env, jname, NULL);
+  float *buf = (float *)malloc((size ? size : 1) * sizeof(float));
+  int rc = MXExecutorGetAux((ExecutorHandle)(intptr_t)handle,
+                            name, buf, (mx_uint)size);
+  (*env)->ReleaseStringUTFChars(env, jname, name);
+  if (rc != 0) { free(buf); throw_mx(env); return NULL; }
+  jfloatArray out = (*env)->NewFloatArray(env, (jsize)size);
+  (*env)->SetFloatArrayRegion(env, out, 0, (jsize)size, buf);
+  free(buf);
+  return out;
+}
+
+JNIFN(void, ndSave)(JNIEnv *env, jobject obj, jstring jpath,
+                    jobjectArray jnames, jlongArray jhandles) {
+  const char *path = (*env)->GetStringUTFChars(env, jpath, NULL);
+  jsize n = (*env)->GetArrayLength(env, jhandles);
+  jlong *hs = (*env)->GetLongArrayElements(env, jhandles, NULL);
+  NDArrayHandle *handles =
+      (NDArrayHandle *)malloc((n ? n : 1) * sizeof(NDArrayHandle));
+  const char **names = (const char **)malloc((n ? n : 1) * sizeof(char *));
+  for (jsize i = 0; i < n; ++i) {
+    handles[i] = (NDArrayHandle)(intptr_t)hs[i];
+    jstring s = (jstring)(*env)->GetObjectArrayElement(env, jnames, i);
+    names[i] = (*env)->GetStringUTFChars(env, s, NULL);
+  }
+  int rc = MXNDArraySave(path, (mx_uint)n, handles, names);
+  for (jsize i = 0; i < n; ++i) {
+    jstring s = (jstring)(*env)->GetObjectArrayElement(env, jnames, i);
+    (*env)->ReleaseStringUTFChars(env, s, names[i]);
+  }
+  (*env)->ReleaseLongArrayElements(env, jhandles, hs, JNI_ABORT);
+  (*env)->ReleaseStringUTFChars(env, jpath, path);
+  free(handles);
+  free((void *)names);
+  if (rc != 0) throw_mx(env);
+}
+
+/* Loads ONCE; element 0 is the String[] of names, element 1 the
+ * long[] of handles. The load record is released with
+ * MXNDArrayListFree before returning (the handles themselves stay
+ * owned by the caller, matching the Python frontend's load). */
+JNIFN(jobjectArray, ndLoad)(JNIEnv *env, jobject obj, jstring jpath) {
+  const char *path = (*env)->GetStringUTFChars(env, jpath, NULL);
+  mx_uint n = 0, nn = 0;
+  NDArrayHandle *handles = NULL;
+  const char **names = NULL;
+  int rc = MXNDArrayLoad(path, &n, &handles, &nn, &names);
+  (*env)->ReleaseStringUTFChars(env, jpath, path);
+  if (rc != 0) { throw_mx(env); return NULL; }
+  jobjectArray jnames = strs_to_java(env, nn, names);
+  jlong *hs = (jlong *)malloc((n ? n : 1) * sizeof(jlong));
+  for (mx_uint i = 0; i < n; ++i) hs[i] = (jlong)(intptr_t)handles[i];
+  jlongArray jhandles = (*env)->NewLongArray(env, (jsize)n);
+  (*env)->SetLongArrayRegion(env, jhandles, 0, (jsize)n, hs);
+  free(hs);
+  MXNDArrayListFree(handles, n, names);
+  jobjectArray out = (*env)->NewObjectArray(env, 2, NULL, NULL);
+  (*env)->SetObjectArrayElement(env, out, 0, (jobject)jnames);
+  (*env)->SetObjectArrayElement(env, out, 1, (jobject)jhandles);
+  return out;
+}

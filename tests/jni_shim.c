@@ -1,0 +1,183 @@
+/* A real (minimal) JNI environment for executing
+ * scala-package/native/src/main/native/mxnet_tpu_jni.c without a JVM
+ * (none exists in this image): arrays are {len, data} records, strings
+ * are C strings, ThrowNew prints and exits. Compiled against the same
+ * stub jni.h as the glue (tests/test_scala_package.py JNI_STUB), so the
+ * struct layout agrees. tests/jni_train.c drives the glue through the
+ * exact sequence the Scala Module / Spark trainPartition performs.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "jni.h"
+
+typedef struct {
+  jsize len;
+  void *data;          /* ints, floats, longs, or void* elements */
+} arr_t;
+
+static jclass shim_FindClass(JNIEnv *env, const char *name) {
+  (void)env;
+  return (jclass)name;
+}
+
+static jint shim_ThrowNew(JNIEnv *env, jclass cls, const char *msg) {
+  (void)env;
+  fprintf(stderr, "JNI throw %s: %s\n", (const char *)cls, msg);
+  exit(2);
+}
+
+static jsize shim_GetArrayLength(JNIEnv *env, jarray a) {
+  (void)env;
+  return ((arr_t *)a)->len;
+}
+
+static jint *shim_GetIntArrayElements(JNIEnv *env, jintArray a, void *c) {
+  (void)env; (void)c;
+  return (jint *)((arr_t *)a)->data;
+}
+static void shim_ReleaseIntArrayElements(JNIEnv *env, jintArray a,
+                                         jint *p, jint mode) {
+  (void)env; (void)a; (void)p; (void)mode;
+}
+
+static jfloat *shim_GetFloatArrayElements(JNIEnv *env, jfloatArray a,
+                                          void *c) {
+  (void)env; (void)c;
+  return (jfloat *)((arr_t *)a)->data;
+}
+static void shim_ReleaseFloatArrayElements(JNIEnv *env, jfloatArray a,
+                                           jfloat *p, jint mode) {
+  (void)env; (void)a; (void)p; (void)mode;
+}
+
+static jlong *shim_GetLongArrayElements(JNIEnv *env, jlongArray a,
+                                        void *c) {
+  (void)env; (void)c;
+  return (jlong *)((arr_t *)a)->data;
+}
+static void shim_ReleaseLongArrayElements(JNIEnv *env, jlongArray a,
+                                          jlong *p, jint mode) {
+  (void)env; (void)a; (void)p; (void)mode;
+}
+
+static arr_t *new_arr(jsize n, size_t elem) {
+  arr_t *a = calloc(1, sizeof(arr_t));
+  a->len = n;
+  a->data = calloc(n ? n : 1, elem);
+  return a;
+}
+
+static jfloatArray shim_NewFloatArray(JNIEnv *env, jsize n) {
+  (void)env;
+  return (jfloatArray)new_arr(n, sizeof(jfloat));
+}
+static void shim_SetFloatArrayRegion(JNIEnv *env, jfloatArray a, jsize off,
+                                     jsize n, const jfloat *src) {
+  (void)env;
+  memcpy((jfloat *)((arr_t *)a)->data + off, src, n * sizeof(jfloat));
+}
+
+static jintArray shim_NewIntArray(JNIEnv *env, jsize n) {
+  (void)env;
+  return (jintArray)new_arr(n, sizeof(jint));
+}
+static void shim_SetIntArrayRegion(JNIEnv *env, jintArray a, jsize off,
+                                   jsize n, const jint *src) {
+  (void)env;
+  memcpy((jint *)((arr_t *)a)->data + off, src, n * sizeof(jint));
+}
+
+static jlongArray shim_NewLongArray(JNIEnv *env, jsize n) {
+  (void)env;
+  return (jlongArray)new_arr(n, sizeof(jlong));
+}
+static void shim_SetLongArrayRegion(JNIEnv *env, jlongArray a, jsize off,
+                                    jsize n, const jlong *src) {
+  (void)env;
+  memcpy((jlong *)((arr_t *)a)->data + off, src, n * sizeof(jlong));
+}
+
+static const char *shim_GetStringUTFChars(JNIEnv *env, jstring s,
+                                          void *c) {
+  (void)env; (void)c;
+  return (const char *)s;
+}
+static void shim_ReleaseStringUTFChars(JNIEnv *env, jstring s,
+                                       const char *p) {
+  (void)env; (void)s; (void)p;
+}
+static jstring shim_NewStringUTF(JNIEnv *env, const char *s) {
+  (void)env;
+  return (jstring)strdup(s);
+}
+
+static jobjectArray shim_NewObjectArray(JNIEnv *env, jsize n, jclass cls,
+                                        jobject init) {
+  (void)env; (void)cls; (void)init;
+  return (jobjectArray)new_arr(n, sizeof(void *));
+}
+static void shim_SetObjectArrayElement(JNIEnv *env, jobjectArray a,
+                                       jsize i, jobject v) {
+  (void)env;
+  ((void **)((arr_t *)a)->data)[i] = v;
+}
+static jobject shim_GetObjectArrayElement(JNIEnv *env, jobjectArray a,
+                                          jsize i) {
+  (void)env;
+  return ((void **)((arr_t *)a)->data)[i];
+}
+
+static struct JNINativeInterface_ iface = {
+  .FindClass = shim_FindClass,
+  .ThrowNew = shim_ThrowNew,
+  .GetArrayLength = shim_GetArrayLength,
+  .GetIntArrayElements = shim_GetIntArrayElements,
+  .ReleaseIntArrayElements = shim_ReleaseIntArrayElements,
+  .GetFloatArrayElements = shim_GetFloatArrayElements,
+  .ReleaseFloatArrayElements = shim_ReleaseFloatArrayElements,
+  .GetLongArrayElements = shim_GetLongArrayElements,
+  .ReleaseLongArrayElements = shim_ReleaseLongArrayElements,
+  .NewLongArray = shim_NewLongArray,
+  .SetLongArrayRegion = shim_SetLongArrayRegion,
+  .NewFloatArray = shim_NewFloatArray,
+  .SetFloatArrayRegion = shim_SetFloatArrayRegion,
+  .NewIntArray = shim_NewIntArray,
+  .SetIntArrayRegion = shim_SetIntArrayRegion,
+  .GetStringUTFChars = shim_GetStringUTFChars,
+  .ReleaseStringUTFChars = shim_ReleaseStringUTFChars,
+  .NewStringUTF = shim_NewStringUTF,
+  .NewObjectArray = shim_NewObjectArray,
+  .SetObjectArrayElement = shim_SetObjectArrayElement,
+  .GetObjectArrayElement = shim_GetObjectArrayElement,
+};
+
+/* exported for the driver */
+JNIEnv jni_shim_env = &iface;
+
+/* helpers the driver uses to build/read shim arrays */
+void *jni_shim_make_ints(const jint *v, jsize n) {
+  arr_t *a = new_arr(n, sizeof(jint));
+  memcpy(a->data, v, n * sizeof(jint));
+  return a;
+}
+void *jni_shim_make_floats(const jfloat *v, jsize n) {
+  arr_t *a = new_arr(n, sizeof(jfloat));
+  memcpy(a->data, v, n * sizeof(jfloat));
+  return a;
+}
+void *jni_shim_make_longs(const jlong *v, jsize n) {
+  arr_t *a = new_arr(n, sizeof(jlong));
+  memcpy(a->data, v, n * sizeof(jlong));
+  return a;
+}
+void *jni_shim_make_strs(const char **v, jsize n) {
+  arr_t *a = new_arr(n, sizeof(void *));
+  for (jsize i = 0; i < n; ++i) ((void **)a->data)[i] = (void *)v[i];
+  return a;
+}
+jsize jni_shim_len(void *a) { return ((arr_t *)a)->len; }
+jint *jni_shim_ints(void *a) { return (jint *)((arr_t *)a)->data; }
+jfloat *jni_shim_floats(void *a) { return (jfloat *)((arr_t *)a)->data; }
+void **jni_shim_objs(void *a) { return (void **)((arr_t *)a)->data; }

@@ -1,0 +1,284 @@
+/* Drives the Scala JNI glue (mxnet_tpu_jni.c) through the exact call
+ * sequence the typed Scala API performs, using the real-implementation
+ * JNI shim (tests/jni_shim.c):
+ *
+ *   local mode:  Module.bind -> initParams -> fit (SGD momentum) — the
+ *                Module.scala loop, gating accuracy.
+ *   dist mode:   MXNetTPUSpark.trainPartition — rank-sharded data,
+ *                kvCreate("dist_sync"), per-step push/pull of every
+ *                gradient through the collective, lr rescaled by
+ *                1/(batch*numWorkers). Run under tools/launch.py with 2
+ *                workers; prints a weight checksum so the pytest can
+ *                assert ALL ranks end bit-identical (the reference
+ *                Spark trainer's invariant).
+ *
+ * Prints "final_acc=<v>" and "weights_sum=<v>".
+ */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "jni.h"
+
+extern JNIEnv jni_shim_env;
+void *jni_shim_make_ints(const jint *v, jsize n);
+void *jni_shim_make_floats(const jfloat *v, jsize n);
+void *jni_shim_make_longs(const jlong *v, jsize n);
+void *jni_shim_make_strs(const char **v, jsize n);
+jsize jni_shim_len(void *a);
+jint *jni_shim_ints(void *a);
+jfloat *jni_shim_floats(void *a);
+void **jni_shim_objs(void *a);
+
+/* glue entry points (jstring == const char* under the shim) */
+jlong Java_ml_mxnet_1tpu_LibInfo_symCreateVariable(JNIEnv *, jobject,
+                                                   jstring);
+jlong Java_ml_mxnet_1tpu_LibInfo_symCreateAtomic(JNIEnv *, jobject,
+                                                 jstring, jobjectArray,
+                                                 jobjectArray);
+void Java_ml_mxnet_1tpu_LibInfo_symCompose(JNIEnv *, jobject, jlong,
+                                           jstring, jobjectArray,
+                                           jlongArray);
+jobjectArray Java_ml_mxnet_1tpu_LibInfo_symListArguments(JNIEnv *, jobject,
+                                                         jlong);
+jintArray Java_ml_mxnet_1tpu_LibInfo_symInferShapes(JNIEnv *, jobject,
+                                                    jlong, jobjectArray,
+                                                    jintArray, jintArray,
+                                                    jint);
+jlong Java_ml_mxnet_1tpu_LibInfo_execSimpleBind(JNIEnv *, jobject, jlong,
+                                                jint, jint, jobjectArray,
+                                                jintArray, jintArray,
+                                                jint);
+void Java_ml_mxnet_1tpu_LibInfo_execSetArg(JNIEnv *, jobject, jlong,
+                                           jstring, jfloatArray);
+void Java_ml_mxnet_1tpu_LibInfo_execForward(JNIEnv *, jobject, jlong,
+                                            jint);
+void Java_ml_mxnet_1tpu_LibInfo_execBackward(JNIEnv *, jobject, jlong);
+jfloatArray Java_ml_mxnet_1tpu_LibInfo_execGetOutput(JNIEnv *, jobject,
+                                                     jlong, jint, jint);
+jfloatArray Java_ml_mxnet_1tpu_LibInfo_execGetGrad(JNIEnv *, jobject,
+                                                   jlong, jstring, jint);
+jlong Java_ml_mxnet_1tpu_LibInfo_ndCreate(JNIEnv *, jobject, jintArray,
+                                          jint, jint);
+void Java_ml_mxnet_1tpu_LibInfo_ndSet(JNIEnv *, jobject, jlong,
+                                      jfloatArray);
+jfloatArray Java_ml_mxnet_1tpu_LibInfo_ndGet(JNIEnv *, jobject, jlong);
+void Java_ml_mxnet_1tpu_LibInfo_ndFree(JNIEnv *, jobject, jlong);
+jlong Java_ml_mxnet_1tpu_LibInfo_kvCreate(JNIEnv *, jobject, jstring);
+jint Java_ml_mxnet_1tpu_LibInfo_kvRank(JNIEnv *, jobject, jlong);
+jint Java_ml_mxnet_1tpu_LibInfo_kvNumWorkers(JNIEnv *, jobject, jlong);
+void Java_ml_mxnet_1tpu_LibInfo_kvInit(JNIEnv *, jobject, jlong, jint,
+                                       jlong);
+void Java_ml_mxnet_1tpu_LibInfo_kvPush(JNIEnv *, jobject, jlong, jint,
+                                       jlong, jint);
+void Java_ml_mxnet_1tpu_LibInfo_kvPull(JNIEnv *, jobject, jlong, jint,
+                                       jlong, jint);
+void Java_ml_mxnet_1tpu_LibInfo_kvBarrier(JNIEnv *, jobject, jlong);
+void Java_ml_mxnet_1tpu_LibInfo_kvFree(JNIEnv *, jobject, jlong);
+void Java_ml_mxnet_1tpu_LibInfo_randomSeed(JNIEnv *, jobject, jint);
+
+#define ENV (&jni_shim_env)
+#define BATCH 32
+#define NFEAT 8
+#define NCLASS 2
+#define NSAMPLE 256
+#define ROUNDS 10
+#define MAXARGS 16
+
+static double frand_state = 12345;
+static float frand(void) {
+  frand_state = fmod(frand_state * 48271.0, 2147483647.0);
+  return (float)(frand_state / 2147483647.0);
+}
+
+/* SymbolOps.X(data=input, params...) */
+static jlong apply_op(const char *op, jlong input, const char *name,
+                      const char **pk, const char **pv, int np) {
+  jlong h = Java_ml_mxnet_1tpu_LibInfo_symCreateAtomic(
+      ENV, NULL, op, jni_shim_make_strs(pk, np),
+      jni_shim_make_strs(pv, np));
+  const char *inkeys[] = {"data"};
+  jlong ins[] = {input};
+  Java_ml_mxnet_1tpu_LibInfo_symCompose(ENV, NULL, h, name,
+                                        jni_shim_make_strs(inkeys, 1),
+                                        jni_shim_make_longs(ins, 1));
+  return h;
+}
+
+int main(int argc, char **argv) {
+  int dist = argc > 1 && strcmp(argv[1], "dist") == 0;
+
+  /* dist mode: the collective group must form BEFORE anything touches
+   * the XLA backend (jax.distributed contract) — same ordering the
+   * Spark trainPartition uses (KVStore.create first) */
+  jlong kv = 0;
+  int rank = 0, nworkers = 1;
+  if (dist) {
+    kv = Java_ml_mxnet_1tpu_LibInfo_kvCreate(ENV, NULL, "dist_sync");
+    rank = Java_ml_mxnet_1tpu_LibInfo_kvRank(ENV, NULL, kv);
+    nworkers = Java_ml_mxnet_1tpu_LibInfo_kvNumWorkers(ENV, NULL, kv);
+  }
+  Java_ml_mxnet_1tpu_LibInfo_randomSeed(ENV, NULL, 7);
+
+  /* ---- Module symbol: data -> FC(16) -> relu -> FC(2) -> softmax --- */
+  jlong data = Java_ml_mxnet_1tpu_LibInfo_symCreateVariable(ENV, NULL,
+                                                            "data");
+  const char *k_hid[] = {"num_hidden"};
+  const char *v16[] = {"16"};
+  const char *v2[] = {"2"};
+  const char *k_act[] = {"act_type"};
+  const char *v_relu[] = {"relu"};
+  jlong fc1 = apply_op("FullyConnected", data, "fc1", k_hid, v16, 1);
+  jlong act = apply_op("Activation", fc1, "act1", k_act, v_relu, 1);
+  jlong fc2 = apply_op("FullyConnected", act, "fc2", k_hid, v2, 1);
+  jlong net = apply_op("SoftmaxOutput", fc2, "softmax", NULL, NULL, 0);
+
+  /* ---- Module.bind: inferShapes + simpleBind ---- */
+  const char *skeys[] = {"data"};
+  jint ind[] = {0, 2};
+  jint sdata[] = {BATCH, NFEAT};
+  void *jkeys = jni_shim_make_strs(skeys, 1);
+  void *jind = jni_shim_make_ints(ind, 2);
+  void *jsdata = jni_shim_make_ints(sdata, 2);
+  void *flat = Java_ml_mxnet_1tpu_LibInfo_symInferShapes(
+      ENV, NULL, net, jkeys, jind, jsdata, 0);
+  void *argnames = Java_ml_mxnet_1tpu_LibInfo_symListArguments(ENV, NULL,
+                                                               net);
+  int nargs = jni_shim_len(argnames);
+  const char **names = (const char **)jni_shim_objs(argnames);
+  long psize[MAXARGS];
+  {
+    jint *f = jni_shim_ints(flat);
+    int p = 1;
+    for (int i = 0; i < nargs; ++i) {
+      int ndim = f[p++];
+      long n = 1;
+      for (int d = 0; d < ndim; ++d) n *= f[p++];
+      psize[i] = n;
+    }
+  }
+  jlong exec = Java_ml_mxnet_1tpu_LibInfo_execSimpleBind(
+      ENV, NULL, net, 1, 0, jkeys, jind, jsdata, 1);
+
+  /* ---- Module.initParams (same seed every rank -> identical init) -- */
+  float *params[MAXARGS];
+  float *moms[MAXARGS];
+  for (int i = 0; i < nargs; ++i) {
+    params[i] = calloc(psize[i], sizeof(float));
+    moms[i] = calloc(psize[i], sizeof(float));
+    if (strstr(names[i], "weight"))
+      for (long j = 0; j < psize[i]; ++j)
+        params[i][j] = (frand() - 0.5f) * 0.5f;
+    if (strcmp(names[i], "data") && strcmp(names[i], "softmax_label"))
+      Java_ml_mxnet_1tpu_LibInfo_execSetArg(
+          ENV, NULL, exec, names[i],
+          jni_shim_make_floats(params[i], (jsize)psize[i]));
+  }
+  /* per-param kv keys + gradient staging buffers (Spark initParams) */
+  jlong gnd[MAXARGS];
+  if (dist) {
+    for (int i = 0; i < nargs; ++i) {
+      if (!strcmp(names[i], "data") ||
+          !strcmp(names[i], "softmax_label")) continue;
+      jint shp[] = {(jint)psize[i]};
+      gnd[i] = Java_ml_mxnet_1tpu_LibInfo_ndCreate(
+          ENV, NULL, jni_shim_make_ints(shp, 1), 1, 0);
+      Java_ml_mxnet_1tpu_LibInfo_ndSet(
+          ENV, NULL, gnd[i],
+          jni_shim_make_floats(params[i], (jsize)psize[i]));
+      Java_ml_mxnet_1tpu_LibInfo_kvInit(ENV, NULL, kv, i, gnd[i]);
+    }
+  }
+
+  /* ---- dataset: two separable blobs, rank-sharded in dist mode ---- */
+  static float X[NSAMPLE][NFEAT];
+  static float y[NSAMPLE];
+  int nlocal = 0;
+  for (int i = 0; i < NSAMPLE; ++i) {
+    int cls = i % 2;
+    float row[NFEAT];
+    for (int j = 0; j < NFEAT; ++j)
+      row[j] = (frand() - 0.5f) + (cls ? 0.8f : -0.8f);
+    /* every rank draws the full stream (keeps RNG identical), keeps
+     * its shard — Spark's repartition equivalent */
+    if (!dist || i % nworkers == rank) {
+      memcpy(X[nlocal], row, sizeof(row));
+      y[nlocal] = (float)cls;
+      nlocal++;
+    }
+  }
+
+  const float lr = 0.1f, momentum = 0.9f;
+  const float rescale = dist ? 1.0f / nworkers : 1.0f;
+  float acc = 0.0f;
+  int cursor = 0;
+  for (int round = 0; round < ROUNDS; ++round) {
+    int correct = 0, seen = 0;
+    int steps = nlocal / BATCH;          /* equal on all ranks */
+    for (int s = 0; s < steps; ++s) {
+      float batch[BATCH * NFEAT];
+      float labels[BATCH];
+      for (int b = 0; b < BATCH; ++b) {
+        int idx = (cursor + b) % nlocal;
+        memcpy(&batch[b * NFEAT], X[idx], NFEAT * sizeof(float));
+        labels[b] = y[idx];
+      }
+      cursor = (cursor + BATCH) % nlocal;
+      Java_ml_mxnet_1tpu_LibInfo_execSetArg(
+          ENV, NULL, exec, "data",
+          jni_shim_make_floats(batch, BATCH * NFEAT));
+      Java_ml_mxnet_1tpu_LibInfo_execSetArg(
+          ENV, NULL, exec, "softmax_label",
+          jni_shim_make_floats(labels, BATCH));
+      Java_ml_mxnet_1tpu_LibInfo_execForward(ENV, NULL, exec, 1);
+      Java_ml_mxnet_1tpu_LibInfo_execBackward(ENV, NULL, exec);
+      for (int i = 0; i < nargs; ++i) {
+        if (!strcmp(names[i], "data") ||
+            !strcmp(names[i], "softmax_label")) continue;
+        void *g = Java_ml_mxnet_1tpu_LibInfo_execGetGrad(
+            ENV, NULL, exec, names[i], (jint)psize[i]);
+        float *gv = jni_shim_floats(g);
+        if (dist) {
+          /* trainPartition: push local grad, pull the cross-worker
+           * sum back before updating */
+          Java_ml_mxnet_1tpu_LibInfo_ndSet(
+              ENV, NULL, gnd[i],
+              jni_shim_make_floats(gv, (jsize)psize[i]));
+          Java_ml_mxnet_1tpu_LibInfo_kvPush(ENV, NULL, kv, i, gnd[i], 0);
+          Java_ml_mxnet_1tpu_LibInfo_kvPull(ENV, NULL, kv, i, gnd[i], 0);
+          void *red = Java_ml_mxnet_1tpu_LibInfo_ndGet(ENV, NULL, gnd[i]);
+          gv = jni_shim_floats(red);
+        }
+        for (long j = 0; j < psize[i]; ++j) {   /* SGD.update */
+          moms[i][j] = momentum * moms[i][j] - lr * rescale * gv[j];
+          params[i][j] += moms[i][j];
+        }
+        Java_ml_mxnet_1tpu_LibInfo_execSetArg(
+            ENV, NULL, exec, names[i],
+            jni_shim_make_floats(params[i], (jsize)psize[i]));
+      }
+      void *out = Java_ml_mxnet_1tpu_LibInfo_execGetOutput(
+          ENV, NULL, exec, 0, BATCH * NCLASS);
+      float *ov = jni_shim_floats(out);
+      for (int b = 0; b < BATCH; ++b) {
+        int guess = ov[b * NCLASS] > ov[b * NCLASS + 1] ? 0 : 1;
+        correct += (guess == (int)labels[b]);
+        seen += 1;
+      }
+    }
+    acc = (float)correct / seen;
+  }
+  if (dist) Java_ml_mxnet_1tpu_LibInfo_kvBarrier(ENV, NULL, kv);
+
+  double wsum = 0.0;
+  for (int i = 0; i < nargs; ++i) {
+    if (!strcmp(names[i], "data") ||
+        !strcmp(names[i], "softmax_label")) continue;
+    for (long j = 0; j < psize[i]; ++j) wsum += (double)params[i][j];
+  }
+  printf("final_acc=%f\n", acc);
+  printf("weights_sum=%.9f\n", wsum);
+  if (dist) Java_ml_mxnet_1tpu_LibInfo_kvFree(ENV, NULL, kv);
+  return acc >= 0.9f ? 0 : 1;
+}

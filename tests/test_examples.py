@@ -33,6 +33,10 @@ EXAMPLES = [
     ("nmt/seq2seq_attention.py", "NMT OK"),
     ("neural_style/neural_style.py", "neural style OK"),
     ("rnn_time_major/rnn_time_major.py", "rnn time major OK"),
+    ("speech_demo/speech_lstm.py", "speech demo OK"),
+    ("kaggle_ndsb1/ndsb1.py", "kaggle ndsb1 OK"),
+    ("kaggle_ndsb2/ndsb2.py", "kaggle ndsb2 OK"),
+    ("python_howto/howto.py", "python howto OK"),
 ]
 
 

@@ -25,6 +25,7 @@ typedef int32_t jint;
 typedef int64_t jlong;
 typedef float jfloat;
 typedef int32_t jsize;
+typedef void *jlongArray;
 typedef void *jobject;
 typedef void *jclass;
 typedef void *jstring;
@@ -42,6 +43,11 @@ struct JNINativeInterface_ {
   void (*ReleaseIntArrayElements)(JNIEnv *, jintArray, jint *, jint);
   jfloat *(*GetFloatArrayElements)(JNIEnv *, jfloatArray, void *);
   void (*ReleaseFloatArrayElements)(JNIEnv *, jfloatArray, jfloat *, jint);
+  jlong *(*GetLongArrayElements)(JNIEnv *, jlongArray, void *);
+  void (*ReleaseLongArrayElements)(JNIEnv *, jlongArray, jlong *, jint);
+  jlongArray (*NewLongArray)(JNIEnv *, jsize);
+  void (*SetLongArrayRegion)(JNIEnv *, jlongArray, jsize, jsize,
+                             const jlong *);
   jfloatArray (*NewFloatArray)(JNIEnv *, jsize);
   void (*SetFloatArrayRegion)(JNIEnv *, jfloatArray, jsize, jsize,
                               const jfloat *);
@@ -125,3 +131,86 @@ def test_spark_module_covers_reference_surface():
                    "setLearningRate", "trainPartition", "kv.push",
                    "kv.pull", "kv.barrier"):
         assert needle in src, needle
+
+
+def _build_jni_driver(tmpdir):
+    r = subprocess.run(["make", "-C", REPO, "predict"],
+                       capture_output=True, text=True)
+    lib = os.path.join(REPO, "mxnet_tpu", "_native", "libmxtpu_predict.so")
+    assert r.returncode == 0 and os.path.exists(lib), r.stderr[-800:]
+    with open(os.path.join(tmpdir, "jni.h"), "w") as f:
+        f.write(JNI_STUB)
+    exe = os.path.join(tmpdir, "jni_train")
+    r = subprocess.run(
+        ["gcc", os.path.join(REPO, "tests", "jni_shim.c"),
+         os.path.join(REPO, "tests", "jni_train.c"), JNI_C,
+         "-o", exe, "-I", tmpdir, "-I", os.path.join(REPO, "include"),
+         "-L", os.path.dirname(lib), "-lmxtpu_predict",
+         "-Wl,-rpath," + os.path.dirname(lib), "-lm"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return exe
+
+
+def _driver_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def test_jni_module_training_executes(tmp_path):
+    """Execution gate for the Scala frontend's native path: no JVM
+    exists in this image, so tests/jni_shim.c implements the JNI
+    environment for real and tests/jni_train.c performs the exact
+    native sequence Module.scala's bind/initParams/fit drives —
+    registry symbol construction, full shape inference, simple_bind,
+    per-batch forward/backward/getGrad, SGD-momentum updates — gating
+    convergence >= 0.9. (Scala-language semantics are covered by the
+    structural gates above, as in the reference whose Spark module also
+    only ran in a real cluster.)"""
+    if shutil.which("gcc") is None or shutil.which("make") is None:
+        pytest.skip("no gcc toolchain")
+    exe = _build_jni_driver(str(tmp_path))
+    r = subprocess.run([exe, "local"], capture_output=True, text=True,
+                       env=_driver_env(), timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
+    acc = float(r.stdout.split("final_acc=")[1].split()[0])
+    assert acc >= 0.9, r.stdout
+
+
+def test_jni_spark_dist_training_two_workers(tmp_path):
+    """The Spark trainer's distribution invariant, executed for real:
+    two processes launched by tools/launch.py each run the
+    MXNetTPUSpark.trainPartition native sequence (rank-sharded data,
+    dist_sync kvstore, per-step gradient push/pull through the
+    collective). Gates: both ranks converge AND end with bit-identical
+    weights (reference scala-package/spark MXNet.scala's guarantee via
+    the shared parameter server)."""
+    import signal
+    import sys as _sys
+    if shutil.which("gcc") is None or shutil.which("make") is None:
+        pytest.skip("no gcc toolchain")
+    exe = _build_jni_driver(str(tmp_path))
+    env = _driver_env()
+    proc = subprocess.Popen(
+        [_sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--coordinator", "127.0.0.1:23473", exe, "dist"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=300)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, signal.SIGKILL)
+        stdout, stderr = proc.communicate()
+        raise
+    if proc.returncode != 0 and "distributed" in (stderr or "").lower() \
+            and "final_acc" not in stdout:
+        pytest.skip("jax.distributed unavailable: %s" % stderr[-200:])
+    assert proc.returncode == 0, (stdout[-1000:], stderr[-2000:])
+    accs = [float(x.split()[0]) for x in stdout.split("final_acc=")[1:]]
+    sums = [x.split()[0] for x in stdout.split("weights_sum=")[1:]]
+    assert len(accs) == 2 and len(sums) == 2, stdout
+    assert all(a >= 0.9 for a in accs), accs
+    assert sums[0] == sums[1], "ranks diverged: %s" % sums

@@ -10,9 +10,69 @@ the TPU runtime.
 """
 import argparse
 import os
+import shlex
 import signal
 import subprocess
 import sys
+
+# environment that must travel to remote workers for the job to behave
+# like the local one (reference dmlc_tracker forwarded its env list the
+# same way, tools/launch.py:32-79 -> dmlc_tracker/ssh.py)
+FORWARD_ENV = ["PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS",
+               "MXNET_ENGINE_TYPE", "MXNET_COMPUTE_DTYPE",
+               "MXNET_BACKWARD_DO_MIRROR", "LD_LIBRARY_PATH"]
+
+
+def worker_env(args, rank):
+    """Rendezvous env for one worker (both launchers use this)."""
+    return {
+        "MXTPU_COORDINATOR": args.coordinator,
+        "MXTPU_NUM_WORKERS": str(args.num_workers),
+        "MXTPU_WORKER_RANK": str(rank),
+        # reference env names kept for script compat
+        "DMLC_ROLE": "worker",
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_WORKER_ID": str(rank),
+    }
+
+
+def monitor(procs):
+    """Failure detection (reference dmlc_tracker behavior): if any
+    worker dies abnormally, the survivors would hang in their next
+    collective — kill the job and report the failure so a supervisor
+    can restart from the last checkpoint."""
+    import time
+
+    def _kill(*_):
+        for p in procs:
+            p.terminate()
+    signal.signal(signal.SIGINT, _kill)
+    signal.signal(signal.SIGTERM, _kill)
+    rc = 0
+    pending = list(procs)
+    while pending:
+        time.sleep(0.2)
+        for p in list(pending):
+            prc = p.poll()
+            if prc is None:
+                continue
+            pending.remove(p)
+            if prc != 0:
+                rc = prc
+                sys.stderr.write(
+                    "launch.py: worker pid %d exited with %d; "
+                    "terminating %d remaining worker(s)\n"
+                    % (p.pid, prc, len(pending)))
+                for q in pending:
+                    q.terminate()
+                for q in pending:
+                    try:
+                        q.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        q.kill()
+                pending = []
+                break
+    return rc
 
 
 def main():
@@ -25,10 +85,25 @@ def main():
                              "replace push/pull)")
     parser.add_argument("--launcher", default="local",
                         choices=["local", "ssh"],
-                        help="local: fork processes on this machine")
+                        help="local: fork processes on this machine; "
+                             "ssh: one process per hostfile entry "
+                             "(round-robin when workers > hosts)")
     parser.add_argument("-H", "--hostfile", default=None,
-                        help="hostfile for ssh launcher")
-    parser.add_argument("--coordinator", default="127.0.0.1:12421")
+                        help="hostfile for ssh launcher (one host per "
+                             "line; '#' comments allowed)")
+    parser.add_argument("--coordinator", default="127.0.0.1:12421",
+                        help="host:port every worker dials for "
+                             "jax.distributed rendezvous; with the ssh "
+                             "launcher this must be an address the "
+                             "remote hosts can reach (i.e. not "
+                             "127.0.0.1)")
+    parser.add_argument("--ssh-cmd", default="ssh",
+                        help="ssh binary (tests substitute a local shim)")
+    parser.add_argument("--sync-dir", default=None,
+                        help="remote working directory (default: this "
+                             "job's cwd, assumed shared e.g. NFS — the "
+                             "reference's ssh tracker made the same "
+                             "assumption)")
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if not args.command:
@@ -38,67 +113,37 @@ def main():
         procs = []
         for rank in range(args.num_workers):
             env = dict(os.environ)
-            env.update({
-                "MXTPU_COORDINATOR": args.coordinator,
-                "MXTPU_NUM_WORKERS": str(args.num_workers),
-                "MXTPU_WORKER_RANK": str(rank),
-                # reference env names kept for script compat
-                "DMLC_ROLE": "worker",
-                "DMLC_NUM_WORKER": str(args.num_workers),
-                "DMLC_WORKER_ID": str(rank),
-            })
+            env.update(worker_env(args, rank))
             procs.append(subprocess.Popen(args.command, env=env))
-
-        def _kill(*_):
-            for p in procs:
-                p.terminate()
-        signal.signal(signal.SIGINT, _kill)
-        signal.signal(signal.SIGTERM, _kill)
-        # failure detection (reference dmlc_tracker behavior): if any
-        # worker dies abnormally, the survivors would hang in their next
-        # collective — kill the job and report the failure so a
-        # supervisor can restart from the last checkpoint
-        import time
-        rc = 0
-        pending = list(procs)
-        while pending:
-            time.sleep(0.2)
-            for p in list(pending):
-                prc = p.poll()
-                if prc is None:
-                    continue
-                pending.remove(p)
-                if prc != 0:
-                    rc = prc
-                    sys.stderr.write(
-                        "launch.py: worker pid %d exited with %d; "
-                        "terminating %d remaining worker(s)\n"
-                        % (p.pid, prc, len(pending)))
-                    for q in pending:
-                        q.terminate()
-                    for q in pending:
-                        try:
-                            q.wait(timeout=10)
-                        except subprocess.TimeoutExpired:
-                            q.kill()
-                    pending = []
-                    break
-        sys.exit(rc)
+        sys.exit(monitor(procs))
     else:
         if not args.hostfile:
             parser.error("ssh launcher needs --hostfile")
-        hosts = [h.strip() for h in open(args.hostfile) if h.strip()]
+        hosts = [h.split("#", 1)[0].strip() for h in open(args.hostfile)]
+        hosts = [h for h in hosts if h]
+        if not hosts:
+            parser.error("hostfile %s lists no hosts" % args.hostfile)
+        cwd = args.sync_dir or os.getcwd()
         procs = []
-        for rank, host in enumerate(hosts[:args.num_workers]):
-            remote_env = ("MXTPU_COORDINATOR=%s MXTPU_NUM_WORKERS=%d "
-                          "MXTPU_WORKER_RANK=%d" %
-                          (args.coordinator, args.num_workers, rank))
-            cmd = ["ssh", host, remote_env + " " + " ".join(args.command)]
-            procs.append(subprocess.Popen(cmd))
-        rc = 0
-        for p in procs:
-            rc = p.wait() or rc
-        sys.exit(rc)
+        for rank in range(args.num_workers):
+            host = hosts[rank % len(hosts)]       # round-robin
+            env = worker_env(args, rank)
+            for k in FORWARD_ENV:                 # propagate local env
+                if os.environ.get(k) is not None:
+                    env[k] = os.environ[k]
+            env_str = " ".join("%s=%s" % (k, shlex.quote(v))
+                               for k, v in sorted(env.items()))
+            remote = "cd %s && env %s %s" % (
+                shlex.quote(cwd), env_str,
+                " ".join(shlex.quote(c) for c in args.command))
+            # -tt forces a remote pty so terminating the local ssh
+            # client HUPs the remote worker too — without it the
+            # launcher's kill-the-job-on-failure guarantee would stop
+            # at the ssh client and orphan remote workers mid-collective
+            procs.append(subprocess.Popen(
+                [args.ssh_cmd, "-tt", "-o", "StrictHostKeyChecking=no",
+                 host, remote]))
+        sys.exit(monitor(procs))
 
 
 if __name__ == "__main__":
