@@ -160,6 +160,22 @@ def _spatial_letters(nd: int) -> str:
     raise MXNetError("unsupported spatial rank %d" % nd)
 
 
+_VALID_LAYOUTS = {"NCW", "NWC", "NCHW", "NHWC", "NCDHW", "NDHWC"}
+
+
+def _layout_is_nhwc(layout):
+    """Validate + classify a layout string: channels-last -> True.
+    None means the NCHW default; anything outside the supported set is
+    an error (a typo'd layout must not silently run as NCHW)."""
+    if layout is None:
+        return False
+    lay = str(layout).upper()
+    if lay not in _VALID_LAYOUTS:
+        raise MXNetError("unsupported layout '%s' (supported: %s)"
+                         % (layout, sorted(_VALID_LAYOUTS)))
+    return lay.endswith("C")
+
+
 class _ConvBase(Operator):
     PARAMS = {
         "kernel": Param("shape", REQUIRED, "(kh, kw)"),
@@ -171,7 +187,14 @@ class _ConvBase(Operator):
         "no_bias": Param(bool, False),
         "workspace": Param(int, 512, "ignored; XLA plans memory"),
         "cudnn_tune": Param(str, None, "ignored on TPU"),
+        "layout": Param(str, None, "NCHW (default) or NHWC — TPU-first "
+                        "extension: NHWC keeps channels on the minor "
+                        "(lane) axis, the layout the TPU vector unit "
+                        "wants, avoiding compiler-inserted transposes"),
     }
+
+    def _is_nhwc(self):
+        return _layout_is_nhwc(self.layout)
 
     def list_arguments(self):
         return ["data", "weight"] if self.no_bias else ["data", "weight", "bias"]
@@ -195,22 +218,32 @@ class Convolution(_ConvBase):
         kernel, stride, pad, dilate = self._norm_params()
         if len(data) != len(kernel) + 2:
             raise MXNetError("Convolution: data must be N,C,spatial*%d" % len(kernel))
-        n, c = data[0], data[1]
+        nhwc = self._is_nhwc()
+        n = data[0]
+        c = data[-1] if nhwc else data[1]
+        sp_in = data[1:-1] if nhwc else data[2:]
         wshape = (self.num_filter, c // self.num_group) + tuple(kernel)
-        out_sp = tuple(_conv_out_dim(data[2 + i], kernel[i], stride[i],
+        out_sp = tuple(_conv_out_dim(sp_in[i], kernel[i], stride[i],
                                      pad[i], dilate[i])
                        for i in range(len(kernel)))
         shapes = [data, wshape]
         if not self.no_bias:
             shapes.append((self.num_filter,))
-        return shapes, [(n, self.num_filter) + out_sp], []
+        out = (n,) + out_sp + (self.num_filter,) if nhwc \
+            else (n, self.num_filter) + out_sp
+        return shapes, [out], []
 
     def apply(self, ctx, inputs, aux):
         lax = _jax().lax
         kernel, stride, pad, dilate = self._norm_params()
         nd = len(kernel)
         spatial = _spatial_letters(nd)
-        dn = ("NC" + spatial, "OI" + spatial, "NC" + spatial)
+        nhwc = self._is_nhwc()
+        # weight stays OIHW in BOTH layouts (checkpoint-canonical); XLA
+        # re-lays it out at compile time, so NHWC costs no transposes at
+        # runtime on TPU
+        act = "N" + spatial + "C" if nhwc else "NC" + spatial
+        dn = (act, "OI" + spatial, act)
         out = lax.conv_general_dilated(
             inputs[0], inputs[1],
             window_strides=stride,
@@ -222,7 +255,9 @@ class Convolution(_ConvBase):
             if inputs[0].dtype == np.float32 else None,
         )
         if not self.no_bias:
-            out = out + inputs[2].reshape((1, -1) + (1,) * nd)
+            bshape = (1,) + (1,) * nd + (-1,) if nhwc \
+                else (1, -1) + (1,) * nd
+            out = out + inputs[2].reshape(bshape)
         return [out], []
 
 
@@ -238,14 +273,19 @@ class Deconvolution(_ConvBase):
         if data is None:
             raise MXNetError("Deconvolution: data shape unknown")
         kernel, stride, pad, dilate = self._norm_params()
-        n, c = data[0], data[1]
+        nhwc = self._is_nhwc()
+        n = data[0]
+        c = data[-1] if nhwc else data[1]
+        sp_in = data[1:-1] if nhwc else data[2:]
         wshape = (c, self.num_filter // self.num_group) + tuple(kernel)
-        out_sp = tuple((data[2 + i] - 1) * stride[i] - 2 * pad[i] + kernel[i]
+        out_sp = tuple((sp_in[i] - 1) * stride[i] - 2 * pad[i] + kernel[i]
                        for i in range(len(kernel)))
         shapes = [data, wshape]
         if not self.no_bias:
             shapes.append((self.num_filter,))
-        return shapes, [(n, self.num_filter) + out_sp], []
+        out = (n,) + out_sp + (self.num_filter,) if nhwc \
+            else (n, self.num_filter) + out_sp
+        return shapes, [out], []
 
     def apply(self, ctx, inputs, aux):
         # gradient-of-conv formulation: input dilation by stride, padding
@@ -256,7 +296,8 @@ class Deconvolution(_ConvBase):
         kernel, stride, pad, dilate = self._norm_params()
         nd = len(kernel)
         spatial = _spatial_letters(nd)
-        dn = ("NC" + spatial, "IO" + spatial, "NC" + spatial)
+        act = "N" + spatial + "C" if self._is_nhwc() else "NC" + spatial
+        dn = (act, "IO" + spatial, act)
         w = inputs[1]
         w = w[(slice(None), slice(None)) + (slice(None, None, -1),) * nd]
         padding = []
@@ -273,7 +314,9 @@ class Deconvolution(_ConvBase):
             feature_group_count=self.num_group,
         )
         if not self.no_bias:
-            out = out + inputs[2].reshape((1, -1) + (1,) * nd)
+            bshape = (1,) + (1,) * nd + (-1,) if self._is_nhwc() \
+                else (1, -1) + (1,) * nd
+            out = out + inputs[2].reshape(bshape)
         return [out], []
 
 
@@ -289,12 +332,20 @@ class Pooling(Operator):
         "stride": Param("shape", None),
         "pad": Param("shape", None),
         "global_pool": Param(bool, False),
+        "layout": Param(str, None, "NCHW (default) or NHWC"),
     }
+
+    def _is_nhwc(self):
+        return _layout_is_nhwc(self.layout)
+
+    def _sp_base(self):
+        return 1 if self._is_nhwc() else 2
 
     def _norm(self, data_shape):
         nd = len(self.kernel)
+        base = self._sp_base()
         if self.global_pool:
-            kernel = tuple(data_shape[2 + i] for i in range(nd))
+            kernel = tuple(data_shape[base + i] for i in range(nd))
             return kernel, (1,) * nd, (0,) * nd
         return self.kernel, self.stride or (1,) * nd, self.pad or (0,) * nd
 
@@ -303,12 +354,18 @@ class Pooling(Operator):
         if data is None:
             raise MXNetError("Pooling: data shape unknown")
         kernel, stride, pad = self._norm(data)
+        base = self._sp_base()
         if self.global_pool:
             out_sp = (1,) * len(kernel)
         else:
-            out_sp = tuple((data[2 + i] + 2 * pad[i] - kernel[i]) // stride[i] + 1
-                           for i in range(len(kernel)))
-        return [data], [data[:2] + out_sp], []
+            out_sp = tuple(
+                (data[base + i] + 2 * pad[i] - kernel[i]) // stride[i] + 1
+                for i in range(len(kernel)))
+        if self._is_nhwc():
+            out = (data[0],) + out_sp + (data[-1],)
+        else:
+            out = data[:2] + out_sp
+        return [data], [out], []
 
     def apply(self, ctx, inputs, aux):
         lax = _jax().lax
@@ -316,9 +373,14 @@ class Pooling(Operator):
         x = inputs[0]
         kernel, stride, pad = self._norm(x.shape)
         nd = len(kernel)
-        window = (1, 1) + tuple(kernel)
-        strides = (1, 1) + tuple(stride)
-        padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+        if self._is_nhwc():
+            window = (1,) + tuple(kernel) + (1,)
+            strides = (1,) + tuple(stride) + (1,)
+            padding = ((0, 0),) + tuple((p, p) for p in pad) + ((0, 0),)
+        else:
+            window = (1, 1) + tuple(kernel)
+            strides = (1, 1) + tuple(stride)
+            padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
         is_float = jnp.issubdtype(x.dtype, jnp.floating)  # incl. bfloat16
         if self.pool_type == "max":
             init = -jnp.inf if is_float else np.iinfo(x.dtype).min
@@ -344,6 +406,7 @@ class BatchNorm(Operator):
         "momentum": Param(float, 0.9),
         "fix_gamma": Param(bool, True),
         "use_global_stats": Param(bool, False),
+        "axis": Param(int, 1, "channel axis (1 = NCHW; -1 for NHWC)"),
     }
 
     def list_arguments(self):
@@ -356,7 +419,7 @@ class BatchNorm(Operator):
         data = in_shapes[0]
         if data is None:
             raise MXNetError("BatchNorm: data shape unknown")
-        c = (data[1],)
+        c = (data[self.axis],)
         return [data, c, c], [data], [c, c]
 
     def apply(self, ctx, inputs, aux):
@@ -364,8 +427,9 @@ class BatchNorm(Operator):
         jax = _jax()
         x, gamma, beta = inputs
         moving_mean, moving_var = aux
-        axes = (0,) + tuple(range(2, x.ndim))
-        bshape = (1, -1) + (1,) * (x.ndim - 2)
+        caxis = self.axis % x.ndim
+        axes = tuple(i for i in range(x.ndim) if i != caxis)
+        bshape = tuple(-1 if i == caxis else 1 for i in range(x.ndim))
         if self.fix_gamma:
             gamma = jnp.ones_like(gamma)
         use_batch_stats = ctx.is_train and not self.use_global_stats

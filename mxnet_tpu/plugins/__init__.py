@@ -1,4 +1,5 @@
-"""Plugin bridges (reference ``plugin/``: torch, caffe, warpctc, ...).
+"""Plugin bridges (reference ``plugin/``: torch, caffe, warpctc,
+opencv, sframe).
 
 - torch bridge (``plugin/torch`` modernized to PyTorch; imported lazily
   so the heavy torch import is only paid when used)
@@ -6,13 +7,25 @@
   emulation registry; registered eagerly so ``sym.CaffeOp`` exists)
 - warpctc is a first-class op (``mxnet_tpu/ops/ctc.py``), not a plugin —
   the TPU runtime needs no external CTC library.
-- sframe has no usable host library in this environment.
+- opencv (``plugin/opencv``): same surface (imdecode/resize/
+  copyMakeBorder/crops/ImageListIter) with PIL+numpy standing in for
+  cv2, which is absent here; lazy like torch.
+- sframe (``plugin/sframe``): MXSFrameDataIter/MXSFrameImageIter with
+  pandas standing in for graphlab's gl_sframe; registered eagerly so
+  the iterator registry lists them.
 """
 from . import caffe_op  # noqa: F401
+from . import sframe  # noqa: F401
 
 
 def __getattr__(name):
-    if name == "torch_bridge":
-        from . import torch_bridge
-        return torch_bridge
+    # importlib (not `from . import X`): a from-import inside the
+    # package's own __getattr__ re-enters it via the import system's
+    # hasattr probe before the submodule lands -> infinite recursion
+    if name in ("torch_bridge", "opencv"):
+        import importlib
+
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
     raise AttributeError(name)

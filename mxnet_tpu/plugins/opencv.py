@@ -1,0 +1,208 @@
+"""OpenCV plugin equivalent (reference ``plugin/opencv/opencv.py`` +
+``cv_api.cc``): imdecode / resize / copyMakeBorder NDArray functions and
+the crop/normalize helpers + ``ImageListIter``.
+
+Backend substitution: this environment has no OpenCV, so the decode /
+resize / border kernels run on PIL + numpy (the reference's were cv2
+calls through C glue — the plugin surface, semantics, and HWC/BGR
+conventions are preserved; interpolation and border flags accept the
+cv2 integer constants). Zero-copy is not a goal here: images are host
+arrays until they enter an executor.
+"""
+import os
+import random as _random
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array
+from .. import io as _io
+
+# cv2 constants accepted by the API (values match OpenCV's headers)
+IMREAD_GRAYSCALE = 0
+IMREAD_COLOR = 1
+INTER_NEAREST = 0
+INTER_LINEAR = 1
+INTER_CUBIC = 2
+BORDER_CONSTANT = 0
+BORDER_REPLICATE = 1
+BORDER_REFLECT = 2
+
+_PIL_RESAMPLE = {}
+
+
+def _resample(interpolation):
+    from PIL import Image
+
+    return {INTER_NEAREST: Image.NEAREST,
+            INTER_LINEAR: Image.BILINEAR,
+            INTER_CUBIC: Image.BICUBIC}.get(interpolation, Image.BILINEAR)
+
+
+def imdecode(str_img, flag=IMREAD_COLOR):
+    """Decode an encoded image buffer -> NDArray (H, W, C) uint8 in BGR
+    channel order (reference MXCVImdecode semantics)."""
+    import io as _bytesio
+
+    from PIL import Image
+
+    img = Image.open(_bytesio.BytesIO(str_img))
+    if flag == IMREAD_GRAYSCALE:
+        arr = np.asarray(img.convert("L"), dtype=np.uint8)[:, :, None]
+    else:
+        rgb = np.asarray(img.convert("RGB"), dtype=np.uint8)
+        arr = rgb[:, :, ::-1]                    # cv2 returns BGR
+    return array(np.ascontiguousarray(arr))
+
+
+def resize(src, size, interpolation=INTER_LINEAR):
+    """Resize (H, W, C) NDArray to size=(w, h) (reference MXCVResize)."""
+    from PIL import Image
+
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    squeeze = arr.shape[2] == 1
+    pim = Image.fromarray(arr.astype(np.uint8).squeeze() if squeeze
+                          else arr.astype(np.uint8))
+    pim = pim.resize((int(size[0]), int(size[1])), _resample(interpolation))
+    out = np.asarray(pim, dtype=np.uint8)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return array(out)
+
+
+def copyMakeBorder(src, top, bot, left, right,
+                   border_type=BORDER_CONSTANT, value=0):
+    """Pad an image border (reference MXCVcopyMakeBorder)."""
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    pad = ((top, bot), (left, right), (0, 0))
+    if border_type == BORDER_CONSTANT:
+        out = np.pad(arr, pad, constant_values=value)
+    elif border_type == BORDER_REPLICATE:
+        out = np.pad(arr, pad, mode="edge")
+    elif border_type == BORDER_REFLECT:
+        out = np.pad(arr, pad, mode="reflect")
+    else:
+        raise MXNetError("copyMakeBorder: unknown border_type %d"
+                         % border_type)
+    return array(out)
+
+
+def scale_down(src_size, size):
+    """Scale down crop size if it's bigger than the image size."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interpolation=INTER_CUBIC):
+    """Crop at a fixed location and optionally resize."""
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    out = arr[y0:y0 + h, x0:x0 + w, :]
+    if size is not None and (w, h) != tuple(size):
+        return resize(array(out), size, interpolation)
+    return array(out)
+
+
+def random_crop(src, size):
+    """Random crop; upsamples when src is smaller than size."""
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = _random.randint(0, w - new_w)
+    y0 = _random.randint(0, h - new_h)
+    return fixed_crop(src, x0, y0, new_w, new_h, size), \
+        (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, min_area=0.25, ratio=(3.0 / 4.0, 4.0 / 3.0)):
+    """Random area + aspect-ratio crop, reference fallback included."""
+    h, w = src.shape[0], src.shape[1]
+    area = w * h
+    for _ in range(10):
+        new_area = _random.uniform(min_area, 1.0) * area
+        new_ratio = _random.uniform(*ratio)
+        new_w = int(np.sqrt(new_area * new_ratio))
+        new_h = int(np.sqrt(new_area / new_ratio))
+        if _random.uniform(0.0, 1.0) < 0.5:
+            new_w, new_h = new_h, new_w
+        if new_w > w or new_h > h:
+            continue
+        x0 = _random.randint(0, w - new_w)
+        y0 = _random.randint(0, h - new_h)
+        return fixed_crop(src, x0, y0, new_w, new_h, size), \
+            (x0, y0, new_w, new_h)
+    return random_crop(src, size)
+
+
+def color_normalize(src, mean, std=None):
+    """(src - mean) / std in float32."""
+    arr = src.asnumpy().astype(np.float32)
+    arr -= np.asarray(mean, dtype=np.float32)
+    if std is not None:
+        arr /= np.asarray(std, dtype=np.float32)
+    return array(arr)
+
+
+class ImageListIter(_io.DataIter):
+    """Iterate (root + list-file) images through the plugin decode path
+    (reference plugin/opencv/opencv.py ImageListIter): batches are
+    (N, H, W, 3) float NDArrays with optional mean subtraction."""
+
+    def __init__(self, root, flist, batch_size, size, mean=None):
+        super().__init__()
+        self.root = root
+        with open(flist) as f:
+            self.list = [line.strip() for line in f if line.strip()]
+        self.cur = 0
+        self.batch_size = batch_size
+        self.size = size
+        self.mean = np.asarray(mean, dtype=np.float32) \
+            if mean is not None else None
+
+    @property
+    def provide_data(self):
+        return [_io.DataDesc("data", (self.batch_size, self.size[1],
+                                      self.size[0], 3))]
+
+    @property
+    def provide_label(self):
+        return [_io.DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self.cur = 0
+
+    def next(self):
+        if self.cur >= len(self.list):
+            raise StopIteration
+        batch = np.zeros((self.batch_size, self.size[1], self.size[0], 3),
+                         dtype=np.float32)
+        labels = np.zeros((self.batch_size,), dtype=np.float32)
+        n = 0
+        for i in range(self.cur, min(len(self.list),
+                                     self.cur + self.batch_size)):
+            entry = self.list[i].split("\t")
+            # accepted line formats: "name" | "label\tname" |
+            # im2rec's "idx\tlabel\tname"
+            name = entry[-1]
+            if len(entry) >= 3:
+                label = float(entry[1])
+            elif len(entry) == 2:
+                label = float(entry[0])
+            else:
+                label = 0.0
+            path = os.path.join(self.root, name)
+            with open(path, "rb") as f:
+                img = imdecode(f.read(), IMREAD_COLOR)
+            img = resize(img, self.size)
+            arr = img.asnumpy().astype(np.float32)
+            if self.mean is not None:
+                arr -= self.mean
+            batch[n] = arr
+            labels[n] = label
+            n += 1
+        pad = self.batch_size - n
+        self.cur += self.batch_size
+        return _io.DataBatch([array(batch)], [array(labels)], pad, None)

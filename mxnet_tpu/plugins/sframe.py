@@ -1,0 +1,137 @@
+"""SFrame plugin equivalent (reference ``plugin/sframe/iter_sframe.cc``):
+``MXSFrameDataIter`` / ``MXSFrameImageIter`` — data iterators over a
+columnar out-of-core table, selecting a data field and a label field
+with declared shapes.
+
+Backend substitution: GraphLab/Turi's ``gl_sframe`` does not exist in
+this environment; pandas (CSV/Parquet-backed DataFrame) plays the
+columnar-table role. The reference's parameter surface is preserved:
+``path_sframe`` (here: .csv/.parquet path or a DataFrame),
+``data_field`` / ``label_field``, ``data_shape`` / ``label_shape``,
+``batch_size``.
+"""
+import numpy as np
+
+from ..base import MXNetError, Registry
+from ..ndarray import array
+from .. import io as _io
+
+_REG = Registry.get_registry("data_iter")
+
+
+def _load_table(path_sframe):
+    import pandas as pd
+
+    if isinstance(path_sframe, pd.DataFrame):
+        return path_sframe
+    if str(path_sframe).endswith(".parquet"):
+        return pd.read_parquet(path_sframe)
+    return pd.read_csv(path_sframe)
+
+
+def _cell_to_array(cell, shape):
+    """A table cell is a scalar, a list, or a string of separated
+    numbers — normalize to float32 with the declared shape."""
+    if isinstance(cell, str):
+        vals = np.asarray([float(v) for v in cell.split()], np.float32) \
+            if " " in cell else np.asarray([float(cell)], np.float32)
+    elif np.isscalar(cell):
+        vals = np.asarray([cell], dtype=np.float32)
+    else:
+        vals = np.asarray(cell, dtype=np.float32).ravel()
+    if int(np.prod(shape)) != vals.size:
+        raise MXNetError(
+            "SFrameIter: cell size %d does not match declared shape %s"
+            % (vals.size, (shape,)))
+    return vals.reshape(shape)
+
+
+@_REG.register("MXSFrameDataIter")
+class MXSFrameDataIter(_io.DataIter):
+    """Dense-row iterator (reference SFrameDataIter): each row's
+    data_field flattens into data_shape."""
+
+    def __init__(self, path_sframe, data_field="data",
+                 label_field="label", data_shape=(1,), label_shape=(1,),
+                 batch_size=32, **kwargs):
+        super().__init__()
+        self._df = _load_table(path_sframe)
+        for f in (data_field, label_field):
+            if f not in self._df.columns:
+                raise MXNetError("SFrameIter: field '%s' not in table "
+                                 "(columns: %s)"
+                                 % (f, list(self._df.columns)))
+        self.data_field = data_field
+        self.label_field = label_field
+        self.data_shape = tuple(int(x) for x in data_shape)
+        self.label_shape = tuple(int(x) for x in label_shape)
+        self.batch_size = batch_size
+        self.cursor = -batch_size
+        self.num_data = len(self._df)
+
+    @property
+    def provide_data(self):
+        return [_io.DataDesc("data",
+                             (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_shape == (1,) \
+            else (self.batch_size,) + self.label_shape
+        return [_io.DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def _rows(self):
+        idx = [(self.cursor + i) % self.num_data
+               for i in range(self.batch_size)]
+        return self._df.iloc[idx]
+
+    def getdata(self):
+        rows = self._rows()
+        data = np.stack([_cell_to_array(c, self.data_shape)
+                         for c in rows[self.data_field]])
+        return [array(data)]
+
+    def getlabel(self):
+        rows = self._rows()
+        lab = np.stack([_cell_to_array(c, self.label_shape)
+                        for c in rows[self.label_field]])
+        if self.label_shape == (1,):
+            lab = lab.ravel()
+        return [array(lab.astype(np.float32))]
+
+    def getpad(self):
+        if self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+@_REG.register("MXSFrameImageIter")
+class MXSFrameImageIter(MXSFrameDataIter):
+    """Image-column iterator (reference SFrameImageIter): the data
+    field holds encoded image bytes; decode through the opencv-plugin
+    path, data_shape is (C, H, W)."""
+
+    def getdata(self):
+        from . import opencv as cv
+
+        c, h, w = self.data_shape
+        rows = self._rows()
+        out = np.zeros((self.batch_size, c, h, w), dtype=np.float32)
+        for n, cell in enumerate(rows[self.data_field]):
+            if isinstance(cell, (bytes, bytearray)):
+                raw = cell
+            else:                            # path column also accepted
+                with open(cell, "rb") as f:
+                    raw = f.read()
+            img = cv.imdecode(raw, cv.IMREAD_COLOR if c == 3
+                              else cv.IMREAD_GRAYSCALE)
+            img = cv.resize(img, (w, h))
+            out[n] = img.asnumpy().astype(np.float32).transpose(2, 0, 1)
+        return [array(out)]

@@ -402,8 +402,10 @@ class Symbol:
         if not partial:
             if any(s is None for s in arg_shapes):
                 missing = [n for n, s in zip(arg_names, arg_shapes) if s is None]
-                raise MXNetError("infer_shape incomplete; unknown args: %s"
-                                 % missing)
+                raise MXNetError(
+                    "infer_shape incomplete; unknown args: %s%s"
+                    % (missing, " (last node error: %s)" % last_err
+                       if last_err is not None else ""))
             if any(s is None for s in out_shapes):
                 raise MXNetError(
                     "infer_shape could not infer outputs%s"

@@ -25,6 +25,9 @@ def _synthetic(n=600, dim=10, classes=3, seed=0):
 
 
 def test_module_fit_convergence():
+    # NDArrayIter(shuffle) and the initializer draw from the GLOBAL
+    # numpy RNG; pin it so suite ordering can't change the init draw
+    np.random.seed(7)
     X, y = _synthetic()
     data = mx.io.NDArrayIter(X, y, batch_size=50, shuffle=True)
     mod = Module(_mlp_sym(), context=mx.cpu())

@@ -1,0 +1,126 @@
+"""opencv + sframe plugin equivalents (reference plugin/opencv/,
+plugin/sframe/): same surfaces over PIL/pandas backends."""
+import os
+
+import numpy as np
+import pytest
+
+pytest.importorskip("PIL")
+
+import mxnet_tpu as mx
+from mxnet_tpu.plugins import opencv as cv
+from mxnet_tpu.plugins.sframe import MXSFrameDataIter, MXSFrameImageIter
+
+
+def _png_bytes(arr):
+    import io as bio
+
+    from PIL import Image
+
+    buf = bio.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def test_imdecode_bgr_and_grayscale():
+    rgb = np.zeros((5, 7, 3), dtype=np.uint8)
+    rgb[..., 0] = 200          # red image
+    raw = _png_bytes(rgb)
+    img = cv.imdecode(raw, cv.IMREAD_COLOR)
+    assert img.shape == (5, 7, 3)
+    out = img.asnumpy()
+    assert out[0, 0, 2] == 200 and out[0, 0, 0] == 0   # BGR order
+    gray = cv.imdecode(raw, cv.IMREAD_GRAYSCALE)
+    assert gray.shape == (5, 7, 1)
+
+
+def test_resize_border_crop_normalize():
+    img = mx.nd.array(np.arange(48, dtype=np.uint8).reshape(4, 4, 3))
+    big = cv.resize(img, (8, 6))
+    assert big.shape == (6, 8, 3)
+    padded = cv.copyMakeBorder(img, 1, 1, 2, 2, cv.BORDER_CONSTANT, 9)
+    assert padded.shape == (6, 8, 3)
+    assert padded.asnumpy()[0, 0, 0] == 9
+    rep = cv.copyMakeBorder(img, 1, 0, 0, 0, cv.BORDER_REPLICATE)
+    assert (rep.asnumpy()[0] == img.asnumpy()[0]).all()
+
+    crop = cv.fixed_crop(big, 1, 2, 4, 3)
+    assert crop.shape == (3, 4, 3)
+    crop2, roi = cv.random_crop(big, (4, 4))
+    assert crop2.shape == (4, 4, 3) and len(roi) == 4
+    crop3, _ = cv.random_size_crop(big, (4, 4))
+    assert crop3.shape == (4, 4, 3)
+
+    norm = cv.color_normalize(img, mean=(1.0, 2.0, 3.0), std=(2, 2, 2))
+    np.testing.assert_allclose(
+        norm.asnumpy()[0, 0], (np.array([0, 1, 2]) - [1, 2, 3]) / 2.0)
+
+
+def test_image_list_iter(tmp_path):
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    names = []
+    for i in range(5):
+        name = "img%d.png" % i
+        Image.fromarray((rng.rand(10, 12, 3) * 255).astype(np.uint8)) \
+            .save(os.path.join(tmp_path, name))
+        names.append("%d\t%d\t%s" % (i, i % 2, name))
+    flist = tmp_path / "list.txt"
+    flist.write_text("\n".join(names) + "\n")
+
+    it = cv.ImageListIter(str(tmp_path) + os.sep, str(flist),
+                          batch_size=2, size=(8, 6))
+    batches = list(iter(it))
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (2, 6, 8, 3)
+    assert batches[-1].pad == 1
+    it.reset()
+    assert next(iter(it)).label[0].asnumpy().tolist() == [0.0, 1.0]
+
+
+def test_sframe_data_iter_roundtrip(tmp_path):
+    import pandas as pd
+
+    rng = np.random.RandomState(1)
+    rows = [{"data": " ".join("%g" % v for v in rng.rand(6)),
+             "label": i % 3} for i in range(10)]
+    path = tmp_path / "table.csv"
+    pd.DataFrame(rows).to_csv(path, index=False)
+
+    it = MXSFrameDataIter(str(path), data_field="data",
+                          label_field="label", data_shape=(2, 3),
+                          label_shape=(1,), batch_size=4)
+    b = next(iter(it))
+    assert b.data[0].shape == (4, 2, 3)
+    assert b.label[0].shape == (4,)
+    # registry creation path (reference MXNET_REGISTER_IO_ITER)
+    it2 = mx.io.MXDataIter("MXSFrameDataIter", path_sframe=str(path),
+                           data_field="data", label_field="label",
+                           data_shape=(6,), batch_size=5)
+    assert next(iter(it2)).data[0].shape == (5, 6)
+
+
+def test_sframe_image_iter(tmp_path):
+    import pandas as pd
+
+    rng = np.random.RandomState(2)
+    paths = []
+    from PIL import Image
+
+    for i in range(6):
+        p = str(tmp_path / ("im%d.png" % i))
+        Image.fromarray((rng.rand(9, 9, 3) * 255).astype(np.uint8)).save(p)
+        paths.append(p)
+    df = pd.DataFrame({"image": paths, "label": [i % 2 for i in range(6)]})
+    it = MXSFrameImageIter(df, data_field="image", label_field="label",
+                           data_shape=(3, 8, 8), batch_size=3)
+    b = next(iter(it))
+    assert b.data[0].shape == (3, 3, 8, 8)
+
+
+def test_sframe_field_error():
+    import pandas as pd
+
+    with pytest.raises(mx.base.MXNetError):
+        MXSFrameDataIter(pd.DataFrame({"a": [1]}), data_field="nope")

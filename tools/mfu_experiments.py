@@ -1,0 +1,190 @@
+"""Measured MFU experiments for the ResNet-50 training step (round-3
+perf item: experiments, not estimates).
+
+Variants, each timed with the same protocol as bench.py (donated
+buffers, two warmup steps, block_until_ready fence):
+
+  baseline  NCHW tower (what bench.py measures)
+  nhwc      channels-last tower (models.get_resnet50(layout="NHWC")):
+            candidates channels onto the TPU lane axis
+  s2d       space-to-depth stem: host-free 2x2 depth-to-space reshape of
+            the input to (N, 12, H/2, W/2) + a 5x5/1 stem conv replacing
+            7x7/2 — structurally the MLPerf trick (measures the
+            throughput effect; not weight-exact with the 7x7 stem)
+  flags:... any variant re-run under an XLA_FLAGS setting (process
+            re-exec; flags only apply at backend init)
+
+Usage:
+  python tools/mfu_experiments.py                  # all variants
+  python tools/mfu_experiments.py --variant nhwc
+  python tools/mfu_experiments.py --sweep-flags \
+      "--xla_tpu_enable_latency_hiding_scheduler=true" ...
+
+Prints one JSON line per measurement:
+  {"experiment": "nhwc", "imgs_per_sec": N, "step_time_ms": N,
+   "mfu_pct": N, "chip": "...", "xla_flags": "..."}
+
+Each line is self-contained evidence for docs/performance.md.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RESNET50_TRAIN_GFLOPS_PER_IMG = 4.089 * 3
+
+
+def _chip_peak(kind):
+    from bench import _chip_peak as peak
+
+    return peak(kind)
+
+
+def build_variant(variant, batch, image, num_classes, small):
+    from mxnet_tpu import models
+
+    layout = "NHWC" if variant == "nhwc" else "NCHW"
+    if variant == "s2d":
+        net = models.get_resnet(
+            [3, 4, 6, 3], [64, 256, 512, 1024, 2048],
+            num_classes=num_classes, small_input=small, stem_s2d=True)
+        data_shape = (batch, 12, image // 2, image // 2)
+    else:
+        net = models.get_resnet50(num_classes=num_classes,
+                                  small_input=small, layout=layout)
+        if layout == "NHWC":
+            data_shape = (batch, image, image, 3)
+        else:
+            data_shape = (batch, 3, image, image)
+    return net, data_shape
+
+
+def measure(variant, batch, image, num_classes, steps, dtype_name):
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.parallel import build_sgd_train_step
+
+    small = image <= 64
+    net, data_shape = build_variant(variant, batch, image, num_classes,
+                                    small)
+    arg_shapes, _, aux_shapes = net.infer_shape(data=data_shape)
+    rng = np.random.RandomState(0)
+    params, data = {}, {}
+    for name, shape in zip(net.list_arguments(), arg_shapes):
+        if name == "data":
+            data[name] = jnp.asarray(rng.rand(*shape), jnp.float32)
+        elif name == "softmax_label":
+            data[name] = jnp.asarray(
+                rng.randint(0, num_classes, shape), jnp.float32)
+        elif name.endswith("gamma"):
+            params[name] = jnp.ones(shape, jnp.float32)
+        else:
+            params[name] = jnp.asarray(rng.randn(*shape) * 0.05,
+                                       jnp.float32)
+    aux = [jnp.ones(s, jnp.float32) if "var" in n
+           else jnp.zeros(s, jnp.float32)
+           for n, s in zip(net.list_auxiliary_states(), aux_shapes)]
+
+    compute_dtype = None if dtype_name == "float32" \
+        else getattr(jnp, dtype_name)
+    step, _ = build_sgd_train_step(net, ["data"], ["softmax_label"],
+                                   lr=0.01, compute_dtype=compute_dtype)
+    jit_step = jax.jit(step, donate_argnums=(0, 2))
+    key = jax.random.PRNGKey(0)
+    outputs, params, aux = jit_step(params, data, aux, key)
+    outputs, params, aux = jit_step(params, data, aux,
+                                    jax.random.fold_in(key, 999))
+    jax.block_until_ready(params)
+    tic = time.time()
+    for i in range(steps):
+        outputs, params, aux = jit_step(params, data, aux,
+                                        jax.random.fold_in(key, i))
+    jax.block_until_ready(params)
+    elapsed = time.time() - tic
+
+    dev = jax.devices()[0]
+    imgs = batch * steps / elapsed
+    result = {
+        "experiment": variant,
+        "imgs_per_sec": round(imgs, 1),
+        "step_time_ms": round(elapsed / steps * 1000, 2),
+        "batch": batch,
+        "image": image,
+        "compute_dtype": dtype_name,
+        "chip": getattr(dev, "device_kind", dev.platform),
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+    }
+    peak = _chip_peak(getattr(dev, "device_kind", "")) \
+        if dev.platform != "cpu" else None
+    if peak and image >= 224:
+        tflops = imgs * RESNET50_TRAIN_GFLOPS_PER_IMG / 1e3
+        result["mfu_pct"] = round(100.0 * tflops / peak, 1)
+    return result
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--variant", default="all",
+                   choices=["all", "baseline", "nhwc", "s2d"])
+    p.add_argument("--batch", type=int, default=None)
+    p.add_argument("--image", type=int, default=None)
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--dtype", default=None)
+    p.add_argument("--sweep-flags", nargs="*", default=None,
+                   help="XLA_FLAGS values; each re-runs the chosen "
+                        "variant in a fresh process")
+    p.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args.sweep_flags is not None and not args._child:
+        variant = args.variant if args.variant != "all" else "baseline"
+        for flags in [""] + list(args.sweep_flags):
+            env = dict(os.environ)
+            if flags:
+                env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " "
+                                    + flags).strip()
+            cmd = [sys.executable, os.path.abspath(__file__), "--_child",
+                   "--variant", variant]
+            for k in ("batch", "image", "steps", "dtype"):
+                v = getattr(args, k)
+                if v is not None:
+                    cmd += ["--%s" % k, str(v)]
+            r = subprocess.run(cmd, env=env)
+            if r.returncode != 0:
+                print(json.dumps({"experiment": variant,
+                                  "xla_flags": flags,
+                                  "error": "child exited %d"
+                                           % r.returncode}))
+        return
+
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    on_accel = jax.devices()[0].platform != "cpu"
+    batch = args.batch or (256 if on_accel else 4)
+    image = args.image or (224 if on_accel else 32)
+    steps = args.steps or (20 if on_accel else 2)
+    dtype = args.dtype or ("bfloat16" if on_accel else "float32")
+    num_classes = 1000 if on_accel else 8
+
+    variants = [args.variant] if args.variant != "all" \
+        else ["baseline", "nhwc", "s2d"]
+    results = []
+    for v in variants:
+        r = measure(v, batch, image, num_classes, steps, dtype)
+        print(json.dumps(r))
+        results.append(r)
+    return results
+
+
+if __name__ == "__main__":
+    main()
