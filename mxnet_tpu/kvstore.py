@@ -12,11 +12,14 @@ TPU-native re-design of the reference's KVStore tier
   GPU-P2P reduce (``comm.h:186-346``) and the ps-lite parameter-server
   tier: with ``pjit`` data parallelism the all-reduce happens *inside* the
   training step, and KVStore keeps the push/pull API for explicit use.
-* ``dist_sync`` / ``dist_async`` — multi-host via ``jax.distributed``
-  process groups. On a single host they degrade to ``local`` with
-  rank 0 / size 1 (the reference's ps-lite async mode has no TPU
-  analogue; ``dist_async`` is accepted and treated as ``dist_sync`` —
-  documented divergence).
+* ``dist_sync`` — multi-host via ``jax.distributed`` process groups.
+  On a single host it degrades to ``local`` with rank 0 / size 1.
+* ``dist_async`` — real asynchronous parameter server
+  (``KVStoreDistAsync`` over ``parallel/ps.py``): per-push server-side
+  optimizer updates with no cross-worker aggregation, the reference's
+  async architecture (``kvstore_dist_server.h:199-207``) brought back
+  as a host-side control plane (async semantics have no collective
+  analogue).
 """
 from __future__ import annotations
 
@@ -224,9 +227,8 @@ class KVStoreDist(KVStore):
     identical reduced gradient, so weights stay consistent without a
     server (the reference's server-side optimizer becomes a replicated
     worker-side update). init broadcasts rank-0 values (reference
-    ``kvstore_dist.h:58-76``). ``dist_async`` is accepted but behaves
-    synchronously — documented divergence (no TPU analogue of ps-lite
-    async push)."""
+    ``kvstore_dist.h:58-76``). The async tier is the separate
+    ``KVStoreDistAsync`` below."""
 
     def __init__(self, kv_type: str = "dist_sync"):
         super().__init__(kv_type)
@@ -260,6 +262,101 @@ class KVStoreDist(KVStore):
         self._dist.barrier()
 
 
+class KVStoreDistAsync(KVStore):
+    """Real asynchronous parameter server (reference
+    ``kvstore_dist_server.h:199-207`` async mode): the server applies
+    each worker's push IMMEDIATELY with the server-side optimizer — no
+    aggregation, no per-step cross-worker barrier — and ``pull``
+    returns whatever the weights are right now. Workers therefore run
+    at their own pace on possibly-stale weights (Hogwild-style), the
+    defining trade of the reference's ``dist_async``.
+
+    The control plane is host-side TCP (``parallel/ps.py``), NOT XLA
+    collectives: async semantics have no collective analogue, which is
+    exactly why round-2 left this tier synchronous. Rank 0 hosts the
+    server thread; every rank is a client. Rank/size come from the
+    launcher env, so no jax.distributed coordination is needed at all."""
+
+    def __init__(self, kv_type: str = "dist_async"):
+        super().__init__(kv_type)
+        import os
+
+        from .parallel import ps
+
+        self._rank = int(os.environ.get("MXTPU_WORKER_RANK", "0") or 0)
+        self._size = int(os.environ.get("MXTPU_NUM_WORKERS", "1") or 1)
+        host, port = ps.ps_address()
+        self._server = None
+        if self._rank == 0:
+            self._server = ps.ParameterServer(host, port, self._size)
+        self._client = ps.PSClient(host, port)
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def num_workers(self) -> int:
+        return self._size
+
+    def init(self, key, value):
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            self._client.call("init", self._rank, k,
+                              vlist[0].asnumpy())
+        # all ranks wait until the authoritative init landed, then sync
+        # THE CALLER'S arrays from the server so every rank starts from
+        # rank-0's values (reference rank-0 init + barrier,
+        # kvstore_dist.h:58-76)
+        self.barrier()
+        from .ndarray import array as nd_array
+
+        for k, vlist in zip(keys, vals):
+            synced = nd_array(self._client.call("pull", k))
+            for v in vlist:
+                synced.copyto(v)
+
+    def set_optimizer(self, optimizer):
+        blob = pickle.dumps(optimizer)
+        self._optimizer = optimizer
+        # reference _send_command_to_servers: the PICKLED optimizer
+        # runs server-side, once per push
+        self._client.call("set_optimizer", blob)
+
+    def push(self, key, value, priority: int = 0):
+        keys, _ = _key_list(key)
+        vals = _val_list(value, len(keys))
+        for k, vlist in zip(keys, vals):
+            merged = self._reduce(vlist)     # local-device reduce only
+            self._client.call("push", k, merged.asnumpy())
+
+    def pull(self, key, out=None, priority: int = 0):
+        if out is None:
+            raise MXNetError("pull requires out")
+        keys, _ = _key_list(key)
+        outs = _val_list(out, len(keys))
+        from .ndarray import array as nd_array
+
+        for k, olist in zip(keys, outs):
+            cur = self._client.call("pull", k)
+            src = nd_array(cur)
+            for o in olist:
+                src.copyto(o)
+
+    def barrier(self):
+        self._client.call("barrier")
+
+    def close(self):
+        if self._server is not None:
+            try:
+                self._client.call("stop")
+            except (MXNetError, OSError, ConnectionError):
+                pass   # server already gone; still close our side
+            self._server.close()
+        self._client.close()
+
+
 class TPUSyncKVStore(KVStore):
     """``tpu_sync`` / ``device``: reduce across device-resident shards with
     a single fused computation; the transfer rides ICI on real hardware."""
@@ -283,6 +380,8 @@ def create(name: str = "local") -> KVStore:
     lname = name.lower()
     if "tpu" in lname or "device" in lname:
         return TPUSyncKVStore(lname)
+    if "async" in lname:
+        return KVStoreDistAsync(lname)
     if "dist" in lname:
         return KVStoreDist(lname)
     if lname in ("local", "local_update_cpu", "local_allreduce_cpu"):

@@ -1,0 +1,229 @@
+"""Asynchronous parameter server (reference ``dist_async``:
+``kvstore_dist_server.h:199-207`` — the server applies each worker's
+push IMMEDIATELY, no cross-worker aggregation or barrier; workers pull
+whatever the current weights are).
+
+The sync tier (``dist_sync``) is collective-based — the TPU-native
+redesign of the reference's aggregating server. Async semantics cannot
+ride collectives (there is no "whenever you feel like it" all-reduce),
+so this module brings back the reference's actual architecture for the
+async tier only: a host-side key-value server owning the weights and
+running the (pickled) optimizer per push, exactly like the reference's
+server-side Python updater (``kvstore.py:231-258`` controller +
+``Executor`` queue).
+
+Transport: length-prefixed pickles over TCP on
+``MXTPU_PS_PORT`` (default: coordinator port + 1). Rank 0 hosts the
+server thread; every worker (rank 0 included) is a client. This is the
+host-side control plane — gradients here are host numpy arrays, the
+same place the reference's ps-lite ZPush buffers lived.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from ..base import MXNetError
+
+_LEN = struct.Struct("!Q")
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def ps_address():
+    """host:port of the parameter server, derived from the coordinator
+    rendezvous (reference: DMLC_PS_ROOT_URI/PORT set by the tracker)."""
+    coord = os.environ.get("MXTPU_COORDINATOR", "127.0.0.1:12421")
+    host, _, port = coord.partition(":")
+    ps_port = int(os.environ.get("MXTPU_PS_PORT", int(port or 12421) + 1))
+    return host or "127.0.0.1", ps_port
+
+
+class ParameterServer:
+    """The server role. Weights live here; pushes update them in place
+    under a lock (per-push optimizer update = the async mode's defining
+    behavior); pulls return the current values."""
+
+    def __init__(self, host, port, num_workers):
+        self.num_workers = num_workers
+        self._store = {}
+        self._opt = None
+        self._opt_states = {}
+        self._lock = threading.Lock()
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._barrier_cv = threading.Condition()
+        self._stop = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(max(8, 2 * num_workers))
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _apply_push(self, key, grad):
+        from ..ndarray import array as nd_array
+
+        with self._lock:
+            if key not in self._store:
+                raise MXNetError("push to uninitialized key %r" % (key,))
+            if self._opt is None:
+                # reference DataHandle without an updater: assign
+                self._store[key] = grad
+                return
+            weight = nd_array(self._store[key])
+            gnd = nd_array(grad)
+            if key not in self._opt_states:
+                self._opt_states[key] = self._opt.create_state(key, weight)
+            self._opt.update(key, weight, gnd, self._opt_states[key])
+            self._store[key] = weight.asnumpy()
+
+    def _serve(self, conn):
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = _recv_msg(conn)
+                except (ConnectionError, OSError):
+                    return
+                op = msg[0]
+                if op == "init":
+                    _, rank, key, val = msg
+                    with self._lock:
+                        # rank 0 is authoritative (reference: rank-0
+                        # push + barrier seeds the server)
+                        if rank == 0 or key not in self._store:
+                            self._store[key] = np.asarray(val)
+                    _send_msg(conn, ("ok",))
+                elif op == "push":
+                    _, key, grad = msg
+                    # per-op errors go back as replies — an exception
+                    # must not kill this serve thread (the client's
+                    # connection would die with it)
+                    try:
+                        self._apply_push(key, np.asarray(grad))
+                    except MXNetError as e:
+                        _send_msg(conn, ("err", str(e)))
+                    else:
+                        _send_msg(conn, ("ok",))
+                elif op == "pull":
+                    _, key = msg
+                    with self._lock:
+                        val = self._store.get(key)
+                        if val is not None:
+                            val = val.copy()
+                    # serialize + send OUTSIDE the lock: a stalled
+                    # client mid-sendall must not block other workers'
+                    # pushes on the store lock
+                    if val is None:
+                        _send_msg(conn, ("err", "key %r not initialized"
+                                         % (key,)))
+                    else:
+                        _send_msg(conn, ("ok", val))
+                elif op == "set_optimizer":
+                    _, blob = msg
+                    with self._lock:
+                        self._opt = pickle.loads(blob)
+                        self._opt_states = {}
+                    _send_msg(conn, ("ok",))
+                elif op == "barrier":
+                    with self._barrier_cv:
+                        gen = self._barrier_gen
+                        self._barrier_count += 1
+                        if self._barrier_count >= self.num_workers:
+                            self._barrier_count = 0
+                            self._barrier_gen += 1
+                            self._barrier_cv.notify_all()
+                        else:
+                            while self._barrier_gen == gen \
+                                    and not self._stop.is_set():
+                                self._barrier_cv.wait(timeout=0.2)
+                    _send_msg(conn, ("ok",))
+                elif op == "stop":
+                    _send_msg(conn, ("ok",))
+                    self._stop.set()
+                    with self._barrier_cv:
+                        self._barrier_cv.notify_all()
+                    return
+                else:
+                    _send_msg(conn, ("err", "unknown op %r" % (op,)))
+        finally:
+            conn.close()
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class PSClient:
+    """One connection to the server; blocking request/response."""
+
+    def __init__(self, host, port, timeout_s=60.0):
+        deadline = time.time() + timeout_s
+        last = None
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=600)
+                break
+            except OSError as e:       # server may not be up yet
+                last = e
+                if time.time() > deadline:
+                    raise MXNetError(
+                        "cannot reach parameter server %s:%d (%s)"
+                        % (host, port, last))
+                time.sleep(0.1)
+        self._lock = threading.Lock()
+
+    def call(self, *msg):
+        with self._lock:
+            _send_msg(self._sock, msg)
+            resp = _recv_msg(self._sock)
+        if resp[0] != "ok":
+            raise MXNetError("parameter server error: %s" % (resp[1],))
+        return resp[1] if len(resp) > 1 else None
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -27,8 +27,7 @@ INTER_CUBIC = 2
 BORDER_CONSTANT = 0
 BORDER_REPLICATE = 1
 BORDER_REFLECT = 2
-
-_PIL_RESAMPLE = {}
+BORDER_REFLECT_101 = 4
 
 
 def _resample(interpolation):
@@ -80,6 +79,10 @@ def copyMakeBorder(src, top, bot, left, right,
     elif border_type == BORDER_REPLICATE:
         out = np.pad(arr, pad, mode="edge")
     elif border_type == BORDER_REFLECT:
+        # cv2's BORDER_REFLECT duplicates the edge pixel -> np
+        # "symmetric"; np "reflect" is cv2's BORDER_REFLECT_101
+        out = np.pad(arr, pad, mode="symmetric")
+    elif border_type == BORDER_REFLECT_101:
         out = np.pad(arr, pad, mode="reflect")
     else:
         raise MXNetError("copyMakeBorder: unknown border_type %d"

@@ -146,24 +146,26 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     if args.sweep_flags is not None and not args._child:
-        variant = args.variant if args.variant != "all" else "baseline"
+        sweep_variants = [args.variant] if args.variant != "all" \
+            else ["baseline", "nhwc", "s2d"]
         for flags in [""] + list(args.sweep_flags):
             env = dict(os.environ)
             if flags:
                 env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " "
                                     + flags).strip()
-            cmd = [sys.executable, os.path.abspath(__file__), "--_child",
-                   "--variant", variant]
-            for k in ("batch", "image", "steps", "dtype"):
-                v = getattr(args, k)
-                if v is not None:
-                    cmd += ["--%s" % k, str(v)]
-            r = subprocess.run(cmd, env=env)
-            if r.returncode != 0:
-                print(json.dumps({"experiment": variant,
-                                  "xla_flags": flags,
-                                  "error": "child exited %d"
-                                           % r.returncode}))
+            for variant in sweep_variants:
+                cmd = [sys.executable, os.path.abspath(__file__),
+                       "--_child", "--variant", variant]
+                for k in ("batch", "image", "steps", "dtype"):
+                    v = getattr(args, k)
+                    if v is not None:
+                        cmd += ["--%s" % k, str(v)]
+                r = subprocess.run(cmd, env=env)
+                if r.returncode != 0:
+                    print(json.dumps({"experiment": variant,
+                                      "xla_flags": flags,
+                                      "error": "child exited %d"
+                                               % r.returncode}))
         return
 
     import jax
