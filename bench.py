@@ -180,7 +180,7 @@ def main():
 
 
 def _bench_recordio(jit_step, params, aux, key, batch, image, num_classes,
-                    steps, rec_env):
+                    steps, rec_env, layout="NCHW"):
     """Opt-in end-to-end tier (MXNET_TPU_BENCH_INPUT=1 or =path.rec):
     the same train step fed from ImageRecordIter — recordio decode +
     augment + H2D included — so the pipeline-vs-compute gap is measured,
@@ -222,9 +222,14 @@ def _bench_recordio(jit_step, params, aux, key, batch, image, num_classes,
         n += batch
     input_rate = n / (time.time() - tic)
 
-    # end-to-end: iterator -> device -> train step
+    # end-to-end: iterator -> device -> train step (batches arrive NCHW
+    # from the iterator; transpose when the winning step is NHWC)
+    def _to_layout(arr):
+        import jax.numpy as jnp
+        return jnp.transpose(arr, (0, 2, 3, 1)) if layout == "NHWC" else arr
+
     b = next(gen)
-    data = {"data": b.data[0]._data.astype(np.float32),
+    data = {"data": _to_layout(b.data[0]._data.astype(np.float32)),
             "softmax_label": b.label[0]._data.astype(np.float32)}
     _, params, aux = jit_step(params, data, aux, key)
     jax.block_until_ready(params)
@@ -232,7 +237,7 @@ def _bench_recordio(jit_step, params, aux, key, batch, image, num_classes,
     tic = time.time()
     for i in range(e2e_steps):
         b = next(gen)
-        data = {"data": b.data[0]._data.astype(np.float32),
+        data = {"data": _to_layout(b.data[0]._data.astype(np.float32)),
                 "softmax_label": b.label[0]._data.astype(np.float32)}
         _, params, aux = jit_step(params, data, aux,
                                   jax.random.fold_in(key, 1000 + i))
@@ -339,6 +344,91 @@ def _bench():
         jax.profiler.stop_trace()
 
     imgs_per_sec = batch * steps / elapsed
+    layout = "NCHW"
+    nhwc_rate = None
+    # MXNET_TPU_BENCH_FORCE_EXPERIMENTS=1 exercises the accelerator-only
+    # experiment paths on CPU so CI covers the code that will run the
+    # moment a chip answers
+    run_experiments = on_accel or bool(
+        os.environ.get("MXNET_TPU_BENCH_FORCE_EXPERIMENTS"))
+    if run_experiments:
+        # round-3 measured experiment, run opportunistically whenever a
+        # real chip answers: time the SAME step with the channels-last
+        # tower (weights are OIHW in both layouts so params carry over)
+        # and let the faster layout own the headline number.
+        try:
+            net2 = models.get_resnet50(num_classes=num_classes,
+                                       small_input=not on_accel,
+                                       layout="NHWC")
+            step2, _ = build_sgd_train_step(
+                net2, ["data"], ["softmax_label"], lr=0.01,
+                compute_dtype=compute_dtype)
+            jit2 = jax.jit(step2, donate_argnums=(0, 2))
+            data2 = dict(data)
+            data2["data"] = jnp.transpose(data["data"], (0, 2, 3, 1))
+            # donate COPIES: the first jit2 call must not consume the
+            # baseline's params/aux buffers — the losing-NHWC path (and
+            # the recordio tier) keeps using them
+            p2 = {k: jnp.copy(v) for k, v in params.items()}
+            a2 = [jnp.copy(v) for v in aux]
+            _, p2, a2 = jit2(p2, data2, a2, key)
+            _, p2, a2 = jit2(p2, data2, a2,
+                             jax.random.fold_in(key, steps + 2))
+            _force(p2)
+            tic2 = time.time()
+            for i in range(steps):
+                _, p2, a2 = jit2(p2, data2, a2,
+                                 jax.random.fold_in(key, i))
+            _force(p2)
+            nhwc_rate = batch * steps / (time.time() - tic2)
+            if nhwc_rate > imgs_per_sec:
+                layout = "NHWC"
+                imgs_per_sec = nhwc_rate
+                elapsed = batch * steps / nhwc_rate
+                params, aux, data = p2, a2, data2
+                jit_step = jit2
+        except Exception as e:  # the experiment must never cost the record
+            sys.stderr.write("bench.py: NHWC variant failed: %s\n" % e)
+
+        # trace artifact for the winner (round-3 evidence item): a
+        # committed-on-round-end summary backs the MFU claims
+        try:
+            import tempfile
+
+            import shutil
+
+            tdir = tempfile.mkdtemp(prefix="bench_trace_")
+            jax.profiler.start_trace(tdir)
+            for i in range(5):
+                outputs, params, aux = jit_step(
+                    params, data, aux, jax.random.fold_in(key, 500 + i))
+            _force(params)
+            jax.profiler.stop_trace()
+            here = os.path.dirname(os.path.abspath(__file__))
+            sys.path.insert(0, os.path.join(here, "tools"))
+            from trace_top import aggregate, find_trace_file, load_events
+
+            rows, total_ms = aggregate(
+                load_events(find_trace_file(tdir)), steps=5, by_op=False)
+            with open(os.path.join(here, ".bench_trace_summary.json"),
+                      "w") as f:
+                json.dump({
+                    "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                 time.gmtime()),
+                    "chip": getattr(devices[0], "device_kind",
+                                    devices[0].platform),
+                    "layout": layout,
+                    "batch": batch,
+                    "device_ms_per_step": round(total_ms, 2),
+                    "top_ops": [
+                        {"ms_per_step": round(ms, 2),
+                         "share_pct": round(share, 1),
+                         "count": n, "op": name}
+                        for ms, share, n, name in rows[:15]],
+                }, f, indent=1)
+            shutil.rmtree(tdir, ignore_errors=True)
+        except Exception as e:
+            sys.stderr.write("bench.py: trace summary failed: %s\n" % e)
     step_ms = elapsed / steps * 1000.0
     tflops_model = imgs_per_sec * RESNET50_TRAIN_GFLOPS_PER_IMG / 1e3 \
         if image == 224 else 0.0
@@ -352,11 +442,14 @@ def _bench():
         "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
         "compute_dtype": dtype_name,
         "batch": batch,
+        "layout": layout,
         "step_time_ms": round(step_ms, 2),
         "tflops_model": round(tflops_model, 1),
         "tflops_xla": round(tflops_xla, 1),
         "chip": getattr(devices[0], "device_kind", devices[0].platform),
     }
+    if nhwc_rate is not None:
+        result["imgs_per_sec_nhwc"] = round(nhwc_rate, 1)
     if peak and tflops_model:
         result["mfu_pct"] = round(100.0 * tflops_model / peak, 1)
     if peak and tflops_xla:
@@ -365,7 +458,8 @@ def _bench():
     rec_env = os.environ.get("MXNET_TPU_BENCH_INPUT")
     if rec_env:
         result.update(_bench_recordio(jit_step, params, aux, key, batch,
-                                      image, num_classes, steps, rec_env))
+                                      image, num_classes, steps, rec_env,
+                                      layout=layout))
 
     # .bench_cache.json is deliberately git-TRACKED: the end-of-round
     # snapshot then preserves the last real on-chip measurement even
