@@ -141,10 +141,18 @@ class FeedForward:
         return self
 
     # -- prediction --------------------------------------------------------
+    def _bindable_labels(self, data_iter):
+        """_init_iter synthesizes a dummy label; drop label descs the
+        symbol has no argument for (predicting through an INTERNALS
+        symbol, the notebook feature-extraction flow)."""
+        args = set(self.symbol.list_arguments())
+        return [d for d in data_iter.provide_label if d.name in args]
+
     def predict(self, X, num_batch=None, return_data=False, reset=True):
         data = self._init_iter(X, None, is_train=False)
         mod = self._make_module(data)
-        mod.bind(data.provide_data, data.provide_label, for_training=False)
+        mod.bind(data.provide_data, self._bindable_labels(data),
+                 for_training=False)
         mod.init_params(arg_params=self.arg_params, aux_params=self.aux_params,
                         allow_missing=False, initializer=self.initializer)
         outputs = mod.predict(data, num_batch=num_batch,
@@ -165,7 +173,8 @@ class FeedForward:
               batch_end_callback=None, reset=True):
         data = self._init_iter(X, y, is_train=False)
         mod = self._make_module(data)
-        mod.bind(data.provide_data, data.provide_label, for_training=False)
+        mod.bind(data.provide_data, self._bindable_labels(data),
+                 for_training=False)
         mod.init_params(arg_params=self.arg_params, aux_params=self.aux_params,
                         initializer=self.initializer)
         res = mod.score(data, eval_metric, num_batch=num_batch,

@@ -37,6 +37,10 @@ EXAMPLES = [
     ("kaggle_ndsb1/ndsb1.py", "kaggle ndsb1 OK"),
     ("kaggle_ndsb2/ndsb2.py", "kaggle ndsb2 OK"),
     ("python_howto/howto.py", "python howto OK"),
+    ("notebooks/simple_bind.py", "simple bind OK"),
+    ("notebooks/composite_symbol.py", "composite symbol OK"),
+    ("notebooks/predict_pretrained.py", "predict pretrained OK"),
+    ("notebooks/cifar_recipe.py", "cifar recipe OK"),
 ]
 
 
