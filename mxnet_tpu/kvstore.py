@@ -290,6 +290,7 @@ class KVStoreDistAsync(KVStore):
         if self._rank == 0:
             self._server = ps.ParameterServer(host, port, self._size)
         self._client = ps.PSClient(host, port)
+        self._client.call("hello", self._rank)
 
     @property
     def rank(self) -> int:
@@ -347,7 +348,19 @@ class KVStoreDistAsync(KVStore):
     def barrier(self):
         self._client.call("barrier")
 
+    def num_dead_node(self, node_id: int = 0) -> int:
+        """Ranks that joined the async group and then lost every
+        connection (reference ``KVStore::get_num_dead_node``); the
+        supervisor's restart-from-checkpoint signal for this tier."""
+        return int(self._client.call("num_dead"))
+
     def close(self):
+        try:
+            # graceful leave first — closing without it reads as a crash
+            # to the server's dead-node accounting
+            self._client.call("bye", self._rank)
+        except (MXNetError, OSError, ConnectionError):
+            pass
         if self._server is not None:
             try:
                 self._client.call("stop")

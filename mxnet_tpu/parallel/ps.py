@@ -73,6 +73,8 @@ class ParameterServer:
         self._store = {}
         self._opt = None
         self._opt_states = {}
+        self._alive = {}          # rank -> live connection count
+        self._seen = set()        # ranks that ever said hello
         self._lock = threading.Lock()
         self._barrier_count = 0
         self._barrier_gen = 0
@@ -116,6 +118,7 @@ class ParameterServer:
             self._store[key] = weight.asnumpy()
 
     def _serve(self, conn):
+        hello_rank = None
         try:
             while not self._stop.is_set():
                 try:
@@ -175,6 +178,30 @@ class ParameterServer:
                                     and not self._stop.is_set():
                                 self._barrier_cv.wait(timeout=0.2)
                     _send_msg(conn, ("ok",))
+                elif op == "hello":
+                    _, rank = msg
+                    with self._lock:
+                        self._seen.add(rank)
+                        self._alive[rank] = self._alive.get(rank, 0) + 1
+                    hello_rank = rank
+                    _send_msg(conn, ("ok",))
+                elif op == "bye":
+                    # graceful leave: a worker that finishes and closes
+                    # normally must NOT read as a crash to num_dead
+                    _, rank = msg
+                    with self._lock:
+                        self._seen.discard(rank)
+                        self._alive.pop(rank, None)
+                    hello_rank = None
+                    _send_msg(conn, ("ok",))
+                elif op == "num_dead":
+                    # reference KVStore::get_num_dead_node
+                    # (kvstore_dist.h:149-158): ranks that joined and
+                    # then lost every connection count as dead
+                    with self._lock:
+                        dead = sum(1 for r in self._seen
+                                   if self._alive.get(r, 0) <= 0)
+                    _send_msg(conn, ("ok", dead))
                 elif op == "stop":
                     _send_msg(conn, ("ok",))
                     self._stop.set()
@@ -184,6 +211,10 @@ class ParameterServer:
                 else:
                     _send_msg(conn, ("err", "unknown op %r" % (op,)))
         finally:
+            if hello_rank is not None:
+                with self._lock:
+                    self._alive[hello_rank] = \
+                        self._alive.get(hello_rank, 1) - 1
             conn.close()
 
     def close(self):

@@ -85,3 +85,56 @@ def test_dist_async_two_workers(tmp_path):
                  timeout=420)
     assert out.returncode == 0, (out.stdout[-1500:], out.stderr[-2500:])
     assert out.stdout.count("ASYNC_OK") == 2, out.stdout[-1500:]
+
+
+def test_async_dead_node_detection():
+    """Failure-detection parity for the async tier (reference
+    KVStore::get_num_dead_node, kvstore_dist.h:149-158): a rank that
+    joined the group and then lost its connection is reported dead."""
+    import os
+    import subprocess
+    import sys
+
+    script = r"""
+import os, sys
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import ps
+
+os.environ["MXTPU_COORDINATOR"] = "127.0.0.1:23476"
+os.environ["MXTPU_NUM_WORKERS"] = "2"
+os.environ["MXTPU_WORKER_RANK"] = "0"
+kv = mx.kv.create("dist_async")            # rank 0: hosts server + hello
+assert kv.num_dead_node() == 0
+
+host, port = ps.ps_address()
+peer = ps.PSClient(host, port)             # rank 1 joins...
+peer.call("hello", 1)
+assert kv.num_dead_node() == 0
+peer.close()                               # ...and dies
+import time
+deadline = time.time() + 10
+while kv.num_dead_node() != 1 and time.time() < deadline:
+    time.sleep(0.1)
+assert kv.num_dead_node() == 1, kv.num_dead_node()
+
+# graceful leave is NOT a death: a polite rank 2 joins and says bye
+peer2 = ps.PSClient(host, port)
+peer2.call("hello", 2)
+peer2.call("bye", 2)
+peer2.close()
+time.sleep(0.3)
+assert kv.num_dead_node() == 1, kv.num_dead_node()
+kv.close()
+print("DEAD_NODE_OK")
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, "-c", fill(script, "")],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr[-1500:])
+    assert "DEAD_NODE_OK" in r.stdout
