@@ -1,0 +1,41 @@
+# GRU training / inference API (reference R-package/R/gru.R:1-355; the
+# reference hand-builds update/reset gates per timestep, gru.R:1-46 —
+# here the fused scan-based `RNN` symbol runs the same recurrence, see
+# rnn_model.R). Entry points and argument names match the reference.
+
+#' Train a GRU language-model (reference mx.gru, gru.R:150-239)
+mx.gru <- function(train.data, eval.data = NULL,
+                   num.gru.layer, seq.len,
+                   num.hidden, num.embed, num.label,
+                   batch.size, input.size,
+                   ctx = mx.cpu(),
+                   num.round = 10, update.period = 1,
+                   initializer = mx.init.uniform(0.01),
+                   dropout = 0, optimizer = "sgd", ...) {
+  mx.rnn.create("gru", train.data, eval.data,
+                num.rnn.layer = num.gru.layer, seq.len = seq.len,
+                num.hidden = num.hidden, num.embed = num.embed,
+                num.label = num.label, batch.size = batch.size,
+                input.size = input.size, ctx = ctx,
+                num.round = num.round, update.period = update.period,
+                initializer = initializer, dropout = dropout,
+                optimizer = optimizer, ...)
+}
+
+#' Single-step GRU inference model (reference mx.gru.inference,
+#' gru.R:242-316)
+mx.gru.inference <- function(num.gru.layer, input.size, num.hidden,
+                             num.embed, num.label, batch.size = 1,
+                             arg.params, ctx = mx.cpu(), dropout = 0) {
+  mx.rnn.infer.model("gru", num.rnn.layer = num.gru.layer,
+                   input.size = input.size, num.hidden = num.hidden,
+                   num.embed = num.embed, num.label = num.label,
+                   batch.size = batch.size, arg.params = arg.params,
+                   ctx = ctx, dropout = dropout)
+}
+
+#' One forward step of a GRU inference model (reference mx.gru.forward,
+#' gru.R:318-355)
+mx.gru.forward <- function(model, input.data, new.seq = FALSE) {
+  mx.rnn.step(model, input.data, new.seq)
+}

@@ -1,0 +1,46 @@
+# LSTM training / inference API (reference R-package/R/lstm.R:1-361).
+# The reference builds the cell by hand (i2h/h2h FullyConnected +
+# SliceChannel into 4 gates per timestep, lstm.R:1-28) and unrolls it
+# seq.len times; here the recurrence is the framework's fused scan-based
+# `RNN` symbol (see rnn_model.R) — same model family, one
+# seq.len-independent graph. The public entry points and their argument
+# names match the reference's.
+
+#' Train an LSTM language-model on (seq.len, nsample) token arrays.
+#' train.data / eval.data: list(data=, label=) of integer id arrays.
+#' (reference mx.lstm, lstm.R:152-241)
+mx.lstm <- function(train.data, eval.data = NULL,
+                    num.lstm.layer, seq.len,
+                    num.hidden, num.embed, num.label,
+                    batch.size, input.size,
+                    ctx = mx.cpu(),
+                    num.round = 10, update.period = 1,
+                    initializer = mx.init.uniform(0.01),
+                    dropout = 0, optimizer = "sgd", ...) {
+  mx.rnn.create("lstm", train.data, eval.data,
+                num.rnn.layer = num.lstm.layer, seq.len = seq.len,
+                num.hidden = num.hidden, num.embed = num.embed,
+                num.label = num.label, batch.size = batch.size,
+                input.size = input.size, ctx = ctx,
+                num.round = num.round, update.period = update.period,
+                initializer = initializer, dropout = dropout,
+                optimizer = optimizer, ...)
+}
+
+#' Single-step LSTM inference model carrying h/c state across calls
+#' (reference mx.lstm.inference, lstm.R:244-320)
+mx.lstm.inference <- function(num.lstm.layer, input.size, num.hidden,
+                              num.embed, num.label, batch.size = 1,
+                              arg.params, ctx = mx.cpu(), dropout = 0) {
+  mx.rnn.infer.model("lstm", num.rnn.layer = num.lstm.layer,
+                   input.size = input.size, num.hidden = num.hidden,
+                   num.embed = num.embed, num.label = num.label,
+                   batch.size = batch.size, arg.params = arg.params,
+                   ctx = ctx, dropout = dropout)
+}
+
+#' One forward step of an LSTM inference model; new.seq=TRUE resets the
+#' carried state (reference mx.lstm.forward, lstm.R:322-361)
+mx.lstm.forward <- function(model, input.data, new.seq = FALSE) {
+  mx.rnn.step(model, input.data, new.seq)
+}

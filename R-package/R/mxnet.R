@@ -118,6 +118,23 @@ arguments.MXSymbol <- function(symbol)
 outputs.MXSymbol <- function(symbol)
   .Call(mxr_sym_list_outputs, symbol$handle)
 
+# one output of a multi-output symbol as its own symbol; `sym[[i]]` is
+# 1-based like everything in R (reference Symbol::GetOutput)
+mx.symbol.get.output <- function(symbol, index) structure(
+  list(handle = .Call(mxr_sym_get_output, symbol$handle,
+                      as.integer(index - 1L))), class = "MXSymbol")
+
+`[[.MXSymbol` <- function(x, i) mx.symbol.get.output(x, i)
+
+mx.symbol.Group <- function(...) {
+  syms <- list(...)
+  if (length(syms) == 1 && is.list(syms[[1]]) &&
+      !inherits(syms[[1]], "MXSymbol")) syms <- syms[[1]]
+  structure(list(handle = .Call(mxr_sym_group,
+                                lapply(syms, function(s) s$handle))),
+            class = "MXSymbol")
+}
+
 mx.symbol.infer.shape <- function(symbol, ...) {
   shapes <- list(...)
   keys <- names(shapes)

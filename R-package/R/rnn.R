@@ -1,0 +1,49 @@
+# Vanilla-RNN training / inference API (reference R-package/R/rnn.R:1-342;
+# reference cell is one i2h+h2h FullyConnected with tanh/relu,
+# rnn.R:1-26 — here the fused scan-based `RNN` symbol, see rnn_model.R).
+# Entry points and argument names match the reference.
+
+#' Train a vanilla-RNN language-model; active.func "tanh" or "relu"
+#' (reference mx.rnn, rnn.R:136-226)
+mx.rnn <- function(train.data, eval.data = NULL,
+                   num.rnn.layer, seq.len,
+                   num.hidden, num.embed, num.label,
+                   batch.size, input.size,
+                   active.func = "tanh",
+                   ctx = mx.cpu(),
+                   num.round = 10, update.period = 1,
+                   initializer = mx.init.uniform(0.01),
+                   dropout = 0, optimizer = "sgd", ...) {
+  if (!active.func %in% c("tanh", "relu"))
+    stop("mx.rnn: active.func must be 'tanh' or 'relu'")
+  mx.rnn.create(paste0("rnn_", active.func), train.data, eval.data,
+                num.rnn.layer = num.rnn.layer, seq.len = seq.len,
+                num.hidden = num.hidden, num.embed = num.embed,
+                num.label = num.label, batch.size = batch.size,
+                input.size = input.size, ctx = ctx,
+                num.round = num.round, update.period = update.period,
+                initializer = initializer, dropout = dropout,
+                optimizer = optimizer, ...)
+}
+
+#' Single-step vanilla-RNN inference model (reference mx.rnn.inference,
+#' rnn.R:229-303)
+mx.rnn.inference <- function(num.rnn.layer, input.size, num.hidden,
+                             num.embed, num.label, batch.size = 1,
+                             arg.params, active.func = "tanh",
+                             ctx = mx.cpu(), dropout = 0) {
+  if (!active.func %in% c("tanh", "relu"))
+    stop("mx.rnn.inference: active.func must be 'tanh' or 'relu'")
+  mx.rnn.infer.model(paste0("rnn_", active.func),
+                     num.rnn.layer = num.rnn.layer,
+                     input.size = input.size, num.hidden = num.hidden,
+                     num.embed = num.embed, num.label = num.label,
+                     batch.size = batch.size, arg.params = arg.params,
+                     ctx = ctx, dropout = dropout)
+}
+
+#' One forward step of a vanilla-RNN inference model (reference
+#' mx.rnn.forward, rnn.R:305-342)
+mx.rnn.forward <- function(model, input.data, new.seq = FALSE) {
+  mx.rnn.step(model, input.data, new.seq)
+}

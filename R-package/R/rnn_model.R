@@ -1,0 +1,307 @@
+# Recurrent model tier (reference R-package/R/rnn_model.R:1-243 plus the
+# shared halves of lstm.R/gru.R/rnn.R): setup + training + inference
+# machinery behind mx.lstm / mx.gru / mx.rnn.
+#
+# TPU-native redesign: the reference unrolls seq.len copies of a cell
+# graph in R (lstm.R:31-90, one SliceChannel slice + 2 FCs per step) and
+# zeroes/copies states around every batch. Here the whole recurrence is
+# ONE `RNN` symbol (the framework's lax.scan-backed fused op,
+# mxnet_tpu/ops/seq.py:138) — the graph is seq.len-independent, compiles
+# once, and runs the recurrence on-device. Public API names and
+# arguments stay reference-compatible.
+
+# weights get optimizer updates; data/label/states do not
+# (reference rnn_model.R:1-4 is.param.name, extended with the fused
+# RNN op's flat "parameters" vector)
+mx.rnn.is.param.name <- function(name) {
+  grepl("weight$", name) || grepl("bias$", name) ||
+    grepl("gamma$", name) || grepl("beta$", name) ||
+    grepl("parameters$", name)
+}
+
+# unrolled-equivalent training symbol: token ids -> embedding ->
+# fused RNN -> per-step softmax over the vocabulary.
+# R-side data layout is (seq.len, batch) colmajor, which crosses the
+# ABI as C-order (batch, seq.len) — same convention as the reference.
+mx.rnn.train.symbol <- function(mode, num.rnn.layer, num.hidden,
+                                num.embed, num.label, input.size,
+                                dropout = 0) {
+  data <- mx.symbol.Variable("data")
+  label <- mx.symbol.Variable("label")
+  embed <- mx.symbol.create("Embedding", data = data,
+                            input_dim = input.size,
+                            output_dim = num.embed, name = "embed")
+  # (batch, seq, embed) -> time-major (seq, batch, embed): the scan
+  # axis must be leading for the fused op
+  tm <- mx.symbol.create("transpose", embed, axes = c(1, 0, 2))
+  rnn <- mx.symbol.create("RNN", tm, state_size = num.hidden,
+                          num_layers = num.rnn.layer, mode = mode,
+                          p = dropout, name = "rnn")
+  flat <- mx.symbol.create("Reshape", rnn, shape = c(-1, num.hidden))
+  fc <- mx.symbol.create("FullyConnected", flat, num_hidden = num.label,
+                         name = "cls")
+  # label (batch, seq) -> seq-major flat, matching the reshape order of
+  # the time-major RNN output (reference lstm.R:84-86 transposes the
+  # same way before its Reshape)
+  lab <- mx.symbol.create("Reshape",
+                          mx.symbol.create("transpose", label,
+                                           axes = c(1, 0)),
+                          shape = c(-1))
+  mx.symbol.create("SoftmaxOutput", data = fc, label = lab, name = "sm")
+}
+
+# single-step inference symbol: one token in, next-token probs +
+# carried states out (reference lstm.inference.symbol, lstm.R:92-149,
+# which BlockGrads every state into the output group)
+mx.rnn.inference.symbol <- function(mode, num.rnn.layer, num.hidden,
+                                    num.embed, num.label, input.size,
+                                    dropout = 0) {
+  data <- mx.symbol.Variable("data")
+  embed <- mx.symbol.create("Embedding", data = data,
+                            input_dim = input.size,
+                            output_dim = num.embed, name = "embed")
+  tm <- mx.symbol.create("transpose", embed, axes = c(1, 0, 2))
+  rnn <- mx.symbol.create("RNN", tm, state_size = num.hidden,
+                          num_layers = num.rnn.layer, mode = mode,
+                          p = dropout, state_outputs = TRUE,
+                          name = "rnn")
+  flat <- mx.symbol.create("Reshape", rnn[[1]],
+                           shape = c(-1, num.hidden))
+  fc <- mx.symbol.create("FullyConnected", flat, num_hidden = num.label,
+                         name = "cls")
+  sm <- mx.symbol.create("SoftmaxOutput", data = fc, name = "sm")
+  outs <- list(sm)
+  for (i in 2:length(outputs.MXSymbol(rnn)))
+    outs[[i]] <- mx.symbol.create("BlockGrad", rnn[[i]])
+  mx.symbol.Group(outs)
+}
+
+mx.rnn.state.names <- function(mode) {
+  if (identical(mode, "lstm")) c("rnn_state", "rnn_state_cell")
+  else "rnn_state"
+}
+
+# bind + init (reference setup.rnn.model, rnn_model.R:36-80)
+mx.rnn.setup.model <- function(rnn.sym, mode, ctx, num.rnn.layer,
+                               seq.len, num.hidden, num.embed,
+                               num.label, batch.size, input.size,
+                               initializer = mx.init.uniform(0.01)) {
+  data.shape <- if (seq.len == 1) c(1, batch.size)
+                else c(seq.len, batch.size)
+  shape.args <- list(data = data.shape)
+  arg.names <- arguments.MXSymbol(rnn.sym)
+  if ("label" %in% arg.names) shape.args$label <- data.shape
+  for (nm in mx.rnn.state.names(mode))
+    shape.args[[nm]] <- c(num.hidden, batch.size, num.rnn.layer)
+
+  shapes <- do.call(mx.symbol.infer.shape,
+                    c(list(rnn.sym), shape.args))
+  if (is.null(shapes))
+    stop("mx.rnn.setup.model: cannot infer shapes")
+
+  arg.params <- list()
+  for (i in seq_along(arg.names)) {
+    nm <- arg.names[[i]]
+    if (mx.rnn.is.param.name(nm))
+      arg.params[[nm]] <- initializer(nm, shapes$arg.shapes[[i]])
+  }
+
+  exec.args <- c(list(symbol = rnn.sym, ctx = ctx, grad.req = "write"),
+                 shape.args)
+  executor <- do.call(mx.simple.bind, exec.args)
+  for (nm in names(arg.params))
+    mx.exec.set.arg(executor, nm, arg.params[[nm]])
+  # states start (and are re-zeroed per batch) at zero
+  for (nm in mx.rnn.state.names(mode))
+    mx.exec.set.arg(executor, nm,
+                    array(0, dim = c(num.hidden, batch.size,
+                                     num.rnn.layer)))
+
+  list(rnn.exec = executor, symbol = rnn.sym, mode = mode,
+       arg.params = arg.params, shapes = shapes, arg.names = arg.names,
+       num.rnn.layer = num.rnn.layer, num.hidden = num.hidden,
+       seq.len = seq.len, batch.size = batch.size,
+       num.embed = num.embed, num.label = num.label,
+       input.size = input.size)
+}
+
+# list(data=, label=) of (seq.len, nsample) integer arrays -> batch
+# iterator (reference check.data + mx.model.init.iter.rnn,
+# rnn_model.R:17-34 / 228-243)
+mx.rnn.check.data <- function(data, batch.size, is.train) {
+  if (is.null(data)) return(NULL)
+  if (!is.list(data) || is.null(data$data) || is.null(data$label))
+    stop("dataset must be list(data = array, label = array) of ",
+         "(seq.len, nsample) token ids")
+  X <- data$data
+  y <- data$label
+  if (is.null(dim(X)) || length(dim(X)) != 2)
+    stop("rnn data must be a (seq.len, nsample) matrix of token ids")
+  nsample <- ncol(X)
+  if (nsample < batch.size)
+    stop("need at least batch.size=", batch.size, " samples")
+  env <- new.env(parent = emptyenv())
+  env$cursor <- 0L
+  nbatches <- nsample %/% batch.size
+  list(
+    reset = function() env$cursor <- 0L,
+    iter.next = function() {
+      env$cursor <- env$cursor + 1L
+      env$cursor <= nbatches
+    },
+    value = function() {
+      lo <- (env$cursor - 1L) * batch.size + 1L
+      hi <- env$cursor * batch.size
+      list(data = X[, lo:hi, drop = FALSE],
+           label = y[, lo:hi, drop = FALSE])
+    },
+    nbatches = nbatches)
+}
+
+# per-batch mean negative log likelihood of the true tokens, from the
+# (seq*batch, vocab) softmax output (reference calc.nll +
+# mx.nd.choose.element.0index, rnn_model.R:83-97)
+mx.rnn.batch.nll <- function(probs, label, batch.size) {
+  flat <- as.integer(t(label))          # seq-major, matches sm rows
+  picked <- probs[cbind(seq_along(flat), flat + 1L)]
+  -sum(log(pmax(picked, 1e-10))) / batch.size
+}
+
+# training loop (reference train.rnn, rnn_model.R:100-225): per batch
+# zero states, forward, backward, SGD-update the weight args; states
+# stay zero (truncated BPTT at batch boundaries, like the reference
+# which re-zeroes init states each batch)
+mx.rnn.train <- function(model, train.data, eval.data = NULL,
+                         num.round = 10, update.period = 1,
+                         optimizer = "sgd", verbose = TRUE, ...) {
+  if (update.period != 1)
+    stop("mx.rnn.train: update.period > 1 needs grad.req='add', which ",
+         "this binding does not expose; use update.period = 1")
+  m <- model
+  exec <- m$rnn.exec
+  updater <- mx.opt.create.updater(optimizer,
+                                   rescale.grad = 1 / m$batch.size, ...)
+  out.shape <- c(m$num.label, m$seq.len * m$batch.size)
+  zero.state <- array(0, dim = c(m$num.hidden, m$batch.size,
+                                 m$num.rnn.layer))
+  arg.params <- m$arg.params
+
+  for (iteration in seq_len(num.round)) {
+    train.data$reset()
+    train.nll <- 0
+    nbatch <- 0
+    while (train.data$iter.next()) {
+      batch <- train.data$value()
+      mx.exec.set.arg(exec, "data", batch$data)
+      mx.exec.set.arg(exec, "label", batch$label)
+      for (nm in mx.rnn.state.names(m$mode))
+        mx.exec.set.arg(exec, nm, zero.state)
+      mx.exec.forward(exec, is.train = TRUE)
+      mx.exec.backward(exec)
+      for (nm in names(arg.params)) {
+        grad <- mx.exec.get.grad(exec, nm, dim(arg.params[[nm]]))
+        arg.params[[nm]] <- updater(nm, arg.params[[nm]], grad)
+        mx.exec.set.arg(exec, nm, arg.params[[nm]])
+      }
+      probs <- mx.exec.get.output(exec, 1L, out.shape)
+      train.nll <- train.nll +
+        mx.rnn.batch.nll(t(probs), batch$label, m$batch.size)
+      nbatch <- nbatch + m$seq.len
+    }
+    if (verbose)
+      cat(sprintf("Iter [%d] Train: NLL=%.5f, Perp=%.5f\n", iteration,
+                  train.nll / nbatch, exp(train.nll / nbatch)))
+    if (!is.null(eval.data)) {
+      eval.data$reset()
+      val.nll <- 0
+      nbatch <- 0
+      while (eval.data$iter.next()) {
+        batch <- eval.data$value()
+        mx.exec.set.arg(exec, "data", batch$data)
+        mx.exec.set.arg(exec, "label", batch$label)
+        for (nm in mx.rnn.state.names(m$mode))
+          mx.exec.set.arg(exec, nm, zero.state)
+        mx.exec.forward(exec, is.train = FALSE)
+        probs <- mx.exec.get.output(exec, 1L, out.shape)
+        val.nll <- val.nll +
+          mx.rnn.batch.nll(t(probs), batch$label, m$batch.size)
+        nbatch <- nbatch + m$seq.len
+      }
+      if (verbose)
+        cat(sprintf("Iter [%d] Val: NLL=%.5f, Perp=%.5f\n", iteration,
+                    val.nll / nbatch, exp(val.nll / nbatch)))
+    }
+  }
+  m$arg.params <- arg.params
+  m
+}
+
+# shared driver behind mx.lstm / mx.gru / mx.rnn (each reference file
+# repeats this block; lstm.R:152-241)
+mx.rnn.create <- function(mode, train.data, eval.data = NULL,
+                          num.rnn.layer, seq.len, num.hidden, num.embed,
+                          num.label, batch.size, input.size,
+                          ctx = mx.cpu(), num.round = 10,
+                          update.period = 1,
+                          initializer = mx.init.uniform(0.01),
+                          dropout = 0, optimizer = "sgd", ...) {
+  train.data <- mx.rnn.check.data(train.data, batch.size, TRUE)
+  eval.data <- mx.rnn.check.data(eval.data, batch.size, FALSE)
+  sym <- mx.rnn.train.symbol(mode, num.rnn.layer, num.hidden, num.embed,
+                             num.label, input.size, dropout)
+  model <- mx.rnn.setup.model(sym, mode, ctx, num.rnn.layer, seq.len,
+                              num.hidden, num.embed, num.label,
+                              batch.size, input.size, initializer)
+  model <- mx.rnn.train(model, train.data, eval.data,
+                        num.round = num.round,
+                        update.period = update.period,
+                        optimizer = optimizer, ...)
+  structure(list(symbol = model$symbol, arg.params = model$arg.params,
+                 aux.params = list(), mode = mode,
+                 num.rnn.layer = num.rnn.layer, num.hidden = num.hidden,
+                 num.embed = num.embed, num.label = num.label,
+                 input.size = input.size),
+            class = "MXFeedForwardModel")
+}
+
+# shared driver behind mx.*.inference (reference
+# mx.lstm.inference, lstm.R:244-320): a seq.len=1 executor whose
+# states persist across step calls
+mx.rnn.infer.model <- function(mode, num.rnn.layer, input.size,
+                             num.hidden, num.embed, num.label,
+                             batch.size = 1, arg.params,
+                             ctx = mx.cpu(), dropout = 0) {
+  sym <- mx.rnn.inference.symbol(mode, num.rnn.layer, num.hidden,
+                                 num.embed, num.label, input.size,
+                                 dropout)
+  model <- mx.rnn.setup.model(sym, mode, ctx, num.rnn.layer,
+                              seq.len = 1, num.hidden, num.embed,
+                              num.label, batch.size, input.size)
+  for (nm in names(arg.params))
+    if (nm %in% model$arg.names && mx.rnn.is.param.name(nm))
+      mx.exec.set.arg(model$rnn.exec, nm, arg.params[[nm]])
+  model$states <- lapply(mx.rnn.state.names(mode), function(nm)
+    array(0, dim = c(num.hidden, batch.size, num.rnn.layer)))
+  names(model$states) <- mx.rnn.state.names(mode)
+  model
+}
+
+# one inference step (reference mx.lstm.forward, lstm.R:322-361):
+# returns list(prob=, model=) with the carried states updated
+mx.rnn.step <- function(model, input.data, new.seq = FALSE) {
+  state.names <- mx.rnn.state.names(model$mode)
+  state.dim <- c(model$num.hidden, model$batch.size, model$num.rnn.layer)
+  if (new.seq)
+    model$states <- lapply(model$states, function(s) array(0, state.dim))
+  exec <- model$rnn.exec
+  dim(input.data) <- c(1, model$batch.size)
+  mx.exec.set.arg(exec, "data", input.data)
+  for (nm in state.names) mx.exec.set.arg(exec, nm, model$states[[nm]])
+  mx.exec.forward(exec, is.train = FALSE)
+  prob <- mx.exec.get.output(exec, 1L,
+                             c(model$num.label, model$batch.size))
+  for (i in seq_along(state.names))
+    model$states[[state.names[[i]]]] <-
+      mx.exec.get.output(exec, 1L + i, state.dim)
+  list(prob = prob, model = model)
+}

@@ -1,0 +1,90 @@
+# Computation-graph visualization (reference R-package/R/viz.graph.R:1-158,
+# mx.model.graph.viz over DiagrammeR). This redesign emits standard
+# Graphviz DOT text from the symbol's JSON — renderable by any dot
+# binary or viewer, with no hard package dependency; if DiagrammeR is
+# installed the DOT is rendered inline like the reference did.
+
+# node shapes/fills by operator family (reference viz.graph.R:60-101
+# used the same grouping for its node styling)
+.mx.viz.node.style <- function(op, param) {
+  if (identical(op, "null"))
+    return(c(shape = "ellipse", fill = "#8dd3c7"))
+  label.extra <- ""
+  if (op %in% c("Convolution", "Deconvolution"))
+    label.extra <- paste0("\\n", param$kernel, "/", param$num_filter)
+  if (identical(op, "FullyConnected"))
+    label.extra <- paste0("\\n", param$num_hidden)
+  if (identical(op, "Activation") || identical(op, "LeakyReLU"))
+    label.extra <- paste0("\\n", param$act_type)
+  if (identical(op, "Pooling"))
+    label.extra <- paste0("\\n", param$pool_type, " ", param$kernel)
+  if (identical(op, "RNN"))
+    label.extra <- paste0("\\n", param$mode, " x", param$num_layers)
+  fill <- switch(op,
+    Convolution = , Deconvolution = , FullyConnected = "#fb8072",
+    Activation = , LeakyReLU = "#ffffb3",
+    Pooling = "#80b1d3",
+    BatchNorm = "#bebada",
+    SoftmaxOutput = , LinearRegressionOutput = ,
+    LogisticRegressionOutput = , MAERegressionOutput = "#fccde5",
+    RNN = "#b3de69",
+    "#d9d9d9")
+  c(shape = "box", fill = fill, extra = label.extra)
+}
+
+#' Render a symbol's computation graph as Graphviz DOT text.
+#'
+#' @param symbol MXSymbol to draw
+#' @param graph.title character title
+#' @param render logical: if TRUE and DiagrammeR is installed, render
+#'   the DOT (reference behavior); the DOT string is always returned
+#'   invisibly so it can be written to a .dot/.gv file.
+#' @return the DOT source, invisibly
+#' (reference graph.viz, viz.graph.R:24-158)
+graph.viz <- function(symbol, graph.title = "Computation Graph",
+                      render = TRUE) {
+  if (!requireNamespace("jsonlite", quietly = TRUE))
+    stop("graph.viz needs the jsonlite package to parse symbol JSON")
+  g <- jsonlite::fromJSON(tojson.MXSymbol(symbol),
+                          simplifyDataFrame = FALSE)
+  nodes <- g$nodes
+  lines <- c("digraph mxnet_tpu {",
+             sprintf("  label=\"%s\"; labelloc=top; rankdir=BT;",
+                     graph.title),
+             "  node [fontsize=10, style=filled];")
+  # hide weight/bias/state leaves like the reference (viz.graph.R:49-58
+  # drops *_weight/*_bias/*_label auxiliaries from the drawing)
+  hidden <- vapply(seq_along(nodes), function(i) {
+    n <- nodes[[i]]
+    identical(n$op, "null") &&
+      grepl("(weight|bias|gamma|beta|label|state|parameters)$", n$name)
+  }, logical(1))
+  for (i in seq_along(nodes)) {
+    if (hidden[[i]]) next
+    n <- nodes[[i]]
+    st <- .mx.viz.node.style(n$op, n$param)
+    label <- if (identical(n$op, "null")) n$name
+             else paste0(n$op, if (!is.null(st[["extra"]])) st[["extra"]]
+                               else "", "\\n", n$name)
+    lines <- c(lines, sprintf(
+      "  n%d [label=\"%s\", shape=%s, fillcolor=\"%s\"];",
+      i, label, st[["shape"]], st[["fill"]]))
+  }
+  for (i in seq_along(nodes)) {
+    if (hidden[[i]]) next
+    for (inp in nodes[[i]]$inputs) {
+      src <- inp[[1]] + 1L                # JSON ids are 0-based
+      if (hidden[[src]]) next
+      lines <- c(lines, sprintf("  n%d -> n%d;", src, i))
+    }
+  }
+  lines <- c(lines, "}")
+  dot <- paste(lines, collapse = "\n")
+  if (render && requireNamespace("DiagrammeR", quietly = TRUE))
+    print(DiagrammeR::grViz(dot))
+  invisible(dot)
+}
+
+#' Reference-compatible alias (the reference exported the same drawing
+#' under mx.model.graph.viz in later revisions)
+mx.graph.viz <- graph.viz

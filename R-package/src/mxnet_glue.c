@@ -518,6 +518,29 @@ SEXP mxr_random_seed(SEXP seed) {
   return R_NilValue;
 }
 
+/* ---- Round-4 surface: multi-output symbols (RNN tier) ----------------- */
+
+/* mxr_sym_get_output(extptr, index0) -> extptr (one output as a symbol,
+ * the [[ operator on multi-output symbols — reference symbol.cc
+ * Symbol::GetOutput) */
+SEXP mxr_sym_get_output(SEXP ptr, SEXP index) {
+  SymbolHandle out;
+  chk(MXSymbolGetOutput(R_ExternalPtrAddr(ptr),
+                        (mx_uint)Rf_asInteger(index), &out));
+  return wrap_handle(out, symbol_finalizer);
+}
+
+/* mxr_sym_group(list_of_extptr) -> extptr (mx.symbol.Group) */
+SEXP mxr_sym_group(SEXP handles) {
+  mx_uint n = (mx_uint)Rf_length(handles);
+  SymbolHandle *hs = (SymbolHandle *)R_alloc(n, sizeof(SymbolHandle));
+  for (mx_uint i = 0; i < n; ++i)
+    hs[i] = R_ExternalPtrAddr(VECTOR_ELT(handles, i));
+  SymbolHandle out;
+  chk(MXSymbolCreateGroup(n, hs, &out));
+  return wrap_handle(out, symbol_finalizer);
+}
+
 /* ---- registration ----------------------------------------------------- */
 
 static const R_CallMethodDef call_methods[] = {
@@ -552,6 +575,8 @@ static const R_CallMethodDef call_methods[] = {
   {"mxr_opt_create", (DL_FUNC)&mxr_opt_create, 3},
   {"mxr_opt_update", (DL_FUNC)&mxr_opt_update, 6},
   {"mxr_random_seed", (DL_FUNC)&mxr_random_seed, 1},
+  {"mxr_sym_get_output", (DL_FUNC)&mxr_sym_get_output, 2},
+  {"mxr_sym_group", (DL_FUNC)&mxr_sym_group, 1},
   {NULL, NULL, 0}
 };
 

@@ -119,6 +119,13 @@ int MXNDArrayLoad(const char *fname, mx_uint *out_size,
                   const char ***out_names);
 int MXNDArrayListFree(NDArrayHandle *arr, mx_uint size,
                       const char **names);
+/*! \brief New caller-owned handle over the SAME underlying NDArray
+ * object — an aliasing handle, not a copy: writes through either
+ * handle (e.g. MXNDArraySyncCopyFromCPU) are visible through both.
+ * Lets a frontend detach MXNDArrayLoad results from the load record
+ * and release the record immediately (the loaded originals are freed
+ * with the record, leaving the dup as sole owner). */
+int MXNDArrayDup(NDArrayHandle handle, NDArrayHandle *out);
 /*! \brief Create with explicit dtype (0=f32 1=f64 2=f16 3=u8 4=i32 5=i8
  * 6=i64 7=bf16 — the mshadow-compatible ids). */
 int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,

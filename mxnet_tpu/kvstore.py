@@ -319,11 +319,16 @@ class KVStoreDistAsync(KVStore):
                 synced.copyto(v)
 
     def set_optimizer(self, optimizer):
-        blob = pickle.dumps(optimizer)
+        """COLLECTIVE: every rank must call this (the reference's
+        ``kvstore.set_optimizer`` barriers the same way — calling it on
+        rank 0 only deadlocks). Rank 0 alone ships the PICKLED
+        optimizer to run server-side, once per push
+        (``_send_command_to_servers``); the barrier keeps later ranks
+        from pushing before it lands."""
         self._optimizer = optimizer
-        # reference _send_command_to_servers: the PICKLED optimizer
-        # runs server-side, once per push
-        self._client.call("set_optimizer", blob)
+        if self._rank == 0:
+            self._client.call("set_optimizer", pickle.dumps(optimizer))
+        self.barrier()
 
     def push(self, key, value, priority: int = 0):
         keys, _ = _key_list(key)

@@ -17,9 +17,19 @@ Transport: length-prefixed pickles over TCP on
 server thread; every worker (rank 0 included) is a client. This is the
 host-side control plane — gradients here are host numpy arrays, the
 same place the reference's ps-lite ZPush buffers lived.
+
+Trust model: pickle deserialization means any peer that can connect
+gets code execution — same trusted-cluster assumption as the
+reference's ps-lite binary protocol, documented in
+``docs/distributed.md``. Setting ``MXTPU_PS_SECRET`` (propagated by
+``tools/launch.py`` like every other ``MXTPU_*`` var) adds an
+HMAC-SHA256 tag over every frame; frames with a missing or wrong tag
+are dropped before ``pickle.loads`` ever sees the payload.
 """
 from __future__ import annotations
 
+import hashlib
+import hmac
 import os
 import pickle
 import socket
@@ -34,24 +44,75 @@ from ..base import MXNetError
 _LEN = struct.Struct("!Q")
 
 
+def _secret():
+    s = os.environ.get("MXTPU_PS_SECRET", "")
+    if not s:
+        # ssh-launched workers get the secret as a 0600 file in the
+        # shared job dir (tools/launch.py) so it never appears on a
+        # remote command line (/proc/*/cmdline is world-readable)
+        path = os.environ.get("MXTPU_PS_SECRET_FILE", "")
+        if path:
+            try:
+                with open(path) as f:
+                    s = f.read().strip()
+            except OSError:
+                s = ""
+    return s.encode() if s else None
+
+
 def _send_msg(sock, obj):
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+    key = _secret()
+    tag = hmac.new(key, payload, hashlib.sha256).digest() if key else b""
+    sock.sendall(_LEN.pack(len(payload)) + tag + payload)
 
 
 def _recv_exact(sock, n):
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+    # chunked: a hostile length prefix must not make one recv() call
+    # allocate the whole claimed frame up front
+    parts = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
         if not chunk:
             raise ConnectionError("peer closed")
-        buf += chunk
-    return buf
+        parts.append(chunk)
+        got += len(chunk)
+    return b"".join(parts)
+
+
+def _max_frame():
+    return int(os.environ.get("MXTPU_PS_MAX_FRAME", 1 << 30))
 
 
 def _recv_msg(sock):
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    return pickle.loads(_recv_exact(sock, n))
+    if n > _max_frame():
+        # refuse before allocating: an unauthenticated peer's length
+        # prefix is the one field read ahead of the HMAC check
+        raise ConnectionError("PS frame length %d exceeds cap %d"
+                              % (n, _max_frame()))
+    # once a frame has started, the rest must arrive promptly: a peer
+    # whose framing disagrees with ours (e.g. MXTPU_PS_SECRET set on
+    # one side only) would otherwise park both ends forever mid-frame
+    old_timeout = sock.gettimeout()
+    sock.settimeout(60.0)
+    try:
+        key = _secret()
+        if key:
+            tag = _recv_exact(sock, hashlib.sha256().digest_size)
+            payload = _recv_exact(sock, n)
+            if not hmac.compare_digest(
+                    tag, hmac.new(key, payload, hashlib.sha256).digest()):
+                raise ConnectionError("PS frame failed HMAC check")
+            return pickle.loads(payload)
+        return pickle.loads(_recv_exact(sock, n))
+    except socket.timeout:
+        raise ConnectionError(
+            "PS frame stalled mid-read (framing mismatch? check that "
+            "MXTPU_PS_SECRET agrees on every rank)")
+    finally:
+        sock.settimeout(old_timeout)
 
 
 def ps_address():
@@ -125,6 +186,11 @@ class ParameterServer:
                     msg = _recv_msg(conn)
                 except (ConnectionError, OSError):
                     return
+                except (pickle.UnpicklingError, EOFError, ValueError,
+                        struct.error):
+                    # garbage frame (framing mismatch / hostile bytes):
+                    # drop the connection, never the serve loop
+                    return
                 op = msg[0]
                 if op == "init":
                     _, rank, key, val = msg
@@ -162,8 +228,18 @@ class ParameterServer:
                 elif op == "set_optimizer":
                     _, blob = msg
                     with self._lock:
-                        self._opt = pickle.loads(blob)
-                        self._opt_states = {}
+                        # a repeat of the CURRENT optimizer (a late
+                        # worker re-sending) must not wipe momentum /
+                        # Adam state accumulated by earlier pushes —
+                        # the reference only ever sends this command
+                        # from rank 0 (kvstore_dist.h
+                        # _send_command_to_servers). A genuinely new
+                        # optimizer (different blob) replaces it and
+                        # starts fresh state.
+                        if blob != getattr(self, "_opt_blob", None):
+                            self._opt = pickle.loads(blob)
+                            self._opt_blob = blob
+                            self._opt_states = {}
                     _send_msg(conn, ("ok",))
                 elif op == "barrier":
                     with self._barrier_cv:

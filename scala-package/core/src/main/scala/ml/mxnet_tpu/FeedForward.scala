@@ -1,0 +1,161 @@
+package ml.mxnet_tpu
+
+/**
+ * Estimator API (reference scala-package
+ * ml.dmlc.mxnet.FeedForward, FeedForward.scala:1-666, plus its
+ * Builder, FeedForward.scala:500-666): symbol + training
+ * configuration in one object, `fit` to train, `predict` over a
+ * DataIter, checkpoint save/load in the reference's
+ * prefix-symbol.json / prefix-%04d.params layout (interoperable with
+ * the Python and R frontends — same container format).
+ *
+ * The heavy lifting delegates to Module (one bound executor, fused
+ * forward/backward under the hood); FeedForward owns the
+ * configuration and lifecycle, exactly the reference's split.
+ */
+class FeedForward(val symbol: Symbol,
+                  val devType: Int = Context.CPU,
+                  val devId: Int = 0,
+                  val numEpoch: Int = 10,
+                  val optimizer: SGD = new SGD(0.01f),
+                  val initializer: Initializer = new Uniform(0.07f),
+                  val batchSize: Int = 128,
+                  val dataName: String = "data",
+                  val labelName: String = "softmax_label",
+                  initArgParams: Map[String, Array[Float]] = null,
+                  initAuxParams: Map[String, Array[Float]] = null)
+    extends AutoCloseable {
+
+  private var module: Module = _
+  private var trained = false
+
+  def argParams: Map[String, Array[Float]] =
+    if (module != null) module.argParams
+    else Option(initArgParams).getOrElse(Map.empty)
+
+  def auxParams: Map[String, Array[Float]] =
+    if (module != null) module.auxParams
+    else Option(initAuxParams).getOrElse(Map.empty)
+
+  private def ensureModule(dataShape: Array[Int]): Module = {
+    if (module == null) {
+      module = new Module(symbol, dataName, labelName, devType, devId)
+        .bind(dataShape)
+      if (initArgParams != null) {
+        module.argParams = initArgParams
+        module.setParams()
+      } else {
+        module.initParams(initializer)
+      }
+      if (initAuxParams != null) {
+        module.auxParams = initAuxParams
+        module.setParams()
+      }
+    }
+    module
+  }
+
+  /** Train (reference FeedForward.fit, FeedForward.scala:200-320):
+   *  infers the input shape from the first batch's length. */
+  def fit(train: DataIter, featureShape: Array[Int],
+          evalData: Option[DataIter] = None,
+          metric: EvalMetric = new Accuracy,
+          verbose: Boolean = true): this.type = {
+    val dataShape = batchSize +: featureShape
+    ensureModule(dataShape)
+      .fit(train, numEpoch, optimizer, metric, evalData, verbose)
+    trained = true
+    this
+  }
+
+  /** Forward every batch of `data` and concatenate the outputs
+   *  (reference FeedForward.predict, FeedForward.scala:120-180). */
+  def predict(data: DataIter, featureShape: Array[Int])
+      : Array[Array[Float]] = {
+    val m = ensureModule(batchSize +: featureShape)
+    data.reset()
+    val out = scala.collection.mutable.ArrayBuffer[Array[Float]]()
+    while (data.hasNext) out += m.predict(data.next().data)
+    out.toArray
+  }
+
+  def score(data: DataIter, featureShape: Array[Int],
+            metric: EvalMetric = new Accuracy): (String, Double) =
+    ensureModule(batchSize +: featureShape).score(data, metric)
+
+  /** Reference checkpoint layout (FeedForward.save ->
+   *  Model.saveCheckpoint, FeedForward.scala:330-360). */
+  def save(prefix: String, epoch: Int = numEpoch): Unit = {
+    require(module != null, "save before bind/fit")
+    module.saveCheckpoint(prefix, epoch)
+  }
+
+  override def close(): Unit = if (module != null) module.close()
+}
+
+object FeedForward {
+  /** One-call train (the round-3 facade, kept for compatibility). */
+  def fit(symbol: Symbol, train: DataIter, dataShape: Array[Int],
+          numEpoch: Int = 10, learningRate: Float = 0.01f,
+          momentum: Float = 0.0f): Module =
+    new Module(symbol)
+      .bind(dataShape)
+      .initParams()
+      .fit(train, numEpoch, new SGD(learningRate, momentum))
+
+  /** Load a checkpoint as a ready-to-predict estimator (reference
+   *  FeedForward.load, FeedForward.scala:380-420). */
+  def load(prefix: String, epoch: Int, batchSize: Int = 128,
+           dataName: String = "data"): FeedForward = {
+    val sym = Symbol.load(s"$prefix-symbol.json")
+    val loaded = NDArrayIO.load(f"$prefix-$epoch%04d.params")
+    val args = loaded.collect {
+      case (k, v) if k.startsWith("arg:") => k.drop(4) -> v.toArray
+    }
+    val auxs = loaded.collect {
+      case (k, v) if k.startsWith("aux:") => k.drop(4) -> v.toArray
+    }
+    loaded.values.foreach(_.close())
+    new FeedForward(sym, batchSize = batchSize, dataName = dataName,
+                    initArgParams = args, initAuxParams = auxs)
+  }
+
+  def newBuilder(symbol: Symbol): Builder = new Builder(symbol)
+
+  /** Reference FeedForward.Builder (FeedForward.scala:500-666). */
+  class Builder(symbol: Symbol) {
+    private var devType = Context.CPU
+    private var devId = 0
+    private var numEpoch = 10
+    private var optimizer = new SGD(0.01f)
+    private var initializer: Initializer = new Uniform(0.07f)
+    private var batchSize = 128
+    private var dataName = "data"
+    private var labelName = "softmax_label"
+    private var argParams: Map[String, Array[Float]] = null
+    private var auxParams: Map[String, Array[Float]] = null
+
+    def setContext(devType: Int, devId: Int = 0): Builder = {
+      this.devType = devType; this.devId = devId; this
+    }
+    def setNumEpoch(n: Int): Builder = { numEpoch = n; this }
+    def setOptimizer(opt: SGD): Builder = { optimizer = opt; this }
+    def setInitializer(init: Initializer): Builder = {
+      initializer = init; this
+    }
+    def setBatchSize(n: Int): Builder = { batchSize = n; this }
+    def setDataName(n: String): Builder = { dataName = n; this }
+    def setLabelName(n: String): Builder = { labelName = n; this }
+    def setArgParams(p: Map[String, Array[Float]]): Builder = {
+      argParams = p; this
+    }
+    def setAuxParams(p: Map[String, Array[Float]]): Builder = {
+      auxParams = p; this
+    }
+
+    def build(): FeedForward =
+      new FeedForward(symbol, devType, devId, numEpoch, optimizer,
+                      initializer, batchSize, dataName, labelName,
+                      argParams, auxParams)
+  }
+}

@@ -76,6 +76,12 @@ private[mxnet_tpu] class LibInfo {
   // one parse of the container, load record freed native-side
   @native def ndLoad(path: String): Array[AnyRef]
 
+  // Round-4 surface: imperative NDArray functions (NDArrayOpsGen sits
+  // on these; reference LibInfo.mxFuncInvoke / mxListFunctions)
+  @native def funcInvoke(name: String, use: Array[Long],
+                         scalars: Array[Float], out: Long): Unit
+  @native def listFunctions(): Array[String]
+
   // KVStore (distributed training; Spark workers call these)
   @native def kvCreate(kvType: String): Long
   @native def kvRank(handle: Long): Int

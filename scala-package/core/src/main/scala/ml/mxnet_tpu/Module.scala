@@ -163,6 +163,15 @@ class Module(symbol: Symbol, dataName: String = "data",
     this
   }
 
+  /** Push the current argParams/auxParams into the bound executor
+   *  (reference Module.setParams — used by FeedForward.load to
+   *  restore checkpointed weights into a fresh bind). */
+  def setParams(): this.type = {
+    argParams.foreach { case (n, v) => exec.setArg(n, v) }
+    auxParams.foreach { case (n, v) => exec.setAux(n, v) }
+    this
+  }
+
   def fit(train: DataIter, numEpoch: Int, optimizer: SGD,
           metric: EvalMetric = new Accuracy,
           evalData: Option[DataIter] = None,
@@ -249,13 +258,5 @@ object Module {
   }
 }
 
-/** Estimator facade (reference ml.dmlc.mxnet.FeedForward). */
-object FeedForward {
-  def fit(symbol: Symbol, train: DataIter, dataShape: Array[Int],
-          numEpoch: Int = 10, learningRate: Float = 0.01f,
-          momentum: Float = 0.0f): Module =
-    new Module(symbol)
-      .bind(dataShape)
-      .initParams()
-      .fit(train, numEpoch, new SGD(learningRate, momentum))
-}
+// The estimator facade over Module lives in FeedForward.scala
+// (reference ml.dmlc.mxnet.FeedForward, FeedForward.scala:1-666).

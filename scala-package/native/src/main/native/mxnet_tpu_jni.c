@@ -711,10 +711,73 @@ JNIFN(void, ndSave)(JNIEnv *env, jobject obj, jstring jpath,
   if (rc != 0) throw_mx(env);
 }
 
+/* ---- Imperative NDArray functions (NDArrayOpsGen) --------------------- */
+
+/* Invoke a registered fixed-arity function by name; result is written
+ * into `out` (reference FunctionBase.invoke over MXFuncInvoke). */
+JNIFN(void, funcInvoke)(JNIEnv *env, jobject obj, jstring jname,
+                        jlongArray juse, jfloatArray jscalars,
+                        jlong out) {
+  const char *name = (*env)->GetStringUTFChars(env, jname, NULL);
+  FunctionHandle fun = NULL;
+  int rc = MXGetFunction(name, &fun);
+  (*env)->ReleaseStringUTFChars(env, jname, name);
+  if (rc != 0) { throw_mx(env); return; }
+  /* validate arity BEFORE the invoke: MXFuncInvoke indexes the
+   * declared n_use/n_scalar elements, so short caller arrays would be
+   * an out-of-bounds read, not an error */
+  mx_uint want_use = 0, want_scalar = 0, want_mutate = 0;
+  int type_mask = 0;
+  if (MXFuncDescribe(fun, &want_use, &want_scalar, &want_mutate,
+                     &type_mask) != 0) {
+    throw_mx(env);
+    return;
+  }
+  if ((mx_uint)(*env)->GetArrayLength(env, juse) != want_use ||
+      (mx_uint)(*env)->GetArrayLength(env, jscalars) != want_scalar) {
+    jclass cls = (*env)->FindClass(env, "java/lang/RuntimeException");
+    (*env)->ThrowNew(env, cls, "funcInvoke: arity mismatch");
+    return;
+  }
+  jsize nu = (*env)->GetArrayLength(env, juse);
+  jlong *uh = (*env)->GetLongArrayElements(env, juse, NULL);
+  NDArrayHandle *use =
+      (NDArrayHandle *)malloc((nu ? nu : 1) * sizeof(NDArrayHandle));
+  for (jsize i = 0; i < nu; ++i) use[i] = (NDArrayHandle)(intptr_t)uh[i];
+  (*env)->ReleaseLongArrayElements(env, juse, uh, JNI_ABORT);
+  jfloat *sc = (*env)->GetFloatArrayElements(env, jscalars, NULL);
+  NDArrayHandle mutate[1] = {(NDArrayHandle)(intptr_t)out};
+  rc = MXFuncInvoke(fun, use, (const mx_float *)sc, mutate);
+  (*env)->ReleaseFloatArrayElements(env, jscalars, sc, JNI_ABORT);
+  free(use);
+  if (rc != 0) throw_mx(env);
+}
+
+/* Registered imperative function names (MXListFunctions). */
+JNIFN(jobjectArray, listFunctions)(JNIEnv *env, jobject obj) {
+  mx_uint n = 0;
+  FunctionHandle *funs = NULL;
+  if (MXListFunctions(&n, &funs) != 0) { throw_mx(env); return NULL; }
+  jclass strcls = (*env)->FindClass(env, "java/lang/String");
+  jobjectArray out = (*env)->NewObjectArray(env, (jsize)n, strcls, NULL);
+  for (mx_uint i = 0; i < n; ++i) {
+    const char *name = NULL, *desc = NULL;
+    mx_uint na = 0;
+    const char **an = NULL, **at = NULL, **ad = NULL;
+    if (MXFuncGetInfo(funs[i], &name, &desc, &na, &an, &at, &ad) != 0) {
+      throw_mx(env);
+      return NULL;
+    }
+    (*env)->SetObjectArrayElement(env, out, (jsize)i,
+                                  (*env)->NewStringUTF(env, name));
+  }
+  return out;
+}
+
 /* Loads ONCE; element 0 is the String[] of names, element 1 the
- * long[] of handles. The load record is released with
- * MXNDArrayListFree before returning (the handles themselves stay
- * owned by the caller, matching the Python frontend's load). */
+ * long[] of handles. MXNDArrayListFree releases the load record AND
+ * its handles, so each handle is first detached via MXNDArrayDup into
+ * a fresh caller-owned handle (closed with the wrapper's dispose). */
 JNIFN(jobjectArray, ndLoad)(JNIEnv *env, jobject obj, jstring jpath) {
   const char *path = (*env)->GetStringUTFChars(env, jpath, NULL);
   mx_uint n = 0, nn = 0;
@@ -725,12 +788,17 @@ JNIFN(jobjectArray, ndLoad)(JNIEnv *env, jobject obj, jstring jpath) {
   if (rc != 0) { throw_mx(env); return NULL; }
   jobjectArray jnames = strs_to_java(env, nn, names);
   jlong *hs = (jlong *)malloc((n ? n : 1) * sizeof(jlong));
-  for (mx_uint i = 0; i < n; ++i) hs[i] = (jlong)(intptr_t)handles[i];
+  for (mx_uint i = 0; i < n; ++i) {
+    NDArrayHandle dup = NULL;
+    MXNDArrayDup(handles[i], &dup);
+    hs[i] = (jlong)(intptr_t)dup;
+  }
   jlongArray jhandles = (*env)->NewLongArray(env, (jsize)n);
   (*env)->SetLongArrayRegion(env, jhandles, 0, (jsize)n, hs);
   free(hs);
   MXNDArrayListFree(handles, n, names);
-  jobjectArray out = (*env)->NewObjectArray(env, 2, NULL, NULL);
+  jclass objcls = (*env)->FindClass(env, "java/lang/Object");
+  jobjectArray out = (*env)->NewObjectArray(env, 2, objcls, NULL);
   (*env)->SetObjectArrayElement(env, out, 0, (jobject)jnames);
   (*env)->SetObjectArrayElement(env, out, 1, (jobject)jhandles);
   return out;

@@ -178,6 +178,17 @@ int MXNDArrayFree(NDArrayHandle handle) {
   return 0;
 }
 
+int MXNDArrayDup(NDArrayHandle handle, NDArrayHandle *out) {
+  GIL gil;
+  NDArrayRec *src = static_cast<NDArrayRec *>(handle);
+  NDArrayRec *rec = new NDArrayRec();
+  Py_XINCREF(src->arr);
+  rec->arr = src->arr;
+  rec->shape = src->shape;  /* GetShape serves from this cache */
+  *out = rec;
+  return 0;
+}
+
 int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_ndim,
                       const mx_uint **out_pdata) {
   NDArrayRec *rec = static_cast<NDArrayRec *>(handle);

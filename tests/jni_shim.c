@@ -178,6 +178,7 @@ void *jni_shim_make_strs(const char **v, jsize n) {
   return a;
 }
 jsize jni_shim_len(void *a) { return ((arr_t *)a)->len; }
+jlong *jni_shim_longs(void *a) { return (jlong *)((arr_t *)a)->data; }
 jint *jni_shim_ints(void *a) { return (jint *)((arr_t *)a)->data; }
 jfloat *jni_shim_floats(void *a) { return (jfloat *)((arr_t *)a)->data; }
 void **jni_shim_objs(void *a) { return (void **)((arr_t *)a)->data; }

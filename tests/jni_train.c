@@ -29,6 +29,7 @@ void *jni_shim_make_strs(const char **v, jsize n);
 jsize jni_shim_len(void *a);
 jint *jni_shim_ints(void *a);
 jfloat *jni_shim_floats(void *a);
+jlong *jni_shim_longs(void *a);
 void **jni_shim_objs(void *a);
 
 /* glue entry points (jstring == const char* under the shim) */
@@ -77,6 +78,12 @@ void Java_ml_mxnet_1tpu_LibInfo_kvPull(JNIEnv *, jobject, jlong, jint,
 void Java_ml_mxnet_1tpu_LibInfo_kvBarrier(JNIEnv *, jobject, jlong);
 void Java_ml_mxnet_1tpu_LibInfo_kvFree(JNIEnv *, jobject, jlong);
 void Java_ml_mxnet_1tpu_LibInfo_randomSeed(JNIEnv *, jobject, jint);
+void Java_ml_mxnet_1tpu_LibInfo_ndSave(JNIEnv *, jobject, jstring,
+                                       jobjectArray, jlongArray);
+jobjectArray Java_ml_mxnet_1tpu_LibInfo_ndLoad(JNIEnv *, jobject, jstring);
+void Java_ml_mxnet_1tpu_LibInfo_funcInvoke(JNIEnv *, jobject, jstring,
+                                           jlongArray, jfloatArray, jlong);
+jobjectArray Java_ml_mxnet_1tpu_LibInfo_listFunctions(JNIEnv *, jobject);
 
 #define ENV (&jni_shim_env)
 #define BATCH 32
@@ -106,8 +113,105 @@ static jlong apply_op(const char *op, jlong input, const char *name,
   return h;
 }
 
+/* NDArrayIO.save/load round-trip (Scala's loadCheckpoint path): the
+ * loaded handles must be caller-owned — readable AND freeable after
+ * the glue released the load record (ndLoad detaches each via
+ * MXNDArrayDup; the earlier ListFree-only version double-freed here,
+ * which an ASAN build of this driver catches deterministically). */
+static int ndio_mode(const char *path) {
+  jint shape[] = {4};
+  void *jshape = jni_shim_make_ints(shape, 1);
+  jlong a = Java_ml_mxnet_1tpu_LibInfo_ndCreate(ENV, NULL, jshape, 1, 0);
+  jlong b = Java_ml_mxnet_1tpu_LibInfo_ndCreate(ENV, NULL, jshape, 1, 0);
+  jfloat va[] = {1.f, 2.f, 3.f, 4.f}, vb[] = {9.f, 8.f, 7.f, 6.f};
+  Java_ml_mxnet_1tpu_LibInfo_ndSet(ENV, NULL, a,
+                                   jni_shim_make_floats(va, 4));
+  Java_ml_mxnet_1tpu_LibInfo_ndSet(ENV, NULL, b,
+                                   jni_shim_make_floats(vb, 4));
+  const char *names[] = {"arg:w", "aux:mean"};
+  jlong hs[] = {a, b};
+  Java_ml_mxnet_1tpu_LibInfo_ndSave(ENV, NULL, path,
+                                    jni_shim_make_strs(names, 2),
+                                    jni_shim_make_longs(hs, 2));
+  Java_ml_mxnet_1tpu_LibInfo_ndFree(ENV, NULL, a);
+  Java_ml_mxnet_1tpu_LibInfo_ndFree(ENV, NULL, b);
+
+  for (int round = 0; round < 2; ++round) {
+    void *pair = Java_ml_mxnet_1tpu_LibInfo_ndLoad(ENV, NULL, path);
+    void *jnames = jni_shim_objs(pair)[0];
+    void *jhandles = jni_shim_objs(pair)[1];
+    if (jni_shim_len(jnames) != 2 || jni_shim_len(jhandles) != 2) {
+      fprintf(stderr, "ndLoad arity wrong\n");
+      return 1;
+    }
+    const char **lnames = (const char **)jni_shim_objs(jnames);
+    jlong *lhs = jni_shim_longs(jhandles);
+    if (strcmp(lnames[0], "arg:w") || strcmp(lnames[1], "aux:mean")) {
+      fprintf(stderr, "ndLoad names wrong: %s %s\n", lnames[0], lnames[1]);
+      return 1;
+    }
+    for (int i = 0; i < 2; ++i) {
+      void *got = Java_ml_mxnet_1tpu_LibInfo_ndGet(ENV, NULL, lhs[i]);
+      jfloat *g = jni_shim_floats(got);
+      const jfloat *want = i == 0 ? va : vb;
+      for (int d = 0; d < 4; ++d) {
+        if (g[d] != want[d]) {
+          fprintf(stderr, "ndLoad data wrong [%d][%d]=%f\n", i, d, g[d]);
+          return 1;
+        }
+      }
+      Java_ml_mxnet_1tpu_LibInfo_ndFree(ENV, NULL, lhs[i]);
+    }
+  }
+  /* imperative function surface (NDArrayOpsGen path): _plus then
+   * _mul_scalar through funcInvoke; listFunctions must name both */
+  void *fnames = Java_ml_mxnet_1tpu_LibInfo_listFunctions(ENV, NULL);
+  int have_plus = 0, have_muls = 0;
+  for (jsize i = 0; i < jni_shim_len(fnames); ++i) {
+    const char *nm = (const char *)jni_shim_objs(fnames)[i];
+    if (!strcmp(nm, "_plus")) have_plus = 1;
+    if (!strcmp(nm, "_mul_scalar")) have_muls = 1;
+  }
+  if (!have_plus || !have_muls) {
+    fprintf(stderr, "listFunctions missing _plus/_mul_scalar\n");
+    return 1;
+  }
+  jlong fa = Java_ml_mxnet_1tpu_LibInfo_ndCreate(ENV, NULL, jshape, 1, 0);
+  jlong fb = Java_ml_mxnet_1tpu_LibInfo_ndCreate(ENV, NULL, jshape, 1, 0);
+  jlong fo = Java_ml_mxnet_1tpu_LibInfo_ndCreate(ENV, NULL, jshape, 1, 0);
+  Java_ml_mxnet_1tpu_LibInfo_ndSet(ENV, NULL, fa,
+                                   jni_shim_make_floats(va, 4));
+  Java_ml_mxnet_1tpu_LibInfo_ndSet(ENV, NULL, fb,
+                                   jni_shim_make_floats(vb, 4));
+  jlong use2[] = {fa, fb};
+  jfloat two[] = {2.f};
+  Java_ml_mxnet_1tpu_LibInfo_funcInvoke(
+      ENV, NULL, "_plus", jni_shim_make_longs(use2, 2),
+      jni_shim_make_floats(two, 0), fo);
+  jlong use1[] = {fo};
+  Java_ml_mxnet_1tpu_LibInfo_funcInvoke(
+      ENV, NULL, "_mul_scalar", jni_shim_make_longs(use1, 1),
+      jni_shim_make_floats(two, 1), fo);
+  void *fres = Java_ml_mxnet_1tpu_LibInfo_ndGet(ENV, NULL, fo);
+  for (int d = 0; d < 4; ++d) {
+    jfloat want = 2.f * (va[d] + vb[d]);
+    if (jni_shim_floats(fres)[d] != want) {
+      fprintf(stderr, "funcInvoke wrong [%d]=%f want %f\n", d,
+              jni_shim_floats(fres)[d], want);
+      return 1;
+    }
+  }
+  Java_ml_mxnet_1tpu_LibInfo_ndFree(ENV, NULL, fa);
+  Java_ml_mxnet_1tpu_LibInfo_ndFree(ENV, NULL, fb);
+  Java_ml_mxnet_1tpu_LibInfo_ndFree(ENV, NULL, fo);
+  printf("ndio_ok\n");
+  return 0;
+}
+
 int main(int argc, char **argv) {
   int dist = argc > 1 && strcmp(argv[1], "dist") == 0;
+  if (argc > 2 && strcmp(argv[1], "ndio") == 0)
+    return ndio_mode(argv[2]);
 
   /* dist mode: the collective group must form BEFORE anything touches
    * the XLA backend (jax.distributed contract) — same ordering the

@@ -1,0 +1,318 @@
+/* Executes the exact .Call sequence the R RNN tier drives
+ * (R-package/R/rnn_model.R mx.rnn.create / mx.rnn.infer.model /
+ * mx.rnn.step, behind mx.lstm / mx.lstm.inference / mx.lstm.forward —
+ * reference R-package/R/lstm.R:152-361), through the real mxnet_glue.c
+ * compiled against tests/r_shim.c. No R interpreter exists in this
+ * image, so this is the execution gate for the R RNN tier's native
+ * path.
+ *
+ * Two phases:
+ *   train      mx.rnn.train.symbol graph (Embedding -> transpose ->
+ *              fused RNN(lstm) -> Reshape -> FC -> SoftmaxOutput with
+ *              transposed flat label), trained to next-token accuracy
+ *              >= 0.9 on a deterministic cyclic-sequence task with the
+ *              optimizer.R SGD-momentum update.
+ *   inference  mx.rnn.inference.symbol graph (state_outputs=TRUE, the
+ *              new mxr_sym_get_output / mxr_sym_group glue), seq.len=1
+ *              executor fed the TRAINED weights, stepped token-by-token
+ *              carrying h/c state exactly like mx.rnn.step — gating the
+ *              same accuracy.
+ *
+ * Prints "train_acc=<v> infer_acc=<v>"; the pytest wrapper gates both.
+ */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "Rinternals.h"
+
+SEXP mxr_sym_variable(SEXP name);
+SEXP mxr_sym_create_atomic(SEXP opname, SEXP keys, SEXP vals);
+SEXP mxr_sym_compose(SEXP ptr, SEXP name, SEXP keys, SEXP args);
+SEXP mxr_sym_infer_shape(SEXP ptr, SEXP keys, SEXP ind, SEXP data);
+SEXP mxr_sym_list_arguments(SEXP ptr);
+SEXP mxr_sym_list_outputs(SEXP ptr);
+SEXP mxr_sym_get_output(SEXP ptr, SEXP index);
+SEXP mxr_sym_group(SEXP handles);
+SEXP mxr_exec_simple_bind(SEXP sym, SEXP dev_type, SEXP dev_id, SEXP keys,
+                          SEXP ind, SEXP data, SEXP for_training);
+SEXP mxr_exec_set_arg(SEXP ptr, SEXP name, SEXP values);
+SEXP mxr_exec_forward(SEXP ptr, SEXP is_train);
+SEXP mxr_exec_backward(SEXP ptr);
+SEXP mxr_exec_get_output(SEXP ptr, SEXP index, SEXP size);
+SEXP mxr_exec_get_grad(SEXP ptr, SEXP name, SEXP size);
+SEXP mxr_random_seed(SEXP seed);
+
+#define SEQLEN 8
+#define BATCH 16
+#define VOCAB 8
+#define NEMBED 8
+#define NHID 16
+#define NLAYER 1
+#define NSAMPLE 64
+#define ROUNDS 60
+#define MAXARGS 16
+
+static SEXP ints(int n, const int *v) {
+  SEXP s = Rf_allocVector(INTSXP, n);
+  for (int i = 0; i < n; ++i) INTEGER(s)[i] = v[i];
+  return s;
+}
+static SEXP int1(int v) { return ints(1, &v); }
+
+static SEXP reals(R_xlen_t n, const double *v) {
+  SEXP s = Rf_allocVector(REALSXP, n);
+  for (R_xlen_t i = 0; i < n; ++i) REAL(s)[i] = v[i];
+  return s;
+}
+
+static SEXP strs(int n, const char **v) {
+  SEXP s = Rf_allocVector(STRSXP, n);
+  for (int i = 0; i < n; ++i) SET_STRING_ELT(s, i, Rf_mkChar(v[i]));
+  return s;
+}
+
+/* mx.symbol.create(op, <positional data>, params..., name=) */
+static SEXP op1(const char *op, SEXP input, const char *name,
+                const char **pk, const char **pv, int np) {
+  SEXP h = mxr_sym_create_atomic(Rf_mkString(op), strs(np, pk),
+                                 strs(np, pv));
+  const char *inkeys[] = {"data"};
+  SEXP args = Rf_allocVector(VECSXP, 1);
+  SET_VECTOR_ELT(args, 0, input);
+  mxr_sym_compose(h, Rf_mkString(name), strs(1, inkeys), args);
+  return h;
+}
+
+/* mx.symbol.create("SoftmaxOutput", data=, label=, name=) */
+static SEXP softmax_with_label(SEXP data, SEXP label, const char *name) {
+  SEXP h = mxr_sym_create_atomic(Rf_mkString("SoftmaxOutput"),
+                                 strs(0, NULL), strs(0, NULL));
+  const char *inkeys[] = {"data", "label"};
+  SEXP args = Rf_allocVector(VECSXP, 2);
+  SET_VECTOR_ELT(args, 0, data);
+  SET_VECTOR_ELT(args, 1, label);
+  mxr_sym_compose(h, Rf_mkString(name), strs(2, inkeys), args);
+  return h;
+}
+
+static double frand(unsigned *seed) {
+  *seed ^= *seed << 13;
+  *seed ^= *seed >> 17;
+  *seed ^= *seed << 5;
+  return (double)(*seed % 1000003) / 1000003.0;
+}
+
+/* Embedding -> time-major transpose -> fused RNN (rnn_model.R
+ * mx.rnn.train.symbol / mx.rnn.inference.symbol share this trunk) */
+static SEXP rnn_trunk(SEXP data, int state_outputs) {
+  const char *k_emb[] = {"input_dim", "output_dim"};
+  char vocab_s[8], embed_s[8];
+  snprintf(vocab_s, sizeof vocab_s, "%d", VOCAB);
+  snprintf(embed_s, sizeof embed_s, "%d", NEMBED);
+  const char *v_emb[] = {vocab_s, embed_s};
+  SEXP embed = op1("Embedding", data, "embed", k_emb, v_emb, 2);
+  const char *k_axes[] = {"axes"};
+  const char *v_axes[] = {"(1, 0, 2)"};
+  SEXP tm = op1("transpose", embed, "tm", k_axes, v_axes, 1);
+  const char *k_rnn[] = {"state_size", "num_layers", "mode",
+                         "state_outputs"};
+  char hid_s[8], lay_s[8];
+  snprintf(hid_s, sizeof hid_s, "%d", NHID);
+  snprintf(lay_s, sizeof lay_s, "%d", NLAYER);
+  const char *v_rnn[] = {hid_s, lay_s, "lstm",
+                         state_outputs ? "True" : "False"};
+  return op1("RNN", tm, "rnn", k_rnn, v_rnn, 4);
+}
+
+static SEXP head_over(SEXP hidden_flat_input, const char *reshape_name) {
+  const char *k_shape[] = {"shape"};
+  char shp[24];
+  snprintf(shp, sizeof shp, "(-1, %d)", NHID);
+  const char *v_shape[] = {shp};
+  SEXP flat = op1("Reshape", hidden_flat_input, reshape_name,
+                  k_shape, v_shape, 1);
+  const char *k_hid[] = {"num_hidden"};
+  char vocab_s[8];
+  snprintf(vocab_s, sizeof vocab_s, "%d", VOCAB);
+  const char *v_hid[] = {vocab_s};
+  return op1("FullyConnected", flat, "cls", k_hid, v_hid, 1);
+}
+
+int main(void) {
+  mxr_random_seed(int1(11));
+
+  /* ---- training symbol (mx.rnn.train.symbol) ---- */
+  SEXP data = mxr_sym_variable(Rf_mkString("data"));
+  SEXP label = mxr_sym_variable(Rf_mkString("label"));
+  SEXP rnn = rnn_trunk(data, 0);
+  SEXP fc = head_over(rnn, "flat");
+  const char *k_axes2[] = {"axes"};
+  const char *v_axes2[] = {"(1, 0)"};
+  SEXP lab_t = op1("transpose", label, "lab_t", k_axes2, v_axes2, 1);
+  const char *k_shape1[] = {"shape"};
+  const char *v_shape1[] = {"-1"};
+  SEXP lab = op1("Reshape", lab_t, "lab", k_shape1, v_shape1, 1);
+  SEXP net = softmax_with_label(fc, lab, "sm");
+
+  /* ---- infer shapes (C-order; the R side revs before this call) --- */
+  const char *skeys[] = {"data", "label", "rnn_state", "rnn_state_cell"};
+  int ind[] = {0, 2, 4, 7, 10};
+  int sdata[] = {BATCH, SEQLEN, BATCH, SEQLEN,
+                 NLAYER, BATCH, NHID, NLAYER, BATCH, NHID};
+  SEXP shapes = mxr_sym_infer_shape(net, strs(4, skeys), ints(5, ind),
+                                    ints(10, sdata));
+  SEXP arg_shapes = VECTOR_ELT(shapes, 0);
+  SEXP arg_names = mxr_sym_list_arguments(net);
+  int nargs = Rf_length(arg_names);
+  if (nargs > MAXARGS) { fprintf(stderr, "too many args\n"); return 1; }
+
+  SEXP exec = mxr_exec_simple_bind(net, int1(1), int1(0), strs(4, skeys),
+                                   ints(5, ind), ints(10, sdata),
+                                   int1(1));
+
+  /* ---- init: uniform weights, zero states/bias (mx.init.uniform) -- */
+  unsigned seed = 99;
+  double *params[MAXARGS];
+  double *moms[MAXARGS];
+  long psize[MAXARGS];
+  for (int i = 0; i < nargs; ++i) {
+    const char *nm = CHAR(STRING_ELT(arg_names, i));
+    SEXP shp = VECTOR_ELT(arg_shapes, i);
+    long n = 1;
+    for (int j = 0; j < Rf_length(shp); ++j) n *= INTEGER(shp)[j];
+    psize[i] = n;
+    params[i] = calloc(n, sizeof(double));
+    moms[i] = calloc(n, sizeof(double));
+    int is_param = strstr(nm, "weight") || strstr(nm, "bias") ||
+                   strstr(nm, "parameters");
+    if (is_param && !strstr(nm, "bias"))
+      for (long j = 0; j < n; ++j) params[i][j] = 0.4 * (frand(&seed) - 0.5);
+    if (strcmp(nm, "data") && strcmp(nm, "label"))
+      mxr_exec_set_arg(exec, Rf_mkString(nm), reals(n, params[i]));
+  }
+
+  /* ---- deterministic cyclic sequences: next = (tok + step) % V ---- */
+  static double X[NSAMPLE][SEQLEN];   /* C-order (batch, seq) per batch */
+  static double Y[NSAMPLE][SEQLEN];
+  for (int s = 0; s < NSAMPLE; ++s) {
+    int start = s % VOCAB;
+    int step = 1 + (s / VOCAB) % 2;   /* two interleaved rules */
+    for (int t = 0; t < SEQLEN; ++t) {
+      X[s][t] = (start + t * step) % VOCAB;
+      Y[s][t] = (start + (t + 1) * step) % VOCAB;
+    }
+  }
+
+  const double lr = 0.25, momentum = 0.9;
+  double train_acc = 0.0;
+  for (int round = 0; round < ROUNDS; ++round) {
+    int correct = 0, seen = 0;
+    for (int lo = 0; lo + BATCH <= NSAMPLE; lo += BATCH) {
+      mxr_exec_set_arg(exec, Rf_mkString("data"),
+                       reals(BATCH * SEQLEN, &X[lo][0]));
+      mxr_exec_set_arg(exec, Rf_mkString("label"),
+                       reals(BATCH * SEQLEN, &Y[lo][0]));
+      mxr_exec_forward(exec, int1(1));
+      mxr_exec_backward(exec);
+      for (int i = 0; i < nargs; ++i) {
+        const char *nm = CHAR(STRING_ELT(arg_names, i));
+        if (!(strstr(nm, "weight") || strstr(nm, "bias") ||
+              strstr(nm, "parameters")))
+          continue;                      /* mx.rnn.is.param.name */
+        SEXP g = mxr_exec_get_grad(exec, Rf_mkString(nm),
+                                   int1((int)psize[i]));
+        for (long j = 0; j < psize[i]; ++j) {
+          moms[i][j] = momentum * moms[i][j]
+                       - (lr / BATCH) * REAL(g)[j];
+          params[i][j] += moms[i][j];
+        }
+        mxr_exec_set_arg(exec, Rf_mkString(nm),
+                         reals(psize[i], params[i]));
+      }
+      /* output rows are seq-major: row r = t*BATCH + b */
+      SEXP out = mxr_exec_get_output(exec, int1(0),
+                                     int1(SEQLEN * BATCH * VOCAB));
+      for (int t = 0; t < SEQLEN; ++t)
+        for (int b = 0; b < BATCH; ++b) {
+          const double *row = REAL(out) + (t * BATCH + b) * VOCAB;
+          int guess = 0;
+          for (int c = 1; c < VOCAB; ++c)
+            if (row[c] > row[guess]) guess = c;
+          correct += (guess == (int)Y[lo + b][t]);
+          seen += 1;
+        }
+    }
+    train_acc = (double)correct / seen;
+  }
+
+  /* ---- inference symbol (mx.rnn.inference.symbol): state_outputs,
+   * output selection + group through the NEW glue ---- */
+  SEXP data_i = mxr_sym_variable(Rf_mkString("data"));
+  SEXP rnn_i = rnn_trunk(data_i, 1);
+  int nouts = Rf_length(mxr_sym_list_outputs(rnn_i));
+  if (nouts != 3) { fprintf(stderr, "state_outputs=3 expected\n"); return 1; }
+  SEXP fc_i = head_over(mxr_sym_get_output(rnn_i, int1(0)), "flat");
+  SEXP sm_i = op1("SoftmaxOutput", fc_i, "sm", NULL, NULL, 0);
+  SEXP group_members = Rf_allocVector(VECSXP, 3);
+  SET_VECTOR_ELT(group_members, 0, sm_i);
+  SET_VECTOR_ELT(group_members, 1,
+                 op1("BlockGrad", mxr_sym_get_output(rnn_i, int1(1)),
+                     "bg_h", NULL, NULL, 0));
+  SET_VECTOR_ELT(group_members, 2,
+                 op1("BlockGrad", mxr_sym_get_output(rnn_i, int1(2)),
+                     "bg_c", NULL, NULL, 0));
+  SEXP inet = mxr_sym_group(group_members);
+
+  const char *ikeys[] = {"data", "rnn_state", "rnn_state_cell"};
+  int iind[] = {0, 2, 5, 8};
+  int isdata[] = {1, 1, NLAYER, 1, NHID, NLAYER, 1, NHID};
+  SEXP iexec = mxr_exec_simple_bind(inet, int1(1), int1(0),
+                                    strs(3, ikeys), ints(4, iind),
+                                    ints(8, isdata), int1(0));
+
+  /* trained weights carry over by NAME (mx.rnn.infer.model) */
+  for (int i = 0; i < nargs; ++i) {
+    const char *nm = CHAR(STRING_ELT(arg_names, i));
+    if (strstr(nm, "weight") || strstr(nm, "bias") ||
+        strstr(nm, "parameters"))
+      mxr_exec_set_arg(iexec, Rf_mkString(nm),
+                       reals(psize[i], params[i]));
+  }
+
+  int state_n = NLAYER * 1 * NHID;
+  double *h_state = calloc(state_n, sizeof(double));
+  double *c_state = calloc(state_n, sizeof(double));
+  int icorrect = 0, iseen = 0;
+  for (int s = 0; s < VOCAB * 2; ++s) {   /* one walk per rule/start */
+    int start = s % VOCAB, step = 1 + (s / VOCAB) % 2;
+    memset(h_state, 0, state_n * sizeof(double));   /* new.seq=TRUE */
+    memset(c_state, 0, state_n * sizeof(double));
+    for (int t = 0; t < SEQLEN; ++t) {
+      double tok = (start + t * step) % VOCAB;
+      int want = (start + (t + 1) * step) % VOCAB;
+      mxr_exec_set_arg(iexec, Rf_mkString("data"), reals(1, &tok));
+      mxr_exec_set_arg(iexec, Rf_mkString("rnn_state"),
+                       reals(state_n, h_state));
+      mxr_exec_set_arg(iexec, Rf_mkString("rnn_state_cell"),
+                       reals(state_n, c_state));
+      mxr_exec_forward(iexec, int1(0));
+      SEXP prob = mxr_exec_get_output(iexec, int1(0), int1(VOCAB));
+      SEXP h_out = mxr_exec_get_output(iexec, int1(1), int1(state_n));
+      SEXP c_out = mxr_exec_get_output(iexec, int1(2), int1(state_n));
+      memcpy(h_state, REAL(h_out), state_n * sizeof(double));
+      memcpy(c_state, REAL(c_out), state_n * sizeof(double));
+      if (t >= 1) {             /* first step has no rule context yet */
+        int guess = 0;
+        for (int c = 1; c < VOCAB; ++c)
+          if (REAL(prob)[c] > REAL(prob)[guess]) guess = c;
+        icorrect += (guess == want);
+        iseen += 1;
+      }
+    }
+  }
+  double infer_acc = (double)icorrect / iseen;
+  printf("train_acc=%f infer_acc=%f\n", train_acc, infer_acc);
+  return (train_acc >= 0.9 && infer_acc >= 0.9) ? 0 : 1;
+}

@@ -67,6 +67,7 @@ def test_ssh_launcher_loopback(tmp_path):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     env["PYTHONPATH"] = REPO
+    env["MXTPU_PS_SECRET"] = "hunter2-cluster-token"
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "tools", "launch.py"),
          "-n", "2", "--launcher", "ssh", "-H", str(hostfile),
@@ -99,3 +100,13 @@ def test_ssh_launcher_loopback(tmp_path):
     ranks = sorted(int(l.split("MXTPU_WORKER_RANK=")[1].split()[0])
                    for l in lines)
     assert ranks == [0, 1]
+
+    # the PS shared secret must never ride the (world-readable) ssh
+    # argv: it is staged as a 0600 file in the job dir and only its
+    # PATH is forwarded (launch.py round-4 hardening)
+    for l in lines:
+        assert "hunter2-cluster-token" not in l, "secret leaked to argv"
+        assert "MXTPU_PS_SECRET_FILE=" in l.split("\t")[1]
+    secret_file = workdir / ".mxtpu_ps_secret"
+    assert secret_file.read_text() == "hunter2-cluster-token"
+    assert (secret_file.stat().st_mode & 0o777) == 0o600

@@ -108,19 +108,122 @@ def test_glue_only_uses_declared_abi_symbols():
     assert not missing, "glue calls undeclared ABI symbols: %s" % missing
 
 
-def test_scala_sources_structurally_balanced():
-    """Cheap structural gate: braces balance in every .scala file and
-    each class/object named in a file exists exactly once."""
+def _strip_scala(src):
+    """Remove string literals (incl. interpolated/triple-quoted) and
+    comments so delimiter analysis sees only code."""
+    src = re.sub(r'"""(?:.|\n)*?"""', '""', src)
+    src = re.sub(r'"(?:[^"\\\n]|\\.)*"', '""', src)
+    src = re.sub(r"'(?:[^'\\]|\\.)'", "' '", src)  # char literals
+    src = re.sub(r"//[^\n]*", "", src)
+    src = re.sub(r"/\*(?:.|\n)*?\*/", "", src)
+    return src
+
+
+def _scala_files():
     for root, _, files in os.walk(SPKG):
         for f in files:
-            if not f.endswith(".scala"):
-                continue
-            src = open(os.path.join(root, f)).read()
-            # strip string literals and comments crudely
-            stripped = re.sub(r'"(?:[^"\\]|\\.)*"', '""', src)
-            stripped = re.sub(r"//[^\n]*", "", stripped)
-            stripped = re.sub(r"/\*.*?\*/", "", stripped, flags=re.S)
-            assert stripped.count("{") == stripped.count("}"), f
+            if f.endswith(".scala"):
+                yield os.path.join(root, f)
+
+
+def test_scala_sources_structurally_balanced():
+    """Structural gate (no scalac in image): delimiters must nest as a
+    well-formed stack — not just equal counts — and every `def` must
+    carry balanced parameter parens and a body (`=` or `{`). Catches
+    truncation, mismatched nesting, and cut-off signatures that a
+    plain brace count misses."""
+    pairs = {"(": ")", "[": "]", "{": "}"}
+    closers = {v: k for k, v in pairs.items()}
+    for path in _scala_files():
+        stripped = _strip_scala(open(path).read())
+        stack = []
+        for ch in stripped:
+            if ch in pairs:
+                stack.append(ch)
+            elif ch in closers:
+                assert stack and stack[-1] == closers[ch], \
+                    "%s: mismatched '%s'" % (path, ch)
+                stack.pop()
+        assert not stack, "%s: unclosed %s" % (path, stack[-5:])
+        # every def has balanced parens in its signature and a body
+        for m in re.finditer(r"\bdef\s+([\w$]+|`[^`]+`)", stripped):
+            i = m.end()
+            while i < len(stripped) and stripped[i] in " \t\n":
+                i += 1
+            if i < len(stripped) and stripped[i] in "([":
+                depth = 0
+                while i < len(stripped):
+                    if stripped[i] in "([":
+                        depth += 1
+                    elif stripped[i] in ")]":
+                        depth -= 1
+                        if depth == 0:
+                            i += 1
+                            # skip further param lists / type params
+                            while i < len(stripped) and \
+                                    stripped[i] in " \t\n":
+                                i += 1
+                            if i < len(stripped) and stripped[i] in "([":
+                                depth = 0
+                                continue
+                            break
+                    i += 1
+                assert depth == 0, "%s: unbalanced signature for %s" \
+                    % (path, m.group(1))
+            rest = stripped[i:i + 200].lstrip()
+            assert rest.startswith(("=", ":", "{")) or rest == "", \
+                "%s: def %s has no type/body" % (path, m.group(1))
+
+
+def test_generated_scala_ops_in_sync():
+    """Drift gate: the committed SymbolOpsGen.scala / NDArrayOpsGen.scala
+    must match what tools/gen_scala_ops.py emits from the LIVE
+    registries (the reference regenerated its typed surface every
+    build; here the generated source is committed and this test is the
+    build step)."""
+    import sys
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "gen_scala_ops.py"),
+         "--check"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr[-1000:])
+
+
+def test_generated_surface_covers_registry():
+    """Every public registered op has a typed creator; every imperative
+    function has a typed NDArray method (reference parity axis: its
+    hand-written Symbol.scala/NDArray.scala covered the full registry
+    of its day)."""
+    gen = open(os.path.join(
+        SPKG, "core", "src", "main", "scala", "ml", "mxnet_tpu",
+        "SymbolOpsGen.scala")).read()
+    ndgen = open(os.path.join(
+        SPKG, "core", "src", "main", "scala", "ml", "mxnet_tpu",
+        "NDArrayOpsGen.scala")).read()
+    import sys
+    sys.path.insert(0, REPO)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from mxnet_tpu.ops import registry
+    seen = set()
+    for key in registry.OP_REGISTRY.list_names():
+        cls = registry.OP_REGISTRY.get(key)
+        op = getattr(cls, "op_name", key)
+        if op.startswith("_") or op in seen:
+            continue
+        seen.add(op)
+        assert re.search(r"\bdef %s\(" % re.escape(op), gen), \
+            "SymbolOpsGen missing %s" % op
+    from mxnet_tpu import capi_helpers as H
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from gen_scala_ops import scala_ident   # the one true name mapping
+    for fn in H.list_functions():
+        ident = scala_ident(fn.lstrip("_"))
+        assert re.search(r"\bdef %s\(" % re.escape(ident), ndgen), \
+            "NDArrayOpsGen missing %s" % fn
 
 
 def test_spark_module_covers_reference_surface():
@@ -178,6 +281,43 @@ def test_jni_module_training_executes(tmp_path):
     assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
     acc = float(r.stdout.split("final_acc=")[1].split()[0])
     assert acc >= 0.9, r.stdout
+
+
+def test_jni_ndarray_io_handles_are_caller_owned(tmp_path):
+    """NDArrayIO.save/load (Scala loadCheckpoint path): ndLoad must
+    return handles the caller can read AND free after the glue drops
+    the load record (advisor r3 high finding: the ListFree-only version
+    returned dangling handles). Built with AddressSanitizer when
+    available so the old double-free aborts instead of passing
+    silently."""
+    if shutil.which("gcc") is None or shutil.which("make") is None:
+        pytest.skip("no gcc toolchain")
+    r = subprocess.run(["make", "-C", REPO, "predict"],
+                       capture_output=True, text=True)
+    lib = os.path.join(REPO, "mxnet_tpu", "_native", "libmxtpu_predict.so")
+    assert r.returncode == 0 and os.path.exists(lib), r.stderr[-800:]
+    with open(os.path.join(tmp_path, "jni.h"), "w") as f:
+        f.write(JNI_STUB)
+    srcs = [os.path.join(REPO, "tests", "jni_shim.c"),
+            os.path.join(REPO, "tests", "jni_train.c"), JNI_C]
+    common = ["-I", str(tmp_path), "-I", os.path.join(REPO, "include"),
+              "-L", os.path.dirname(lib), "-lmxtpu_predict",
+              "-Wl,-rpath," + os.path.dirname(lib), "-lm"]
+    exe = os.path.join(tmp_path, "jni_ndio")
+    asan = subprocess.run(
+        ["gcc", "-fsanitize=address", *srcs, "-o", exe, *common],
+        capture_output=True, text=True)
+    if asan.returncode != 0:  # no ASAN runtime in image: plain build
+        r = subprocess.run(["gcc", *srcs, "-o", exe, *common],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr[-2000:]
+    env = _driver_env()
+    env["ASAN_OPTIONS"] = "detect_leaks=0"  # embedded CPython "leaks"
+    out = subprocess.run(
+        [exe, "ndio", os.path.join(tmp_path, "params.bin")],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert out.returncode == 0, (out.stdout, out.stderr[-3000:])
+    assert "ndio_ok" in out.stdout
 
 
 def test_jni_spark_dist_training_two_workers(tmp_path):

@@ -20,7 +20,8 @@ import sys
 # same way, tools/launch.py:32-79 -> dmlc_tracker/ssh.py)
 FORWARD_ENV = ["PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS",
                "MXNET_ENGINE_TYPE", "MXNET_COMPUTE_DTYPE",
-               "MXNET_BACKWARD_DO_MIRROR", "LD_LIBRARY_PATH"]
+               "MXNET_BACKWARD_DO_MIRROR", "LD_LIBRARY_PATH",
+               "MXTPU_PS_PORT", "MXTPU_PS_SECRET"]
 
 
 def worker_env(args, rank):
@@ -124,13 +125,29 @@ def main():
         if not hosts:
             parser.error("hostfile %s lists no hosts" % args.hostfile)
         cwd = args.sync_dir or os.getcwd()
+        # the PS shared secret must NOT ride the ssh command line (argv
+        # is world-readable in /proc on every worker host): stage it as
+        # a 0600 file in the job dir (shared, e.g. NFS — already this
+        # launcher's assumption) and forward only the file's PATH;
+        # parallel/ps.py reads MXTPU_PS_SECRET_FILE as a fallback
+        secret_file = None
+        if os.environ.get("MXTPU_PS_SECRET"):
+            secret_file = os.path.join(cwd, ".mxtpu_ps_secret")
+            fd = os.open(secret_file,
+                         os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+            with os.fdopen(fd, "w") as f:
+                f.write(os.environ["MXTPU_PS_SECRET"])
         procs = []
         for rank in range(args.num_workers):
             host = hosts[rank % len(hosts)]       # round-robin
             env = worker_env(args, rank)
             for k in FORWARD_ENV:                 # propagate local env
+                if k == "MXTPU_PS_SECRET":
+                    continue                      # staged as a file
                 if os.environ.get(k) is not None:
                     env[k] = os.environ[k]
+            if secret_file is not None:
+                env["MXTPU_PS_SECRET_FILE"] = secret_file
             env_str = " ".join("%s=%s" % (k, shlex.quote(v))
                                for k, v in sorted(env.items()))
             remote = "cd %s && env %s %s" % (
