@@ -1,0 +1,59 @@
+# Char-RNN language model in R (reference vignette
+# R-package/vignettes/CharRnnModel.Rmd): train mx.lstm on character
+# sequences, then sample text with the stateful single-step inference
+# model. Runs on synthetic text so it works offline.
+library(mxnet.tpu)
+
+# ---- toy corpus: repeated alphabet phrases -------------------------
+corpus <- paste(rep("the quick brown fox jumps over the lazy dog ", 40),
+                collapse = "")
+chars <- sort(unique(strsplit(corpus, "")[[1]]))
+vocab <- length(chars)
+char.to.id <- stats::setNames(seq_along(chars) - 1L, chars)
+
+seq.len <- 16
+batch.size <- 8
+ids <- char.to.id[strsplit(corpus, "")[[1]]]
+n.seq <- (length(ids) - 1) %/% seq.len
+X <- matrix(0L, seq.len, n.seq)
+Y <- matrix(0L, seq.len, n.seq)
+for (s in seq_len(n.seq)) {
+  lo <- (s - 1) * seq.len + 1
+  X[, s] <- ids[lo:(lo + seq.len - 1)]
+  Y[, s] <- ids[(lo + 1):(lo + seq.len)]     # next-char targets
+}
+
+# ---- train (reference mx.lstm call shape, CharRnnModel.Rmd) --------
+model <- mx.lstm(list(data = X, label = Y),
+                 num.lstm.layer = 1,
+                 seq.len = seq.len,
+                 num.hidden = 32,
+                 num.embed = 16,
+                 num.label = vocab,
+                 batch.size = batch.size,
+                 input.size = vocab,
+                 num.round = 5,
+                 optimizer = "sgd",
+                 learning.rate = 0.2)
+
+# ---- sample with the stateful inference model ----------------------
+infer <- mx.lstm.inference(num.lstm.layer = 1,
+                           input.size = vocab,
+                           num.hidden = 32,
+                           num.embed = 16,
+                           num.label = vocab,
+                           batch.size = 1,
+                           arg.params = model$arg.params)
+seed.char <- "t"
+cur <- char.to.id[[seed.char]]
+out <- seed.char
+new.seq <- TRUE
+for (i in 1:40) {
+  step <- mx.lstm.forward(infer, cur, new.seq = new.seq)
+  infer <- step$model
+  new.seq <- FALSE
+  probs <- as.numeric(step$prob)
+  cur <- which.max(probs) - 1L               # greedy decode
+  out <- paste0(out, chars[cur + 1L])
+}
+cat("sampled:", out, "\n")

@@ -11,6 +11,13 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 import numpy as np
 
+# honor JAX_PLATFORMS (the site hook overrides the env at import)
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms",
+                      os.environ["JAX_PLATFORMS"])
+
 import mxnet_tpu as mx
 from mxnet_tpu import operator as mop
 from mxnet_tpu import symbol as sym

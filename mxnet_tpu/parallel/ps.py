@@ -44,7 +44,16 @@ from ..base import MXNetError
 _LEN = struct.Struct("!Q")
 
 
+_SECRET_CACHE = False      # False = unresolved; None/bytes = resolved
+
+
 def _secret():
+    # resolved once per process (the value is immutable for the job's
+    # lifetime): _send_msg/_recv_msg call this on EVERY frame and the
+    # file branch would otherwise re-read the secret file per push/pull
+    global _SECRET_CACHE
+    if _SECRET_CACHE is not False:
+        return _SECRET_CACHE
     s = os.environ.get("MXTPU_PS_SECRET", "")
     if not s:
         # ssh-launched workers get the secret as a 0600 file in the
@@ -57,7 +66,8 @@ def _secret():
                     s = f.read().strip()
             except OSError:
                 s = ""
-    return s.encode() if s else None
+    _SECRET_CACHE = s.encode() if s else None
+    return _SECRET_CACHE
 
 
 def _send_msg(sock, obj):

@@ -1,0 +1,89 @@
+package ml.mxnet_tpu.examples
+
+import ml.mxnet_tpu._
+
+/**
+ * Typed-API training walkthrough (reference
+ * scala-package/examples/.../TrainMnist.scala): builds the LeNet-ish
+ * net through the GENERATED typed creators (SymbolOpsGen), trains with
+ * the FeedForward estimator, checkpoints, reloads, and runs an
+ * imperative NDArray op through NDArrayOpsGen — the round-4 surface in
+ * one program.
+ *
+ * Run on a host with the JNI library built:
+ *   scala -cp core.jar ml.mxnet_tpu.examples.TrainMnist <data>
+ */
+object TrainMnist {
+  def buildNet(numClasses: Int): Symbol = {
+    val data = Symbol.Variable("data")
+    val c1 = SymbolOpsGen.Convolution(data, Array(3, 3), 8, name = "c1")
+    val a1 = SymbolOpsGen.Activation(c1, "relu", name = "a1")
+    val p1 = SymbolOpsGen.Pooling(a1, name = "p1", kernel = Array(2, 2),
+                                  stride = Array(2, 2))
+    val fl = SymbolOpsGen.Flatten(p1, name = "fl")
+    val f1 = SymbolOpsGen.FullyConnected(fl, numClasses, name = "fc1")
+    SymbolOpsGen.SoftmaxOutput(f1, name = "softmax")
+  }
+
+  def main(args: Array[String]): Unit = {
+    val numClasses = 10
+    val batch = 32
+    val featureShape = Array(1, 28, 28)
+
+    val (trainData, trainLabel) = Mnist.load(args.headOption.getOrElse("."))
+    val iter = new NDArrayIter(trainData, trainLabel, batch,
+                               shuffle = true)
+
+    val estimator = FeedForward.newBuilder(buildNet(numClasses))
+      .setNumEpoch(5)
+      .setBatchSize(batch)
+      .setOptimizer(new SGD(learningRate = 0.1f, momentum = 0.9f))
+      .build()
+    estimator.fit(iter, featureShape)
+    estimator.save("mnist-lenet")
+    estimator.close()
+
+    // reload and score (checkpoint interop with Python/R: same layout)
+    val restored = FeedForward.load("mnist-lenet", 5, batchSize = batch)
+    val (name, value) = restored.score(iter, featureShape)
+    println(s"reloaded $name=$value")
+    restored.close()
+
+    // the generated imperative surface: (a + b) * 2 elementwise
+    val a = NDArray.array(Array(1f, 2f, 3f, 4f), Array(4))
+    val b = NDArray.array(Array(9f, 8f, 7f, 6f), Array(4))
+    val out = NDArray.zeros(Array(4))
+    NDArrayOpsGen.mulScalar(NDArrayOpsGen.plus(a, b, out), 2f, out)
+    println("funcInvoke: " + out.toArray.mkString(","))
+    Seq(a, b, out).foreach(_.close())
+  }
+}
+
+/** Minimal idx-format reader (the reference example read MNIST the
+ *  same way; tools/make_mnist_synth.py writes compatible files). */
+object Mnist {
+  import java.io.{DataInputStream, FileInputStream}
+  import java.util.zip.GZIPInputStream
+
+  private def open(path: String): DataInputStream = {
+    val raw = new FileInputStream(path)
+    new DataInputStream(
+      if (path.endsWith(".gz")) new GZIPInputStream(raw) else raw)
+  }
+
+  def load(dir: String): (Array[Array[Float]], Array[Float]) = {
+    val imgs = open(s"$dir/train-images-idx3-ubyte")
+    require(imgs.readInt() == 2051, "bad image magic")
+    val n = imgs.readInt(); val h = imgs.readInt(); val w = imgs.readInt()
+    val data = Array.fill(n) {
+      Array.fill(h * w)((imgs.readUnsignedByte() / 255.0f))
+    }
+    imgs.close()
+    val lbls = open(s"$dir/train-labels-idx1-ubyte")
+    require(lbls.readInt() == 2049, "bad label magic")
+    val m = lbls.readInt()
+    val label = Array.fill(m)(lbls.readUnsignedByte().toFloat)
+    lbls.close()
+    (data, label)
+  }
+}

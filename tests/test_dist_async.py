@@ -125,6 +125,9 @@ def test_ps_hmac_framing(monkeypatch):
     from mxnet_tpu.parallel import ps
 
     monkeypatch.setenv("MXTPU_PS_SECRET", "cluster-token")
+    # the secret resolves once per process; reset the cache so this
+    # test's env takes effect (and is restored for later tests)
+    monkeypatch.setattr(ps, "_SECRET_CACHE", False)
     server = ps.ParameterServer("127.0.0.1", 23712, num_workers=1)
     try:
         c = ps.PSClient("127.0.0.1", 23712)

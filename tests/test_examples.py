@@ -41,6 +41,9 @@ EXAMPLES = [
     ("notebooks/composite_symbol.py", "composite symbol OK"),
     ("notebooks/predict_pretrained.py", "predict pretrained OK"),
     ("notebooks/cifar_recipe.py", "cifar recipe OK"),
+    ("rcnn/rcnn_demo.py",
+     "Faster R-CNN pipeline (Proposal CustomOp + ROIPooling) OK"),
+    ("rcnn/train_end2end.py", "rcnn end2end OK"),
 ]
 
 

@@ -1,0 +1,114 @@
+"""The ImageNet training recipe runs end-to-end (reference
+example/image-classification/train_imagenet.py + train_model.py): the
+example must train over REAL recordio input through ImageRecordIter's
+sharded decode pipeline — kvstore wiring, lr schedule, checkpointing,
+top-k metrics — on an ImageNet-shaped synthetic dataset (zero-egress
+image: no real ImageNet), and the saved checkpoint must load back.
+"""
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytest.importorskip("PIL")
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+sys.path.insert(0, REPO)
+
+
+def _make_imagenet_shaped(tmp_path, n_train=192, n_val=48, size=96,
+                          classes=4):
+    """Tiny recordio pair with a strongly class-dependent color so a
+    few epochs separate it (same recipe as the cifar example gate)."""
+    import mxnet_tpu.recordio as rio
+
+    rng = np.random.RandomState(7)
+    for name, n in (("train.rec", n_train), ("val.rec", n_val)):
+        w = rio.MXRecordIO(str(tmp_path / name), "w")
+        for i in range(n):
+            cls = i % classes
+            img = (rng.rand(size, size, 3) * 60).astype(np.uint8)
+            img[:, :, cls % 3] += np.uint8(120 + 20 * cls)
+            w.write(rio.pack_img(rio.IRHeader(0, float(cls), i, 0), img,
+                                 quality=95, img_fmt=".png"))
+        w.close()
+
+
+def test_train_imagenet_example_end_to_end(tmp_path):
+    _make_imagenet_shaped(tmp_path)
+    prefix = str(tmp_path / "chk")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "image_classification",
+                      "train_imagenet.py"),
+         "--data-dir", str(tmp_path),
+         "--network", "inception-bn",
+         "--data-shape", "96",
+         "--num-classes", "4",
+         "--num-examples", "192",
+         "--batch-size", "16",
+         "--num-epochs", "3",
+         "--lr", "0.05",
+         "--lr-factor", "0.9",
+         "--lr-factor-epoch", "1",
+         "--save-model-prefix", prefix],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-2500:])
+    assert "train imagenet OK" in r.stdout, r.stdout[-1000:]
+
+    # it LEARNED: last logged train accuracy beats 4-class chance by 2x
+    accs = re.findall(r"Train-accuracy=([0-9.]+)", r.stderr + r.stdout)
+    assert accs, "no Train-accuracy lines logged"
+    assert float(accs[-1]) > 0.5, accs
+
+    # checkpoint round-trips through the standard loader
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, 3)
+    assert "softmax" in sym.tojson()
+    assert any(k.endswith("weight") for k in arg_params)
+
+
+def test_train_imagenet_shards_by_rank(tmp_path):
+    """num_parts/part_index wiring: two ranks see DISJOINT record
+    shards that together cover the set (the reference DP input
+    contract, train_imagenet.py:69-70). Labels carry a unique per-record
+    id so identical shards (a part_index-ignored bug) cannot pass."""
+    import numpy as np
+
+    import mxnet_tpu.recordio as rio
+
+    rng = np.random.RandomState(3)
+    w = rio.MXRecordIO(str(tmp_path / "train.rec"), "w")
+    for i in range(32):
+        img = (rng.rand(96, 96, 3) * 255).astype(np.uint8)
+        w.write(rio.pack_img(rio.IRHeader(0, float(i), i, 0), img,
+                             quality=95, img_fmt=".png"))
+    w.close()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+
+    shards = []
+    for part in range(2):
+        it = mx.io.ImageRecordIter(
+            path_imgrec=str(tmp_path / "train.rec"),
+            data_shape=(3, 96, 96), batch_size=8,
+            num_parts=2, part_index=part)
+        ids = set()
+        for batch in it:
+            ids.update(int(v) for v in batch.label[0].asnumpy())
+        shards.append(ids)
+    assert shards[0].isdisjoint(shards[1]), \
+        shards[0] & shards[1]                       # no overlap
+    assert shards[0] | shards[1] == set(range(32))  # full coverage
+    assert min(len(s) for s in shards) >= 12        # roughly even

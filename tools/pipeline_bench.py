@@ -76,6 +76,15 @@ def measure(rec_path: str, image: int, batch: int, threads: int,
 
 
 def main(argv=None):
+    # the site hook overrides JAX_PLATFORMS at import; honoring the env
+    # var needs an explicit config update AFTER importing jax (same
+    # guard as bench.py / conftest.py) — without it a dead accelerator
+    # tunnel hangs this host-side decode benchmark on backend init
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms",
+                          os.environ["JAX_PLATFORMS"])
     p = argparse.ArgumentParser()
     p.add_argument("--rec", default=None, help="existing .rec (default: synthesize)")
     p.add_argument("--threads", default="1,%d" % max(2, os.cpu_count() or 1))
