@@ -64,6 +64,52 @@ as.array.MXNDArray <- function(x, ...) {
 
 dim.MXNDArray <- function(x) rev(.Call(mxr_nd_shape, x$handle))
 
+# empty device array with the same shape AND context as x (arithmetic
+# on a tpu-resident array must stay on the tpu)
+.mx.nd.like <- function(x) {
+  ctx <- .Call(mxr_nd_context, x$handle)
+  structure(
+    list(handle = .Call(mxr_nd_create, .Call(mxr_nd_shape, x$handle),
+                        ctx[[1]], ctx[[2]])), class = "MXNDArray")
+}
+
+# registered fixed-arity function on device arrays (reference
+# R-package/src/ndarray.cc: mx.nd ops ride MXFuncInvoke)
+.mx.nd.func <- function(name, nds, scalars = numeric(0), out = NULL) {
+  if (is.null(out)) out <- .mx.nd.like(nds[[1]])
+  .Call(mxr_func_invoke, name, lapply(nds, function(v) v$handle),
+        as.numeric(scalars), out$handle)
+  out
+}
+
+# arithmetic group generic (reference R-package/R/ndarray.R
+# Ops.MXNDArray): +,-,*,/ between device arrays and scalars run on
+# device through the registered _plus/_minus/... functions
+Ops.MXNDArray <- function(e1, e2) {
+  if (missing(e2)) {                       # unary +x / -x
+    if (.Generic == "-")
+      return(.mx.nd.func("_rminus_scalar", list(e1), 0))
+    if (.Generic == "+")
+      return(e1)
+    stop("unary operator ", .Generic, " not supported on MXNDArray")
+  }
+  ops <- c("+" = "_plus", "-" = "_minus", "*" = "_mul", "/" = "_div")
+  if (!(.Generic %in% names(ops)))
+    stop("operator ", .Generic, " not supported on MXNDArray")
+  nd1 <- inherits(e1, "MXNDArray")
+  nd2 <- inherits(e2, "MXNDArray")
+  if (nd1 && nd2)
+    return(.mx.nd.func(ops[[.Generic]], list(e1, e2)))
+  if (nd1) {                               # array <op> scalar
+    scalar.op <- paste0(ops[[.Generic]], "_scalar")
+    return(.mx.nd.func(scalar.op, list(e1), e2))
+  }
+  # scalar <op> array: + and * commute; - and / need reversed forms
+  rev.op <- switch(.Generic, "+" = "_plus_scalar", "*" = "_mul_scalar",
+                   "-" = "_rminus_scalar", "/" = "_rdiv_scalar")
+  .mx.nd.func(rev.op, list(e2), e1)
+}
+
 mx.nd.save <- function(ndarray.list, filename) {
   handles <- lapply(ndarray.list, function(a) a$handle)
   .Call(mxr_nd_save, filename, handles)

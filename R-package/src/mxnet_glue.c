@@ -518,6 +518,45 @@ SEXP mxr_random_seed(SEXP seed) {
   return R_NilValue;
 }
 
+/* ---- Round-4 surface: imperative NDArray functions -------------------- */
+
+/* mxr_nd_context(extptr) -> c(dev_type, dev_id) */
+SEXP mxr_nd_context(SEXP ptr) {
+  int dev_type = 1, dev_id = 0;
+  chk(MXNDArrayGetContext(R_ExternalPtrAddr(ptr), &dev_type, &dev_id));
+  SEXP out = PROTECT(Rf_allocVector(INTSXP, 2));
+  INTEGER(out)[0] = dev_type;
+  INTEGER(out)[1] = dev_id;
+  UNPROTECT(1);
+  return out;
+}
+
+/* mxr_func_invoke(name, list_of_nd_extptr, scalars_numeric, out_extptr)
+ * — registered fixed-arity function; result written into out
+ * (reference R-package/src/ndarray.cc dispatched mx.nd.internal ops
+ * through MXFuncInvoke the same way). */
+SEXP mxr_func_invoke(SEXP name, SEXP use, SEXP scalars, SEXP out) {
+  FunctionHandle fun;
+  chk(MXGetFunction(CHAR(STRING_ELT(name, 0)), &fun));
+  mx_uint nu = (mx_uint)Rf_length(use);
+  NDArrayHandle *uh =
+      (NDArrayHandle *)R_alloc(nu ? nu : 1, sizeof(NDArrayHandle));
+  for (mx_uint i = 0; i < nu; ++i)
+    uh[i] = R_ExternalPtrAddr(VECTOR_ELT(use, i));
+  mx_uint ns = (mx_uint)Rf_length(scalars);
+  mx_float *sc = (mx_float *)R_alloc(ns ? ns : 1, sizeof(mx_float));
+  for (mx_uint i = 0; i < ns; ++i) sc[i] = (mx_float)REAL(scalars)[i];
+  mx_uint want_u = 0, want_s = 0, want_m = 0;
+  int mask = 0;
+  chk(MXFuncDescribe(fun, &want_u, &want_s, &want_m, &mask));
+  if (want_u != nu || want_s != ns)
+    Rf_error("mxnet_tpu: %s expects %u arrays + %u scalars (got %u + %u)",
+             CHAR(STRING_ELT(name, 0)), want_u, want_s, nu, ns);
+  NDArrayHandle mutate[1] = {R_ExternalPtrAddr(out)};
+  chk(MXFuncInvoke(fun, uh, sc, mutate));
+  return out;
+}
+
 /* ---- Round-4 surface: multi-output symbols (RNN tier) ----------------- */
 
 /* mxr_sym_get_output(extptr, index0) -> extptr (one output as a symbol,
@@ -577,6 +616,8 @@ static const R_CallMethodDef call_methods[] = {
   {"mxr_random_seed", (DL_FUNC)&mxr_random_seed, 1},
   {"mxr_sym_get_output", (DL_FUNC)&mxr_sym_get_output, 2},
   {"mxr_sym_group", (DL_FUNC)&mxr_sym_group, 1},
+  {"mxr_func_invoke", (DL_FUNC)&mxr_func_invoke, 4},
+  {"mxr_nd_context", (DL_FUNC)&mxr_nd_context, 1},
   {NULL, NULL, 0}
 };
 

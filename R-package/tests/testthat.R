@@ -1,0 +1,7 @@
+# Standard testthat runner (R CMD check entry point). CI in this image
+# has no R runtime; the native twins of these tests run in
+# tests/test_r_package.py through the real .Call glue.
+library(testthat)
+library(mxnet.tpu)
+
+test_check("mxnet.tpu")

@@ -1,0 +1,44 @@
+# FeedForward training (reference R-package/tests/testthat/
+# test_model.R trained MNIST; this trains a separable synthetic task
+# so it runs offline). The same training sequence is executed natively
+# in CI by tests/r_glue_train.c (convergence >= 0.9).
+require(mxnet.tpu)
+
+context("models")
+
+test_that("feedforward model converges", {
+  set.seed(7)
+  n <- 400
+  y <- sample(0:1, n, replace = TRUE)
+  X <- matrix(rnorm(n * 8), 8, n) + rep(y * 1.5, each = 8)
+
+  data <- mx.symbol.Variable("data")
+  net <- mx.symbol.FullyConnected(data, name = "fc1", num_hidden = 16)
+  net <- mx.symbol.create("Activation", net, act_type = "relu")
+  net <- mx.symbol.FullyConnected(net, name = "fc2", num_hidden = 2)
+  net <- mx.symbol.create("SoftmaxOutput", net, name = "softmax")
+
+  model <- mx.model.FeedForward.create(
+    net, X = X, y = y, num.round = 8, array.batch.size = 32,
+    learning.rate = 0.1, momentum = 0.9,
+    array.layout = "colmajor", verbose = FALSE)
+
+  pred <- predict(model, X, array.layout = "colmajor")
+  acc <- mean(max.col(t(pred)) - 1 == y)
+  expect_true(acc > 0.9)
+})
+
+test_that("checkpoint save/load round-trip", {
+  data <- mx.symbol.Variable("data")
+  net <- mx.symbol.FullyConnected(data, name = "fc", num_hidden = 2)
+  net <- mx.symbol.create("SoftmaxOutput", net, name = "softmax")
+  model <- mx.model.FeedForward.create(
+    net, X = matrix(rnorm(64), 4, 16), y = sample(0:1, 16, TRUE),
+    num.round = 1, array.batch.size = 8, array.layout = "colmajor",
+    verbose = FALSE)
+  prefix <- tempfile()
+  mx.model.save(model, prefix, 1)
+  loaded <- mx.model.load(prefix, 1)
+  expect_equal(sort(names(loaded$arg.params)),
+               sort(names(model$arg.params)))
+})

@@ -1,0 +1,44 @@
+# NDArray arithmetic and IO (reference
+# R-package/tests/testthat/test_ndarray.R): the Ops.MXNDArray group
+# generic must match R arithmetic elementwise, including reversed
+# scalar operands. No R runtime exists in this image's CI, so the same
+# op sequence is executed natively by tests/r_glue_rnn_train.c
+# (func_invoke_ok); this file runs under testthat wherever R exists.
+require(mxnet.tpu)
+
+context("ndarray")
+
+test_that("element-wise calculation for vector", {
+  x <- as.numeric(1:10)
+  mat <- mx.nd.array(as.array(x), mx.cpu(0))
+  expect_equal(x, as.numeric(as.array(mat)))
+  expect_equal(x + 1, as.numeric(as.array(mat + 1)))
+  expect_equal(x - 10, as.numeric(as.array(mat - 10)))
+  expect_equal(x * 20, as.numeric(as.array(mat * 20)))
+  expect_equal(x / 3, as.numeric(as.array(mat / 3)), tolerance = 1e-5)
+  expect_equal(-1 - x, as.numeric(as.array(-1 - mat)))
+  expect_equal(-5 / x, as.numeric(as.array(-5 / mat)), tolerance = 1e-5)
+  expect_equal(x + x, as.numeric(as.array(mat + mat)))
+  expect_equal(x / x, as.numeric(as.array(mat / mat)))
+  expect_equal(x * x, as.numeric(as.array(mat * mat)))
+  expect_equal(x - x, as.numeric(as.array(mat - mat)))
+  expect_equal(as.numeric(as.array(1 - mat)), 1 - x)
+})
+
+test_that("element-wise calculation for matrix", {
+  x <- matrix(as.numeric(1:4), 2, 2)
+  mat <- mx.nd.array(as.array(x), mx.cpu(0))
+  expect_equal(x, as.array(mat))
+  expect_equal(x + 1, as.array(mat + 1))
+  expect_equal(x * 20, as.array(mat * 20))
+  expect_equal(x / 3, as.array(mat / 3), tolerance = 1e-5)
+  expect_equal(x * x, as.array(mat * mat))
+})
+
+test_that("save/load round-trip", {
+  x <- matrix(as.numeric(1:6), 2, 3)
+  path <- tempfile(fileext = ".nd")
+  mx.nd.save(list(w = mx.nd.array(x)), path)
+  back <- mx.nd.load(path)
+  expect_equal(as.array(back[["w"]]), x)
+})

@@ -1,0 +1,39 @@
+# Symbol composition (reference R-package/tests/testthat/test_symbol.R).
+require(mxnet.tpu)
+
+context("symbol")
+
+test_that("basic symbol operation", {
+  data <- mx.symbol.Variable("data")
+  net1 <- mx.symbol.FullyConnected(data = data, name = "fc1",
+                                   num_hidden = 10)
+  net1 <- mx.symbol.FullyConnected(data = net1, name = "fc2",
+                                   num_hidden = 100)
+  expect_equal(arguments.MXSymbol(net1),
+               c("data", "fc1_weight", "fc1_bias",
+                 "fc2_weight", "fc2_bias"))
+})
+
+test_that("shape inference", {
+  data <- mx.symbol.Variable("data")
+  net <- mx.symbol.FullyConnected(data = data, name = "fc",
+                                  num_hidden = 8)
+  shapes <- mx.symbol.infer.shape(net, data = c(5, 32))
+  expect_equal(shapes$out.shapes[[1]], c(8, 32))
+})
+
+test_that("multi-output select and group", {
+  s <- mx.symbol.create("SliceChannel", mx.symbol.Variable("x"),
+                        num_outputs = 2, name = "split")
+  expect_equal(length(outputs.MXSymbol(s)), 2)
+  g <- mx.symbol.Group(list(s[[1]], s[[2]]))
+  expect_equal(length(outputs.MXSymbol(g)), 2)
+})
+
+test_that("json round-trip", {
+  net <- mx.symbol.FullyConnected(mx.symbol.Variable("data"),
+                                  name = "fc", num_hidden = 4)
+  j <- tojson.MXSymbol(net)
+  back <- mx.symbol.load.json(j)
+  expect_equal(arguments.MXSymbol(back), arguments.MXSymbol(net))
+})

@@ -1,0 +1,32 @@
+function [X, Y] = read_idx(image_file, label_file)
+%READ_IDX Load an idx-format image/label pair (MNIST layout).
+%   [X, Y] = mxnet.read_idx('t10k-images-idx3-ubyte', ...
+%                           't10k-labels-idx1-ubyte')
+%   X: H x W x 1 x N single in [0,1]; Y: N x 1 double class ids.
+%   Files may be produced by tools/make_mnist_synth.py or be the real
+%   MNIST set (reference matlab/tests/prepare_data.m downloaded them).
+
+fid = fopen(image_file, 'rb', 'ieee-be');
+assert(fid > 0, 'cannot open %s', image_file);
+magic = fread(fid, 1, 'int32');
+assert(magic == 2051, 'bad image magic %d', magic);
+n = fread(fid, 1, 'int32');
+h = fread(fid, 1, 'int32');
+w = fread(fid, 1, 'int32');
+raw = fread(fid, n * h * w, 'uint8');
+fclose(fid);
+% idx is row-major (n, h, w); the column-major reshape already yields
+% the W x H x N layout model.forward expects (its row-major reversal
+% restores (N, H, W) — see model.m:58 'input: W x H x C x N')
+X = single(reshape(raw, [w, h, n])) / 255;
+X = reshape(X, [w, h, 1, n]);
+
+fid = fopen(label_file, 'rb', 'ieee-be');
+assert(fid > 0, 'cannot open %s', label_file);
+magic = fread(fid, 1, 'int32');
+assert(magic == 2049, 'bad label magic %d', magic);
+m = fread(fid, 1, 'int32');
+assert(m == n, 'image/label count mismatch');
+Y = fread(fid, m, 'uint8');
+fclose(fid);
+end

@@ -1,0 +1,41 @@
+%% Prediction test (reference matlab/tests/test_prediction.m).
+% Loads a checkpoint written by any frontend (same container format:
+% prefix-symbol.json + prefix-%04d.params) and checks batch prediction
+% accuracy. Offline version: point MODEL_PREFIX at a checkpoint
+% trained locally, e.g. by examples/image_classification/train_mnist.py
+% (the reference downloaded a pretrained lenet instead).
+%
+% The predict C ABI this exercises is validated in CI by
+% tests/test_matlab_package.py (no MATLAB/Octave in that image).
+
+addpath('..')
+
+MODEL_PREFIX = getenv('MXNET_TPU_TEST_PREFIX');
+if isempty(MODEL_PREFIX)
+  error('set MXNET_TPU_TEST_PREFIX to a trained checkpoint prefix');
+end
+EPOCH = str2double(getenv('MXNET_TPU_TEST_EPOCH'));
+if isnan(EPOCH), EPOCH = 10; end
+
+%% load data (idx files, e.g. from tools/make_mnist_synth.py)
+[X, Y] = mxnet.read_idx('t10k-images-idx3-ubyte', ...
+                        't10k-labels-idx1-ubyte');
+
+%% load model + predict in batches
+clear model
+model = mxnet.model;
+model.load(MODEL_PREFIX, EPOCH);
+
+err = 0;
+batch = 500;
+n = floor(numel(Y) / batch) * batch;
+for i = 1 : n / batch
+  ix = (i-1)*batch+1 : i*batch;
+  pred = model.forward(X(:,:,:,ix));
+  [~, k] = max(pred);
+  err = err + nnz(k - 1 ~= Y(ix)');
+end
+
+err = err / n;
+fprintf('prediction error: %f\n', err);
+assert(err < 0.05);

@@ -384,6 +384,13 @@ def _func_table():
     reg("_minus_scalar", 1, 1, "subtract scalar", lambda u, s: u[0] - s[0])
     reg("_mul_scalar", 1, 1, "multiply by scalar", lambda u, s: u[0] * s[0])
     reg("_div_scalar", 1, 1, "divide by scalar", lambda u, s: u[0] / s[0])
+    # reversed-operand scalar forms (reference _rminus_scalar /
+    # _rdiv_scalar): the R/Scala operator overloads need them for
+    # `1 - mat` and `5 / mat`
+    reg("_rminus_scalar", 1, 1, "scalar minus array",
+        lambda u, s: s[0] - u[0])
+    reg("_rdiv_scalar", 1, 1, "scalar divided by array",
+        lambda u, s: s[0] / u[0])
     reg("_copyto", 1, 0, "copy", lambda u, s: u[0].copy())
     reg("dot", 2, 0, "matrix product", lambda u, s: nd.dot(u[0], u[1]))
     reg("clip", 1, 2, "clip to [a_min, a_max]",

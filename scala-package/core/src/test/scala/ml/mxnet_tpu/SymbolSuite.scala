@@ -1,0 +1,69 @@
+package ml.mxnet_tpu
+
+import org.scalatest.FunSuite
+
+/**
+ * Symbol surface tests (reference scala-package core
+ * SymbolSuite.scala + ExecutorSuite.scala). The same sequences run in
+ * CI through the JNI shim (tests/jni_train.c builds, shape-infers,
+ * binds and trains this composition natively).
+ */
+class SymbolSuite extends FunSuite {
+  private def mlp(): Symbol = {
+    val data = Symbol.Variable("data")
+    val fc1 = SymbolOpsGen.FullyConnected(data, 16, name = "fc1")
+    val act = SymbolOpsGen.Activation(fc1, "relu", name = "act")
+    val fc2 = SymbolOpsGen.FullyConnected(act, 2, name = "fc2")
+    SymbolOpsGen.SoftmaxOutput(fc2, name = "softmax")
+  }
+
+  test("typed creators compose the expected arguments") {
+    val net = mlp()
+    assert(net.listArguments.toSeq ==
+      Seq("data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+          "softmax_label"))
+  }
+
+  test("shape inference resolves every argument") {
+    val net = mlp()
+    val (args, outs, _) = net.inferShapes(Map("data" -> Array(8, 5)))
+    assert(outs(0).toSeq == Seq(8, 2))
+    val byName = net.listArguments.zip(args).toMap
+    assert(byName("fc1_weight").toSeq == Seq(16, 5))
+  }
+
+  test("json round-trip preserves structure") {
+    val net = mlp()
+    val back = Symbol.loadJson(net.toJson)
+    assert(back.listArguments.toSeq == net.listArguments.toSeq)
+  }
+
+  test("executor binds and runs forward") {
+    val net = mlp()
+    val exe = net.simpleBind(Map("data" -> Array(4, 5)))
+    exe.setArg("data", Array.fill(20)(1.0f))
+    exe.forward()
+    val out = exe.getOutput(0, 8)
+    assert(math.abs(out.sum - 4.0f) < 1e-3)   // 4 softmax rows
+    exe.close()
+  }
+
+  test("FeedForward estimator trains a separable task") {
+    val rng = new scala.util.Random(3)
+    val data = Array.tabulate(128) { i =>
+      val cls = i % 2
+      Array.fill(5)(rng.nextFloat() - 0.5f + (if (cls == 1) 1f else -1f))
+    }
+    val label = Array.tabulate(128)(i => (i % 2).toFloat)
+    val iter = new NDArrayIter(data, label, 16, shuffle = true)
+    val est = FeedForward.newBuilder(mlp())
+      .setNumEpoch(8)
+      .setBatchSize(16)
+      .setOptimizer(new SGD(learningRate = 0.1f, momentum = 0.9f))
+      .build()
+    est.fit(iter, Array(5), verbose = false)
+    val (_, acc) = est.score(iter, Array(5))
+    assert(acc > 0.9)
+    est.close()
+  }
+}

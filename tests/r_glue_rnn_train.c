@@ -43,6 +43,10 @@ SEXP mxr_exec_backward(SEXP ptr);
 SEXP mxr_exec_get_output(SEXP ptr, SEXP index, SEXP size);
 SEXP mxr_exec_get_grad(SEXP ptr, SEXP name, SEXP size);
 SEXP mxr_random_seed(SEXP seed);
+SEXP mxr_nd_create(SEXP shape, SEXP dev_type, SEXP dev_id);
+SEXP mxr_nd_set(SEXP ptr, SEXP values);
+SEXP mxr_nd_get(SEXP ptr);
+SEXP mxr_func_invoke(SEXP name, SEXP use, SEXP scalars, SEXP out);
 
 #define SEQLEN 8
 #define BATCH 16
@@ -313,6 +317,41 @@ int main(void) {
     }
   }
   double infer_acc = (double)icorrect / iseen;
+
+  /* ---- Ops.MXNDArray path: ((v + w) * 2 - 1) / 4 via the exact
+   * mxr_func_invoke sequence the R group generic drives ---- */
+  int nd_shape[] = {3};
+  SEXP va_nd = mxr_nd_create(ints(1, nd_shape), int1(1), int1(0));
+  SEXP vb_nd = mxr_nd_create(ints(1, nd_shape), int1(1), int1(0));
+  SEXP vo_nd = mxr_nd_create(ints(1, nd_shape), int1(1), int1(0));
+  double va[] = {1, 2, 3}, vb[] = {10, 20, 30};
+  mxr_nd_set(va_nd, reals(3, va));
+  mxr_nd_set(vb_nd, reals(3, vb));
+  SEXP use2 = Rf_allocVector(VECSXP, 2);
+  SET_VECTOR_ELT(use2, 0, va_nd);
+  SET_VECTOR_ELT(use2, 1, vb_nd);
+  mxr_func_invoke(Rf_mkString("_plus"), use2,
+                  Rf_allocVector(REALSXP, 0), vo_nd);
+  SEXP use1 = Rf_allocVector(VECSXP, 1);
+  SET_VECTOR_ELT(use1, 0, vo_nd);
+  double two = 2.0, one = 1.0, four = 4.0;
+  mxr_func_invoke(Rf_mkString("_mul_scalar"), use1, reals(1, &two),
+                  vo_nd);
+  mxr_func_invoke(Rf_mkString("_minus_scalar"), use1, reals(1, &one),
+                  vo_nd);
+  mxr_func_invoke(Rf_mkString("_div_scalar"), use1, reals(1, &four),
+                  vo_nd);
+  SEXP got = mxr_nd_get(vo_nd);
+  for (int d = 0; d < 3; ++d) {
+    double want = ((va[d] + vb[d]) * 2.0 - 1.0) / 4.0;
+    if (fabs(REAL(got)[d] - want) > 1e-5) {
+      fprintf(stderr, "func_invoke wrong [%d]=%f want %f\n", d,
+              REAL(got)[d], want);
+      return 1;
+    }
+  }
+  printf("func_invoke_ok\n");
+
   printf("train_acc=%f infer_acc=%f\n", train_acc, infer_acc);
   return (train_acc >= 0.9 && infer_acc >= 0.9) ? 0 : 1;
 }

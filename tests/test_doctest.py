@@ -1,0 +1,15 @@
+"""Executable-documentation tier (reference
+``tests/python/doctest/run.py``: the reference ran its operator doc
+examples as doctests in CI so documentation could never drift from
+behavior). Runs every example in ``mxnet_tpu/symbol_doc.py``."""
+import doctest
+
+import mxnet_tpu  # noqa: F401  (imported for the doctest globals)
+from mxnet_tpu import symbol_doc
+
+
+def test_symbol_doc_examples():
+    results = doctest.testmod(symbol_doc, verbose=False)
+    assert results.attempted > 15, \
+        "doctest collection shrank: %d examples" % results.attempted
+    assert results.failed == 0, "%d doctest failures" % results.failed

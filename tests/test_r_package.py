@@ -235,6 +235,8 @@ def test_r_glue_rnn_training_and_inference_execute(tmp_path):
         train_acc = float(r.stdout.split("train_acc=")[1].split()[0])
         infer_acc = float(r.stdout.split("infer_acc=")[1].split()[0])
         assert train_acc >= 0.9 and infer_acc >= 0.9, r.stdout
+        # the Ops.MXNDArray arithmetic path (mxr_func_invoke) ran too
+        assert "func_invoke_ok" in r.stdout, r.stdout
 
 
 def test_rnn_R_defines_reference_surface():

@@ -102,6 +102,11 @@ def fire():
     _run([py, mfu, "--variant", "baseline", "--sweep-flags",
           "--xla_tpu_enable_latency_hiding_scheduler=true"],
          4000, outfile="MFU_EXPERIMENTS.jsonl")
+    # batch scaling: 512 amortizes per-step overhead if HBM allows
+    # (bf16 ResNet-50 activations at 512x224x224 fit a v5e's 16 GB
+    # with donation; an OOM here just logs and moves on)
+    _run([py, mfu, "--variant", "baseline", "--batch", "512"],
+         3000, outfile="MFU_EXPERIMENTS.jsonl")
     # 4. operator consistency sweep (the hardware-validation tier)
     out = _run([py, os.path.join(REPO, "tools", "tpu_consistency.py")],
                3000)
