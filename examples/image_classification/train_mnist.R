@@ -1,0 +1,89 @@
+# Train MNIST from R (reference
+# example/image-classification/train_mnist.R). Works against idx files
+# in --data-dir (tools/make_mnist_synth.py generates compatible files
+# offline; the reference downloaded the real set). The Python twin is
+# train_mnist.py; both write the same checkpoint layout.
+#
+#   Rscript train_mnist.R --network mlp --data-dir mnist/
+library(mxnet.tpu)
+
+get_mlp <- function() {
+  data <- mx.symbol.Variable("data")
+  fc1 <- mx.symbol.FullyConnected(data = data, name = "fc1",
+                                  num_hidden = 128)
+  act1 <- mx.symbol.create("Activation", fc1, act_type = "relu")
+  fc2 <- mx.symbol.FullyConnected(data = act1, name = "fc2",
+                                  num_hidden = 64)
+  act2 <- mx.symbol.create("Activation", fc2, act_type = "relu")
+  fc3 <- mx.symbol.FullyConnected(data = act2, name = "fc3",
+                                  num_hidden = 10)
+  mx.symbol.create("SoftmaxOutput", fc3, name = "softmax")
+}
+
+get_lenet <- function() {
+  data <- mx.symbol.Variable("data")
+  conv1 <- mx.symbol.create("Convolution", data, kernel = c(5, 5),
+                            num_filter = 20)
+  tanh1 <- mx.symbol.create("Activation", conv1, act_type = "tanh")
+  pool1 <- mx.symbol.create("Pooling", tanh1, pool_type = "max",
+                            kernel = c(2, 2), stride = c(2, 2))
+  conv2 <- mx.symbol.create("Convolution", pool1, kernel = c(5, 5),
+                            num_filter = 50)
+  tanh2 <- mx.symbol.create("Activation", conv2, act_type = "tanh")
+  pool2 <- mx.symbol.create("Pooling", tanh2, pool_type = "max",
+                            kernel = c(2, 2), stride = c(2, 2))
+  flatten <- mx.symbol.create("Flatten", pool2)
+  fc1 <- mx.symbol.create("FullyConnected", flatten, num_hidden = 500)
+  tanh3 <- mx.symbol.create("Activation", fc1, act_type = "tanh")
+  fc2 <- mx.symbol.create("FullyConnected", tanh3, num_hidden = 10)
+  mx.symbol.create("SoftmaxOutput", fc2, name = "softmax")
+}
+
+read.idx <- function(image_file, label_file, flat) {
+  img <- file(image_file, "rb")
+  stopifnot(readBin(img, "integer", 1, endian = "big") == 2051L)
+  n <- readBin(img, "integer", 1, endian = "big")
+  h <- readBin(img, "integer", 1, endian = "big")
+  w <- readBin(img, "integer", 1, endian = "big")
+  raw <- as.numeric(readBin(img, "integer", n * h * w, size = 1,
+                            signed = FALSE)) / 255
+  close(img)
+  lbl <- file(label_file, "rb")
+  stopifnot(readBin(lbl, "integer", 1, endian = "big") == 2049L)
+  m <- readBin(lbl, "integer", 1, endian = "big")
+  y <- as.numeric(readBin(lbl, "integer", m, size = 1, signed = FALSE))
+  close(lbl)
+  # idx is row-major (n, h, w); colmajor R wants feature-major columns
+  X <- array(raw, dim = c(w * h, n))
+  if (!flat) dim(X) <- c(w, h, 1, n)
+  list(x = X, y = y)
+}
+
+main <- function() {
+  args <- commandArgs(trailingOnly = TRUE)
+  opt <- list(network = "mlp", data_dir = "mnist/", num_round = 10,
+              batch_size = 128, lr = 0.1)
+  if (length(args) >= 2)
+    for (i in seq(1, length(args) - 1, by = 2)) {
+      key <- gsub("-", "_", sub("^--", "", args[[i]]))
+      opt[[key]] <- args[[i + 1]]
+    }
+
+  flat <- identical(opt$network, "mlp")
+  net <- if (flat) get_mlp() else get_lenet()
+  train <- read.idx(file.path(opt$data_dir, "train-images-idx3-ubyte"),
+                    file.path(opt$data_dir, "train-labels-idx1-ubyte"),
+                    flat)
+  mx.set.seed(0)
+  model <- mx.model.FeedForward.create(
+    net, X = train$x, y = train$y,
+    num.round = as.integer(opt$num_round),
+    array.batch.size = as.integer(opt$batch_size),
+    learning.rate = as.numeric(opt$lr), momentum = 0.9,
+    array.layout = "colmajor",
+    batch.end.callback = mx.callback.log.train.metric(100))
+  mx.model.save(model, "mnist-r", as.integer(opt$num_round))
+  invisible(model)
+}
+
+if (sys.nframe() == 0) main()

@@ -105,6 +105,11 @@ def test_mfu_experiments_harness_runs():
                         "16", "--steps", "1"])
     assert results and results[0]["experiment"] == "nhwc"
     assert results[0]["imgs_per_sec"] > 0
+    # the combined channels-last + space-to-depth variant (round 4)
+    results = mod.main(["--variant", "nhwc_s2d", "--batch", "2",
+                        "--image", "16", "--steps", "1"])
+    assert results and results[0]["experiment"] == "nhwc_s2d"
+    assert results[0]["imgs_per_sec"] > 0
 
 
 def test_deconvolution_nhwc_matches_nchw():

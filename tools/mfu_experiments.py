@@ -11,6 +11,7 @@ buffers, two warmup steps, block_until_ready fence):
             the input to (N, 12, H/2, W/2) + a 5x5/1 stem conv replacing
             7x7/2 — structurally the MLPerf trick (measures the
             throughput effect; not weight-exact with the 7x7 stem)
+  nhwc_s2d  both together: channels-last tower + s2d stem
   flags:... any variant re-run under an XLA_FLAGS setting (process
             re-exec; flags only apply at backend init)
 
@@ -51,12 +52,16 @@ def _chip_peak(kind):
 def build_variant(variant, batch, image, num_classes, small):
     from mxnet_tpu import models
 
-    layout = "NHWC" if variant == "nhwc" else "NCHW"
-    if variant == "s2d":
+    layout = "NHWC" if variant in ("nhwc", "nhwc_s2d") else "NCHW"
+    if variant in ("s2d", "nhwc_s2d"):
         net = models.get_resnet(
             [3, 4, 6, 3], [64, 256, 512, 1024, 2048],
-            num_classes=num_classes, small_input=small, stem_s2d=True)
-        data_shape = (batch, 12, image // 2, image // 2)
+            num_classes=num_classes, small_input=small, stem_s2d=True,
+            layout=layout)
+        if layout == "NHWC":
+            data_shape = (batch, image // 2, image // 2, 12)
+        else:
+            data_shape = (batch, 12, image // 2, image // 2)
     else:
         net = models.get_resnet50(num_classes=num_classes,
                                   small_input=small, layout=layout)
@@ -134,7 +139,8 @@ def measure(variant, batch, image, num_classes, steps, dtype_name):
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--variant", default="all",
-                   choices=["all", "baseline", "nhwc", "s2d"])
+                   choices=["all", "baseline", "nhwc", "s2d",
+                            "nhwc_s2d"])
     p.add_argument("--batch", type=int, default=None)
     p.add_argument("--image", type=int, default=None)
     p.add_argument("--steps", type=int, default=None)
@@ -147,7 +153,7 @@ def main(argv=None):
 
     if args.sweep_flags is not None and not args._child:
         sweep_variants = [args.variant] if args.variant != "all" \
-            else ["baseline", "nhwc", "s2d"]
+            else ["baseline", "nhwc", "s2d", "nhwc_s2d"]
         for flags in [""] + list(args.sweep_flags):
             env = dict(os.environ)
             if flags:
@@ -179,7 +185,7 @@ def main(argv=None):
     num_classes = 1000 if on_accel else 8
 
     variants = [args.variant] if args.variant != "all" \
-        else ["baseline", "nhwc", "s2d"]
+        else ["baseline", "nhwc", "s2d", "nhwc_s2d"]
     results = []
     for v in variants:
         r = measure(v, batch, image, num_classes, steps, dtype)
