@@ -81,8 +81,12 @@ print("ASYNC_OK rank=%d" % rank)
 
 
 def test_dist_async_two_workers(tmp_path):
+    # run the whole tier AUTHENTICATED: the secret propagates through
+    # launch.py's local env path and every PS frame carries an HMAC
+    # tag (round-4 hardening exercised end to end, not just in-process)
     out = launch(tmp_path, fill(ASYNC_SCRIPT, tmp_path), port=23475,
-                 timeout=420)
+                 timeout=420,
+                 extra_env={"MXTPU_PS_SECRET": "gate-token"})
     assert out.returncode == 0, (out.stdout[-1500:], out.stderr[-2500:])
     assert out.stdout.count("ASYNC_OK") == 2, out.stdout[-1500:]
 
