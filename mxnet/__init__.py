@@ -30,17 +30,30 @@ __version__ = _m.__version__
 _MODULES = [
     "attribute", "base", "callback", "context", "engine", "executor",
     "executor_manager", "filesystem", "initializer", "io", "kvstore",
-    "lr_scheduler", "metric", "model", "module", "monitor", "name",
-    "ndarray", "operator", "optimizer", "random", "recordio", "rtc",
-    "symbol", "test_utils", "visualization", "profiler", "export",
+    "kvstore_server", "libinfo", "lr_scheduler", "metric", "model",
+    "module", "monitor", "name", "ndarray", "operator", "optimizer",
+    "random", "recordio", "rtc", "symbol", "symbol_doc", "test_utils",
+    "visualization", "profiler", "export",
 ]
 _SHORT = {"nd": "ndarray", "sym": "symbol", "init": "initializer",
           "kv": "kvstore", "mod": "module", "viz": "visualization"}
+# reference module names whose implementation lives under a different
+# name here (python/mxnet/misc.py was the pre-lr_scheduler home of the
+# schedulers; the _internal namespaces held the generated operators;
+# torch.py was the torch-op bridge)
+_COMPAT = {"misc": "lr_scheduler",
+           "_ndarray_internal": "ndarray_ops",
+           "_symbol_internal": "symbol",
+           "torch": "plugins.torch_bridge"}
 
 for _name in _MODULES:
     _mod_obj = importlib.import_module("mxnet_tpu." + _name)
     globals()[_name] = _mod_obj
     sys.modules["mxnet." + _name] = _mod_obj
+for _alias, _target in _COMPAT.items():
+    _mod_obj = importlib.import_module("mxnet_tpu." + _target)
+    globals()[_alias] = _mod_obj
+    sys.modules["mxnet." + _alias] = _mod_obj
 for _alias, _target in _SHORT.items():
     _mod_obj = sys.modules["mxnet." + _target]
     globals()[_alias] = _mod_obj

@@ -288,7 +288,14 @@ class KVStoreDistAsync(KVStore):
         host, port = ps.ps_address()
         self._server = None
         if self._rank == 0:
-            self._server = ps.ParameterServer(host, port, self._size)
+            try:
+                self._server = ps.ParameterServer(host, port, self._size)
+            except OSError:
+                # the address is already served: a dedicated
+                # DMLC_ROLE=server process (mxnet_tpu/kvstore_server.py,
+                # the reference launch contract) owns the store — run
+                # as a pure client like every other rank
+                self._server = None
         self._client = ps.PSClient(host, port)
         self._client.call("hello", self._rank)
 
@@ -366,11 +373,15 @@ class KVStoreDistAsync(KVStore):
             self._client.call("bye", self._rank)
         except (MXNetError, OSError, ConnectionError):
             pass
-        if self._server is not None:
+        # rank 0 stops the server whether it self-hosted OR a dedicated
+        # DMLC_ROLE=server process owns it — otherwise an external
+        # server would block in run() forever after the job ends
+        if self._rank == 0:
             try:
                 self._client.call("stop")
             except (MXNetError, OSError, ConnectionError):
                 pass   # server already gone; still close our side
+        if self._server is not None:
             self._server.close()
         self._client.close()
 

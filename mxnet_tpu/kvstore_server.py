@@ -1,0 +1,52 @@
+"""Server-role entry point (reference ``python/mxnet/kvstore_server.py``:
+a launched process with DMLC_ROLE=server ran ``KVStoreServer.run()``
+forever, applying the pickled optimizer the workers sent).
+
+In this runtime the synchronous tiers have no server processes at all
+(the all-reduce is compiled into the training step), and the async
+tier's server is a thread on rank 0 (``parallel/ps.py``). This module
+keeps the reference's launch contract working: a process started with
+the server role hosts the parameter server and blocks until the job
+stops, so reference-style trackers that spawn servers still function.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from .parallel import ps
+
+
+class KVStoreServer:
+    """Reference ``KVStoreServer``: wraps the server loop.
+
+    The reference pulled the optimizer out of a controller command;
+    here the ``ParameterServer`` receives it over the wire
+    (``set_optimizer``) like every other command.
+    """
+
+    def __init__(self, num_workers: int | None = None):
+        self.num_workers = num_workers or int(
+            os.environ.get("MXTPU_NUM_WORKERS",
+                           os.environ.get("DMLC_NUM_WORKER", "1")))
+        host, port = ps.ps_address()
+        self._server = ps.ParameterServer(host, port, self.num_workers)
+
+    def run(self):
+        """Block until the server is stopped (a worker's ``stop``)."""
+        try:
+            while not self._server._stop.is_set():
+                time.sleep(0.2)
+        finally:
+            self._server.close()
+
+
+def _init_kvstore_server_module():
+    """Reference module hook: run the server when this process has the
+    server role (DMLC_ROLE=server), otherwise do nothing."""
+    role = os.environ.get("DMLC_ROLE", "")
+    if role == "server":
+        KVStoreServer().run()
+
+
+_init_kvstore_server_module()

@@ -1,0 +1,26 @@
+"""Library/version info (reference ``python/mxnet/libinfo.py``: its
+``find_lib_path`` located libmxnet.so for ctypes). Here the runtime is
+the package itself; the discoverable native artifacts are the host
+engine and the C predict ABI built under ``mxnet_tpu/_native``."""
+from __future__ import annotations
+
+import os
+
+from . import __version__  # noqa: F401  (reference exposed it here too)
+
+
+def find_lib_path():
+    """Paths of the built native libraries, most specific first.
+
+    Returns the existing candidates among the host-engine library
+    (``libmxtpu.so``) and the embedded-runtime C ABI
+    (``libmxtpu_predict.so``). Empty list if neither is built —
+    unlike the reference this is not fatal, because the Python
+    frontend does not need a native library to run.
+    """
+    here = os.path.dirname(os.path.abspath(os.path.expanduser(__file__)))
+    candidates = [
+        os.path.join(here, "_native", "libmxtpu_predict.so"),
+        os.path.join(here, "_native", "libmxtpu.so"),
+    ]
+    return [p for p in candidates if os.path.exists(p)]
