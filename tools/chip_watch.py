@@ -80,8 +80,38 @@ def _run(cmd, timeout_s, env_overrides=None, outfile=None):
     return r.stdout
 
 
+ARTIFACTS = ["BENCH_watch.json", ".bench_cache.json",
+             ".bench_trace_summary.json", "MFU_EXPERIMENTS.jsonl",
+             "TPU_CONSISTENCY.txt"]
+
+
+def _commit(stage, stamp):
+    """Commit whatever artifacts exist RIGHT NOW: a tunnel window can
+    die mid-sequence, and evidence from completed stages must survive
+    it (a single end-of-sequence commit would lose everything)."""
+    present = [a for a in ARTIFACTS
+               if os.path.exists(os.path.join(REPO, a))]
+    if not present:
+        return
+    add = subprocess.run(["git", "add", "--"] + present,
+                         capture_output=True, text=True, cwd=REPO)
+    if add.returncode != 0:        # e.g. index.lock held by another git
+        log("add[%s] FAILED rc=%d %s" % (stage, add.returncode,
+                                         add.stderr.strip()[-160:]))
+        return
+    # pathspec'd commit: anything ELSE staged in the shared repo must
+    # not be swept into an evidence commit
+    r = subprocess.run(
+        ["git", "commit", "-m",
+         "On-chip evidence: %s (chip_watch %s)" % (stage, stamp),
+         "--"] + present,
+        capture_output=True, text=True, cwd=REPO)
+    log("commit[%s] rc=%d %s" % (stage, r.returncode,
+                                 r.stdout.strip()[-160:]))
+
+
 def fire():
-    """Run the armed sequence and commit whatever landed."""
+    """Run the armed sequence, committing after every stage."""
     py = sys.executable
     stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     with open(os.path.join(REPO, "BENCH_watch.json"), "a") as f:
@@ -90,13 +120,16 @@ def fire():
     # 1. headline bench (includes NHWC + CIFAR tiers + trace summary)
     _run([py, os.path.join(REPO, "bench.py")], 3000,
          outfile="BENCH_watch.json")
+    _commit("headline bench", stamp)
     # 2. end-to-end recordio-fed tier (synthetic input, real decode path)
     _run([py, os.path.join(REPO, "bench.py")], 3000,
          env_overrides={"MXNET_TPU_BENCH_INPUT": "1"},
          outfile="BENCH_watch.json")
+    _commit("e2e input-fed bench", stamp)
     # 3. MFU experiments: all variants, then the latency-hiding flag
     mfu = os.path.join(REPO, "tools", "mfu_experiments.py")
     _run([py, mfu], 4000, outfile="MFU_EXPERIMENTS.jsonl")
+    _commit("mfu variants", stamp)
     # paired same-session baseline-vs-flag comparison (the sweep
     # re-runs the variant with and without each flag)
     _run([py, mfu, "--variant", "baseline", "--sweep-flags",
@@ -107,24 +140,14 @@ def fire():
     # with donation; an OOM here just logs and moves on)
     _run([py, mfu, "--variant", "baseline", "--batch", "512"],
          3000, outfile="MFU_EXPERIMENTS.jsonl")
+    _commit("mfu flag sweep + batch scaling", stamp)
     # 4. operator consistency sweep (the hardware-validation tier)
     out = _run([py, os.path.join(REPO, "tools", "tpu_consistency.py")],
                3000)
     if out is not None:
         with open(os.path.join(REPO, "TPU_CONSISTENCY.txt"), "a") as f:
             f.write("== chip_watch %s ==\n%s" % (stamp, out))
-
-    artifacts = ["BENCH_watch.json", ".bench_cache.json",
-                 ".bench_trace_summary.json", "MFU_EXPERIMENTS.jsonl",
-                 "TPU_CONSISTENCY.txt"]
-    present = [a for a in artifacts
-               if os.path.exists(os.path.join(REPO, a))]
-    subprocess.run(["git", "add", "--"] + present, cwd=REPO)
-    r = subprocess.run(
-        ["git", "commit", "-m",
-         "On-chip evidence drop (chip_watch %s)" % stamp],
-        capture_output=True, text=True, cwd=REPO)
-    log("commit rc=%d %s" % (r.returncode, r.stdout.strip()[-200:]))
+    _commit("op consistency sweep", stamp)
 
 
 def main(argv=None):
