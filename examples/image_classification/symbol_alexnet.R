@@ -1,0 +1,47 @@
+# AlexNet symbol in R (reference
+# example/image-classification/symbol_alexnet.R). Build with
+# get_symbol(num_classes) and train via mx.model.FeedForward.create.
+library(mxnet.tpu)
+
+get_symbol <- function(num_classes = 1000) {
+  input_data <- mx.symbol.Variable("data")
+  # stage 1
+  conv1 <- mx.symbol.create("Convolution", input_data, kernel = c(11, 11),
+                            stride = c(4, 4), num_filter = 96)
+  relu1 <- mx.symbol.create("Activation", conv1, act_type = "relu")
+  pool1 <- mx.symbol.create("Pooling", relu1, pool_type = "max",
+                            kernel = c(3, 3), stride = c(2, 2))
+  lrn1 <- mx.symbol.create("LRN", pool1, nsize = 5)
+  # stage 2
+  conv2 <- mx.symbol.create("Convolution", lrn1, kernel = c(5, 5),
+                            pad = c(2, 2), num_filter = 256)
+  relu2 <- mx.symbol.create("Activation", conv2, act_type = "relu")
+  pool2 <- mx.symbol.create("Pooling", relu2, kernel = c(3, 3),
+                            stride = c(2, 2), pool_type = "max")
+  lrn2 <- mx.symbol.create("LRN", pool2, nsize = 5)
+  # stage 3
+  conv3 <- mx.symbol.create("Convolution", lrn2, kernel = c(3, 3),
+                            pad = c(1, 1), num_filter = 384)
+  relu3 <- mx.symbol.create("Activation", conv3, act_type = "relu")
+  conv4 <- mx.symbol.create("Convolution", relu3, kernel = c(3, 3),
+                            pad = c(1, 1), num_filter = 384)
+  relu4 <- mx.symbol.create("Activation", conv4, act_type = "relu")
+  conv5 <- mx.symbol.create("Convolution", relu4, kernel = c(3, 3),
+                            pad = c(1, 1), num_filter = 256)
+  relu5 <- mx.symbol.create("Activation", conv5, act_type = "relu")
+  pool3 <- mx.symbol.create("Pooling", relu5, kernel = c(3, 3),
+                            stride = c(2, 2), pool_type = "max")
+  # stage 4
+  flatten <- mx.symbol.create("Flatten", pool3)
+  fc1 <- mx.symbol.create("FullyConnected", flatten, num_hidden = 4096)
+  relu6 <- mx.symbol.create("Activation", fc1, act_type = "relu")
+  dropout1 <- mx.symbol.create("Dropout", relu6, p = 0.5)
+  # stage 5
+  fc2 <- mx.symbol.create("FullyConnected", dropout1, num_hidden = 4096)
+  relu7 <- mx.symbol.create("Activation", fc2, act_type = "relu")
+  dropout2 <- mx.symbol.create("Dropout", relu7, p = 0.5)
+  # stage 6
+  fc3 <- mx.symbol.create("FullyConnected", dropout2,
+                          num_hidden = num_classes)
+  mx.symbol.create("SoftmaxOutput", fc3, name = "softmax")
+}

@@ -1,0 +1,66 @@
+# GoogLeNet (Inception v1) symbol in R (reference
+# example/image-classification/symbol_googlenet.R).
+library(mxnet.tpu)
+
+conv.factory2 <- function(data, num_filter, kernel, stride = c(1, 1),
+                          pad = c(0, 0), name = "") {
+  conv <- mx.symbol.create("Convolution", data, kernel = kernel,
+                           stride = stride, pad = pad,
+                           num_filter = num_filter,
+                           name = paste0("conv_", name))
+  mx.symbol.create("Activation", conv, act_type = "relu",
+                   name = paste0("relu_", name))
+}
+
+inception7 <- function(data, n1x1, n3x3red, n3x3, n5x5red, n5x5, proj,
+                       name) {
+  c1 <- conv.factory2(data, n1x1, c(1, 1), name = paste0(name, "_1x1"))
+  c3r <- conv.factory2(data, n3x3red, c(1, 1),
+                       name = paste0(name, "_3x3r"))
+  c3 <- conv.factory2(c3r, n3x3, c(3, 3), pad = c(1, 1),
+                      name = paste0(name, "_3x3"))
+  c5r <- conv.factory2(data, n5x5red, c(1, 1),
+                       name = paste0(name, "_5x5r"))
+  c5 <- conv.factory2(c5r, n5x5, c(5, 5), pad = c(2, 2),
+                      name = paste0(name, "_5x5"))
+  p <- mx.symbol.create("Pooling", data, kernel = c(3, 3),
+                        stride = c(1, 1), pad = c(1, 1),
+                        pool_type = "max", name = paste0(name, "_pool"))
+  pp <- conv.factory2(p, proj, c(1, 1), name = paste0(name, "_proj"))
+  mx.symbol.create("Concat", c1, c3, c5, pp, num_args = 4,
+                   name = paste0(name, "_concat"))
+}
+
+get_symbol <- function(num_classes = 1000) {
+  data <- mx.symbol.Variable("data")
+  net <- conv.factory2(data, 64, c(7, 7), c(2, 2), c(3, 3), "stem1")
+  net <- mx.symbol.create("Pooling", net, kernel = c(3, 3),
+                          stride = c(2, 2), pad = c(1, 1),
+                          pool_type = "max")
+  net <- conv.factory2(net, 64, c(1, 1), name = "stem2r")
+  net <- conv.factory2(net, 192, c(3, 3), pad = c(1, 1), name = "stem2")
+  net <- mx.symbol.create("Pooling", net, kernel = c(3, 3),
+                          stride = c(2, 2), pad = c(1, 1),
+                          pool_type = "max")
+  net <- inception7(net, 64, 96, 128, 16, 32, 32, "in3a")
+  net <- inception7(net, 128, 128, 192, 32, 96, 64, "in3b")
+  net <- mx.symbol.create("Pooling", net, kernel = c(3, 3),
+                          stride = c(2, 2), pad = c(1, 1),
+                          pool_type = "max")
+  net <- inception7(net, 192, 96, 208, 16, 48, 64, "in4a")
+  net <- inception7(net, 160, 112, 224, 24, 64, 64, "in4b")
+  net <- inception7(net, 128, 128, 256, 24, 64, 64, "in4c")
+  net <- inception7(net, 112, 144, 288, 32, 64, 64, "in4d")
+  net <- inception7(net, 256, 160, 320, 32, 128, 128, "in4e")
+  net <- mx.symbol.create("Pooling", net, kernel = c(3, 3),
+                          stride = c(2, 2), pad = c(1, 1),
+                          pool_type = "max")
+  net <- inception7(net, 256, 160, 320, 32, 128, 128, "in5a")
+  net <- inception7(net, 384, 192, 384, 48, 128, 128, "in5b")
+  net <- mx.symbol.create("Pooling", net, kernel = c(7, 7),
+                          stride = c(1, 1), pool_type = "avg")
+  net <- mx.symbol.create("Flatten", net)
+  net <- mx.symbol.create("FullyConnected", net,
+                          num_hidden = num_classes, name = "fc")
+  mx.symbol.create("SoftmaxOutput", net, name = "softmax")
+}

@@ -1,0 +1,65 @@
+# Small ResNet for 28x28 inputs in R (reference
+# example/image-classification/symbol_resnet-28-small.R).
+library(mxnet.tpu)
+
+conv.factory <- function(data, num_filter, kernel, stride = c(1, 1),
+                         pad = c(0, 0), act = TRUE, name = "") {
+  conv <- mx.symbol.create("Convolution", data, kernel = kernel,
+                           stride = stride, pad = pad,
+                           num_filter = num_filter,
+                           name = paste0("conv_", name))
+  bn <- mx.symbol.create("BatchNorm", conv, name = paste0("bn_", name))
+  if (act) {
+    return(mx.symbol.create("Activation", bn, act_type = "relu",
+                            name = paste0("relu_", name)))
+  }
+  bn
+}
+
+residual.factory <- function(data, num_filter, dim.match, name) {
+  if (dim.match) {
+    identity.data <- data
+    conv1 <- conv.factory(data, num_filter, c(3, 3), c(1, 1), c(1, 1),
+                          name = paste0(name, "_c1"))
+    conv2 <- conv.factory(conv1, num_filter, c(3, 3), c(1, 1), c(1, 1),
+                          act = FALSE, name = paste0(name, "_c2"))
+    new.data <- identity.data + conv2
+  } else {
+    conv1 <- conv.factory(data, num_filter, c(3, 3), c(2, 2), c(1, 1),
+                          name = paste0(name, "_c1"))
+    conv2 <- conv.factory(conv1, num_filter, c(3, 3), c(1, 1), c(1, 1),
+                          act = FALSE, name = paste0(name, "_c2"))
+    project.data <- conv.factory(data, num_filter, c(2, 2), c(2, 2),
+                                 act = FALSE,
+                                 name = paste0(name, "_proj"))
+    new.data <- project.data + conv2
+  }
+  mx.symbol.create("Activation", new.data, act_type = "relu",
+                   name = paste0(name, "_out"))
+}
+
+residual.net <- function(data, n) {
+  net <- data
+  for (i in seq_len(n)) net <- residual.factory(net, 16, TRUE,
+                                                paste0("a", i))
+  net <- residual.factory(net, 32, FALSE, "b0")
+  for (i in seq_len(n - 1)) net <- residual.factory(net, 32, TRUE,
+                                                    paste0("b", i))
+  net <- residual.factory(net, 64, FALSE, "c0")
+  for (i in seq_len(n - 1)) net <- residual.factory(net, 64, TRUE,
+                                                    paste0("c", i))
+  net
+}
+
+get_symbol <- function(num_classes = 10, n = 3) {
+  data <- mx.symbol.Variable("data")
+  net <- conv.factory(data, 16, c(3, 3), c(1, 1), c(1, 1),
+                      name = "stem")
+  net <- residual.net(net, n)
+  net <- mx.symbol.create("Pooling", net, kernel = c(7, 7),
+                          pool_type = "avg", name = "gpool")
+  net <- mx.symbol.create("Flatten", net)
+  net <- mx.symbol.create("FullyConnected", net,
+                          num_hidden = num_classes, name = "fc")
+  mx.symbol.create("SoftmaxOutput", net, name = "softmax")
+}

@@ -1,0 +1,56 @@
+# VGG-16 symbol in R (reference
+# example/image-classification/symbol_vgg.R).
+library(mxnet.tpu)
+
+conv.block <- function(data, num_filter, name) {
+  conv <- mx.symbol.create("Convolution", data, kernel = c(3, 3),
+                           pad = c(1, 1), num_filter = num_filter,
+                           name = paste0("conv", name))
+  mx.symbol.create("Activation", conv, act_type = "relu",
+                   name = paste0("relu", name))
+}
+
+get_symbol <- function(num_classes = 1000) {
+  data <- mx.symbol.Variable("data")
+  # group 1
+  net <- conv.block(data, 64, "1_1")
+  net <- conv.block(net, 64, "1_2")
+  net <- mx.symbol.create("Pooling", net, pool_type = "max",
+                          kernel = c(2, 2), stride = c(2, 2))
+  # group 2
+  net <- conv.block(net, 128, "2_1")
+  net <- conv.block(net, 128, "2_2")
+  net <- mx.symbol.create("Pooling", net, pool_type = "max",
+                          kernel = c(2, 2), stride = c(2, 2))
+  # group 3
+  net <- conv.block(net, 256, "3_1")
+  net <- conv.block(net, 256, "3_2")
+  net <- conv.block(net, 256, "3_3")
+  net <- mx.symbol.create("Pooling", net, pool_type = "max",
+                          kernel = c(2, 2), stride = c(2, 2))
+  # group 4
+  net <- conv.block(net, 512, "4_1")
+  net <- conv.block(net, 512, "4_2")
+  net <- conv.block(net, 512, "4_3")
+  net <- mx.symbol.create("Pooling", net, pool_type = "max",
+                          kernel = c(2, 2), stride = c(2, 2))
+  # group 5
+  net <- conv.block(net, 512, "5_1")
+  net <- conv.block(net, 512, "5_2")
+  net <- conv.block(net, 512, "5_3")
+  net <- mx.symbol.create("Pooling", net, pool_type = "max",
+                          kernel = c(2, 2), stride = c(2, 2))
+  # classifier
+  net <- mx.symbol.create("Flatten", net)
+  net <- mx.symbol.create("FullyConnected", net, num_hidden = 4096,
+                          name = "fc6")
+  net <- mx.symbol.create("Activation", net, act_type = "relu")
+  net <- mx.symbol.create("Dropout", net, p = 0.5)
+  net <- mx.symbol.create("FullyConnected", net, num_hidden = 4096,
+                          name = "fc7")
+  net <- mx.symbol.create("Activation", net, act_type = "relu")
+  net <- mx.symbol.create("Dropout", net, p = 0.5)
+  net <- mx.symbol.create("FullyConnected", net,
+                          num_hidden = num_classes, name = "fc8")
+  mx.symbol.create("SoftmaxOutput", net, name = "softmax")
+}

@@ -72,7 +72,9 @@ S3_GENERICS = {"predict", "dim", "as.array", "print", "Ops"}
 def _strip_r(src):
     """Blank out strings and comments with a char scanner — regexes
     mis-nest when a comment contains an apostrophe (\"don't\") or a
-    string contains '#'."""
+    string contains '#'. POSITION-PRESERVING: the result has the same
+    length as the input (string contents and comments become spaces),
+    so offsets found in the stripped text index into the raw text."""
     out = []
     i, n = 0, len(src)
     while i < n:
@@ -83,12 +85,15 @@ def _strip_r(src):
             i += 1
             while i < n and src[i] != quote:
                 if src[i] == "\\":
+                    out.append(" ")
                     i += 1
+                out.append(" ")
                 i += 1
             out.append(quote)
             i += 1
         elif ch == "#":
             while i < n and src[i] != "\n":
+                out.append(" ")
                 i += 1
         else:
             out.append(ch)
@@ -175,3 +180,83 @@ def test_every_r_call_resolves():
     assert not unresolved, (
         "R calls that resolve to no definition (typo'd API name?):\n"
         + "\n".join("  %s: %s()" % u for u in unresolved))
+
+
+def test_r_operator_usage_matches_registry():
+    """Every mx.symbol.create(\"Op\", key = ...) call site in R names a
+    REGISTERED operator and only passes declared parameter keys — a
+    typo'd op name or param (`n_filter` for `num_filter`) fails here
+    instead of at the first R runtime."""
+    import sys
+
+    sys.path.insert(0, REPO)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from mxnet_tpu.ops import registry
+
+    known_ops = {}
+    for key in registry.OP_REGISTRY.list_names():
+        cls = registry.OP_REGISTRY.get(key)
+        known_ops[getattr(cls, "op_name", key).lower()] = set(
+            getattr(cls, "PARAMS", {}))
+
+    # args every creator accepts regardless of op (symbol inputs by
+    # role name, and the node name)
+    generic = {"name", "data", "label", "weight", "bias", "rois",
+               "lhs", "rhs"}
+
+    bad = []
+    for path in _r_files():
+        raw = open(path).read()
+        stripped = _strip_r(raw)   # same length: offsets carry over
+        # single- or double-quoted op name; contents are blanked in
+        # `stripped`, so recover the actual name from `raw` at the
+        # same offsets
+        for m in re.finditer(
+                r"mx\.symbol\.create\(\s*([\"'])", stripped):
+            quote = m.group(1)
+            name_end = stripped.index(quote, m.end())
+            op = raw[m.end():name_end]
+            if op.lower() not in known_ops:
+                bad.append((os.path.relpath(path, REPO),
+                            "unknown op %r" % op))
+                continue
+            # param keys of THIS call: scan the STRIPPED text (strings
+            # and comments blanked) to the matching close paren
+            depth, i = 1, name_end + 1
+            while i < len(stripped) and depth:
+                if stripped[i] == "(":
+                    depth += 1
+                elif stripped[i] == ")":
+                    depth -= 1
+                i += 1
+            call = stripped[name_end + 1:i - 1]
+            # split the call body at DEPTH-0 commas so params of nested
+            # mx.symbol.create(...) calls aren't attributed to this op
+            args, depth, seg = [], 0, []
+            for ch in call:
+                if ch in "([":
+                    depth += 1
+                elif ch in ")]":
+                    depth -= 1
+                if ch == "," and depth == 0:
+                    args.append("".join(seg))
+                    seg = []
+                else:
+                    seg.append(ch)
+            args.append("".join(seg))
+            for arg in args:
+                pk = re.match(r"\s*([A-Za-z_][A-Za-z0-9._]*)\s*=[^=]",
+                              arg)
+                if not pk:
+                    continue
+                key = pk.group(1).replace(".", "_")
+                if key in generic or key in known_ops[op.lower()]:
+                    continue
+                bad.append((os.path.relpath(path, REPO),
+                            "%s(%s=...) not a declared param"
+                            % (op, pk.group(1))))
+    bad = sorted(set(bad))
+    assert not bad, ("R operator usage inconsistent with the registry:\n"
+                     + "\n".join("  %s: %s" % b for b in bad))
