@@ -172,6 +172,34 @@ mx.symbol.get.output <- function(symbol, index) structure(
 
 `[[.MXSymbol` <- function(x, i) mx.symbol.get.output(x, i)
 
+# arithmetic group generic on SYMBOLS (reference R-package/R/symbol.R
+# Ops.MXSymbol: graph-building +,-,*,/ dispatch to the registered
+# _Plus/_Minus/... internal ops, so residual connections like
+# `identity + conv` compose symbolically)
+Ops.MXSymbol <- function(e1, e2) {
+  ops <- c("+" = "_Plus", "-" = "_Minus", "*" = "_Mul", "/" = "_Div")
+  if (missing(e2)) {                       # unary +x / -x
+    if (.Generic == "-")
+      return(mx.symbol.create("_MulScalar", e1, scalar = -1))
+    if (.Generic == "+")
+      return(e1)
+    stop("unary operator ", .Generic, " not supported on MXSymbol")
+  }
+  if (!(.Generic %in% names(ops)))
+    stop("operator ", .Generic, " not supported on MXSymbol")
+  s1 <- inherits(e1, "MXSymbol")
+  s2 <- inherits(e2, "MXSymbol")
+  if (s1 && s2)
+    return(mx.symbol.create(ops[[.Generic]], e1, e2))
+  if (s1)                                  # symbol <op> scalar
+    return(mx.symbol.create(paste0(ops[[.Generic]], "Scalar"), e1,
+                            scalar = e2))
+  # scalar <op> symbol: + and * commute; - and / need reversed forms
+  rev.op <- switch(.Generic, "+" = "_PlusScalar", "*" = "_MulScalar",
+                   "-" = "_RMinusScalar", "/" = "_RDivScalar")
+  mx.symbol.create(rev.op, e2, scalar = e1)
+}
+
 mx.symbol.Group <- function(...) {
   syms <- list(...)
   if (length(syms) == 1 && is.list(syms[[1]]) &&

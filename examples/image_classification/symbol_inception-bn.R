@@ -1,0 +1,84 @@
+# Inception-BatchNorm symbol in R (reference
+# example/image-classification/symbol_inception-bn.R).
+library(mxnet.tpu)
+
+conv.bn.act <- function(data, num_filter, kernel, stride = c(1, 1),
+                        pad = c(0, 0), name = "") {
+  conv <- mx.symbol.create("Convolution", data, kernel = kernel,
+                           stride = stride, pad = pad,
+                           num_filter = num_filter,
+                           name = paste0(name, "_conv"))
+  bn <- mx.symbol.create("BatchNorm", conv, name = paste0(name, "_bn"))
+  mx.symbol.create("Activation", bn, act_type = "relu",
+                   name = paste0(name, "_relu"))
+}
+
+inception.bn <- function(data, n1x1, n3x3red, n3x3, nd3x3red, nd3x3,
+                         pool, proj, name) {
+  c1 <- conv.bn.act(data, n1x1, c(1, 1), name = paste0(name, "_1x1"))
+  c3 <- conv.bn.act(data, n3x3red, c(1, 1),
+                    name = paste0(name, "_3x3r"))
+  c3 <- conv.bn.act(c3, n3x3, c(3, 3), pad = c(1, 1),
+                    name = paste0(name, "_3x3"))
+  cd <- conv.bn.act(data, nd3x3red, c(1, 1),
+                    name = paste0(name, "_d3x3r"))
+  cd <- conv.bn.act(cd, nd3x3, c(3, 3), pad = c(1, 1),
+                    name = paste0(name, "_d3x3a"))
+  cd <- conv.bn.act(cd, nd3x3, c(3, 3), pad = c(1, 1),
+                    name = paste0(name, "_d3x3b"))
+  p <- mx.symbol.create("Pooling", data, kernel = c(3, 3),
+                        stride = c(1, 1), pad = c(1, 1),
+                        pool_type = pool, name = paste0(name, "_pool"))
+  pp <- conv.bn.act(p, proj, c(1, 1), name = paste0(name, "_proj"))
+  mx.symbol.create("Concat", c1, c3, cd, pp, num_args = 4,
+                   name = paste0(name, "_concat"))
+}
+
+inception.bn.stride <- function(data, n3x3red, n3x3, nd3x3red, nd3x3,
+                                name) {
+  c3 <- conv.bn.act(data, n3x3red, c(1, 1),
+                    name = paste0(name, "_3x3r"))
+  c3 <- conv.bn.act(c3, n3x3, c(3, 3), stride = c(2, 2), pad = c(1, 1),
+                    name = paste0(name, "_3x3"))
+  cd <- conv.bn.act(data, nd3x3red, c(1, 1),
+                    name = paste0(name, "_d3x3r"))
+  cd <- conv.bn.act(cd, nd3x3, c(3, 3), pad = c(1, 1),
+                    name = paste0(name, "_d3x3a"))
+  cd <- conv.bn.act(cd, nd3x3, c(3, 3), stride = c(2, 2), pad = c(1, 1),
+                    name = paste0(name, "_d3x3b"))
+  p <- mx.symbol.create("Pooling", data, kernel = c(3, 3),
+                        stride = c(2, 2), pad = c(1, 1),
+                        pool_type = "max", name = paste0(name, "_pool"))
+  mx.symbol.create("Concat", c3, cd, p, num_args = 3,
+                   name = paste0(name, "_concat"))
+}
+
+get_symbol <- function(num_classes = 1000) {
+  data <- mx.symbol.Variable("data")
+  net <- conv.bn.act(data, 64, c(7, 7), c(2, 2), c(3, 3), "stem1")
+  net <- mx.symbol.create("Pooling", net, kernel = c(3, 3),
+                          stride = c(2, 2), pad = c(1, 1),
+                          pool_type = "max")
+  net <- conv.bn.act(net, 64, c(1, 1), name = "stem2r")
+  net <- conv.bn.act(net, 192, c(3, 3), pad = c(1, 1), name = "stem2")
+  net <- mx.symbol.create("Pooling", net, kernel = c(3, 3),
+                          stride = c(2, 2), pad = c(1, 1),
+                          pool_type = "max")
+  net <- inception.bn(net, 64, 64, 64, 64, 96, "avg", 32, "in3a")
+  net <- inception.bn(net, 64, 64, 96, 64, 96, "avg", 64, "in3b")
+  net <- inception.bn.stride(net, 128, 160, 64, 96, "in3c")
+  net <- inception.bn(net, 224, 64, 96, 96, 128, "avg", 128, "in4a")
+  net <- inception.bn(net, 192, 96, 128, 96, 128, "avg", 128, "in4b")
+  net <- inception.bn(net, 160, 128, 160, 128, 160, "avg", 128, "in4c")
+  net <- inception.bn(net, 96, 128, 192, 160, 192, "avg", 128, "in4d")
+  net <- inception.bn.stride(net, 128, 192, 192, 256, "in4e")
+  net <- inception.bn(net, 352, 192, 320, 160, 224, "avg", 128, "in5a")
+  net <- inception.bn(net, 352, 192, 320, 192, 224, "max", 128, "in5b")
+  net <- mx.symbol.create("Pooling", net, kernel = c(7, 7),
+                          stride = c(1, 1), pool_type = "avg",
+                          name = "gpool")
+  net <- mx.symbol.create("Flatten", net)
+  net <- mx.symbol.create("FullyConnected", net,
+                          num_hidden = num_classes, name = "fc1")
+  mx.symbol.create("SoftmaxOutput", net, name = "softmax")
+}

@@ -1,0 +1,75 @@
+# U-Net symbol in R (reference
+# example/image-classification/symbol_unet.R): encoder-decoder with
+# skip connections via Concat; Deconvolution up-pooling.
+library(mxnet.tpu)
+
+convolution_module <- function(net, kernel_size, pad_size, filter_count,
+                               stride = c(1, 1), batch_norm = TRUE,
+                               down_pool = FALSE, up_pool = FALSE,
+                               act_type = "relu", convolution = TRUE) {
+  if (up_pool) {
+    net <- mx.symbol.create("Deconvolution", net, kernel = c(2, 2),
+                            pad = c(0, 0), stride = c(2, 2),
+                            num_filter = filter_count)
+    net <- mx.symbol.create("BatchNorm", net)
+    if (act_type != "")
+      net <- mx.symbol.create("Activation", net, act_type = act_type)
+  }
+  if (convolution)
+    net <- mx.symbol.create("Convolution", net, kernel = kernel_size,
+                            stride = stride, pad = pad_size,
+                            num_filter = filter_count)
+  if (batch_norm)
+    net <- mx.symbol.create("BatchNorm", net)
+  if (act_type != "")
+    net <- mx.symbol.create("Activation", net, act_type = act_type)
+  if (down_pool)
+    net <- mx.symbol.create("Pooling", net, pool_type = "max",
+                            kernel = c(2, 2), stride = c(2, 2))
+  net
+}
+
+get_symbol <- function(num_classes = 10) {
+  data <- mx.symbol.Variable("data")
+  kernel_size <- c(3, 3)
+  pad_size <- c(1, 1)
+  filter_count <- 32
+
+  # encoder
+  pool1 <- convolution_module(data, kernel_size, pad_size, filter_count,
+                              down_pool = TRUE)
+  net <- pool1
+  pool2 <- convolution_module(net, kernel_size, pad_size,
+                              filter_count * 2, down_pool = TRUE)
+  net <- pool2
+  pool3 <- convolution_module(net, kernel_size, pad_size,
+                              filter_count * 4, down_pool = TRUE)
+  net <- pool3
+  pool4 <- convolution_module(net, kernel_size, pad_size,
+                              filter_count * 4, down_pool = TRUE)
+  net <- pool4
+  net <- mx.symbol.create("Dropout", net, p = 0.5)
+  pool5 <- convolution_module(net, kernel_size, pad_size,
+                              filter_count * 8, down_pool = TRUE)
+  net <- pool5
+
+  # decoder with skip connections
+  net <- convolution_module(net, kernel_size, pad_size,
+                            filter_count * 4, up_pool = TRUE)
+  net <- convolution_module(net, kernel_size, pad_size,
+                            filter_count * 4, up_pool = TRUE)
+  net <- mx.symbol.create("Concat", pool3, net, num_args = 2)
+  net <- mx.symbol.create("Dropout", net, p = 0.5)
+  net <- convolution_module(net, kernel_size, pad_size,
+                            filter_count * 4)
+  net <- convolution_module(net, kernel_size, pad_size,
+                            filter_count * 4, up_pool = TRUE)
+  net <- mx.symbol.create("Concat", pool2, net, num_args = 2)
+  net <- mx.symbol.create("Dropout", net, p = 0.5)
+  net <- convolution_module(net, kernel_size, pad_size,
+                            filter_count * 4)
+  net <- convolution_module(net, kernel_size, pad_size,
+                            filter_count * 4, up_pool = TRUE)
+  convolution_module(net, kernel_size, pad_size, filter_count * 4,
+                     up_pool = TRUE)
+}
