@@ -16,6 +16,7 @@ The reference registers NDArray functions into a C registry
 from __future__ import annotations
 
 import struct
+import warnings
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -31,6 +32,35 @@ __all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
 def _jnp():
     import jax.numpy as jnp
     return jnp
+
+
+def _require_dtype(dtype):
+    """Validate an explicitly requested dtype against jax's x64 mode.
+
+    With x64 disabled (the TPU default), jax silently narrows int64/
+    float64/uint64 to their 32-bit forms — the kind of divergence that
+    bites custom-op authors. The reference honors 64-bit dtypes
+    (``include/mxnet/base.h`` mshadow dtype tables), so here a 64-bit
+    request is either honored (x64 enabled) or rejected loudly — never
+    truncated.
+    """
+    if dtype is None:
+        # np.dtype(None) is float64 — an unset dtype means the reference
+        # default (mx_real_t), not a 64-bit request
+        return np.dtype(mx_real_t)
+    dt = np.dtype(dtype)
+    if dt.itemsize == 8 and dt.kind in "iuf":
+        from jax import config as _jax_config
+
+        if not _jax_config.read("jax_enable_x64"):
+            narrowed = np.dtype(dt.str[:-1] + "4")
+            raise MXNetError(
+                "dtype %s requested but jax is running with x64 disabled, "
+                "which would silently narrow it to %s. Request %s "
+                "explicitly, or enable 64-bit mode (JAX_ENABLE_X64=1 / "
+                "jax.config.update('jax_enable_x64', True)) to honor it."
+                % (dt, narrowed, narrowed))
+    return dt
 
 
 class NDArray:
@@ -104,7 +134,8 @@ class NDArray:
         return self.asnumpy().reshape(())[()]
 
     def astype(self, dtype) -> "NDArray":
-        return _new_from(self, lambda x: x.astype(np.dtype(dtype)), [self])
+        dt = _require_dtype(dtype)
+        return _new_from(self, lambda x: x.astype(dt), [self])
 
     # -- placement ---------------------------------------------------------
     def copyto(self, other: Union["NDArray", Context]) -> "NDArray":
@@ -326,9 +357,12 @@ def _inplace(lhs: NDArray, rhs, fn) -> NDArray:
 def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
     if isinstance(source, NDArray):
         source = source.asnumpy()
+    if dtype is not None:
+        dtype = _require_dtype(dtype)
     arr = np.asarray(source, dtype=dtype)
-    if dtype is None and arr.dtype in (np.float64, np.int64):
-        # reference default: float32 arrays (mx_real_t)
+    if dtype is None and arr.dtype in (np.float64, np.int64, np.uint64):
+        # reference default: float32 arrays (mx_real_t). uint64 included:
+        # letting it reach jax would silently truncate to uint32
         arr = arr.astype(mx_real_t)
     return NDArray(arr, ctx=ctx)
 
@@ -338,6 +372,7 @@ def empty(shape, ctx=None, dtype=mx_real_t) -> NDArray:
 
 
 def zeros(shape, ctx=None, dtype=mx_real_t) -> NDArray:
+    dtype = _require_dtype(dtype)
     jnp = _jnp()
     if isinstance(shape, int):
         shape = (shape,)
@@ -347,6 +382,7 @@ def zeros(shape, ctx=None, dtype=mx_real_t) -> NDArray:
 
 
 def ones(shape, ctx=None, dtype=mx_real_t) -> NDArray:
+    dtype = _require_dtype(dtype)
     jnp = _jnp()
     if isinstance(shape, int):
         shape = (shape,)
@@ -356,6 +392,7 @@ def ones(shape, ctx=None, dtype=mx_real_t) -> NDArray:
 
 
 def full(shape, val, ctx=None, dtype=mx_real_t) -> NDArray:
+    dtype = _require_dtype(dtype)
     jnp = _jnp()
     if isinstance(shape, int):
         shape = (shape,)
@@ -365,6 +402,7 @@ def full(shape, val, ctx=None, dtype=mx_real_t) -> NDArray:
 
 
 def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=mx_real_t) -> NDArray:
+    dtype = _require_dtype(dtype)
     arr = np.arange(start, stop, step, dtype=np.dtype(dtype))
     if repeat != 1:
         arr = np.repeat(arr, repeat)
@@ -513,6 +551,60 @@ def choose_element_0index(lhs: NDArray, rhs: NDArray) -> NDArray:
         lhs, lambda a, b: a[_jnp().arange(a.shape[0]), b.astype("int32")], [lhs, rhs])
 
 
+@_register_fn("element_mask")
+def element_mask(lhs: NDArray, rhs: NDArray) -> NDArray:
+    """out[i, ...] = lhs[i, ...] * rhs[i] — per-row mask broadcast
+    (reference SimpleOp element_mask, broadcast_mask_op-inl.h:23-60)."""
+    if lhs.ndim < 2 or rhs.ndim != 1 or lhs.shape[0] != rhs.shape[0]:
+        raise MXNetError(
+            "element_mask: source tensor should be 2D or more, mask 1D "
+            "with matching first dim; got lhs=%s rhs=%s"
+            % (lhs.shape, rhs.shape))
+
+    def _do(a, b):
+        mask = b.reshape((a.shape[0],) + (1,) * (a.ndim - 1))
+        return a * mask.astype(a.dtype)
+    return _new_from(lhs, _do, [lhs, rhs])
+
+
+def _check_crop_region(shape, begin, end, what="crop_assign"):
+    """Validate a [begin, end) region against shape; returns the region
+    shape. Shared by the imperative fns here and the symbolic
+    CropAssign/CropAssignScalar ops (ops/tensor.py)."""
+    if len(begin) != len(shape) or len(end) != len(shape):
+        raise MXNetError("%s: begin/end must cover all %d axes"
+                         % (what, len(shape)))
+    for b, e, d in zip(begin, end, shape):
+        if not (0 <= b <= e <= d):
+            raise MXNetError("%s: invalid range [%d, %d) on axis of size "
+                             "%d" % (what, b, e, d))
+    return tuple(e - b for b, e in zip(begin, end))
+
+
+@_register_fn("crop_assign")
+def crop_assign(lhs: NDArray, rhs: NDArray, begin, end) -> NDArray:
+    """Write rhs into lhs[begin:end) (reference SimpleOp _crop_assign,
+    matrix_op-inl.h:452-524; functional here — returns a new array)."""
+    region = _check_crop_region(lhs.shape, begin, end)
+    if rhs.shape != region:
+        raise MXNetError("crop_assign: rhs shape %s does not match region "
+                         "%s" % (rhs.shape, region))
+    idx = tuple(slice(b, e) for b, e in zip(begin, end))
+    return _new_from(lhs, lambda a, b: a.at[idx].set(b.astype(a.dtype)),
+                     [lhs, rhs])
+
+
+@_register_fn("crop_assign_scalar")
+def crop_assign_scalar(data: NDArray, scalar, begin, end) -> NDArray:
+    """Fill data[begin:end) with a scalar (reference SimpleOp
+    _crop_assign_scalar, matrix_op-inl.h:526-600)."""
+    _check_crop_region(data.shape, begin, end)
+    idx = tuple(slice(b, e) for b, e in zip(begin, end))
+    return _new_from(
+        data, lambda a: a.at[idx].set(np.asarray(scalar, dtype=a.dtype)),
+        [data])
+
+
 # ---------------------------------------------------------------------------
 # serialization (reference ndarray.h:304-315 save/load with names)
 # ---------------------------------------------------------------------------
@@ -567,6 +659,20 @@ def load_from_stream(f, what: str = "<stream>"):
         nbytes, = struct.unpack("<Q", f.read(8))
         raw = f.read(nbytes)
         arr = np.frombuffer(raw, dtype=DTYPE_ID_TO_NP[dtype_id]).reshape(shape)
+        dt = arr.dtype
+        if dt.itemsize == 8 and dt.kind in "iuf":
+            from jax import config as _jax_config
+
+            if not _jax_config.read("jax_enable_x64"):
+                # loading must not hard-fail on 64-bit checkpoints (saved
+                # under x64 or by the reference): narrow deliberately,
+                # loudly — unlike creation, where the request is rejected
+                narrowed = np.dtype(dt.str[:-1] + "4")
+                warnings.warn(
+                    "%s: narrowing stored %s array to %s (jax x64 "
+                    "disabled; set JAX_ENABLE_X64=1 to load losslessly)"
+                    % (what, dt, narrowed), stacklevel=2)
+                arr = arr.astype(narrowed)
         arrays.append(array(arr, dtype=arr.dtype))
     n_names, = struct.unpack("<Q", f.read(8))
     names = []

@@ -398,7 +398,9 @@ class Pooling(Operator):
 # ---------------------------------------------------------------------------
 # BatchNorm (reference batch_norm-inl.h; aux moving_mean/moving_var)
 # ---------------------------------------------------------------------------
-@register_op("BatchNorm")
+# CuDNNBatchNorm (reference cudnn_batch_norm.cc) is the same op with a
+# vendor fast path; XLA is the single backend here, so it aliases.
+@register_op("BatchNorm", aliases=("CuDNNBatchNorm",))
 class BatchNorm(Operator):
     name_hint = "batchnorm"
     PARAMS = {

@@ -110,23 +110,37 @@ class _ScalarOp(Operator):
         return [type(self).fn(inputs[0], self.scalar)], []
 
 
-def _def_scalar(name, hint, fn):
+def _def_scalar(name, hint, fn, aliases=()):
     cls = type(name.strip("_"), (_ScalarOp,), {"fn": staticmethod(fn),
                                                "name_hint": hint})
-    register_op(name)(cls)
+    # the reference registers these SimpleOps under snake_case names too
+    # (operator_util.cc TOSTRING of the op name, e.g. "_plus_scalar")
+    register_op(name, aliases=aliases)(cls)
     return cls
 
 
-_def_scalar("_PlusScalar", "plusscalar", lambda a, s: a + s)
-_def_scalar("_MinusScalar", "minusscalar", lambda a, s: a - s)
-_def_scalar("_RMinusScalar", "rminusscalar", lambda a, s: s - a)
-_def_scalar("_MulScalar", "mulscalar", lambda a, s: a * s)
-_def_scalar("_DivScalar", "divscalar", lambda a, s: a / s)
-_def_scalar("_RDivScalar", "rdivscalar", lambda a, s: s / a)
-_def_scalar("_PowerScalar", "powerscalar", lambda a, s: a ** s)
-_def_scalar("_RPowerScalar", "rpowerscalar", lambda a, s: s ** a)
-_def_scalar("_MaximumScalar", "maximumscalar", lambda a, s: _jnp().maximum(a, s))
-_def_scalar("_MinimumScalar", "minimumscalar", lambda a, s: _jnp().minimum(a, s))
+_def_scalar("_PlusScalar", "plusscalar", lambda a, s: a + s,
+            aliases=("_plus_scalar",))
+_def_scalar("_MinusScalar", "minusscalar", lambda a, s: a - s,
+            aliases=("_minus_scalar",))
+_def_scalar("_RMinusScalar", "rminusscalar", lambda a, s: s - a,
+            aliases=("_rminus_scalar",))
+_def_scalar("_MulScalar", "mulscalar", lambda a, s: a * s,
+            aliases=("_mul_scalar",))
+_def_scalar("_DivScalar", "divscalar", lambda a, s: a / s,
+            aliases=("_div_scalar",))
+_def_scalar("_RDivScalar", "rdivscalar", lambda a, s: s / a,
+            aliases=("_rdiv_scalar",))
+_def_scalar("_PowerScalar", "powerscalar", lambda a, s: a ** s,
+            aliases=("_power_scalar",))
+_def_scalar("_RPowerScalar", "rpowerscalar", lambda a, s: s ** a,
+            aliases=("_rpower_scalar",))
+_def_scalar("_MaximumScalar", "maximumscalar",
+            lambda a, s: _jnp().maximum(a, s),
+            aliases=("_maximum_scalar",))
+_def_scalar("_MinimumScalar", "minimumscalar",
+            lambda a, s: _jnp().minimum(a, s),
+            aliases=("_minimum_scalar",))
 
 
 # ---------------------------------------------------------------------------
@@ -494,6 +508,117 @@ class Crop(Operator):
         else:
             oh, ow = self.offset
         return [x[:, :, oh:oh + h, ow:ow + w]], []
+
+
+@register_op("element_mask")
+class ElementMask(Operator):
+    """reference SimpleOp ``element_mask`` (broadcast_mask_op-inl.h:23-88):
+    ``out[i, ...] = lhs[i, ...] * rhs[i]`` — a 1-D per-row mask broadcast
+    over a >=2-D tensor. The reference backward masks only ``out_grad``
+    into ``lhs_grad`` and assigns no ``rhs_grad``, so the mask is a
+    constant for autodiff (stop_gradient)."""
+
+    name_hint = "elementmask"
+
+    def list_arguments(self):
+        return ["lhs", "rhs"]
+
+    def infer_shape(self, in_shapes):
+        lhs, rhs = in_shapes
+        if lhs is None:
+            raise MXNetError("element_mask: lhs shape unknown")
+        if len(lhs) < 2:
+            raise MXNetError("element_mask: source tensor should be 2D or "
+                             "more, got %s" % (lhs,))
+        want_rhs = (lhs[0],)
+        if rhs is not None and tuple(rhs) != want_rhs:
+            raise MXNetError("element_mask: mask must be 1D of length %d, "
+                             "got %s" % (lhs[0], rhs))
+        return [lhs, want_rhs], [lhs], []
+
+    def apply(self, ctx, inputs, aux):
+        jax = _jax()
+        lhs, rhs = inputs
+        mask = jax.lax.stop_gradient(rhs).reshape(
+            (lhs.shape[0],) + (1,) * (len(lhs.shape) - 1))
+        return [lhs * mask.astype(lhs.dtype)], []
+
+
+@register_op("_crop_assign", aliases=("_CropAssign",))
+class CropAssign(Operator):
+    """reference SimpleOp ``_crop_assign`` (matrix_op-inl.h:452-524):
+    write ``rhs`` into the ``[begin, end)`` region of ``lhs``. The
+    reference mutates lhs in place (kWriteInplace); here the op is
+    functional — ``at[...].set`` — and the executor's output buffer
+    takes the role of the in-place destination."""
+
+    name_hint = "cropassign"
+    PARAMS = {
+        "begin": Param("shape", REQUIRED),
+        "end": Param("shape", REQUIRED),
+    }
+
+    def list_arguments(self):
+        return ["lhs", "rhs"]
+
+    def infer_shape(self, in_shapes):
+        from ..ndarray import _check_crop_region
+
+        lhs, rhs = in_shapes
+        if lhs is None:
+            raise MXNetError("_crop_assign: lhs shape unknown")
+        region = _check_crop_region(lhs, self.begin, self.end,
+                                    "_crop_assign")
+        if rhs is not None and tuple(rhs) != region:
+            raise MXNetError("_crop_assign: rhs shape %s does not match "
+                             "region %s" % (rhs, region))
+        return [lhs, region], [lhs], []
+
+    def apply(self, ctx, inputs, aux):
+        lhs, rhs = inputs
+        idx = tuple(slice(b, e) for b, e in zip(self.begin, self.end))
+        return [lhs.at[idx].set(rhs.astype(lhs.dtype))], []
+
+
+@register_op("_crop_assign_scalar", aliases=("_CropAssignScalar",))
+class CropAssignScalar(Operator):
+    """reference SimpleOp ``_crop_assign_scalar`` (matrix_op-inl.h:526-600):
+    fill the ``[begin, end)`` region of the input with a scalar."""
+
+    name_hint = "cropassignscalar"
+    PARAMS = {
+        "scalar": Param(float, 0.0),
+        "begin": Param("shape", REQUIRED),
+        "end": Param("shape", REQUIRED),
+    }
+
+    def infer_shape(self, in_shapes):
+        from ..ndarray import _check_crop_region
+
+        data = in_shapes[0]
+        if data is None:
+            raise MXNetError("_crop_assign_scalar: data shape unknown")
+        _check_crop_region(data, self.begin, self.end,
+                           "_crop_assign_scalar")
+        return [data], [data], []
+
+    def apply(self, ctx, inputs, aux):
+        x = inputs[0]
+        idx = tuple(slice(b, e) for b, e in zip(self.begin, self.end))
+        return [x.at[idx].set(np.asarray(self.scalar, dtype=x.dtype))], []
+
+
+@register_op("_CrossDeviceCopy")
+class CrossDeviceCopy(Operator):
+    """reference ``_CrossDeviceCopy`` (cross_device_copy.cc): a graph node
+    marking a device boundary. Placement is the executor's job (group2ctx
+    inserts jax.device_put at ctx_group edges — executor.py make_graph_eval),
+    so the op itself is the identity."""
+
+    name_hint = "crossdevicecopy"
+
+    def apply(self, ctx, inputs, aux):
+        return [inputs[0]], []
 
 
 @register_op("slice_axis")

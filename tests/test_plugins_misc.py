@@ -161,7 +161,7 @@ def test_torch_bridge_int_label_criterion():
     loss = torch_criterion(lambda: torch.nn.CrossEntropyLoss(), data,
                            label, name="ce_int")
     ex = loss.simple_bind(mx.cpu(), data=(4, 3), label=(4,),
-                          type_dict={"label": np.int64})
+                          type_dict={"label": np.int32})
     ex.arg_dict["data"][:] = np.random.rand(4, 3).astype(np.float32)
     ex.arg_dict["label"][:] = np.array([0, 1, 2, 0])
     ex.forward(is_train=True)
