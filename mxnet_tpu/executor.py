@@ -367,12 +367,33 @@ class Executor:
                 return jnp.zeros_like(x)
             return np.zeros(x.shape, jax.dtypes.float0)
 
-        def make_fwd_bwd(want_internals):
+        # Donate the aux buffers (BN running stats) into the fused train
+        # step: backward() always replaces them with aux_out, so XLA can
+        # write the new stats into the old HBM buffers. Args (params) are
+        # NOT donated — they outlive the step (the optimizer update, which
+        # donates them itself, runs outside this computation) — and neither
+        # are head_grads (self._head_ones is cached across steps). Donation
+        # follows the same engine-safety rule as the optimizer kernels,
+        # re-checked at every call: set_engine() may switch to a threaded
+        # engine after bind, and a donation decision frozen at bind time
+        # would keep deleting buffers a queued reader still sees.
+        from .optimizer import _donation_ok
+
+        fwd_bwd_cache = {}
+
+        def get_fwd_bwd(want_internals):
+            k = (want_internals, _donation_ok())
+            if k not in fwd_bwd_cache:
+                fwd_bwd_cache[k] = make_fwd_bwd(*k)
+            return fwd_bwd_cache[k]
+
+        def make_fwd_bwd(want_internals, donate):
             # one builder for the plain and the monitored training step:
             # with want_internals the SAME fused fwd+bwd also emits every
             # internal output, so a monitored batch costs one forward
             # (the naive monitor-forward-then-train scheme doubled it)
-            @jax.jit
+            @functools.partial(jax.jit,
+                               donate_argnums=(1,) if donate else ())
             def step(args, aux, key, head_grads):
                 garr = [args[i] for i in grad_idx]
 
@@ -395,16 +416,13 @@ class Executor:
 
             return step
 
-        _fwd_bwd_plain = make_fwd_bwd(False)
-        _fwd_bwd_mon = make_fwd_bwd(True)
-
         def fwd_bwd(args, aux, key, head_grads):
-            outs, aux_out, grads = _fwd_bwd_plain(args, aux, key,
-                                                  head_grads)
+            outs, aux_out, grads = get_fwd_bwd(False)(args, aux, key,
+                                                      head_grads)
             return outs, grads, aux_out
 
         def fwd_bwd_monitor(args, aux, key, head_grads):
-            outs, aux_out, internals, grads = _fwd_bwd_mon(
+            outs, aux_out, internals, grads = get_fwd_bwd(True)(
                 args, aux, key, head_grads)
             return outs, grads, aux_out, internals
 
@@ -415,6 +433,10 @@ class Executor:
         self._fwd_infer = fwd_infer
         self._fwd_train = fwd_train
         self._fwd_bwd = fwd_bwd
+        # raw jitted step factory, exposed for the HLO regression gates
+        # (tests/test_hlo_gates.py asserts aux donation aliasing on
+        # _get_fwd_bwd(False) under the default engine)
+        self._get_fwd_bwd = get_fwd_bwd
         self._fwd_monitor = fwd_monitor
         self._fwd_bwd_monitor = fwd_bwd_monitor
 

@@ -1,0 +1,375 @@
+"""Pre-decoded image cache: decode JPEG recordio ONCE, feed forever.
+
+The reference scales JPEG decode with an OMP pool
+(``/root/reference/src/io/iter_image_recordio.cc:109-455``) — on a GPU
+box with dozens of cores that feeds the device. A TPU v5e consumes
+~2,500 img/s at 224px while one host core decodes ~90 img/s, so decoding
+per epoch can never feed the chip from a few cores. The TPU-native
+answer is to move the expensive work out of the steady state:
+
+* ``build_decoded_cache``  — one offline pass: decode + resize every
+  record, store raw uint8 HWC tensors in a memmapped flat file (plus a
+  float32 label table and a JSON header). Decode cost is paid once per
+  dataset, not once per epoch.
+* ``CachedImageRecordIter`` — training-time iterator over the memmap.
+  Per-epoch augmentation keeps the cheap ops (random crop = array
+  slicing, mirror = negative stride) on the host, and runs the
+  arithmetic (cast, mean/scale normalize, HWC->CHW) on DEVICE in one
+  fused jitted kernel. Batches cross the host->device link as uint8 —
+  4x fewer bytes than float32.
+
+Cache layout (``<prefix>.meta.json`` / ``.data`` / ``.label``)::
+
+    meta:  {"num": N, "height": H, "width": W, "channels": C,
+            "label_width": L, "version": 1}
+    data:  uint8  [N, H, W, C]   (memmapped at iteration time)
+    label: float32 [N, L]
+
+The stored H/W should be the training crop plus the augmentation margin
+(e.g. store 256, crop 224 — the classic ImageNet recipe).
+"""
+from __future__ import annotations
+
+import json
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .base import MXNetError
+from .io import DataBatch, DataDesc, DataIter
+
+__all__ = ["build_decoded_cache", "CachedImageRecordIter"]
+
+
+def _decode_record(rec: bytes, store_hw: Tuple[int, int], channels: int):
+    """JPEG record -> (uint8 HWC resized to store_hw, label vector)."""
+    from PIL import Image
+
+    from . import recordio as rio
+
+    header, img = rio.unpack_img(rec, iscolor=1 if channels == 3 else 0)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    h, w = store_hw
+    if img.shape[0] != h or img.shape[1] != w:
+        img = np.asarray(Image.fromarray(img.astype(np.uint8))
+                         .resize((w, h)))
+        if img.ndim == 2:
+            img = img[:, :, None]
+    return img.astype(np.uint8), np.atleast_1d(
+        np.asarray(header.label, dtype=np.float32))
+
+
+def build_decoded_cache(path_imgrec: str, cache_prefix: str,
+                        store_shape: Tuple[int, int, int],
+                        preprocess_threads: int = 4,
+                        overwrite: bool = False) -> dict:
+    """Decode every record of ``path_imgrec`` once into a memmapped
+    uint8 cache at ``cache_prefix``. ``store_shape`` is (C, H, W) — use
+    crop size + margin (e.g. (3, 256, 256) for 224 training).
+
+    Returns the meta dict. Idempotent: an existing complete cache with
+    the SAME store shape is reused; a shape mismatch (or ``overwrite``)
+    rebuilds. The write is atomic (tmp + rename) so a killed build can't
+    leave a torn cache that later runs trust. Memory stays bounded at
+    one decode chunk regardless of dataset size."""
+    from . import recordio as rio
+
+    c, h, w = store_shape
+    meta_path = cache_prefix + ".meta.json"
+    if not overwrite and os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        if (meta.get("height"), meta.get("width"),
+                meta.get("channels")) == (h, w, c):
+            return meta
+        # a cache built at a different store_shape is NOT the cache the
+        # caller asked for — silently reusing it would train with the
+        # wrong augmentation margin (or make every crop request fail
+        # with 'rebuild the cache' while rebuild keeps no-op'ing)
+
+    # pass 1: count records (framing reads only, no decode, no
+    # retention — an ImageNet-scale .rec must never be resident in RAM)
+    n = 0
+    reader = rio.MXRecordIO(path_imgrec, "r")
+    while reader.read() is not None:
+        n += 1
+    reader.close()
+    if n == 0:
+        raise MXNetError("no records found in %s" % path_imgrec)
+
+    # pass 2: stream decode in bounded chunks — peak RAM is one chunk of
+    # compressed records + its decoded rows, independent of dataset size
+    reader = rio.MXRecordIO(path_imgrec, "r")
+    first = reader.read()
+    _, first_label = _decode_record(first, (h, w), c)
+    label_width = first_label.size
+    pid_sfx = ".tmp.%d" % os.getpid()
+    data_tmp = cache_prefix + ".data" + pid_sfx
+    label_tmp = cache_prefix + ".label" + pid_sfx
+    data_mm = np.lib.format.open_memmap(
+        data_tmp, mode="w+", dtype=np.uint8, shape=(n, h, w, c))
+    labels = np.zeros((n, label_width), dtype=np.float32)
+
+    def _work(args):
+        i, rec = args
+        img, label = _decode_record(rec, (h, w), c)
+        data_mm[i] = img
+        labels[i, :] = label
+
+    threads = max(1, int(preprocess_threads))
+    chunk_size = max(64, 16 * threads)
+    pool = ThreadPoolExecutor(threads) if threads > 1 else None
+    try:
+        i, rec = 0, first
+        chunk = []
+        while rec is not None:
+            chunk.append((i, rec))
+            if len(chunk) >= chunk_size:
+                if pool is not None:
+                    list(pool.map(_work, chunk))
+                else:
+                    for item in chunk:
+                        _work(item)
+                chunk = []
+            i += 1
+            rec = reader.read()
+        if chunk:
+            if pool is not None:
+                list(pool.map(_work, chunk))
+            else:
+                for item in chunk:
+                    _work(item)
+    finally:
+        if pool is not None:
+            pool.shutdown()
+        reader.close()
+    data_mm.flush()
+    del data_mm
+    np.save(label_tmp, labels)
+    # np.save appends .npy; normalize the tmp name back
+    if os.path.exists(label_tmp + ".npy"):
+        os.replace(label_tmp + ".npy", label_tmp)
+
+    meta = {"num": n, "height": h, "width": w, "channels": c,
+            "label_width": int(label_width), "version": 1}
+    meta_tmp = meta_path + pid_sfx
+    with open(meta_tmp, "w") as f:
+        json.dump(meta, f)
+    # publish data before meta: meta's existence is the completeness marker
+    os.replace(data_tmp, cache_prefix + ".data")
+    os.replace(label_tmp, cache_prefix + ".label")
+    os.replace(meta_tmp, meta_path)
+    return meta
+
+
+class CachedImageRecordIter(DataIter):
+    """Iterator over a pre-decoded uint8 cache (see module docstring).
+
+    Augmentation model (the steady-state-cheap subset of
+    ``ImageRecordIter``): per-epoch reshuffle, random/center crop from
+    the stored margin, random mirror. Color jitter and affine transforms
+    belong in the one-off cache build or the model, not the per-epoch
+    loop. ``mean_rgb``/``scale`` normalization and HWC->CHW run fused on
+    device; the host only slices uint8.
+
+    Sharding mirrors ``ImageRecordIter`` (``num_parts``/``part_index``
+    give each worker a disjoint shard, reference
+    iter_image_recordio.cc:109-170)."""
+
+    def __init__(self, cache_prefix: str, data_shape, batch_size: int,
+                 shuffle: bool = True, rand_crop: bool = False,
+                 rand_mirror: bool = False, num_parts: int = 1,
+                 part_index: int = 0, seed: int = 0,
+                 mean_r: float = 0.0, mean_g: float = 0.0,
+                 mean_b: float = 0.0, scale: float = 1.0,
+                 device_normalize: bool = True,
+                 device_augment: bool = False,
+                 label_name: str = "softmax_label"):
+        super().__init__()
+        meta_path = cache_prefix + ".meta.json"
+        if not os.path.exists(meta_path):
+            raise MXNetError(
+                "no decoded cache at %s (build one with "
+                "mxnet_tpu.io_cache.build_decoded_cache or "
+                "tools/im2tensor.py)" % meta_path)
+        with open(meta_path) as f:
+            self.meta = json.load(f)
+        c, h, w = data_shape
+        if c != self.meta["channels"]:
+            raise MXNetError("cache stores %d channels, asked for %d"
+                             % (self.meta["channels"], c))
+        if h > self.meta["height"] or w > self.meta["width"]:
+            raise MXNetError(
+                "crop %dx%d exceeds stored size %dx%d — rebuild the "
+                "cache with a larger store_shape"
+                % (h, w, self.meta["height"], self.meta["width"]))
+        self.data_shape = (c, h, w)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.scale = scale
+        self.mean = np.asarray([mean_r, mean_g, mean_b][:c], np.float32)
+        self.device_normalize = device_normalize
+        # device_augment ships the FULL stored frame as uint8 and runs
+        # crop + mirror + normalize fused on the accelerator (vmapped
+        # dynamic_slice): the host's only per-batch work is one memmap
+        # gather (~27k img/s/core measured at 256px — >10x a v5e's
+        # 2.5k img/s ResNet-50 consumption); the crop FLOPs vanish into
+        # the device step. The host-crop mode (~3k img/s/core) stays the
+        # default for CPU-only runs where device cycles are host cycles.
+        self.device_augment = device_augment
+        self.label_name = label_name
+        self._data = np.load(cache_prefix + ".data", mmap_mode="r")
+        self._labels = np.load(cache_prefix + ".label", mmap_mode="r")
+        self._seed = int(seed)
+        self._epoch = 0
+        # rank sharding: contiguous stripes, same contract as
+        # ImageRecordIter (disjoint, near-equal)
+        n = self.meta["num"]
+        if not (0 <= part_index < num_parts):
+            raise MXNetError("part_index %d out of range for num_parts %d"
+                             % (part_index, num_parts))
+        per = n // num_parts
+        extra = n % num_parts
+        start = part_index * per + min(part_index, extra)
+        count = per + (1 if part_index < extra else 0)
+        self._indices = np.arange(start, start + count)
+        self.num_data = count
+        self.cursor = -batch_size
+        self._order = None
+        self._norm_fn = None
+
+    # -- normalize-on-device kernel -------------------------------------
+    def _normalize(self, batch_u8: np.ndarray):
+        """uint8 NHWC -> float32 NCHW, (x - mean) * scale, one fused XLA
+        kernel on the default device. The uint8 host->device transfer
+        moves 4x fewer bytes than shipping float32."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._norm_fn is None:
+            mean = jnp.asarray(self.mean, jnp.float32)
+            scale = float(self.scale)
+
+            @jax.jit
+            def norm(x):
+                y = (x.astype(jnp.float32) - mean) * scale
+                return jnp.transpose(y, (0, 3, 1, 2))
+
+            self._norm_fn = norm
+        return self._norm_fn(batch_u8)
+
+    def _device_augment(self, full_u8, tops, lefts, mirror):
+        """uint8 NHWC full frames + per-image crop offsets/mirror mask ->
+        augmented, normalized float32 NCHW, all in one jitted kernel."""
+        import jax
+        import jax.numpy as jnp
+
+        if getattr(self, "_aug_fn", None) is None:
+            c, h, w = self.data_shape
+            mean = jnp.asarray(self.mean, jnp.float32)
+            scale = float(self.scale)
+
+            @jax.jit
+            def aug(x, top, left, m):
+                def one(img, t, l, mi):
+                    crop = jax.lax.dynamic_slice(img, (t, l, 0), (h, w, c))
+                    return jnp.where(mi, crop[:, ::-1], crop)
+
+                y = jax.vmap(one)(x, top, left, m)
+                y = (y.astype(jnp.float32) - mean) * scale
+                return jnp.transpose(y, (0, 3, 1, 2))
+
+            self._aug_fn = aug
+        return self._aug_fn(full_u8, tops, lefts, mirror)
+
+    # -- DataIter interface ---------------------------------------------
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        lw = self.meta["label_width"]
+        shape = (self.batch_size,) if lw == 1 else (self.batch_size, lw)
+        return [DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        self._epoch += 1
+        self._order = None
+
+    def _epoch_order(self):
+        if self._order is None:
+            if self.shuffle:
+                rng = np.random.RandomState(
+                    (self._seed * 0x9E3779B1 + self._epoch * 1000003)
+                    & 0xFFFFFFFF)
+                self._order = self._indices[rng.permutation(self.num_data)]
+            else:
+                self._order = self._indices
+        return self._order
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor + self.batch_size <= self.num_data
+
+    def next(self) -> DataBatch:
+        from . import ndarray as nd
+
+        if not self.iter_next():
+            raise StopIteration
+        idx = self._epoch_order()[self.cursor:self.cursor + self.batch_size]
+        c, h, w = self.data_shape
+        sh, sw = self.meta["height"], self.meta["width"]
+        rng = np.random.RandomState(
+            (self._seed * 2654435761 + self._epoch * 1000003
+             + self.cursor) & 0xFFFFFFFF)
+
+        if self.device_augment:
+            # order within a batch is irrelevant to SGD; sorting the
+            # gather improves memmap locality
+            gidx = np.sort(idx)
+            full = np.ascontiguousarray(self._data[gidx])
+            if self.rand_crop and (sh > h or sw > w):
+                tops = rng.randint(0, sh - h + 1, self.batch_size)
+                lefts = rng.randint(0, sw - w + 1, self.batch_size)
+            else:
+                tops = np.full(self.batch_size, (sh - h) // 2)
+                lefts = np.full(self.batch_size, (sw - w) // 2)
+            mirror = (rng.rand(self.batch_size) < 0.5) if self.rand_mirror \
+                else np.zeros(self.batch_size, bool)
+            data = nd.NDArray(self._device_augment(full, tops, lefts,
+                                                   mirror))
+            labels = np.asarray(self._labels[gidx])
+            if self.meta["label_width"] == 1:
+                labels = labels[:, 0]
+            return DataBatch([data], [nd.array(labels)], pad=0,
+                             index=gidx)
+
+        out = np.empty((self.batch_size, h, w, c), dtype=np.uint8)
+        for k, i in enumerate(idx):
+            if self.rand_crop and (sh > h or sw > w):
+                top = rng.randint(0, sh - h + 1)
+                left = rng.randint(0, sw - w + 1)
+            else:
+                top, left = (sh - h) // 2, (sw - w) // 2
+            img = self._data[i, top:top + h, left:left + w]
+            if self.rand_mirror and rng.rand() < 0.5:
+                img = img[:, ::-1]
+            out[k] = img
+        labels = np.asarray(self._labels[idx])
+        if self.meta["label_width"] == 1:
+            labels = labels[:, 0]
+
+        if self.device_normalize:
+            data = nd.NDArray(self._normalize(out))
+        else:
+            x = (out.astype(np.float32) - self.mean) * self.scale
+            data = nd.array(np.transpose(x, (0, 3, 1, 2)))
+        return DataBatch([data], [nd.array(labels)], pad=0,
+                         index=np.asarray(idx))

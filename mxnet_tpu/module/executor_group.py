@@ -123,6 +123,17 @@ class DataParallelExecutorGroup:
             if name in shared_args and shared_args[name].shape == shape:
                 arr = shared_args[name]
             else:
+                if name in shared_args and not is_data:
+                    # weight sharing requires shape invariance across
+                    # buckets (reference shared_exec contract,
+                    # graph_executor.cc Init shared-memory path): a
+                    # silently re-allocated zero param would train/infer
+                    # garbage for this bucket
+                    raise MXNetError(
+                        "shared param '%s' changes shape across buckets "
+                        "(%s vs %s); bucketing shares weights, so every "
+                        "bucket's symbol must give params the same shape"
+                        % (name, shared_args[name].shape, shape))
                 arr = self._place(np.zeros(shape, dtype=np.float32), baxis)
             args.append(arr)
             if self.grad_req.get(name, "null") != "null":
@@ -160,13 +171,27 @@ class DataParallelExecutorGroup:
     # ------------------------------------------------------------------
     def set_params(self, arg_params: Dict[str, NDArray],
                    aux_params: Dict[str, NDArray]):
+        def _placed_copy(arr):
+            # _place is a no-copy when the source already lives on the
+            # target device (device_put returns a fresh HANDLE to the SAME
+            # buffer); the executor's buffers get DONATED (optimizer
+            # update, fused-train-step aux), so they must never alias the
+            # module-level host copies — donation would delete both
+            import jax.numpy as jnp
+
+            from ..ndarray import _shares_buffer
+
+            placed = self._place(arr, None)._data
+            if isinstance(arr, NDArray) and _shares_buffer(placed, arr._data):
+                placed = jnp.copy(placed)
+            return placed
+
         for name, arr in arg_params.items():
             if name in self.executor.arg_dict:
-                dst = self.executor.arg_dict[name]
-                dst._data = self._place(arr, None)._data
+                self.executor.arg_dict[name]._data = _placed_copy(arr)
         for name, arr in (aux_params or {}).items():
             if name in self.executor.aux_dict:
-                self.executor.aux_dict[name]._data = self._place(arr, None)._data
+                self.executor.aux_dict[name]._data = _placed_copy(arr)
 
     def get_params(self, arg_params: Dict[str, NDArray],
                    aux_params: Dict[str, NDArray]):

@@ -106,10 +106,17 @@ class Module(BaseModule):
             grad_req=grad_req)
         self.binded = True
         if shared_module is not None and shared_module.params_initialized:
+            # weight sharing happens at the executor tier: _bind_exec reused
+            # the shared group's param NDArrays directly. Do NOT set_params
+            # from the module-level host copies here — they go stale the
+            # moment update() runs (only get_params syncs them back), so
+            # copying them in would reset trained weights on every
+            # new-bucket bind.
             self._arg_params = shared_module._arg_params
             self._aux_params = shared_module._aux_params
+            self._params_dirty = shared_module._params_dirty
             self.params_initialized = True
-        if self.params_initialized:
+        elif self.params_initialized:
             # params loaded before bind (Module.load path)
             self._exec_group.set_params(self._arg_params, self._aux_params)
 

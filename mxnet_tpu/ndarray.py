@@ -63,6 +63,23 @@ def _require_dtype(dtype):
     return dt
 
 
+def _shares_buffer(a, b) -> bool:
+    """True when two jax arrays alias the same device buffer.
+
+    ``jax.device_put`` (and no-op ``astype``) on a same-device array may
+    return a NEW ``jax.Array`` handle to the SAME underlying buffer, so an
+    identity check is insufficient: donating one handle deletes the data
+    both see. Sharded arrays have no single buffer pointer — there
+    ``device_put`` across shardings is a real copy, so answering False is
+    correct."""
+    if a is b:
+        return True
+    try:
+        return a.unsafe_buffer_pointer() == b.unsafe_buffer_pointer()
+    except Exception:
+        return False
+
+
 class NDArray:
     """An n-dimensional device array with imperative, engine-ordered ops."""
 
@@ -153,8 +170,17 @@ class NDArray:
             raise MXNetError("copyto shape mismatch %s vs %s" % (self.shape, other.shape))
 
         def _do():
-            other._data = jax.device_put(
+            new = jax.device_put(
                 self._data.astype(other.dtype), other._ctx.jax_device())
+            if _shares_buffer(new, self._data):
+                # device_put is a no-copy on same-device transfers; copyto
+                # must yield a DISTINCT buffer, or donating either array
+                # (optimizer / executor-aux donation) would delete the
+                # other's data
+                import jax.numpy as jnp
+
+                new = jnp.copy(new)
+            other._data = new
         get_engine().push(_do, const_vars=[self._var], mutable_vars=[other._var])
         return other
 

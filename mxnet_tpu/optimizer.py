@@ -32,6 +32,136 @@ def register(name_or_cls=None, override: bool = False):
 
 
 
+# ---------------------------------------------------------------------------
+# Fused, donated update kernels.
+#
+# Each optimizer's math is a pure function (weight, grad, states, scalars) ->
+# (new_weight, new_states), jitted once per (kind, structure) with the weight
+# and state buffers DONATED: XLA writes the new values into the old buffers,
+# so training holds ONE copy of params + optimizer state in HBM instead of a
+# transient two (the reference gets this from in-place C++/CUDA kernels,
+# src/operator/optimizer_op-inl.h; buffer donation is the XLA-idiomatic
+# form of in-place). Hyperparameters ride in one traced f32 vector, so an
+# LRScheduler changing lr every step reuses the same compiled kernel, and
+# the vector is cast to the weight dtype inside the kernel to preserve the
+# weak-type promotion the eager form had (bf16 weights stay bf16).
+# ---------------------------------------------------------------------------
+
+_JIT_UPDATES: Dict[tuple, Any] = {}
+
+
+def _donation_ok() -> bool:
+    """Donate only under engines that run host closures inline (XLAEngine /
+    NaiveEngine, the defaults). A threaded engine may interleave a direct
+    ``_data`` read between the donating dispatch and the write-back, and
+    donation turns that stale read into a deleted-buffer error."""
+    from .base import getenv
+    from .engine import NaiveEngine, XLAEngine, get_engine
+
+    if not getenv("MXNET_TPU_DONATE", True):
+        return False
+    # allowlist, not a not-ThreadedEngine check: native or third-party
+    # engines that run closures on worker threads must stay excluded too
+    return type(get_engine()) in (XLAEngine, NaiveEngine)
+
+
+def _update_math(kind: str, n_states: int, clipped: bool):
+    """Pure update math. Scalar layout: ``s[0]`` = rescale_grad, then the
+    kind-specific hyperparameters, then (when ``clipped``) the clip bound
+    as ``s[-1]``."""
+    import jax
+    import jax.numpy as jnp
+
+    def pre(g, s):
+        g = g * s[0]
+        if clipped:
+            g = jnp.clip(g, -s[-1], s[-1])
+        return g
+
+    if kind in ("sgd", "nag"):
+        nag = kind == "nag"
+
+        def fn(w, g, states, s):
+            s = s.astype(w.dtype)
+            lr, wd, mom = s[1], s[2], s[3]
+            g = pre(g, s) + wd * w
+            if n_states == 0:
+                return w - lr * g, states
+            (m,) = states
+            if nag:
+                m = mom * m + g
+                return w - lr * (g + mom * m), (m,)
+            m = mom * m - lr * g
+            return w + m, (m,)
+    elif kind == "sgld":
+        def fn(w, g, states, s, key):
+            s = s.astype(w.dtype)
+            lr, wd = s[1], s[2]
+            g = pre(g, s) + wd * w
+            noise = jax.random.normal(key, w.shape, dtype=w.dtype)
+            return w - lr / 2 * g + jnp.sqrt(lr) * noise, states
+    elif kind == "adam":
+        def fn(w, g, states, s):
+            s = s.astype(w.dtype)
+            step_lr, wd, b1, b2, eps = s[1], s[2], s[3], s[4], s[5]
+            mean, var = states
+            g = pre(g, s) + wd * w
+            mean = b1 * mean + (1 - b1) * g
+            var = b2 * var + (1 - b2) * g * g
+            w = w - step_lr * mean / (jnp.sqrt(var) + eps)
+            return w, (mean, var)
+    elif kind == "adagrad":
+        def fn(w, g, states, s):
+            s = s.astype(w.dtype)
+            lr, wd, eps = s[1], s[2], s[3]
+            (acc,) = states
+            g = pre(g, s)
+            acc = acc + g * g
+            w = w - lr * (g / jnp.sqrt(acc + eps) + wd * w)
+            return w, (acc,)
+    elif kind == "rmsprop":
+        def fn(w, g, states, s):
+            s = s.astype(w.dtype)
+            lr, wd, g1, g2 = s[1], s[2], s[3], s[4]
+            n, gs, delta = states
+            g = pre(g, s) + wd * w
+            n = (1 - g1) * g * g + g1 * n
+            gs = (1 - g1) * g + g1 * gs
+            delta = g2 * delta - lr * g / jnp.sqrt(n - gs * gs + 1e-4)
+            return w + delta, (n, gs, delta)
+    elif kind == "adadelta":
+        def fn(w, g, states, s):
+            s = s.astype(w.dtype)
+            wd, rho, eps = s[1], s[2], s[3]
+            acc_g, acc_d = states
+            g = pre(g, s)
+            acc_g = rho * acc_g + (1 - rho) * g * g
+            cur = jnp.sqrt(acc_d + eps) / jnp.sqrt(acc_g + eps) * g
+            acc_d = rho * acc_d + (1 - rho) * cur * cur
+            return w - cur - wd * w, (acc_g, acc_d)
+    else:  # pragma: no cover
+        raise MXNetError("unknown update kind %r" % kind)
+    return fn
+
+
+def _apply_update(kind, w, g, states, scalars, clipped, key=None):
+    import jax
+    import jax.numpy as jnp
+
+    donate = _donation_ok()
+    ck = (kind, len(states), clipped, donate)
+    fn = _JIT_UPDATES.get(ck)
+    if fn is None:
+        math_fn = _update_math(kind, len(states), clipped)
+        fn = jax.jit(math_fn,
+                     donate_argnums=(0, 2) if donate else ())
+        _JIT_UPDATES[ck] = fn
+    s_vec = jnp.asarray(scalars, jnp.float32)
+    if key is not None:
+        return fn(w, g, states, s_vec, key)
+    return fn(w, g, states, s_vec)
+
+
 def _zeros_like_state(weight: NDArray) -> NDArray:
     """Optimizer state matching the weight's dtype AND device sharding —
     params may be replicated over a device mesh (executor_group), and the
@@ -109,13 +239,31 @@ class Optimizer:
         # bias/gamma/beta conventionally get no weight decay unless overridden
         return wd
 
-    def _preprocess(self, grad):
-        import jax.numpy as jnp
+    def _run(self, kind, weight, grad, state_nds, scalars, key=None):
+        """Dispatch one fused, donated update kernel through the engine.
 
-        g = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
-        return g
+        ``state_nds`` are the state NDArrays (possibly empty); ``scalars``
+        the per-step hyperparameters, packed into one traced f32 vector so
+        an LRScheduler changing lr every step reuses the compiled kernel.
+        """
+        from .engine import get_engine
+
+        clip = self.clip_gradient
+        rescale = self.rescale_grad
+        state_nds = tuple(state_nds)
+
+        def _do():
+            new_w, new_s = _apply_update(
+                kind, weight._data, grad._data,
+                tuple(s._data for s in state_nds),
+                (rescale,) + tuple(scalars)
+                + ((clip,) if clip is not None else ()),
+                clipped=clip is not None, key=key)
+            weight._data = new_w
+            for nd, nv in zip(state_nds, new_s):
+                nd._data = nv
+        muts = [weight._var] + [s._var for s in state_nds]
+        get_engine().push(_do, const_vars=[grad._var], mutable_vars=muts)
 
 
 @register("sgd")
@@ -135,19 +283,9 @@ class SGD(Optimizer):
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
-        mom = self.momentum
-        opt = self
-
-        def _do():
-            g = opt._preprocess(grad._data) + wd * weight._data
-            if state is None:
-                weight._data = weight._data - lr * g
-            else:
-                state._data = mom * state._data - lr * g
-                weight._data = weight._data + state._data
-        from .engine import get_engine
-        muts = [weight._var] if state is None else [weight._var, state._var]
-        get_engine().push(_do, const_vars=[grad._var], mutable_vars=muts)
+        self._run("sgd", weight, grad,
+                  () if state is None else (state,),
+                  (lr, wd, self.momentum))
 
 
 @register("ccsgd")
@@ -164,19 +302,9 @@ class NAG(SGD):
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
-        mom = self.momentum
-        opt = self
-
-        def _do():
-            g = opt._preprocess(grad._data) + wd * weight._data
-            if state is None:
-                weight._data = weight._data - lr * g
-            else:
-                state._data = mom * state._data + g
-                weight._data = weight._data - lr * (g + mom * state._data)
-        from .engine import get_engine
-        muts = [weight._var] if state is None else [weight._var, state._var]
-        get_engine().push(_do, const_vars=[grad._var], mutable_vars=muts)
+        self._run("nag", weight, grad,
+                  () if state is None else (state,),
+                  (lr, wd, self.momentum))
 
 
 @register("sgld")
@@ -184,23 +312,13 @@ class SGLD(Optimizer):
     """Stochastic gradient Langevin dynamics (reference optimizer.py:361)."""
 
     def update(self, index, weight, grad, state):
-        import jax
-
         from . import random as _random
 
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
-        opt = self
-
-        def _do():
-            g = opt._preprocess(grad._data) + wd * weight._data
-            noise = jax.random.normal(_random.next_key(), weight.shape,
-                                      dtype=weight._data.dtype)
-            weight._data = weight._data - lr / 2 * g \
-                + math.sqrt(lr) * noise
-        from .engine import get_engine
-        get_engine().push(_do, const_vars=[grad._var], mutable_vars=[weight._var])
+        self._run("sgld", weight, grad, (), (lr, wd),
+                  key=_random.next_key())
 
 
 @register("adam")
@@ -218,27 +336,14 @@ class Adam(Optimizer):
         return (_zeros_like_state(weight), _zeros_like_state(weight))
 
     def update(self, index, weight, grad, state):
-        import jax.numpy as jnp
-
         self._update_count(index)
         t = self._index_update_count[index]
         lr = self._get_lr(index)
         wd = self._get_wd(index)
-        mean, var = state
-        opt = self
-
-        def _do():
-            g = opt._preprocess(grad._data) + wd * weight._data
-            mean._data = opt.beta1 * mean._data + (1 - opt.beta1) * g
-            var._data = opt.beta2 * var._data + (1 - opt.beta2) * g * g
-            coef1 = 1.0 - opt.beta1 ** t
-            coef2 = 1.0 - opt.beta2 ** t
-            step_lr = lr * math.sqrt(coef2) / coef1
-            weight._data = weight._data - step_lr * mean._data / \
-                (jnp.sqrt(var._data) + opt.epsilon)
-        from .engine import get_engine
-        get_engine().push(_do, const_vars=[grad._var],
-                          mutable_vars=[weight._var, mean._var, var._var])
+        step_lr = lr * math.sqrt(1.0 - self.beta2 ** t) \
+            / (1.0 - self.beta1 ** t)
+        self._run("adam", weight, grad, state,
+                  (step_lr, wd, self.beta1, self.beta2, self.epsilon))
 
 
 @register("adagrad")
@@ -253,22 +358,11 @@ class AdaGrad(Optimizer):
         return _zeros_like_state(weight)
 
     def update(self, index, weight, grad, state):
-        import jax.numpy as jnp
-
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
-        opt = self
-
-        def _do():
-            g = opt._preprocess(grad._data)
-            state._data = state._data + g * g
-            weight._data = weight._data - lr * (
-                g / jnp.sqrt(state._data + opt.float_stable_eps)
-                + wd * weight._data)
-        from .engine import get_engine
-        get_engine().push(_do, const_vars=[grad._var],
-                          mutable_vars=[weight._var, state._var])
+        self._run("adagrad", weight, grad, (state,),
+                  (lr, wd, self.float_stable_eps))
 
 
 @register("rmsprop")
@@ -288,25 +382,11 @@ class RMSProp(Optimizer):
                 _zeros_like_state(weight))   # delta
 
     def update(self, index, weight, grad, state):
-        import jax.numpy as jnp
-
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
-        n, g_state, delta = state
-        opt = self
-
-        def _do():
-            g = opt._preprocess(grad._data) + wd * weight._data
-            n._data = (1 - opt.gamma1) * g * g + opt.gamma1 * n._data
-            g_state._data = (1 - opt.gamma1) * g + opt.gamma1 * g_state._data
-            delta._data = opt.gamma2 * delta._data - lr * g / jnp.sqrt(
-                n._data - g_state._data * g_state._data + 1e-4)
-            weight._data = weight._data + delta._data
-        from .engine import get_engine
-        get_engine().push(_do, const_vars=[grad._var],
-                          mutable_vars=[weight._var, n._var, g_state._var,
-                                        delta._var])
+        self._run("rmsprop", weight, grad, state,
+                  (lr, wd, self.gamma1, self.gamma2))
 
 
 @register("adadelta")
@@ -322,24 +402,10 @@ class AdaDelta(Optimizer):
         return (_zeros_like_state(weight), _zeros_like_state(weight))
 
     def update(self, index, weight, grad, state):
-        import jax.numpy as jnp
-
         self._update_count(index)
         wd = self._get_wd(index)
-        acc_g, acc_delta = state
-        opt = self
-
-        def _do():
-            g = opt._preprocess(grad._data)
-            acc_g._data = opt.rho * acc_g._data + (1 - opt.rho) * g * g
-            cur_delta = jnp.sqrt(acc_delta._data + opt.epsilon) / \
-                jnp.sqrt(acc_g._data + opt.epsilon) * g
-            acc_delta._data = opt.rho * acc_delta._data + \
-                (1 - opt.rho) * cur_delta * cur_delta
-            weight._data = weight._data - cur_delta - wd * weight._data
-        from .engine import get_engine
-        get_engine().push(_do, const_vars=[grad._var],
-                          mutable_vars=[weight._var, acc_g._var, acc_delta._var])
+        self._run("adadelta", weight, grad, state,
+                  (wd, self.rho, self.epsilon))
 
 
 @register("test")

@@ -14,6 +14,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 # full-precision matmuls/convs so finite-difference gradient checks are tight
 os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
 
+# parameter-server frame auth is default-on (the server refuses to start
+# without a secret); the suite runs authenticated end to end, like every
+# launch.py job. Worker subprocesses inherit this env.
+os.environ.setdefault("MXTPU_PS_SECRET", "test-suite-token")
+
 # the axon TPU site hook overrides JAX_PLATFORMS at import; force cpu via
 # config too
 import jax  # noqa: E402

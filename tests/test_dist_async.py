@@ -217,3 +217,51 @@ print("DEAD_NODE_OK")
         capture_output=True, text=True, env=env, timeout=120)
     assert r.returncode == 0, (r.stdout, r.stderr[-1500:])
     assert "DEAD_NODE_OK" in r.stdout
+
+
+def test_server_refuses_unauthenticated_start(monkeypatch):
+    """Default-on frame auth (round-4 verdict #7): with no secret staged
+    the server must refuse to start (unauthenticated pickle frames are
+    RCE for anyone who can reach the port); MXTPU_PS_INSECURE=1 is the
+    explicit opt-out."""
+    import pytest
+
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.parallel import ps
+
+    monkeypatch.delenv("MXTPU_PS_SECRET", raising=False)
+    monkeypatch.delenv("MXTPU_PS_SECRET_FILE", raising=False)
+    monkeypatch.delenv("MXTPU_PS_INSECURE", raising=False)
+    monkeypatch.setattr(ps, "_SECRET_CACHE", False)
+    with pytest.raises(MXNetError, match="refuses to start"):
+        ps.ParameterServer("127.0.0.1", 23713, num_workers=1)
+
+    monkeypatch.setenv("MXTPU_PS_INSECURE", "1")
+    monkeypatch.setattr(ps, "_SECRET_CACHE", False)
+    server = ps.ParameterServer("127.0.0.1", 23713, num_workers=1)
+    server.close()
+
+
+def test_launch_generates_job_secret(monkeypatch):
+    """tools/launch.py stages a generated secret when the operator set
+    none, so every launched job runs authenticated by default."""
+    import importlib.util
+    import os as _os
+
+    spec = importlib.util.spec_from_file_location(
+        "launch_mod", _os.path.join(_os.path.dirname(__file__), "..",
+                                    "tools", "launch.py"))
+    launch_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(launch_mod)
+
+    monkeypatch.delenv("MXTPU_PS_SECRET", raising=False)
+    monkeypatch.delenv("MXTPU_PS_INSECURE", raising=False)
+    s = launch_mod.job_secret()
+    assert s and len(s) >= 32
+    # operator-provided secret wins
+    monkeypatch.setenv("MXTPU_PS_SECRET", "operator-token")
+    assert launch_mod.job_secret() == "operator-token"
+    # explicit opt-out: no generated secret
+    monkeypatch.setenv("MXTPU_PS_INSECURE", "1")
+    monkeypatch.delenv("MXTPU_PS_SECRET", raising=False)
+    assert launch_mod.job_secret() is None

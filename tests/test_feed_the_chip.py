@@ -49,3 +49,79 @@ def test_e2e_rate_within_10pct_of_device_resident():
         "raise MXNET_TPU_BENCH_THREADS or the decode pool is the "
         "bottleneck" % (100 * ratio, rec["e2e_imgs_per_sec"],
                         rec["value"], rec.get("input_imgs_per_sec")))
+
+
+def test_cached_pipeline_outruns_jpeg_decode(tmp_path):
+    """Round-4 verdict #2 gate, CPU-runnable: the pre-decoded cache path
+    must sustain a host-side feed rate that (a) dwarfs per-epoch JPEG
+    decode and (b) exceeds the chip's recorded consumption (2,519 img/s
+    ResNet-50 bf16, BENCH_watch.json 2026-07-31) from ONE core. The
+    device_augment mode's host work is a single uint8 memmap gather —
+    crop/mirror/normalize ride the device step."""
+    import time
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from pipeline_bench import make_synthetic_rec
+
+    from mxnet_tpu import io, io_cache
+
+    rec = str(tmp_path / "s.rec")
+    make_synthetic_rec(rec, 96, 224)
+    prefix = rec + ".cache"
+    io_cache.build_decoded_cache(rec, prefix, (3, 256, 256),
+                                 preprocess_threads=4)
+
+    def rate(it, seconds=1.5, fence=lambda b: b.data[0].wait_to_read()):
+        next(it)
+        it.reset()
+        n = 0
+        tic = time.time()
+        while time.time() - tic < seconds:
+            try:
+                b = next(it)
+            except StopIteration:
+                it.reset()
+                continue
+            fence(b)
+            n += it.batch_size
+        return n / (time.time() - tic)
+
+    jpeg = rate(io.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, 224, 224), batch_size=32,
+        preprocess_threads=1, rand_crop=True, rand_mirror=True,
+        scale=1 / 255.0))
+    cached = rate(io_cache.CachedImageRecordIter(
+        prefix, (3, 224, 224), 32, shuffle=True, rand_crop=True,
+        rand_mirror=True, scale=1 / 255.0))
+
+    # host-side-only rate of the device_augment mode: the memmap gather
+    # (the augment kernel itself runs on the accelerator in production —
+    # timing it on this CPU box would charge the chip's work to the host)
+    data = np.load(prefix + ".data", mmap_mode="r")
+    rng = np.random.RandomState(0)
+    n = 0
+    tic = time.time()
+    while time.time() - tic < 1.5:
+        idx = np.sort(rng.randint(0, 96, 32))
+        np.ascontiguousarray(data[idx])
+        rng.randint(0, 33, 32)
+        rng.randint(0, 33, 32)
+        n += 32
+    gather = n / (time.time() - tic)
+
+    assert cached >= 4 * jpeg, (
+        "cached path %.0f img/s vs jpeg %.0f img/s — expected >=4x"
+        % (cached, jpeg))
+    # the absolute feed-the-chip bar is machine-dependent (a throttled
+    # CI container can lose a 480 MB/s memcpy race with no code
+    # regression): enforced on the nightly/chip_watch boxes, reported
+    # informationally elsewhere
+    if os.environ.get("MXNET_TPU_STRICT_FEED_GATE"):
+        assert gather >= 2519, (
+            "device_augment host-side gather sustains %.0f img/s — "
+            "below the chip's recorded 2,519 img/s consumption" % gather)
+    else:
+        print("device_augment host-side gather: %.0f img/s "
+              "(chip consumes 2,519)" % gather)

@@ -1,0 +1,240 @@
+"""Off-chip HLO regression gates (round-4 verdict #1a).
+
+The TPU tunnel is intermittent, so a perf regression introduced while it
+is down would otherwise be invisible until the next on-chip run. These
+gates assert compiled-program properties of the flagship ResNet-50 train
+step — flop ratios, buffer donation, bf16 conv layouts, transpose counts
+— from ``jit.lower(...).compile()`` on whatever backend CI has. They are
+proxies for the on-chip numbers the reference publishes
+(/root/reference/example/image-classification/README.md:202-257): the
+exact TPU schedules differ, but the regressions these catch (double
+compute, lost donation, f32 convs sneaking back, layout thrash in the
+traced graph) show up on any backend.
+"""
+import os
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import models
+from mxnet_tpu.parallel import build_sgd_train_step
+
+BATCH, IMAGE, NUM_CLASSES = 8, 32, 16
+
+
+def _feeds(net, data_shape, n_class, dtype=np.float32):
+    rng = np.random.RandomState(0)
+    arg_shapes, _, aux_shapes = net.infer_shape(data=data_shape)
+    params, data = {}, {}
+    for name, shape in zip(net.list_arguments(), arg_shapes):
+        if name == "data":
+            data[name] = rng.rand(*shape).astype(dtype)
+        elif name == "softmax_label":
+            data[name] = rng.randint(0, n_class, shape).astype(np.float32)
+        elif name.endswith("gamma"):
+            params[name] = np.ones(shape, dtype=dtype)
+        else:
+            params[name] = (rng.randn(*shape) * 0.05).astype(dtype)
+    aux = [np.ones(s, dtype=np.float32) if "var" in n
+           else np.zeros(s, dtype=np.float32)
+           for n, s in zip(net.list_auxiliary_states(), aux_shapes)]
+    return params, data, aux
+
+
+def _cost(compiled):
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return cost or {}
+
+
+@pytest.fixture(scope="module")
+def train_lowering():
+    """One bf16 ResNet-50 (CIFAR-scale) train-step compile shared by all
+    gates — the same build bench.py measures on chip."""
+    net = models.get_resnet50(num_classes=NUM_CLASSES, small_input=True)
+    params, data, aux = _feeds(net, (BATCH, 3, IMAGE, IMAGE), NUM_CLASSES)
+    step, _ = build_sgd_train_step(net, ["data"], ["softmax_label"],
+                                   lr=0.01, compute_dtype=jnp.bfloat16)
+    jit_step = jax.jit(step, donate_argnums=(0, 2))
+    key = jax.random.PRNGKey(0)
+    lowered = jit_step.lower(params, data, aux, key)
+    compiled = lowered.compile()
+    return {"net": net, "params": params, "data": data, "aux": aux,
+            "lowered": lowered, "compiled": compiled,
+            "mlir": lowered.as_text(), "hlo": compiled.as_text()}
+
+
+def test_train_step_donates_params_and_aux(train_lowering):
+    """Every param and every aux buffer must be donated into the step —
+    losing donation costs a transient 2x param HBM on chip (round-4
+    verdict weak #3)."""
+    n_donatable = len(train_lowering["params"]) + len(train_lowering["aux"])
+    aliased = train_lowering["mlir"].count("tf.aliasing_output")
+    assert aliased >= n_donatable, (
+        "expected >= %d donated buffers in the train step, lowering "
+        "records %d" % (n_donatable, aliased))
+
+
+@pytest.fixture(scope="module")
+def fwd_compiled(train_lowering):
+    """Inference-forward compile of the same net, the yardstick for the
+    train-step flop/byte ratios (same backend, so backend-specific
+    layout-copy inflation cancels out of the ratios)."""
+    from mxnet_tpu.executor import make_graph_eval
+    net = train_lowering["net"]
+    params, data, aux = (train_lowering["params"], train_lowering["data"],
+                         train_lowering["aux"])
+    eval_graph, _ = make_graph_eval(net)
+    arg_names = net.list_arguments()
+
+    def fwd(params, data, aux):
+        args = [params[n] if n in params else data[n] for n in arg_names]
+        outs, _ = eval_graph(args, aux, None, False)
+        return outs[0]
+
+    return jax.jit(fwd).lower(params, data, aux).compile()
+
+
+def test_train_step_flops_ratio(train_lowering, fwd_compiled):
+    """Train-step flops must stay ~3x the inference forward (fwd + bwd-
+    data + bwd-weights). A silent double-compute regression (lost remat
+    boundary, duplicated subgraph, monitor fetch leaking into the hot
+    step) breaks the upper bound; dropping the backward breaks the
+    lower."""
+    train_flops = float(_cost(train_lowering["compiled"]).get("flops", 0.0))
+    assert train_flops > 0, "cost_analysis returned no flop count"
+    fwd_flops = float(_cost(fwd_compiled).get("flops", 0.0))
+    assert fwd_flops > 0
+    ratio = train_flops / fwd_flops
+    assert 2.0 <= ratio <= 4.2, (
+        "train/forward flop ratio %.2f out of [2.0, 4.2] "
+        "(train=%.3e fwd=%.3e)" % (ratio, train_flops, fwd_flops))
+
+
+def test_train_step_convs_run_bf16(train_lowering):
+    """Under compute_dtype=bfloat16 every convolution must consume bf16
+    operands — one f32 conv halves MXU throughput for that op on chip.
+    Asserted on the lowered stablehlo (the traced graph, which this
+    framework controls): backends without native bf16 convs (CPU) upcast
+    at compile time, but on TPU the traced dtype is what the MXU sees."""
+    convs = [ln for ln in train_lowering["mlir"].splitlines()
+             if "stablehlo.convolution" in ln]
+    assert len(convs) >= 100, (
+        "expected the fused fwd+bwd conv stack (~3x53 convs), found %d"
+        % len(convs))
+    f32_convs = [ln.strip() for ln in convs
+                 if re.search(r"xf32>", ln.split("->")[0])]
+    assert not f32_convs, (
+        "%d convolutions traced with f32 operands under bf16 compute:\n%s"
+        % (len(f32_convs), "\n".join(c[:200] for c in f32_convs[:5])))
+
+
+def test_train_step_transpose_bound(train_lowering):
+    """Layout-thrash gate on the traced graph: the step traces 3
+    transposes total (measured 2026-07-31; the compiled count is backend
+    layout policy — CPU normalizes every conv to its preferred layout —
+    so the gate pins what the framework itself emits). A jump past the
+    bound means a new explicit layout conversion entered the hot path
+    (the round-2..4 NHWC work was exactly about these)."""
+    transposes = len([ln for ln in train_lowering["mlir"].splitlines()
+                      if "stablehlo.transpose" in ln])
+    assert transposes <= 16, (
+        "%d traced transposes in the train step (bound 16, baseline 3)"
+        % transposes)
+
+
+def test_train_step_bytes_accessed_ratio(train_lowering, fwd_compiled):
+    """HBM-traffic gate: train-step bytes accessed stays within 8x the
+    inference forward's (fwd+bwd re-reads activations ~3x; backend
+    layout-copy inflation affects both sides equally). Catches a
+    materialized all-internals fetch or a lost fusion leaking whole
+    activation maps to memory."""
+    touched = float(_cost(train_lowering["compiled"])
+                    .get("bytes accessed", 0.0))
+    fwd_touched = float(_cost(fwd_compiled).get("bytes accessed", 0.0))
+    if touched <= 0 or fwd_touched <= 0:
+        pytest.skip("backend reports no bytes-accessed estimate")
+    ratio = touched / fwd_touched
+    assert ratio <= 8.0, (
+        "train step touches %.1fx the forward's bytes (bound 8x; "
+        "train=%.1f MB fwd=%.1f MB)"
+        % (ratio, touched / 1e6, fwd_touched / 1e6))
+
+
+def test_executor_fwd_bwd_donates_aux():
+    """The Module/fit path (Executor._fwd_bwd) must donate the aux (BN
+    stat) buffers: backward() always writes aux_out back, so the old
+    buffers are dead and XLA should reuse their HBM."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+
+    data = sym.Variable("data")
+    net = sym.Convolution(data, num_filter=8, kernel=(3, 3), name="conv")
+    net = sym.BatchNorm(net, name="bn")
+    net = sym.FullyConnected(sym.Flatten(net), num_hidden=4, name="fc")
+    net = sym.SoftmaxOutput(net, name="softmax")
+
+    ex = net.simple_bind(mx.cpu(), data=(2, 3, 8, 8))
+    args = [a._data for a in ex.arg_arrays]
+    aux = [a._data for a in ex.aux_arrays]
+    assert aux, "test symbol must carry BN aux states"
+    key = jax.random.PRNGKey(0)
+    outs_spec, _ = jax.eval_shape(ex._fwd_train, args, aux, key)
+    heads = [jnp.ones(s.shape, s.dtype) for s in outs_spec]
+    mlir = ex._get_fwd_bwd(False).lower(args, aux, key, heads).as_text()
+    assert mlir.count("tf.aliasing_output") >= len(aux), (
+        "executor fwd+bwd lowering donates %d buffers, expected the %d "
+        "aux states" % (mlir.count("tf.aliasing_output"), len(aux)))
+
+
+def test_optimizer_update_donates_and_matches_eager():
+    """The fused update kernels donate weight+state (in-place in HBM, the
+    XLA form of the reference's in-place optimizer kernels) and keep the
+    reference math: sgd-momentum checked against a hand-rolled eager
+    step."""
+    from mxnet_tpu.optimizer import _apply_update
+
+    w = jnp.asarray(np.random.RandomState(0).randn(64, 32), jnp.float32)
+    g = jnp.asarray(np.random.RandomState(1).randn(64, 32), jnp.float32)
+    m = jnp.zeros_like(w)
+    lr, wd, mom, rescale = 0.1, 1e-4, 0.9, 1.0
+
+    expect_g = g * rescale + wd * w
+    expect_m = mom * m - lr * expect_g
+    expect_w = w + expect_m
+
+    new_w, (new_m,) = _apply_update("sgd", w, g, (m,),
+                                    (rescale, lr, wd, mom), clipped=False)
+    np.testing.assert_allclose(np.asarray(new_w), np.asarray(expect_w),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_m), np.asarray(expect_m),
+                               rtol=1e-6)
+    # donation consumed the inputs (default engine runs closures inline,
+    # so _donation_ok() held and the old buffers must be gone)
+    for old in (w, m):
+        with pytest.raises(RuntimeError):
+            _ = np.asarray(old)
+
+
+def test_optimizer_update_scalar_change_reuses_compile():
+    """An LRScheduler changes lr every step; the update kernel must not
+    retrace per value (scalars ride in a traced vector)."""
+    from mxnet_tpu.optimizer import _JIT_UPDATES, _apply_update
+
+    w = jnp.ones((16,), jnp.float32)
+    g = jnp.ones((16,), jnp.float32)
+    _apply_update("sgd", w, g, (), (1.0, 0.1, 0.0, 0.0), clipped=False)
+    key = [k for k in _JIT_UPDATES if k[0] == "sgd" and k[1] == 0][0]
+    fn = _JIT_UPDATES[key]
+    before = fn._cache_size()
+    for lr in (0.09, 0.05, 0.01):
+        w2 = jnp.ones((16,), jnp.float32)
+        _apply_update("sgd", w2, g, (), (1.0, lr, 0.0, 0.0), clipped=False)
+    assert fn._cache_size() == before, (
+        "update kernel retraced on an lr change: cache grew %d -> %d"
+        % (before, fn._cache_size()))

@@ -107,6 +107,9 @@ def test_ssh_launcher_loopback(tmp_path):
     for l in lines:
         assert "hunter2-cluster-token" not in l, "secret leaked to argv"
         assert "MXTPU_PS_SECRET_FILE=" in l.split("\t")[1]
-    secret_file = workdir / ".mxtpu_ps_secret"
-    assert secret_file.read_text() == "hunter2-cluster-token"
-    assert (secret_file.stat().st_mode & 0o777) == 0o600
+    # filename is unique per job (pid.time suffix) so overlapping jobs
+    # in one shared dir cannot clobber each other's secret
+    secrets = list(workdir.glob(".mxtpu_ps_secret.*"))
+    assert len(secrets) == 1, secrets
+    assert secrets[0].read_text() == "hunter2-cluster-token"
+    assert (secrets[0].stat().st_mode & 0o777) == 0o600

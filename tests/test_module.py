@@ -164,3 +164,38 @@ def test_sequential_module():
     score_metric = mx.metric.create("acc")
     res = smod.score(data, score_metric)
     assert res[0][1] > 0.4
+
+
+def test_get_params_after_backward_without_update():
+    """Donation-alias regression (round-5 review): the fused train step
+    donates the executor's aux buffers, and the optimizer donates weight
+    buffers. Neither may delete the module-level host copies — bind ->
+    init_params -> forward/backward -> get_params (no update, so no
+    device sync) must still serialize cleanly."""
+    data = sym.Variable("data")
+    net = sym.Convolution(data, num_filter=4, kernel=(3, 3), name="c1")
+    net = sym.BatchNorm(net, name="bn1")
+    net = sym.FullyConnected(sym.Flatten(net), num_hidden=2, name="fc")
+    net = sym.SoftmaxOutput(net, name="softmax")
+
+    mod = Module(net, context=mx.cpu())
+    mod.bind([("data", (4, 1, 8, 8))], [("softmax_label", (4,))])
+    mod.init_params()
+    rng = np.random.RandomState(0)
+    batch = mx.io.DataBatch(
+        [mx.nd.array(rng.randn(4, 1, 8, 8).astype(np.float32))],
+        [mx.nd.array(rng.randint(0, 2, 4).astype(np.float32))])
+    mod.forward(batch, is_train=True)
+    mod.backward()          # donates aux into the fused step
+    arg_params, aux_params = mod.get_params()
+    for name, arr in list(arg_params.items()) + list(aux_params.items()):
+        np.asarray(arr.asnumpy())   # deleted buffers raise here
+
+    # and after an update (weights donated), params still read back
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    arg_params, aux_params = mod.get_params()
+    for name, arr in list(arg_params.items()) + list(aux_params.items()):
+        assert np.isfinite(arr.asnumpy()).all()

@@ -132,8 +132,8 @@ def fire():
     _commit("mfu variants", stamp)
     # paired same-session baseline-vs-flag comparison (the sweep
     # re-runs the variant with and without each flag)
-    _run([py, mfu, "--variant", "baseline", "--sweep-flags",
-          "--xla_tpu_enable_latency_hiding_scheduler=true"],
+    _run([py, mfu, "--variant", "baseline",
+          "--sweep-flags=--xla_tpu_enable_latency_hiding_scheduler=true"],
          4000, outfile="MFU_EXPERIMENTS.jsonl")
     # batch scaling: 512 amortizes per-step overhead if HBM allows
     # (bf16 ResNet-50 activations at 512x224x224 fit a v5e's 16 GB

@@ -14,6 +14,7 @@ import shlex
 import signal
 import subprocess
 import sys
+import time
 
 # environment that must travel to remote workers for the job to behave
 # like the local one (reference dmlc_tracker forwarded its env list the
@@ -21,7 +22,19 @@ import sys
 FORWARD_ENV = ["PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS",
                "MXNET_ENGINE_TYPE", "MXNET_COMPUTE_DTYPE",
                "MXNET_BACKWARD_DO_MIRROR", "LD_LIBRARY_PATH",
-               "MXTPU_PS_PORT", "MXTPU_PS_SECRET"]
+               "MXTPU_PS_PORT", "MXTPU_PS_SECRET", "MXTPU_PS_INSECURE"]
+
+
+def job_secret():
+    """The PS frame secret for this job: the operator's MXTPU_PS_SECRET
+    if set, otherwise a generated one — every launched job runs
+    authenticated by default (the server refuses unauthenticated frames
+    unless MXTPU_PS_INSECURE=1 is exported explicitly)."""
+    if os.environ.get("MXTPU_PS_INSECURE") == "1":
+        return os.environ.get("MXTPU_PS_SECRET") or None
+    import secrets
+
+    return os.environ.get("MXTPU_PS_SECRET") or secrets.token_hex(32)
 
 
 def worker_env(args, rank):
@@ -111,10 +124,14 @@ def main():
         parser.error("no command given")
 
     if args.launcher == "local":
+        secret = job_secret()
         procs = []
         for rank in range(args.num_workers):
             env = dict(os.environ)
             env.update(worker_env(args, rank))
+            if secret:
+                # same host, env dict (not argv) — no /proc exposure
+                env["MXTPU_PS_SECRET"] = secret
             procs.append(subprocess.Popen(args.command, env=env))
         sys.exit(monitor(procs))
     else:
@@ -131,12 +148,18 @@ def main():
         # launcher's assumption) and forward only the file's PATH;
         # parallel/ps.py reads MXTPU_PS_SECRET_FILE as a fallback
         secret_file = None
-        if os.environ.get("MXTPU_PS_SECRET"):
-            secret_file = os.path.join(cwd, ".mxtpu_ps_secret")
+        secret = job_secret()
+        if secret:
+            # unique per-job filename: two jobs launched from the same
+            # shared dir must not clobber each other's secret (a stale
+            # read would make every HMAC check fail with no useful error)
+            secret_file = os.path.join(
+                cwd, ".mxtpu_ps_secret.%d.%d" % (os.getpid(),
+                                                 int(time.time())))
             fd = os.open(secret_file,
                          os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
             with os.fdopen(fd, "w") as f:
-                f.write(os.environ["MXTPU_PS_SECRET"])
+                f.write(secret)
         procs = []
         for rank in range(args.num_workers):
             host = hosts[rank % len(hosts)]       # round-robin

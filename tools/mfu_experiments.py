@@ -32,6 +32,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -105,15 +106,25 @@ def measure(variant, batch, image, num_classes, steps, dtype_name):
                                    lr=0.01, compute_dtype=compute_dtype)
     jit_step = jax.jit(step, donate_argnums=(0, 2))
     key = jax.random.PRNGKey(0)
+
+    def _force(tree):
+        # fetch a scalar: block_until_ready alone can under-synchronize
+        # through remote-device transports, inflating throughput by
+        # orders of magnitude (same fence as bench.py — the 2026-07-31
+        # pre-fix numbers in MFU_EXPERIMENTS.jsonl show the failure mode:
+        # 1.46 ms "steps" for batch-256 ResNet-50)
+        leaf = next(iter(tree.values())) if isinstance(tree, dict) else tree
+        return float(np.asarray(leaf.sum()))
+
     outputs, params, aux = jit_step(params, data, aux, key)
     outputs, params, aux = jit_step(params, data, aux,
                                     jax.random.fold_in(key, 999))
-    jax.block_until_ready(params)
+    _force(params)
     tic = time.time()
     for i in range(steps):
         outputs, params, aux = jit_step(params, data, aux,
                                         jax.random.fold_in(key, i))
-    jax.block_until_ready(params)
+    _force(params)
     elapsed = time.time() - tic
 
     dev = jax.devices()[0]
@@ -127,6 +138,9 @@ def measure(variant, batch, image, num_classes, steps, dtype_name):
         "compute_dtype": dtype_name,
         "chip": getattr(dev, "device_kind", dev.platform),
         "xla_flags": os.environ.get("XLA_FLAGS", ""),
+        # marks results produced with the scalar-fetch fence; earlier
+        # lines without this field under-synchronized and are invalid
+        "fence": "scalar_fetch",
     }
     peak = _chip_peak(getattr(dev, "device_kind", "")) \
         if dev.platform != "cpu" else None
@@ -146,15 +160,27 @@ def main(argv=None):
     p.add_argument("--steps", type=int, default=None)
     p.add_argument("--dtype", default=None)
     p.add_argument("--sweep-flags", nargs="*", default=None,
-                   help="XLA_FLAGS values; each re-runs the chosen "
-                        "variant in a fresh process")
+                   help="XLA_FLAGS sweep entries; each entry re-runs "
+                        "the chosen variant in a fresh process. Values "
+                        "start with '--', which argparse rejects as "
+                        "positional — use the '=' form. Commas separate "
+                        "INDEPENDENT entries "
+                        "(--sweep-flags=--flag1,--flag2 sweeps each "
+                        "alone); spaces inside one shell-quoted value "
+                        "compose a combined set "
+                        "(--sweep-flags='--flag1 --flag2')")
     p.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     args = p.parse_args(argv)
 
     if args.sweep_flags is not None and not args._child:
         sweep_variants = [args.variant] if args.variant != "all" \
             else ["baseline", "nhwc", "s2d", "nhwc_s2d"]
-        for flags in [""] + list(args.sweep_flags):
+        # commas separate independent sweep entries; split only on
+        # commas that start the NEXT flag — a flag's own value may
+        # contain commas (--xla_disable_hlo_passes=a,b)
+        flag_sets = [x for f in args.sweep_flags
+                     for x in re.split(r",(?=--)", f)]
+        for flags in [""] + flag_sets:
             env = dict(os.environ)
             if flags:
                 env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " "

@@ -10,6 +10,10 @@ cd "$(dirname "$0")/.."
 echo "== nightly gates (MNIST convergence, dist_sync 4-proc, recovery) =="
 python -m pytest tests/ -m nightly -q
 
+echo "== feed-the-chip absolute gate (dedicated box: strict) =="
+MXNET_TPU_STRICT_FEED_GATE=1 python -m pytest \
+    tests/test_feed_the_chip.py -q
+
 echo "== dist_sync 2-proc tier (kvstore arithmetic + training) =="
 python -m pytest tests/test_dist_kvstore.py -q
 
