@@ -45,3 +45,54 @@ mx.io.arrayiter <- function(data, label = NULL, batch.size = 128,
     }
   )
 }
+
+# ---- runtime-backed iterators ----------------------------------------------
+# Parity target: the reference's generated io creators
+# (R-package/R/mxnet_generated.R:480-610): mx.io.ImageRecordIter,
+# mx.io.MNISTIter, mx.io.CSVIter. Each rides the runtime's iterator
+# registry through .Call glue (src/mxnet_glue.c mxr_io_*) and returns the
+# same contract as mx.io.arrayiter: list(batch.size, reset, iter.next,
+# value), with value()$data in R column-major layout (sample axis LAST).
+
+mx.io.create <- function(name, ...) {
+  kw <- list(...)
+  kw <- Filter(Negate(is.null), kw)   # NULL kwarg == omitted (R idiom)
+  if (length(kw) && (is.null(names(kw)) || any(names(kw) == "")))
+    stop("mx.io.create: all iterator parameters must be named")
+  # R convention uses dots in argument names; the runtime expects
+  # underscores (batch.size -> batch_size), like the reference R package
+  keys <- gsub("\\.", "_", names(kw))
+  # shape-typed keys need tuple syntax even for one dimension
+  # (data.shape = 1 -> "(1,)"): .mx.param.str is the one shared
+  # value serializer for the ABI
+  vals <- vapply(seq_along(kw), function(i) {
+    .mx.param.str(kw[[i]], force.tuple = grepl("shape$", keys[[i]]))
+  }, character(1))
+  handle <- .Call(mxr_io_create, name, keys, unname(vals))
+
+  to.r <- function(values) {
+    cdim <- attr(values, "mx.dim")
+    if (length(cdim) <= 1) return(as.numeric(values))
+    .mx.from.c.order(values, rev(cdim))
+  }
+  bs <- kw[["batch.size"]]
+  if (is.null(bs)) bs <- kw[["batch_size"]]
+
+  list(
+    batch.size = if (is.null(bs)) NA_integer_ else as.integer(bs),
+    reset = function() {
+      .Call(mxr_io_before_first, handle)
+      invisible(NULL)
+    },
+    iter.next = function() .Call(mxr_io_next, handle) != 0L,
+    value = function() {
+      v <- .Call(mxr_io_value, handle)
+      list(data = to.r(v$data), label = to.r(v$label),
+           pad = v$pad)
+    }
+  )
+}
+
+mx.io.ImageRecordIter <- function(...) mx.io.create("ImageRecordIter", ...)
+mx.io.MNISTIter <- function(...) mx.io.create("MNISTIter", ...)
+mx.io.CSVIter <- function(...) mx.io.create("CSVIter", ...)

@@ -72,12 +72,24 @@ mx.model.FeedForward.create <- function(
   data.name <- names2[[1]]
   label.name <- names2[[2]]
 
-  X <- mx.model.select.layout.train(X, array.layout)
-  iter <- mx.io.arrayiter(X, y, batch.size = array.batch.size,
-                          shuffle = TRUE)
-
-  dshape <- dim(X)
-  input.shape <- c(dshape[-length(dshape)], array.batch.size)
+  if (is.list(X) && is.function(X$iter.next)) {
+    # X is already a data iterator (mx.io.arrayiter / ImageRecordIter /
+    # MNISTIter / CSVIter ... — the reference accepts either form);
+    # probe one batch for the input shape, then rewind
+    iter <- X
+    iter$reset()
+    if (!iter$iter.next())
+      stop("mx.model.FeedForward.create: the data iterator is empty")
+    probe <- iter$value()
+    input.shape <- dim(probe$data)
+    iter$reset()
+  } else {
+    X <- mx.model.select.layout.train(X, array.layout)
+    iter <- mx.io.arrayiter(X, y, batch.size = array.batch.size,
+                            shuffle = TRUE)
+    dshape <- dim(X)
+    input.shape <- c(dshape[-length(dshape)], array.batch.size)
+  }
   init <- mx.model.init.params(symbol, input.shape, initializer)
   arg.params <- init$arg.params
   aux.params <- init$aux.params

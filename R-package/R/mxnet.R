@@ -38,6 +38,19 @@ mx.tpu <- function(dev.id = 0L) structure(
   aperm(arr, rev(seq_along(shape)))
 }
 
+# ONE serializer for R values crossing the ABI as parameter strings —
+# symbol params (mx.symbol.create) and iterator kwargs (mx.io.create)
+# must not drift apart. force.tuple renders a length-1 numeric as a
+# one-element tuple ("(3,)") for keys whose runtime type is a shape.
+.mx.param.str <- function(v, force.tuple = FALSE) {
+  if (is.logical(v)) return(if (v) "True" else "False")
+  if (is.numeric(v) && length(v) > 1)
+    return(paste0("(", paste(as.integer(v), collapse = ", "), ")"))
+  if (force.tuple && is.numeric(v))
+    return(paste0("(", as.integer(v), ",)"))
+  as.character(v)
+}
+
 # ---- NDArray ---------------------------------------------------------------
 
 mx.nd.array <- function(src.array, ctx = mx.cpu()) {
@@ -255,11 +268,7 @@ mx.symbol.create <- function(op.name, ..., name = "") {
     stop("mx.symbol.", op.name,
          ": use either all-named or all-positional symbol inputs")
   param.keys <- names(params)
-  param.vals <- vapply(params, function(v) {
-    if (is.numeric(v) && length(v) > 1)
-      paste0("(", paste(as.integer(v), collapse = ", "), ")")
-    else as.character(v)
-  }, character(1))
+  param.vals <- vapply(params, .mx.param.str, character(1))
   handle <- .Call(mxr_sym_create_atomic, op.name,
                   as.character(param.keys), as.character(param.vals))
   if (length(named.inputs) > 0) {

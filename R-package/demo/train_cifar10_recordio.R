@@ -1,0 +1,45 @@
+# Train a small convnet from a CIFAR-10 recordio file — the reference's
+# R-package recordio workflow (reference R-package/R/mxnet_generated.R
+# ImageRecordIter + example/image-classification/train_cifar10.R),
+# running on the runtime-backed mx.io.ImageRecordIter binding.
+#
+# Prepare data with tools/im2rec.py (or download the CIFAR-10 .rec from
+# the reference's data/ scripts), then:
+#   Rscript train_cifar10_recordio.R cifar10_train.rec
+
+args <- commandArgs(trailingOnly = TRUE)
+rec.file <- if (length(args) >= 1) args[[1]] else "cifar10_train.rec"
+
+library(mxnet)
+
+train.iter <- mx.io.ImageRecordIter(
+  path.imgrec = rec.file,
+  data.shape = c(3, 28, 28),
+  batch.size = 128,
+  shuffle = TRUE,
+  rand.crop = TRUE,
+  rand.mirror = TRUE,
+  mean.r = 127.5, mean.g = 127.5, mean.b = 127.5,
+  scale = 1 / 127.5)
+
+data <- mx.symbol.Variable("data")
+conv1 <- mx.symbol.Convolution(data, kernel = c(3, 3), pad = c(1, 1),
+                               num_filter = 32, name = "conv1")
+act1 <- mx.symbol.Activation(conv1, act_type = "relu")
+pool1 <- mx.symbol.Pooling(act1, kernel = c(2, 2), stride = c(2, 2),
+                           pool_type = "max")
+conv2 <- mx.symbol.Convolution(pool1, kernel = c(3, 3), pad = c(1, 1),
+                               num_filter = 64, name = "conv2")
+act2 <- mx.symbol.Activation(conv2, act_type = "relu")
+pool2 <- mx.symbol.Pooling(act2, kernel = c(2, 2), stride = c(2, 2),
+                           pool_type = "max")
+flat <- mx.symbol.Flatten(pool2)
+fc1 <- mx.symbol.FullyConnected(flat, num_hidden = 128, name = "fc1")
+act3 <- mx.symbol.Activation(fc1, act_type = "relu")
+fc2 <- mx.symbol.FullyConnected(act3, num_hidden = 10, name = "fc2")
+net <- mx.symbol.SoftmaxOutput(fc2, name = "softmax")
+
+model <- mx.model.FeedForward.create(
+  net, X = train.iter, ctx = mx.cpu(), num.round = 10,
+  learning.rate = 0.05, momentum = 0.9,
+  eval.metric = mx.metric.accuracy)

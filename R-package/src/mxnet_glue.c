@@ -580,6 +580,94 @@ SEXP mxr_sym_group(SEXP handles) {
   return wrap_handle(out, symbol_finalizer);
 }
 
+/* ---- data iterators ---------------------------------------------------
+ * Parity target: the reference's generated R io creators
+ * (R-package/R/mxnet_generated.R:480-610 — mx.io.ImageRecordIter,
+ * mx.io.MNISTIter, mx.io.CSVIter over MXDataIterCreateIter). Handles
+ * returned by MXDataIterGetData/GetLabel are views owned by the
+ * iterator, so the values are copied straight into R arrays here and
+ * never wrapped with a freeing finalizer. */
+
+static void dataiter_finalizer(SEXP ptr) {
+  DataIterHandle h = R_ExternalPtrAddr(ptr);
+  if (h) { MXDataIterFree(h); R_ClearExternalPtr(ptr); }
+}
+
+/* mxr_io_create(name, keys, vals) -> extptr */
+SEXP mxr_io_create(SEXP name, SEXP keys, SEXP vals) {
+  mx_uint n;
+  DataIterCreator *creators;
+  chk(MXListDataIters(&n, &creators));
+  const char *want = CHAR(STRING_ELT(name, 0));
+  DataIterCreator creator = NULL;
+  for (mx_uint i = 0; i < n && !creator; ++i) {
+    const char *inm, *desc;
+    chk(MXDataIterGetIterInfo(creators[i], &inm, &desc));
+    if (strcmp(inm, want) == 0) creator = creators[i];
+  }
+  if (!creator) Rf_error("mxnet_tpu: unknown data iterator '%s'", want);
+  mx_uint np = (mx_uint)Rf_length(keys);
+  const char **ck = (const char **)R_alloc(np ? np : 1, sizeof(char *));
+  const char **cv = (const char **)R_alloc(np ? np : 1, sizeof(char *));
+  for (mx_uint i = 0; i < np; ++i) {
+    ck[i] = CHAR(STRING_ELT(keys, i));
+    cv[i] = CHAR(STRING_ELT(vals, i));
+  }
+  DataIterHandle h;
+  chk(MXDataIterCreateIter(creator, np, ck, cv, &h));
+  return wrap_handle(h, dataiter_finalizer);
+}
+
+SEXP mxr_io_before_first(SEXP it) {
+  chk(MXDataIterBeforeFirst(R_ExternalPtrAddr(it)));
+  return R_NilValue;
+}
+
+SEXP mxr_io_next(SEXP it) {
+  int more;
+  chk(MXDataIterNext(R_ExternalPtrAddr(it), &more));
+  return Rf_ScalarInteger(more);
+}
+
+static SEXP iter_array(NDArrayHandle h) {
+  mx_uint ndim;
+  const mx_uint *dims;
+  chk(MXNDArrayGetShape(h, &ndim, &dims));
+  R_xlen_t n = 1;
+  for (mx_uint i = 0; i < ndim; ++i) n *= dims[i];
+  float *buf = (float *)R_alloc(n, sizeof(float));
+  chk(MXNDArraySyncCopyToCPU(h, buf, (mx_uint)n));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, n));
+  for (R_xlen_t i = 0; i < n; ++i) REAL(out)[i] = buf[i];
+  SEXP dim = PROTECT(Rf_allocVector(INTSXP, ndim));
+  for (mx_uint i = 0; i < ndim; ++i) INTEGER(dim)[i] = (int)dims[i];
+  Rf_setAttrib(out, Rf_install("mx.dim"), dim);
+  UNPROTECT(2);
+  return out;
+}
+
+/* mxr_io_value(extptr) -> list(data=, label=, pad=) with C-order dims
+ * in the mx.dim attribute (R side converts layout, like mxr_nd_get) */
+SEXP mxr_io_value(SEXP it) {
+  DataIterHandle h = R_ExternalPtrAddr(it);
+  NDArrayHandle data, label;
+  int pad;
+  chk(MXDataIterGetData(h, &data));
+  chk(MXDataIterGetLabel(h, &label));
+  chk(MXDataIterGetPadNum(h, &pad));
+  SEXP out = PROTECT(Rf_allocVector(VECSXP, 3));
+  SET_VECTOR_ELT(out, 0, iter_array(data));
+  SET_VECTOR_ELT(out, 1, iter_array(label));
+  SET_VECTOR_ELT(out, 2, Rf_ScalarInteger(pad));
+  SEXP names = PROTECT(Rf_allocVector(STRSXP, 3));
+  SET_STRING_ELT(names, 0, Rf_mkChar("data"));
+  SET_STRING_ELT(names, 1, Rf_mkChar("label"));
+  SET_STRING_ELT(names, 2, Rf_mkChar("pad"));
+  Rf_setAttrib(out, R_NamesSymbol, names);
+  UNPROTECT(2);
+  return out;
+}
+
 /* ---- registration ----------------------------------------------------- */
 
 static const R_CallMethodDef call_methods[] = {
@@ -618,6 +706,10 @@ static const R_CallMethodDef call_methods[] = {
   {"mxr_sym_group", (DL_FUNC)&mxr_sym_group, 1},
   {"mxr_func_invoke", (DL_FUNC)&mxr_func_invoke, 4},
   {"mxr_nd_context", (DL_FUNC)&mxr_nd_context, 1},
+  {"mxr_io_create", (DL_FUNC)&mxr_io_create, 3},
+  {"mxr_io_before_first", (DL_FUNC)&mxr_io_before_first, 1},
+  {"mxr_io_next", (DL_FUNC)&mxr_io_next, 1},
+  {"mxr_io_value", (DL_FUNC)&mxr_io_value, 1},
   {NULL, NULL, 0}
 };
 

@@ -1,0 +1,226 @@
+/* Execution gate for the R io-iterator bindings (round-4 verdict #3):
+ * drives the exact .Call sequence mx.io.ImageRecordIter / mx.io.MNISTIter
+ * / mx.io.CSVIter (R-package/R/io.R) and mx.model.FeedForward.create
+ * (R/model.R, iterator form) perform — mxr_io_create with string kwargs,
+ * before_first / next / value per batch, batches fed to a LeNet-style
+ * executor trained with the optimizer.R SGD math. No R interpreter
+ * exists in this image, so tests/r_shim.c supplies the R C API
+ * (reference parity: R-package/R/mxnet_generated.R:480-610 creators,
+ * exercised by the reference's R testthat CI).
+ *
+ * argv: 1=path.rec  2=data.csv  3=mnist-images  4=mnist-labels
+ * Prints "final_acc=<v>"; the pytest wrapper gates >= 0.9.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "Rinternals.h"
+
+SEXP mxr_io_create(SEXP name, SEXP keys, SEXP vals);
+SEXP mxr_io_before_first(SEXP it);
+SEXP mxr_io_next(SEXP it);
+SEXP mxr_io_value(SEXP it);
+SEXP mxr_sym_variable(SEXP name);
+SEXP mxr_sym_create_atomic(SEXP opname, SEXP keys, SEXP vals);
+SEXP mxr_sym_compose(SEXP ptr, SEXP name, SEXP keys, SEXP args);
+SEXP mxr_sym_infer_shape(SEXP ptr, SEXP keys, SEXP ind, SEXP data);
+SEXP mxr_sym_list_arguments(SEXP ptr);
+SEXP mxr_exec_simple_bind(SEXP sym, SEXP dev_type, SEXP dev_id, SEXP keys,
+                          SEXP ind, SEXP data, SEXP for_training);
+SEXP mxr_exec_set_arg(SEXP ptr, SEXP name, SEXP values);
+SEXP mxr_exec_forward(SEXP ptr, SEXP is_train);
+SEXP mxr_exec_backward(SEXP ptr);
+SEXP mxr_exec_get_output(SEXP ptr, SEXP index, SEXP size);
+SEXP mxr_exec_get_grad(SEXP ptr, SEXP name, SEXP size);
+SEXP mxr_random_seed(SEXP seed);
+
+#define BATCH 8
+#define IMG 12
+#define NCLASS 2
+#define ROUNDS 10
+
+static SEXP ints(int n, const int *v) {
+  SEXP s = Rf_allocVector(INTSXP, n);
+  for (int i = 0; i < n; ++i) INTEGER(s)[i] = v[i];
+  return s;
+}
+static SEXP int1(int v) { return ints(1, &v); }
+static SEXP reals(R_xlen_t n, const double *v) {
+  SEXP s = Rf_allocVector(REALSXP, n);
+  for (R_xlen_t i = 0; i < n; ++i) REAL(s)[i] = v[i];
+  return s;
+}
+static SEXP strs(int n, const char **v) {
+  SEXP s = Rf_allocVector(STRSXP, n);
+  for (int i = 0; i < n; ++i) SET_STRING_ELT(s, i, Rf_mkChar(v[i]));
+  return s;
+}
+static SEXP atomic_op(const char *op, SEXP input, const char *name,
+                      const char **pkeys, const char **pvals, int np) {
+  SEXP h = mxr_sym_create_atomic(Rf_mkString(op), strs(np, pkeys),
+                                 strs(np, pvals));
+  const char *inkeys[] = {"data"};
+  SEXP args = Rf_allocVector(VECSXP, 1);
+  SET_VECTOR_ELT(args, 0, input);
+  mxr_sym_compose(h, Rf_mkString(name), strs(1, inkeys), args);
+  return h;
+}
+static double frand(unsigned *seed) {
+  *seed ^= *seed << 13;
+  *seed ^= *seed >> 17;
+  *seed ^= *seed << 5;
+  return (double)(*seed % 1000003) / 1000003.0;
+}
+static long elems(SEXP arr) {
+  SEXP dim = Rf_getAttrib(arr, Rf_install("mx.dim"));
+  long n = 1;
+  for (int i = 0; i < Rf_length(dim); ++i) n *= INTEGER(dim)[i];
+  return n;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s rec csv mnist-img mnist-lbl\n", argv[0]);
+    return 2;
+  }
+  mxr_random_seed(int1(7));
+
+  /* ---- mx.io.ImageRecordIter(path.imgrec=..., data.shape=c(3,12,12),
+   * batch.size=8, shuffle=TRUE) — kwargs as the R wrapper stringifies
+   * them ---- */
+  const char *ik[] = {"path_imgrec", "data_shape", "batch_size",
+                      "shuffle", "scale", "mean_r", "mean_g", "mean_b"};
+  char shape_str[64];
+  snprintf(shape_str, sizeof shape_str, "(3,%d,%d)", IMG, IMG);
+  /* centered pixels ((x-127.5)/127.5), the R vignette recipe */
+  const char *iv[] = {argv[1], shape_str, "8", "True", "0.00784313725",
+                      "127.5", "127.5", "127.5"};
+  SEXP rec_it = mxr_io_create(Rf_mkString("ImageRecordIter"),
+                              strs(8, ik), strs(8, iv));
+
+  /* ---- LeNet-style net: conv -> relu -> flatten -> FC(2) -> softmax */
+  SEXP data = mxr_sym_variable(Rf_mkString("data"));
+  const char *k_conv[] = {"num_filter", "kernel"};
+  const char *v_conv[] = {"4", "(3, 3)"};
+  SEXP conv = atomic_op("Convolution", data, "conv1", k_conv, v_conv, 2);
+  const char *k_act[] = {"act_type"};
+  const char *v_act[] = {"relu"};
+  SEXP act = atomic_op("Activation", conv, "act1", k_act, v_act, 1);
+  SEXP flat = atomic_op("Flatten", act, "flat", NULL, NULL, 0);
+  const char *k_hid[] = {"num_hidden"};
+  const char *v_hid[] = {"2"};
+  SEXP fc = atomic_op("FullyConnected", flat, "fc", k_hid, v_hid, 1);
+  SEXP net = atomic_op("SoftmaxOutput", fc, "softmax", NULL, NULL, 0);
+
+  const char *shape_keys[] = {"data"};
+  int ind[] = {0, 4};
+  int sdata[] = {BATCH, 3, IMG, IMG};
+  SEXP shapes = mxr_sym_infer_shape(net, strs(1, shape_keys),
+                                    ints(2, ind), ints(4, sdata));
+  SEXP arg_shapes = VECTOR_ELT(shapes, 0);
+  SEXP arg_names = mxr_sym_list_arguments(net);
+  int nargs = Rf_length(arg_names);
+  SEXP exec = mxr_exec_simple_bind(net, int1(1), int1(0),
+                                   strs(1, shape_keys), ints(2, ind),
+                                   ints(4, sdata), int1(1));
+
+  unsigned seed = 42;
+  double *params[16], *moms[16];
+  long psize[16];
+  for (int i = 0; i < nargs; ++i) {
+    const char *nm = CHAR(STRING_ELT(arg_names, i));
+    SEXP shp = VECTOR_ELT(arg_shapes, i);
+    long n = 1;
+    for (int j = 0; j < Rf_length(shp); ++j) n *= INTEGER(shp)[j];
+    psize[i] = n;
+    params[i] = calloc(n, sizeof(double));
+    moms[i] = calloc(n, sizeof(double));
+    if (strstr(nm, "weight"))
+      for (long j = 0; j < n; ++j)
+        params[i][j] = (frand(&seed) - 0.5) * 0.2;
+    if (strcmp(nm, "data") && strcmp(nm, "softmax_label"))
+      mxr_exec_set_arg(exec, Rf_mkString(nm), reals(n, params[i]));
+  }
+
+  const double lr = 0.05, momentum = 0.9;
+  double acc = 0.0;
+  for (int round = 0; round < ROUNDS; ++round) {
+    int correct = 0, seen = 0;
+    mxr_io_before_first(rec_it);
+    while (Rf_asInteger(mxr_io_next(rec_it))) {
+      SEXP v = mxr_io_value(rec_it);
+      SEXP bd = VECTOR_ELT(v, 0);           /* C-order (B,3,IMG,IMG) */
+      SEXP bl = VECTOR_ELT(v, 1);
+      if (elems(bd) != BATCH * 3 * IMG * IMG) {
+        fprintf(stderr, "bad batch size %ld\n", elems(bd));
+        return 1;
+      }
+      mxr_exec_set_arg(exec, Rf_mkString("data"), bd);
+      mxr_exec_set_arg(exec, Rf_mkString("softmax_label"), bl);
+      mxr_exec_forward(exec, int1(1));
+      mxr_exec_backward(exec);
+      for (int i = 0; i < nargs; ++i) {
+        const char *nm = CHAR(STRING_ELT(arg_names, i));
+        if (!strcmp(nm, "data") || !strcmp(nm, "softmax_label")) continue;
+        SEXP g = mxr_exec_get_grad(exec, Rf_mkString(nm),
+                                   int1((int)psize[i]));
+        for (long j = 0; j < psize[i]; ++j) {
+          moms[i][j] = momentum * moms[i][j] - lr * REAL(g)[j];
+          params[i][j] += moms[i][j];
+        }
+        mxr_exec_set_arg(exec, Rf_mkString(nm),
+                         reals(psize[i], params[i]));
+      }
+      SEXP out = mxr_exec_get_output(exec, int1(0),
+                                     int1(BATCH * NCLASS));
+      for (int b = 0; b < BATCH; ++b) {
+        int guess = REAL(out)[b * NCLASS] > REAL(out)[b * NCLASS + 1]
+                        ? 0 : 1;
+        correct += (guess == (int)REAL(bl)[b]);
+        seen += 1;
+      }
+    }
+    acc = (double)correct / seen;
+  }
+
+  /* ---- mx.io.CSVIter: exact read-back of known rows ---- */
+  const char *ck[] = {"data_csv", "data_shape", "batch_size"};
+  const char *cv[] = {argv[2], "(3,)", "2"};
+  SEXP csv_it = mxr_io_create(Rf_mkString("CSVIter"), strs(3, ck),
+                              strs(3, cv));
+  mxr_io_before_first(csv_it);
+  if (!Rf_asInteger(mxr_io_next(csv_it))) return 1;
+  SEXP cval = mxr_io_value(csv_it);
+  SEXP cdat = VECTOR_ELT(cval, 0);
+  /* wrapper wrote rows (r*3+c)*0.5 */
+  for (int i = 0; i < 6; ++i) {
+    double want = i * 0.5;
+    if (REAL(cdat)[i] < want - 1e-5 || REAL(cdat)[i] > want + 1e-5) {
+      fprintf(stderr, "csv[%d]=%f want %f\n", i, REAL(cdat)[i], want);
+      return 1;
+    }
+  }
+
+  /* ---- mx.io.MNISTIter: idx files parse, shapes and labels sane ---- */
+  const char *mk[] = {"image", "label", "batch_size", "shuffle"};
+  const char *mv[] = {argv[3], argv[4], "4", "False"};
+  SEXP mn_it = mxr_io_create(Rf_mkString("MNISTIter"), strs(4, mk),
+                             strs(4, mv));
+  mxr_io_before_first(mn_it);
+  if (!Rf_asInteger(mxr_io_next(mn_it))) return 1;
+  SEXP mval = mxr_io_value(mn_it);
+  if (elems(VECTOR_ELT(mval, 0)) != 4 * 1 * 28 * 28) {
+    fprintf(stderr, "mnist batch elems %ld\n",
+            elems(VECTOR_ELT(mval, 0)));
+    return 1;
+  }
+  for (int i = 0; i < 4; ++i) {
+    double l = REAL(VECTOR_ELT(mval, 1))[i];
+    if (l < 0 || l >= 10) { fprintf(stderr, "mnist label %f\n", l);
+                            return 1; }
+  }
+
+  printf("final_acc=%f\n", acc);
+  return acc >= 0.9 ? 0 : 1;
+}

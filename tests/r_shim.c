@@ -96,6 +96,12 @@ double Rf_asReal(SEXP v) {
   return 0;
 }
 
+SEXP Rf_ScalarInteger(int v) {
+  sexp_rec *r = rec(INTSXP, 1);
+  r->ints[0] = v;
+  return r;
+}
+
 SEXP Rf_setAttrib(SEXP x, SEXP sym, SEXP val) {
   sexp_rec *r = (sexp_rec *)x;
   attrib *a = calloc(1, sizeof(attrib));

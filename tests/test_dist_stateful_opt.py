@@ -1,0 +1,235 @@
+"""Stateful-optimizer distributed gates (round-4 verdict #5): Adam /
+momentum state must accumulate correctly across ≥2 ranks, survive a
+late-joiner's set_optimizer, and survive worker restarts — the bug class
+the reference guards with rank-0-only command handling
+(/root/reference/src/kvstore/kvstore_dist_server.h:166-207)."""
+import pickle
+
+import numpy as np
+import pytest
+
+from dist_util import REPO, fill, launch, maybe_skip_unavailable
+
+
+def _serial_adam_trajectory(n_steps, lr=0.1, shape=(2,)):
+    """The expected weight after n_steps server-side Adam updates of
+    grad=1 — computed through the SAME optimizer implementation the
+    server unpickles, driven locally."""
+    import mxnet_tpu as mx
+
+    opt = mx.optimizer.Adam(learning_rate=lr)
+    w = mx.nd.zeros(shape)
+    state = opt.create_state(0, w)
+    g = mx.nd.ones(shape)
+    for _ in range(n_steps):
+        opt.update(0, w, g, state)
+    return w.asnumpy()
+
+
+ADAM_ASYNC_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+
+kv = mx.kv.create("dist_async")
+rank, nw = kv.rank, kv.num_workers
+assert nw == 2, nw
+
+# ---- exactness: server-side Adam accumulates first/second moments
+# across BOTH workers' pushes. Constant grads make the trajectory
+# order-independent, so the interleaving doesn't matter — only that the
+# server kept ONE evolving (mean, var, t) across 2*K pushes.
+K = 4
+kv.set_optimizer(mx.optimizer.Adam(learning_rate=0.1))
+kv.barrier()
+kv.init(3, mx.nd.zeros((2,)))
+for _ in range(K):
+    kv.push(3, mx.nd.ones((2,), dtype="float32"))
+kv.barrier()                       # all 2K pushes landed
+w = mx.nd.zeros((2,))
+kv.pull(3, w)
+
+opt = mx.optimizer.Adam(learning_rate=0.1)
+ref = mx.nd.zeros((2,))
+state = opt.create_state(0, ref)
+for _ in range(2 * K):
+    opt.update(0, ref, mx.nd.ones((2,)), state)
+np.testing.assert_allclose(w.asnumpy(), ref.asnumpy(), atol=1e-5)
+
+# ---- convergence: Module trains through server-side Adam
+rng = np.random.RandomState(0)
+n = 256
+y = rng.randint(0, 2, n).astype(np.float32)
+X = (rng.randn(n, 8).astype(np.float32) * 0.5 + y[:, None])
+Xs, ys = X[rank::nw], y[rank::nw]
+data = mx.sym.Variable("data")
+net = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+net = mx.sym.Activation(data=net, act_type="relu")
+net = mx.sym.FullyConnected(data=net, num_hidden=2, name="fc2")
+net = mx.sym.SoftmaxOutput(data=net, name="softmax")
+it = mx.io.NDArrayIter(Xs, ys, batch_size=16, shuffle=False)
+mod = mx.mod.Module(net, context=mx.cpu())
+mod.fit(it, num_epoch=20, kvstore=kv,
+        optimizer="adam", optimizer_params={"learning_rate": 0.005})
+it.reset()
+acc = next(iter(dict(mod.score(it, "acc")).values()))
+assert acc > 0.9, acc
+kv.barrier()
+if rank == 0:
+    kv.close()
+print("ADAM_ASYNC_OK rank=%d acc=%.3f" % (rank, acc))
+"""
+
+
+def test_dist_async_adam_two_workers(tmp_path):
+    out = launch(tmp_path, fill(ADAM_ASYNC_SCRIPT, tmp_path), port=23480,
+                 timeout=420)
+    assert out.returncode == 0, (out.stdout[-1500:], out.stderr[-2500:])
+    assert out.stdout.count("ADAM_ASYNC_OK") == 2, out.stdout[-1500:]
+
+
+SYNC_MOMENTUM_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+
+TMP = %(tmp)r
+kv = mx.kv.create("dist_sync")
+rank, nw = kv.rank, kv.num_workers
+
+rng = np.random.RandomState(0)
+n = 256
+y = rng.randint(0, 2, n).astype(np.float32)
+X = (rng.randn(n, 8).astype(np.float32) * 0.5 + y[:, None])
+Xs, ys = X[rank::nw], y[rank::nw]
+data = mx.sym.Variable("data")
+net = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+net = mx.sym.Activation(data=net, act_type="relu")
+net = mx.sym.FullyConnected(data=net, num_hidden=2, name="fc2")
+net = mx.sym.SoftmaxOutput(data=net, name="softmax")
+it = mx.io.NDArrayIter(Xs, ys, batch_size=16, shuffle=False)
+mod = mx.mod.Module(net, context=mx.cpu())
+mod.fit(it, num_epoch=8, kvstore=kv, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.2, "momentum": 0.9})
+it.reset()
+acc = next(iter(dict(mod.score(it, "acc")).values()))
+assert acc > 0.9, acc
+
+# sync + stateful updater must stay bit-identical across ranks: every
+# rank applies the same aggregated gradients to the same momentum
+arg, _ = mod.get_params()
+np.save(os.path.join(TMP, "w_%d.npy" % rank),
+        arg["fc1_weight"].asnumpy())
+kv.barrier()
+if rank == 1:
+    a = np.load(os.path.join(TMP, "w_0.npy"))
+    b = np.load(os.path.join(TMP, "w_1.npy"))
+    np.testing.assert_array_equal(a, b)
+print("SYNC_MOM_OK rank=%d acc=%.3f" % (rank, acc))
+"""
+
+
+def test_dist_sync_momentum_identical_across_ranks(tmp_path):
+    out = launch(tmp_path, fill(SYNC_MOMENTUM_SCRIPT, tmp_path),
+                 port=23481, timeout=420)
+    maybe_skip_unavailable(out, "SYNC_MOM_OK" in out.stdout)
+    assert out.returncode == 0, (out.stdout[-1500:], out.stderr[-2500:])
+    assert out.stdout.count("SYNC_MOM_OK") == 2, out.stdout[-1500:]
+
+
+def test_worker_restart_preserves_server_adam_state():
+    """A worker dying and reconnecting (new TCP session, same rank) must
+    keep descending the SAME Adam trajectory: the state lives on the
+    server, not in any client."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import ps
+
+    server = ps.ParameterServer("127.0.0.1", 23718, num_workers=1)
+    try:
+        c = ps.PSClient("127.0.0.1", 23718)
+        c.call("hello", 0)
+        c.call("set_optimizer",
+               pickle.dumps(mx.optimizer.Adam(learning_rate=0.1)))
+        c.call("init", 0, 0, np.zeros(2, np.float32))
+        for _ in range(3):
+            c.call("push", 0, np.ones(2, np.float32))
+        c.close()                       # worker "crash"
+
+        c2 = ps.PSClient("127.0.0.1", 23718)   # restarted worker
+        c2.call("hello", 0)
+        for _ in range(3):
+            c2.call("push", 0, np.ones(2, np.float32))
+        got = c2.call("pull", 0)
+        c2.close()
+        np.testing.assert_allclose(got, _serial_adam_trajectory(6),
+                                   atol=1e-5)
+    finally:
+        server.close()
+
+
+def test_late_joiner_set_optimizer_keeps_adam_state():
+    """A late worker's set_optimizer must not wipe the server's Adam
+    moments (first-writer-wins, reference rank-0-only command path)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import ps
+
+    server = ps.ParameterServer("127.0.0.1", 23719, num_workers=2)
+    try:
+        blob = pickle.dumps(mx.optimizer.Adam(learning_rate=0.1))
+        c0 = ps.PSClient("127.0.0.1", 23719)
+        c0.call("hello", 0)
+        c0.call("set_optimizer", blob)
+        c0.call("init", 0, 0, np.zeros(2, np.float32))
+        for _ in range(3):
+            c0.call("push", 0, np.ones(2, np.float32))
+
+        c1 = ps.PSClient("127.0.0.1", 23719)   # late joiner
+        c1.call("hello", 1)
+        c1.call("set_optimizer", blob)          # must be a no-op
+        for _ in range(3):
+            c1.call("push", 0, np.ones(2, np.float32))
+        got = c1.call("pull", 0)
+        np.testing.assert_allclose(got, _serial_adam_trajectory(6),
+                                   atol=1e-5)
+        c0.close()
+        c1.close()
+    finally:
+        server.close()
+
+
+def test_updater_adam_state_checkpoint_roundtrip():
+    """Worker restart via checkpoint: serializing updater states
+    (get_states/set_states, the Module.save_checkpoint path) and
+    restoring into a FRESH updater must continue the exact trajectory of
+    an uninterrupted run — momentum/variance survive the restart."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.optimizer import get_updater
+
+    rng = np.random.RandomState(0)
+    grads = [rng.randn(4, 3).astype(np.float32) for _ in range(10)]
+
+    def run(split=None):
+        w = mx.nd.zeros((4, 3))
+        upd = get_updater(mx.optimizer.Adam(learning_rate=0.05))
+        for i, g in enumerate(grads):
+            if split is not None and i == split:
+                blob = upd.get_states()
+                w_np = w.asnumpy()
+                # "restart": brand-new updater + weight from checkpoint
+                upd = get_updater(mx.optimizer.Adam(learning_rate=0.05))
+                upd.set_states(blob)
+                # num_update lives in the optimizer; restore it the way
+                # Module.load does via begin_num_update
+                upd.optimizer.begin_num_update = i
+                upd.optimizer.num_update = i
+                w = mx.nd.array(w_np)
+            upd(0, mx.nd.array(g), w)
+        return w.asnumpy()
+
+    np.testing.assert_allclose(run(split=5), run(), atol=1e-6)

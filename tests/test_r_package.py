@@ -39,6 +39,7 @@ int *INTEGER(SEXP); double *REAL(SEXP);
 int Rf_length(SEXP); R_xlen_t Rf_xlength(SEXP);
 int Rf_asInteger(SEXP);
 double Rf_asReal(SEXP);
+SEXP Rf_ScalarInteger(int);
 SEXP Rf_setAttrib(SEXP, SEXP, SEXP); SEXP Rf_getAttrib(SEXP, SEXP);
 SEXP PROTECT(SEXP); void UNPROTECT(int);
 void Rf_error(const char*, ...);
@@ -268,3 +269,83 @@ def test_model_R_defines_reference_training_surface():
                "mx.callback.log.train.metric"]:
         assert re.search(re.escape(fn) + r"\s*(<-|<<-)", rsrc), \
             "missing %s" % fn
+
+
+def test_r_glue_io_iterators_train(tmp_path):
+    """Execution gate for the R io-iterator bindings (round-4 verdict
+    #3): tests/r_glue_io_train.c drives the exact .Call sequence
+    mx.io.ImageRecordIter / CSVIter / MNISTIter (R/io.R) and the
+    iterator form of mx.model.FeedForward.create perform — create from
+    string kwargs, before_first/next/value, batches into a conv
+    executor trained with the optimizer.R SGD math — gating >= 0.9
+    accuracy from a recordio file, exact CSV read-back, and idx-format
+    MNIST parsing. Reference surface: R-package/R/mxnet_generated.R:
+    480-610."""
+    import shutil
+    if shutil.which("gcc") is None or shutil.which("make") is None:
+        pytest.skip("no gcc toolchain")
+    import sys as _sys
+
+    import numpy as np
+
+    _sys.path.insert(0, os.path.join(REPO, "tools"))
+    from make_mnist_synth import write_idx_images, write_idx_labels
+
+    from mxnet_tpu import recordio as rio
+
+    # class-conditional 12x12 recordio (dark=0 / bright=1)
+    rng = np.random.RandomState(0)
+    rec = str(tmp_path / "t.rec")
+    w = rio.MXRecordIO(rec, "w")
+    for i in range(64):
+        label = i % 2
+        lo, hi = (0, 110) if label == 0 else (145, 255)
+        w.write(rio.pack_img(
+            rio.IRHeader(0, float(label), i, 0),
+            rng.randint(lo, hi, (12, 12, 3)).astype(np.uint8),
+            quality=95))
+    w.close()
+
+    csv = str(tmp_path / "t.csv")
+    with open(csv, "w") as f:
+        for r in range(4):
+            f.write(",".join(str((r * 3 + c) * 0.5) for c in range(3))
+                    + "\n")
+
+    mimg = str(tmp_path / "imgs-idx3-ubyte")
+    mlbl = str(tmp_path / "lbls-idx1-ubyte")
+    write_idx_images(mimg, rng.randint(0, 255, (16, 28, 28))
+                     .astype(np.uint8))
+    write_idx_labels(mlbl, (np.arange(16) % 10).astype(np.uint8))
+
+    r = subprocess.run(["make", "-C", REPO, "predict"],
+                       capture_output=True, text=True)
+    lib = os.path.join(REPO, "mxnet_tpu", "_native", "libmxtpu_predict.so")
+    assert r.returncode == 0 and os.path.exists(lib), r.stderr[-800:]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        with open(os.path.join(tmp, "Rinternals.h"), "w") as f:
+            f.write(R_STUB)
+        with open(os.path.join(tmp, "R.h"), "w") as f:
+            f.write('#include "Rinternals.h"\n')
+        exe = os.path.join(tmp, "r_glue_io_train")
+        r = subprocess.run(
+            ["gcc", os.path.join(REPO, "tests", "r_shim.c"),
+             os.path.join(REPO, "tests", "r_glue_io_train.c"),
+             os.path.join(RPKG, "src", "mxnet_glue.c"),
+             "-o", exe, "-I", tmp, "-I", os.path.join(REPO, "include"),
+             "-L", os.path.dirname(lib), "-lmxtpu_predict",
+             "-Wl,-rpath," + os.path.dirname(lib)],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr[-2000:]
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        r = subprocess.run([exe, rec, csv, mimg, mlbl],
+                           capture_output=True, text=True, env=env,
+                           timeout=600)
+        assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
+        acc = float(r.stdout.strip().split("final_acc=")[1])
+        assert acc >= 0.9, r.stdout

@@ -33,7 +33,8 @@ BASE_R = {
     "floor", "ceiling", "round", "pmin", "pmax", "cumsum", "range",
     "setdiff", "union", "intersect", "any", "all", "is.null",
     "is.numeric", "is.character", "is.function", "is.list", "is.array",
-    "is.matrix", "is.na", "is.nan", "nchar", "paste", "paste0",
+    "is.matrix", "is.na", "is.nan", "is.logical", "unname", "Filter",
+    "Negate", "nchar", "paste", "paste0",
     "sprintf", "format", "substr", "strsplit", "sub", "gsub", "grepl",
     "regmatches", "gregexpr", "startsWith", "endsWith", "toupper",
     "tolower", "trimws", "as.numeric", "as.integer", "as.character",
