@@ -187,6 +187,7 @@ class CachedImageRecordIter(DataIter):
                  mean_b: float = 0.0, scale: float = 1.0,
                  device_normalize: bool = True,
                  device_augment: bool = False,
+                 output_layout: str = "NCHW",
                  label_name: str = "softmax_label"):
         super().__init__()
         meta_path = cache_prefix + ".meta.json"
@@ -222,6 +223,13 @@ class CachedImageRecordIter(DataIter):
         # the device step. The host-crop mode (~3k img/s/core) stays the
         # default for CPU-only runs where device cycles are host cycles.
         self.device_augment = device_augment
+        # NHWC consumers (channels-last towers) read batches without the
+        # NCHW transpose — emitting their layout directly avoids a
+        # cancelling transpose pair per batch in the consumer
+        if output_layout not in ("NCHW", "NHWC"):
+            raise MXNetError("output_layout must be NCHW or NHWC, got %r"
+                             % (output_layout,))
+        self.output_layout = output_layout
         self.label_name = label_name
         self._data = np.load(cache_prefix + ".data", mmap_mode="r")
         self._labels = np.load(cache_prefix + ".label", mmap_mode="r")
@@ -255,10 +263,12 @@ class CachedImageRecordIter(DataIter):
             mean = jnp.asarray(self.mean, jnp.float32)
             scale = float(self.scale)
 
+            nchw = self.output_layout == "NCHW"
+
             @jax.jit
             def norm(x):
                 y = (x.astype(jnp.float32) - mean) * scale
-                return jnp.transpose(y, (0, 3, 1, 2))
+                return jnp.transpose(y, (0, 3, 1, 2)) if nchw else y
 
             self._norm_fn = norm
         return self._norm_fn(batch_u8)
@@ -274,6 +284,8 @@ class CachedImageRecordIter(DataIter):
             mean = jnp.asarray(self.mean, jnp.float32)
             scale = float(self.scale)
 
+            nchw = self.output_layout == "NCHW"
+
             @jax.jit
             def aug(x, top, left, m):
                 def one(img, t, l, mi):
@@ -282,7 +294,7 @@ class CachedImageRecordIter(DataIter):
 
                 y = jax.vmap(one)(x, top, left, m)
                 y = (y.astype(jnp.float32) - mean) * scale
-                return jnp.transpose(y, (0, 3, 1, 2))
+                return jnp.transpose(y, (0, 3, 1, 2)) if nchw else y
 
             self._aug_fn = aug
         return self._aug_fn(full_u8, tops, lefts, mirror)
@@ -290,7 +302,10 @@ class CachedImageRecordIter(DataIter):
     # -- DataIter interface ---------------------------------------------
     @property
     def provide_data(self):
-        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+        c, h, w = self.data_shape
+        shape = (self.batch_size, c, h, w) if self.output_layout == "NCHW" \
+            else (self.batch_size, h, w, c)
+        return [DataDesc("data", shape)]
 
     @property
     def provide_label(self):
@@ -370,6 +385,8 @@ class CachedImageRecordIter(DataIter):
             data = nd.NDArray(self._normalize(out))
         else:
             x = (out.astype(np.float32) - self.mean) * self.scale
-            data = nd.array(np.transpose(x, (0, 3, 1, 2)))
+            if self.output_layout == "NCHW":
+                x = np.transpose(x, (0, 3, 1, 2))
+            data = nd.array(x)
         return DataBatch([data], [nd.array(labels)], pad=0,
                          index=np.asarray(idx))

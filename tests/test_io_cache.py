@@ -171,3 +171,18 @@ def test_shape_mismatch_rebuilds_cache(cache, tmp_path):
     assert (meta2["height"], meta2["width"]) == (36, 36)
     data = np.load(prefix + ".data", mmap_mode="r")
     assert data.shape == (24, 36, 36, 3)
+
+
+def test_output_layout_nhwc_matches_nchw(cache):
+    prefix, _ = cache
+    kw = dict(shuffle=False, scale=1 / 255.0)
+    for aug in (dict(), dict(device_augment=True)):
+        nchw = io_cache.CachedImageRecordIter(prefix, (3, 28, 28), 8,
+                                              **kw, **aug)
+        nhwc = io_cache.CachedImageRecordIter(prefix, (3, 28, 28), 8,
+                                              output_layout="NHWC",
+                                              **kw, **aug)
+        assert nhwc.provide_data[0].shape == (8, 28, 28, 3)
+        a = next(nchw).data[0].asnumpy()
+        b = next(nhwc).data[0].asnumpy()
+        np.testing.assert_allclose(a, b.transpose(0, 3, 1, 2), rtol=1e-6)
