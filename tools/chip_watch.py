@@ -58,7 +58,8 @@ def probe(timeout_s=240):
     return _accelerator_reachable(timeout_s)
 
 
-def _run(cmd, timeout_s, env_overrides=None, outfile=None):
+def _run(cmd, timeout_s, env_overrides=None, outfile=None,
+         keep_output=False):
     """Run one suite stage; never let a hang wedge the watchdog."""
     env = dict(os.environ)
     env.update(env_overrides or {})
@@ -66,8 +67,15 @@ def _run(cmd, timeout_s, env_overrides=None, outfile=None):
     try:
         r = subprocess.run(cmd, capture_output=True, text=True, env=env,
                            timeout=timeout_s, cwd=REPO)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
         log("TIMEOUT after %ds: %s" % (timeout_s, cmd))
+        if keep_output and e.stdout:
+            # the per-case lines completed before the hang are the
+            # evidence this watchdog exists to save
+            out = e.stdout
+            if isinstance(out, bytes):
+                out = out.decode("utf-8", "replace")
+            return out
         return None
     if r.stderr:
         sys.stderr.write(r.stderr[-2000:])
@@ -76,6 +84,12 @@ def _run(cmd, timeout_s, env_overrides=None, outfile=None):
             f.write(r.stdout)
     if r.returncode != 0:
         log("stage failed rc=%d" % r.returncode)
+        if keep_output:
+            # a partially-failing sweep (e.g. tpu_consistency with one
+            # FAIL case, rc=1) is still evidence — per-case PASS/FAIL
+            # lines must reach the artifact, not vanish with the rc.
+            # Empty stdout (crash before any case) is NOT evidence.
+            return r.stdout or None
         return None
     return r.stdout
 
@@ -141,9 +155,11 @@ def fire():
     _run([py, mfu, "--variant", "baseline", "--batch", "512"],
          3000, outfile="MFU_EXPERIMENTS.jsonl")
     _commit("mfu flag sweep + batch scaling", stamp)
-    # 4. operator consistency sweep (the hardware-validation tier)
+    # 4. operator consistency sweep (the hardware-validation tier);
+    # keep_output: rc=1 means "ran, some case FAILED" — that per-case
+    # evidence is exactly what the artifact is for
     out = _run([py, os.path.join(REPO, "tools", "tpu_consistency.py")],
-               3000)
+               3000, keep_output=True)
     if out is not None:
         with open(os.path.join(REPO, "TPU_CONSISTENCY.txt"), "a") as f:
             f.write("== chip_watch %s ==\n%s" % (stamp, out))
