@@ -71,6 +71,16 @@ parser.add_argument("--data-shape", type=int, default=224,
                     help="input image edge length")
 parser.add_argument("--preprocess-threads", type=int, default=4,
                     help="decode pool size (feed-the-chip knob)")
+parser.add_argument("--use-cache", action="store_true",
+                    help="decode each .rec ONCE into a uint8 memmap "
+                         "cache next to it, then feed training from the "
+                         "cache with crop/mirror/normalize fused on "
+                         "device — sustains TPU-rate input from one "
+                         "host core (docs/performance.md); per-epoch "
+                         "JPEG decode needs ~28 cores at 224px")
+parser.add_argument("--cache-margin", type=int, default=32,
+                    help="stored-image margin above the crop size "
+                         "(store 256 for 224 crops)")
 args = parser.parse_args()
 
 
@@ -89,6 +99,8 @@ def get_net(name, num_classes):
 
 def get_iterator(args, kv):
     data_shape = (3, args.data_shape, args.data_shape)
+    if args.use_cache:
+        return get_cached_iterator(args, kv, data_shape)
     train = mx.io.ImageRecordIter(
         path_imgrec=os.path.join(args.data_dir, args.train_dataset),
         mean_r=123.68, mean_g=116.779, mean_b=103.939,
@@ -111,6 +123,33 @@ def get_iterator(args, kv):
         num_parts=kv.num_workers,
         part_index=kv.rank)
     return train, val
+
+
+def get_cached_iterator(args, kv, data_shape):
+    """The cache-fed input path (mxnet_tpu.io_cache): decode each .rec
+    once into a memmapped uint8 store, then feed every epoch from the
+    cache with the augmentation arithmetic fused on device. Exactly ONE
+    rank builds (O_EXCL lockfile in the shared data dir); the others
+    wait for the finished cache, and a regenerated .rec invalidates it
+    (size/mtime fingerprint in the meta)."""
+    from mxnet_tpu import io_cache
+
+    store = args.data_shape + args.cache_margin
+    iters = []
+    for dataset, train_aug in ((args.train_dataset, True),
+                               (args.val_dataset, False)):
+        rec = os.path.join(args.data_dir, dataset)
+        prefix = rec + ".cache"
+        io_cache.build_decoded_cache(
+            rec, prefix, (3, store, store),
+            preprocess_threads=args.preprocess_threads)
+        iters.append(io_cache.CachedImageRecordIter(
+            prefix, data_shape, args.batch_size,
+            shuffle=train_aug, rand_crop=train_aug,
+            rand_mirror=train_aug, device_augment=True,
+            mean_r=123.68, mean_g=116.779, mean_b=103.939,
+            num_parts=kv.num_workers, part_index=kv.rank))
+    return iters[0], iters[1]
 
 
 net = get_net(args.network, args.num_classes)

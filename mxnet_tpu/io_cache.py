@@ -75,20 +75,85 @@ def build_decoded_cache(path_imgrec: str, cache_prefix: str,
     rebuilds. The write is atomic (tmp + rename) so a killed build can't
     leave a torn cache that later runs trust. Memory stays bounded at
     one decode chunk regardless of dataset size."""
+    import socket
+    import time
+
     from . import recordio as rio
 
     c, h, w = store_shape
     meta_path = cache_prefix + ".meta.json"
-    if not overwrite and os.path.exists(meta_path):
+    src_stat = os.stat(path_imgrec)
+
+    def _fresh(meta):
+        # the cache must match BOTH the requested store shape and the
+        # source .rec it was decoded from — a regenerated rec (new
+        # size/mtime) silently training on old decoded data is the
+        # worst failure mode a cache can have
+        return ((meta.get("height"), meta.get("width"),
+                 meta.get("channels")) == (h, w, c)
+                and meta.get("src_size") == src_stat.st_size
+                and meta.get("src_mtime") == int(src_stat.st_mtime))
+
+    def _existing():
+        if overwrite or not os.path.exists(meta_path):
+            return None
         with open(meta_path) as f:
             meta = json.load(f)
-        if (meta.get("height"), meta.get("width"),
-                meta.get("channels")) == (h, w, c):
+        return meta if _fresh(meta) else None
+
+    meta = _existing()
+    if meta is not None:
+        return meta
+
+    # single-builder lock: in a multi-rank job every worker calls this
+    # over a shared filesystem — exactly one decodes, the rest wait for
+    # the finished cache (O_CREAT|O_EXCL is atomic on POSIX and NFSv3+)
+    lock_path = cache_prefix + ".build.lock"
+    deadline = time.time() + float(
+        os.environ.get("MXTPU_CACHE_BUILD_TIMEOUT", 24 * 3600))
+    while True:
+        try:
+            fd = os.open(lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, ("%s:%d" % (socket.gethostname(),
+                                     os.getpid())).encode())
+            os.close(fd)
+        except FileExistsError:
+            # another rank is building: wait, then re-evaluate
+            while os.path.exists(lock_path):
+                if time.time() > deadline:
+                    raise MXNetError(
+                        "timed out waiting for another rank's cache "
+                        "build (lock %s); if the builder crashed, "
+                        "delete the lock file and retry" % lock_path)
+                time.sleep(2.0)
+            meta = _existing()
+            if meta is not None:
+                return meta
+            continue    # builder produced a different cache — our turn
+        break           # lock held: we build
+    try:
+        # holders re-check: the cache may have been completed between
+        # our freshness check and winning the lock
+        meta = _existing()
+        if meta is not None:
             return meta
-        # a cache built at a different store_shape is NOT the cache the
-        # caller asked for — silently reusing it would train with the
-        # wrong augmentation margin (or make every crop request fail
-        # with 'rebuild the cache' while rebuild keeps no-op'ing)
+        return _locked_build(path_imgrec, cache_prefix, store_shape,
+                             preprocess_threads, src_stat)
+    finally:
+        try:
+            os.unlink(lock_path)
+        except OSError:
+            pass
+
+
+def _locked_build(path_imgrec, cache_prefix, store_shape,
+                  preprocess_threads, src_stat):
+    import socket
+
+    from . import recordio as rio
+
+    c, h, w = store_shape
+    meta_path = cache_prefix + ".meta.json"
 
     # pass 1: count records (framing reads only, no decode, no
     # retention — an ImageNet-scale .rec must never be resident in RAM)
@@ -106,7 +171,9 @@ def build_decoded_cache(path_imgrec: str, cache_prefix: str,
     first = reader.read()
     _, first_label = _decode_record(first, (h, w), c)
     label_width = first_label.size
-    pid_sfx = ".tmp.%d" % os.getpid()
+    # host+pid: two ranks on different hosts can share a bare PID, and
+    # colliding tmp paths would cross-corrupt the builds
+    pid_sfx = ".tmp.%s.%d" % (socket.gethostname(), os.getpid())
     data_tmp = cache_prefix + ".data" + pid_sfx
     label_tmp = cache_prefix + ".label" + pid_sfx
     data_mm = np.lib.format.open_memmap(
@@ -154,7 +221,11 @@ def build_decoded_cache(path_imgrec: str, cache_prefix: str,
         os.replace(label_tmp + ".npy", label_tmp)
 
     meta = {"num": n, "height": h, "width": w, "channels": c,
-            "label_width": int(label_width), "version": 1}
+            "label_width": int(label_width), "version": 1,
+            # staleness fingerprint of the source .rec: a regenerated
+            # rec (different size/mtime) forces a rebuild
+            "src_size": src_stat.st_size,
+            "src_mtime": int(src_stat.st_mtime)}
     meta_tmp = meta_path + pid_sfx
     with open(meta_tmp, "w") as f:
         json.dump(meta, f)
@@ -317,6 +388,7 @@ class CachedImageRecordIter(DataIter):
         self.cursor = -self.batch_size
         self._epoch += 1
         self._order = None
+        self._batch_cursor = None   # cursor values repeat across epochs
 
     def _epoch_order(self):
         if self._order is None:
@@ -333,11 +405,32 @@ class CachedImageRecordIter(DataIter):
         self.cursor += self.batch_size
         return self.cursor + self.batch_size <= self.num_data
 
-    def next(self) -> DataBatch:
-        from . import ndarray as nd
+    # C-API / base-DataIter accessor protocol (MXDataIterNext then
+    # GetData/GetLabel): the batch for the current cursor is built once
+    # and cached, so getdata()+getlabel() cost one construction
+    def getdata(self):
+        return self._current_batch().data
+    def getlabel(self):
+        return self._current_batch().label
+    def getpad(self):
+        return 0
+    def getindex(self):
+        return self._current_batch().index
 
+    def next(self) -> DataBatch:
         if not self.iter_next():
             raise StopIteration
+        return self._current_batch()
+
+    def _current_batch(self) -> DataBatch:
+        if getattr(self, "_batch_cursor", None) != self.cursor:
+            self._batch = self._make_batch()
+            self._batch_cursor = self.cursor
+        return self._batch
+
+    def _make_batch(self) -> DataBatch:
+        from . import ndarray as nd
+
         idx = self._epoch_order()[self.cursor:self.cursor + self.batch_size]
         c, h, w = self.data_shape
         sh, sw = self.meta["height"], self.meta["width"]
@@ -390,3 +483,11 @@ class CachedImageRecordIter(DataIter):
             data = nd.array(x)
         return DataBatch([data], [nd.array(labels)], pad=0,
                          index=np.asarray(idx))
+
+
+# registry entry: reachable from the C API (MXListDataIters /
+# MXDataIterCreateIter) and therefore from every non-Python frontend,
+# like the three reference iterators
+from .io import _REG as _IO_REG  # noqa: E402
+
+_IO_REG.register("CachedImageRecordIter")(CachedImageRecordIter)

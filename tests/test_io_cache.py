@@ -186,3 +186,70 @@ def test_output_layout_nhwc_matches_nchw(cache):
         a = next(nchw).data[0].asnumpy()
         b = next(nhwc).data[0].asnumpy()
         np.testing.assert_allclose(a, b.transpose(0, 3, 1, 2), rtol=1e-6)
+
+
+def test_registered_in_iterator_registry(cache):
+    """The cached iterator rides the same registry as the reference
+    iterators, so the C API (and every frontend above it) can create it
+    by name with string kwargs."""
+    from mxnet_tpu import capi_helpers
+
+    prefix, _ = cache
+    assert "CachedImageRecordIter" in capi_helpers.list_data_iters()
+    it = capi_helpers.create_data_iter(
+        "CachedImageRecordIter",
+        ["cache_prefix", "data_shape", "batch_size", "shuffle"],
+        [prefix, "(3, 28, 28)", "4", "False"])
+    assert capi_helpers.iter_next(it) == 1
+    data = capi_helpers.iter_get_data(it)
+    assert tuple(data.shape) == (4, 3, 28, 28)
+    label = capi_helpers.iter_get_label(it)
+    assert label.shape[0] == 4
+    # epoch boundary honours the C protocol: reset rewinds, next works
+    capi_helpers.iter_before_first(it)
+    assert capi_helpers.iter_next(it) == 1
+
+
+def test_rebuild_on_source_change(cache, tmp_path):
+    """A regenerated .rec (new size/mtime) must invalidate the cache —
+    silently training on stale decoded data is the worst cache failure."""
+    import time
+
+    prefix, meta = cache
+    rec = tmp_path / "t.rec"
+    _write_rec(rec, num=30)            # more records, new content
+    os.utime(rec, (time.time() + 5, time.time() + 5))
+    meta2 = io_cache.build_decoded_cache(str(rec), prefix, (3, 32, 32))
+    assert meta2["num"] == 30
+    data = np.load(prefix + ".data", mmap_mode="r")
+    assert data.shape[0] == 30
+
+
+def test_concurrent_builders_single_winner(tmp_path):
+    """Multi-rank contract: many processes calling build_decoded_cache
+    on one shared prefix produce exactly one consistent cache (O_EXCL
+    lockfile, waiters poll for the finished meta)."""
+    import subprocess
+    import sys
+
+    rec = tmp_path / "t.rec"
+    _write_rec(rec)
+    prefix = str(tmp_path / "t.cache")
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    script = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "from mxnet_tpu import io_cache as ic\n"
+        "m = ic.build_decoded_cache(%r, %r, (3, 32, 32),"
+        " preprocess_threads=2)\n"
+        "print('NUM=%%d' %% m['num'])\n" % (repo, str(rec), prefix))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen([sys.executable, "-c", script],
+                              stdout=subprocess.PIPE, text=True, env=env)
+             for _ in range(3)]
+    outs = [p.communicate(timeout=180)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    assert all("NUM=24" in o for o in outs), outs
+    assert not os.path.exists(prefix + ".build.lock")
+    data = np.load(prefix + ".data", mmap_mode="r")
+    assert data.shape == (24, 32, 32, 3)

@@ -112,3 +112,33 @@ def test_train_imagenet_shards_by_rank(tmp_path):
         shards[0] & shards[1]                       # no overlap
     assert shards[0] | shards[1] == set(range(32))  # full coverage
     assert min(len(s) for s in shards) >= 12        # roughly even
+
+
+def test_train_imagenet_cache_path(tmp_path):
+    """--use-cache trains from the decoded uint8 memmap with device-side
+    augmentation (the feed path sized for TPU rates) and still learns
+    and checkpoints; the caches land next to the .rec files."""
+    _make_imagenet_shaped(tmp_path, n_train=96, n_val=32)
+    prefix = str(tmp_path / "chk")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "image_classification",
+                      "train_imagenet.py"),
+         "--data-dir", str(tmp_path),
+         "--network", "inception-bn",
+         "--data-shape", "80",
+         "--cache-margin", "16",
+         "--use-cache",
+         "--num-classes", "4",
+         "--num-examples", "96",
+         "--batch-size", "16",
+         "--num-epochs", "3",
+         "--lr", "0.05",
+         "--save-model-prefix", prefix],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-2500:])
+    assert "train imagenet OK" in r.stdout, r.stdout[-1000:]
+    assert os.path.exists(str(tmp_path / "train.rec.cache.meta.json"))
+    accs = re.findall(r"Train-accuracy=([0-9.]+)", r.stderr + r.stdout)
+    assert accs and float(accs[-1]) > 0.5, accs

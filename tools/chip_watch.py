@@ -71,11 +71,15 @@ def _run(cmd, timeout_s, env_overrides=None, outfile=None,
         log("TIMEOUT after %ds: %s" % (timeout_s, cmd))
         if keep_output and e.stdout:
             # the per-case lines completed before the hang are the
-            # evidence this watchdog exists to save
+            # evidence this watchdog exists to save — marked INCOMPLETE
+            # in the artifact itself so a reader can't mistake a
+            # truncated sweep for a clean one
             out = e.stdout
             if isinstance(out, bytes):
                 out = out.decode("utf-8", "replace")
-            return out
+            return out + ("\n[chip_watch] INCOMPLETE: stage timed out "
+                          "after %ds; cases below never ran\n"
+                          % timeout_s)
         return None
     if r.stderr:
         sys.stderr.write(r.stderr[-2000:])
@@ -84,12 +88,13 @@ def _run(cmd, timeout_s, env_overrides=None, outfile=None,
             f.write(r.stdout)
     if r.returncode != 0:
         log("stage failed rc=%d" % r.returncode)
-        if keep_output:
+        if keep_output and r.stdout:
             # a partially-failing sweep (e.g. tpu_consistency with one
             # FAIL case, rc=1) is still evidence — per-case PASS/FAIL
             # lines must reach the artifact, not vanish with the rc.
             # Empty stdout (crash before any case) is NOT evidence.
-            return r.stdout or None
+            return r.stdout + ("\n[chip_watch] stage exited rc=%d\n"
+                               % r.returncode)
         return None
     return r.stdout
 
