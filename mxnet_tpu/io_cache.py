@@ -78,21 +78,34 @@ def build_decoded_cache(path_imgrec: str, cache_prefix: str,
     import socket
     import time
 
-    from . import recordio as rio
-
     c, h, w = store_shape
     meta_path = cache_prefix + ".meta.json"
-    src_stat = os.stat(path_imgrec)
+    try:
+        src_stat = os.stat(path_imgrec)
+    except FileNotFoundError:
+        # "decode once, feed forever": deleting the source .rec after a
+        # successful build is a legitimate disk-reclaim move — a shape-
+        # matching complete cache stays usable (staleness can no longer
+        # be judged, which is fine: there is nothing to be stale against)
+        if not overwrite and os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            if (meta.get("height"), meta.get("width"),
+                    meta.get("channels")) == (h, w, c):
+                return meta
+        raise MXNetError("no recordio at %s and no matching decoded "
+                         "cache at %s" % (path_imgrec, cache_prefix))
 
     def _fresh(meta):
         # the cache must match BOTH the requested store shape and the
         # source .rec it was decoded from — a regenerated rec (new
         # size/mtime) silently training on old decoded data is the
-        # worst failure mode a cache can have
+        # worst failure mode a cache can have. mtime at ns resolution:
+        # whole seconds leave a same-second-regeneration hole.
         return ((meta.get("height"), meta.get("width"),
                  meta.get("channels")) == (h, w, c)
                 and meta.get("src_size") == src_stat.st_size
-                and meta.get("src_mtime") == int(src_stat.st_mtime))
+                and meta.get("src_mtime") == src_stat.st_mtime_ns)
 
     def _existing():
         if overwrite or not os.path.exists(meta_path):
@@ -225,7 +238,7 @@ def _locked_build(path_imgrec, cache_prefix, store_shape,
             # staleness fingerprint of the source .rec: a regenerated
             # rec (different size/mtime) forces a rebuild
             "src_size": src_stat.st_size,
-            "src_mtime": int(src_stat.st_mtime)}
+            "src_mtime": src_stat.st_mtime_ns}
     meta_tmp = meta_path + pid_sfx
     with open(meta_tmp, "w") as f:
         json.dump(meta, f)

@@ -253,3 +253,18 @@ def test_concurrent_builders_single_winner(tmp_path):
     assert not os.path.exists(prefix + ".build.lock")
     data = np.load(prefix + ".data", mmap_mode="r")
     assert data.shape == (24, 32, 32, 3)
+
+
+def test_cache_survives_source_deletion(cache, tmp_path):
+    """'Decode once, feed forever': deleting the source .rec after a
+    successful build must not break cache reuse; with neither source
+    nor cache the error is explicit."""
+    prefix, meta = cache
+    os.unlink(str(tmp_path / "t.rec"))
+    meta2 = io_cache.build_decoded_cache(str(tmp_path / "t.rec"), prefix,
+                                         (3, 32, 32))
+    assert meta2["num"] == meta["num"]
+    with pytest.raises(MXNetError, match="no recordio"):
+        io_cache.build_decoded_cache(str(tmp_path / "gone.rec"),
+                                     str(tmp_path / "other.cache"),
+                                     (3, 32, 32))

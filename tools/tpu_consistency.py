@@ -73,6 +73,13 @@ def cases(mx):
 def run():
     import jax
 
+    # the site hook overrides JAX_PLATFORMS at import; without
+    # re-applying it, JAX_PLATFORMS=cpu still initializes the
+    # accelerator backend and a dead tunnel hangs jax.devices() forever
+    # (same guard as bench.py / pipeline_bench.py)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     import mxnet_tpu as mx
     from mxnet_tpu.test_utils import check_consistency
 
@@ -81,18 +88,47 @@ def run():
         print("TPU_CONSISTENCY skipped: no accelerator (platform=cpu)")
         return 2
 
+    import signal
+
+    # per-case watchdog (SIGALRM): catches cases that stall at the
+    # Python level or run pathologically slowly. A hang INSIDE one C++
+    # dispatch defers the signal until the call returns — that case is
+    # covered by chip_watch's process-level timeout, which now salvages
+    # the completed PASS/FAIL lines and marks the artifact INCOMPLETE.
+    case_timeout = int(os.environ.get("MXTPU_CONSISTENCY_CASE_TIMEOUT",
+                                      300))
+
+    class _CaseTimeout(Exception):
+        pass
+
+    def _alarm(signum, frame):
+        raise _CaseTimeout("case exceeded %ds" % case_timeout)
+
+    has_alarm = hasattr(signal, "SIGALRM")
+    if has_alarm:
+        signal.signal(signal.SIGALRM, _alarm)
+
     ok = fail = 0
     for name, sym, shapes, grad_req in cases(mx):
         try:
+            if has_alarm:
+                signal.alarm(case_timeout)
             check_consistency(sym, [
                 dict(ctx=mx.cpu(), **shapes),
                 dict(ctx=mx.tpu(0), **shapes),
             ], grad_req=grad_req)
             print("PASS %s" % name)
             ok += 1
+        except _CaseTimeout as e:
+            print("FAIL %s: TIMEOUT %s" % (name, e))
+            fail += 1
         except Exception as e:  # noqa: BLE001 - report and continue
             print("FAIL %s: %s" % (name, str(e)[:200]))
             fail += 1
+        finally:
+            if has_alarm:
+                signal.alarm(0)
+        sys.stdout.flush()
     print("TPU_CONSISTENCY ok=%d fail=%d" % (ok, fail))
     return 1 if fail else 0
 
