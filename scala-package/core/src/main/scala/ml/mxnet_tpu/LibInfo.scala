@@ -93,6 +93,17 @@ private[mxnet_tpu] class LibInfo {
                      priority: Int): Unit
   @native def kvBarrier(handle: Long): Unit
   @native def kvFree(handle: Long): Unit
+
+  // Data iterators (reference ml.dmlc.mxnet.io MXDataIter surface)
+  @native def iterCreate(name: String, keys: Array[String],
+                         vals: Array[String]): Long
+  @native def iterFree(handle: Long): Unit
+  @native def iterBeforeFirst(handle: Long): Unit
+  @native def iterNext(handle: Long): Int
+  @native def iterGetData(handle: Long): Array[Float]
+  @native def iterGetDataShape(handle: Long): Array[Int]
+  @native def iterGetLabel(handle: Long): Array[Float]
+  @native def iterGetPadNum(handle: Long): Int
 }
 
 object LibInfo {

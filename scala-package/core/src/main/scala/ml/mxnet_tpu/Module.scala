@@ -10,7 +10,8 @@ import scala.collection.mutable
  * prefix-symbol.json / prefix-%04d.params layout (arg:/aux: key
  * prefixes), interoperable with the Python and R frontends.
  */
-case class DataBatch(data: Array[Float], label: Array[Float])
+case class DataBatch(data: Array[Float], label: Array[Float],
+                     pad: Int = 0)
 
 trait DataIter {
   def batchSize: Int
@@ -40,6 +41,69 @@ class NDArrayIter(data: Array[Array[Float]], label: Array[Float],
     cursor += batchSize
     DataBatch(idx.flatMap(data(_)), idx.map(label(_)))
   }
+}
+
+/** Runtime-backed iterator over the C ABI's registry (reference
+ *  ml.dmlc.mxnet.io.MXDataIter): ImageRecordIter / MNISTIter / CSVIter
+ *  / CachedImageRecordIter created by name with string kwargs. Batches
+ *  arrive as flat row-major floats; `dataShape` gives the C-order batch
+ *  shape for reshaping on the consumer side. */
+class MXDataIter(name: String, params: Map[String, String])
+    extends DataIter with AutoCloseable {
+  private val lib = LibInfo.lib
+  private val handle: Long = {
+    val (ks, vs) = params.toSeq.unzip
+    lib.iterCreate(name, ks.toArray, vs.toArray)
+  }
+  val batchSize: Int =
+    params.get("batch_size").map(_.toInt).getOrElse(-1)
+  private var advanced = false
+  private var more = false
+  private var shape: Array[Int] = null
+
+  def reset(): Unit = {
+    lib.iterBeforeFirst(handle)
+    advanced = false
+  }
+
+  def hasNext: Boolean = {
+    if (!advanced) {
+      more = lib.iterNext(handle) != 0
+      advanced = true
+    }
+    more
+  }
+
+  /** Batch-scoped reads happen HERE, while the runtime cursor is on
+   *  this batch: hasNext pre-advances the cursor, so reading pad or
+   *  shape through separate accessors after the fact would describe
+   *  the WRONG batch. pad rides inside the DataBatch (the reference
+   *  DataBatch carries pad the same way). */
+  def next(): DataBatch = {
+    if (!hasNext) throw new NoSuchElementException("iterator exhausted")
+    advanced = false
+    val d = lib.iterGetData(handle)
+    val l = lib.iterGetLabel(handle)
+    if (shape == null) shape = lib.iterGetDataShape(handle)
+    DataBatch(d, l, lib.iterGetPadNum(handle))
+  }
+
+  /** C-order batch shape, e.g. (N, C, H, W) — constant per iterator;
+   *  captured once alongside the first next() (a separate fetch per
+   *  batch would pay a redundant device round-trip). Null before the
+   *  first next(). */
+  def dataShape: Array[Int] = shape
+
+  def close(): Unit = lib.iterFree(handle)
+}
+
+object MXDataIter {
+  def imageRecordIter(params: Map[String, String]): MXDataIter =
+    new MXDataIter("ImageRecordIter", params)
+  def mnistIter(params: Map[String, String]): MXDataIter =
+    new MXDataIter("MNISTIter", params)
+  def csvIter(params: Map[String, String]): MXDataIter =
+    new MXDataIter("CSVIter", params)
 }
 
 trait EvalMetric {

@@ -803,3 +803,141 @@ JNIFN(jobjectArray, ndLoad)(JNIEnv *env, jobject obj, jstring jpath) {
   (*env)->SetObjectArrayElement(env, out, 1, (jobject)jhandles);
   return out;
 }
+
+/* ---- Data iterators ----------------------------------------------------
+ * Parity target: the reference Scala io package (ml.dmlc.mxnet.io
+ * MXDataIter over MXDataIterCreateIter). Data/label handles returned by
+ * the C API are views owned by the iterator, so values are copied into
+ * fresh Java arrays here and never freed through MXNDArrayFree. */
+
+JNIFN(jlong, iterCreate)(JNIEnv *env, jobject obj, jstring jname,
+                         jobjectArray jkeys, jobjectArray jvals) {
+  mx_uint n = 0;
+  DataIterCreator *creators = NULL;
+  if (MXListDataIters(&n, &creators) != 0) { throw_mx(env); return 0; }
+  const char *name = (*env)->GetStringUTFChars(env, jname, NULL);
+  DataIterCreator creator = NULL;
+  for (mx_uint i = 0; i < n && creator == NULL; ++i) {
+    const char *inm = NULL, *desc = NULL;
+    if (MXDataIterGetIterInfo(creators[i], &inm, &desc) != 0) {
+      (*env)->ReleaseStringUTFChars(env, jname, name);
+      throw_mx(env);
+      return 0;
+    }
+    if (strcmp(inm, name) == 0) creator = creators[i];
+  }
+  (*env)->ReleaseStringUTFChars(env, jname, name);
+  if (creator == NULL) {
+    jclass cls = (*env)->FindClass(env, "java/lang/RuntimeException");
+    (*env)->ThrowNew(env, cls, "unknown data iterator");
+    return 0;
+  }
+  jsize np = (*env)->GetArrayLength(env, jkeys);
+  const char **keys = (const char **)malloc((np ? np : 1) * sizeof(char *));
+  const char **vals = (const char **)malloc((np ? np : 1) * sizeof(char *));
+  for (jsize i = 0; i < np; ++i) {
+    jstring k = (jstring)(*env)->GetObjectArrayElement(env, jkeys, i);
+    jstring v = (jstring)(*env)->GetObjectArrayElement(env, jvals, i);
+    keys[i] = (*env)->GetStringUTFChars(env, k, NULL);
+    vals[i] = (*env)->GetStringUTFChars(env, v, NULL);
+  }
+  DataIterHandle h = NULL;
+  int rc = MXDataIterCreateIter(creator, (mx_uint)np, keys, vals, &h);
+  for (jsize i = 0; i < np; ++i) {
+    jstring k = (jstring)(*env)->GetObjectArrayElement(env, jkeys, i);
+    jstring v = (jstring)(*env)->GetObjectArrayElement(env, jvals, i);
+    (*env)->ReleaseStringUTFChars(env, k, keys[i]);
+    (*env)->ReleaseStringUTFChars(env, v, vals[i]);
+  }
+  free(keys);
+  free(vals);
+  if (rc != 0) { throw_mx(env); return 0; }
+  return (jlong)(intptr_t)h;
+}
+
+JNIFN(void, iterFree)(JNIEnv *env, jobject obj, jlong handle) {
+  MXDataIterFree((DataIterHandle)(intptr_t)handle);
+}
+
+JNIFN(void, iterBeforeFirst)(JNIEnv *env, jobject obj, jlong handle) {
+  if (MXDataIterBeforeFirst((DataIterHandle)(intptr_t)handle) != 0)
+    throw_mx(env);
+}
+
+JNIFN(jint, iterNext)(JNIEnv *env, jobject obj, jlong handle) {
+  int more = 0;
+  if (MXDataIterNext((DataIterHandle)(intptr_t)handle, &more) != 0) {
+    throw_mx(env);
+    return 0;
+  }
+  return (jint)more;
+}
+
+static jfloatArray iter_copy_array(JNIEnv *env, NDArrayHandle h) {
+  mx_uint ndim = 0;
+  const mx_uint *dims = NULL;
+  if (MXNDArrayGetShape(h, &ndim, &dims) != 0) {
+    throw_mx(env);
+    return NULL;
+  }
+  mx_uint n = 1;
+  for (mx_uint i = 0; i < ndim; ++i) n *= dims[i];
+  float *buf = (float *)malloc(n * sizeof(float));
+  if (MXNDArraySyncCopyToCPU(h, buf, n) != 0) {
+    free(buf);
+    throw_mx(env);
+    return NULL;
+  }
+  jfloatArray out = (*env)->NewFloatArray(env, (jsize)n);
+  (*env)->SetFloatArrayRegion(env, out, 0, (jsize)n, buf);
+  free(buf);
+  return out;
+}
+
+JNIFN(jfloatArray, iterGetData)(JNIEnv *env, jobject obj, jlong handle) {
+  NDArrayHandle h = NULL;
+  if (MXDataIterGetData((DataIterHandle)(intptr_t)handle, &h) != 0) {
+    throw_mx(env);
+    return NULL;
+  }
+  return iter_copy_array(env, h);
+}
+
+JNIFN(jintArray, iterGetDataShape)(JNIEnv *env, jobject obj,
+                                   jlong handle) {
+  NDArrayHandle h = NULL;
+  if (MXDataIterGetData((DataIterHandle)(intptr_t)handle, &h) != 0) {
+    throw_mx(env);
+    return NULL;
+  }
+  mx_uint ndim = 0;
+  const mx_uint *dims = NULL;
+  if (MXNDArrayGetShape(h, &ndim, &dims) != 0) {
+    throw_mx(env);
+    return NULL;
+  }
+  jintArray out = (*env)->NewIntArray(env, (jsize)ndim);
+  jint *tmp = (jint *)malloc(ndim * sizeof(jint));
+  for (mx_uint i = 0; i < ndim; ++i) tmp[i] = (jint)dims[i];
+  (*env)->SetIntArrayRegion(env, out, 0, (jsize)ndim, tmp);
+  free(tmp);
+  return out;
+}
+
+JNIFN(jfloatArray, iterGetLabel)(JNIEnv *env, jobject obj, jlong handle) {
+  NDArrayHandle h = NULL;
+  if (MXDataIterGetLabel((DataIterHandle)(intptr_t)handle, &h) != 0) {
+    throw_mx(env);
+    return NULL;
+  }
+  return iter_copy_array(env, h);
+}
+
+JNIFN(jint, iterGetPadNum)(JNIEnv *env, jobject obj, jlong handle) {
+  int pad = 0;
+  if (MXDataIterGetPadNum((DataIterHandle)(intptr_t)handle, &pad) != 0) {
+    throw_mx(env);
+    return 0;
+  }
+  return (jint)pad;
+}

@@ -1,0 +1,250 @@
+/* Execution gate for the Scala io-iterator surface: drives the exact
+ * native sequence ml.mxnet_tpu.MXDataIter + FeedForward.fit perform —
+ * iterCreate with string kwargs, beforeFirst/next/getData/getLabel per
+ * batch, batches into a conv executor trained with the Scala SGD math —
+ * through the real JNI glue (mxnet_tpu_jni.c) over tests/jni_shim.c
+ * (no JVM exists in this image). Reference parity:
+ * scala-package ml.dmlc.mxnet.io.MXDataIter over MXDataIterCreateIter.
+ *
+ * argv: 1=path.rec  2=data.csv
+ * Prints "final_acc=<v>"; the pytest wrapper gates >= 0.9.
+ */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "jni.h"
+
+extern JNIEnv jni_shim_env;
+void *jni_shim_make_ints(const jint *v, jsize n);
+void *jni_shim_make_floats(const jfloat *v, jsize n);
+void *jni_shim_make_longs(const jlong *v, jsize n);
+void *jni_shim_make_strs(const char **v, jsize n);
+jsize jni_shim_len(void *a);
+jint *jni_shim_ints(void *a);
+jfloat *jni_shim_floats(void *a);
+void **jni_shim_objs(void *a);
+
+jlong Java_ml_mxnet_1tpu_LibInfo_symCreateVariable(JNIEnv *, jobject,
+                                                   jstring);
+jlong Java_ml_mxnet_1tpu_LibInfo_symCreateAtomic(JNIEnv *, jobject,
+                                                 jstring, jobjectArray,
+                                                 jobjectArray);
+void Java_ml_mxnet_1tpu_LibInfo_symCompose(JNIEnv *, jobject, jlong,
+                                           jstring, jobjectArray,
+                                           jlongArray);
+jobjectArray Java_ml_mxnet_1tpu_LibInfo_symListArguments(JNIEnv *, jobject,
+                                                         jlong);
+jintArray Java_ml_mxnet_1tpu_LibInfo_symInferShapes(JNIEnv *, jobject,
+                                                    jlong, jobjectArray,
+                                                    jintArray, jintArray,
+                                                    jint);
+jlong Java_ml_mxnet_1tpu_LibInfo_execSimpleBind(JNIEnv *, jobject, jlong,
+                                                jint, jint, jobjectArray,
+                                                jintArray, jintArray,
+                                                jint);
+void Java_ml_mxnet_1tpu_LibInfo_execSetArg(JNIEnv *, jobject, jlong,
+                                           jstring, jfloatArray);
+void Java_ml_mxnet_1tpu_LibInfo_execForward(JNIEnv *, jobject, jlong,
+                                            jint);
+void Java_ml_mxnet_1tpu_LibInfo_execBackward(JNIEnv *, jobject, jlong);
+jfloatArray Java_ml_mxnet_1tpu_LibInfo_execGetOutput(JNIEnv *, jobject,
+                                                     jlong, jint, jint);
+jfloatArray Java_ml_mxnet_1tpu_LibInfo_execGetGrad(JNIEnv *, jobject,
+                                                   jlong, jstring, jint);
+void Java_ml_mxnet_1tpu_LibInfo_randomSeed(JNIEnv *, jobject, jint);
+jlong Java_ml_mxnet_1tpu_LibInfo_iterCreate(JNIEnv *, jobject, jstring,
+                                            jobjectArray, jobjectArray);
+void Java_ml_mxnet_1tpu_LibInfo_iterFree(JNIEnv *, jobject, jlong);
+void Java_ml_mxnet_1tpu_LibInfo_iterBeforeFirst(JNIEnv *, jobject, jlong);
+jint Java_ml_mxnet_1tpu_LibInfo_iterNext(JNIEnv *, jobject, jlong);
+jfloatArray Java_ml_mxnet_1tpu_LibInfo_iterGetData(JNIEnv *, jobject,
+                                                   jlong);
+jintArray Java_ml_mxnet_1tpu_LibInfo_iterGetDataShape(JNIEnv *, jobject,
+                                                      jlong);
+jfloatArray Java_ml_mxnet_1tpu_LibInfo_iterGetLabel(JNIEnv *, jobject,
+                                                    jlong);
+jint Java_ml_mxnet_1tpu_LibInfo_iterGetPadNum(JNIEnv *, jobject, jlong);
+
+#define ENV (&jni_shim_env)
+#define BATCH 8
+#define IMG 12
+#define NCLASS 2
+#define ROUNDS 10
+#define MAXARGS 16
+
+static double frand_state = 777;
+static float frand(void) {
+  frand_state = fmod(frand_state * 48271.0, 2147483647.0);
+  return (float)(frand_state / 2147483647.0);
+}
+
+static jlong apply_op(const char *op, jlong input, const char *name,
+                      const char **pk, const char **pv, int np) {
+  jlong h = Java_ml_mxnet_1tpu_LibInfo_symCreateAtomic(
+      ENV, NULL, op, jni_shim_make_strs(pk, np),
+      jni_shim_make_strs(pv, np));
+  const char *inkeys[] = {"data"};
+  jlong ins[] = {input};
+  Java_ml_mxnet_1tpu_LibInfo_symCompose(ENV, NULL, h, name,
+                                        jni_shim_make_strs(inkeys, 1),
+                                        jni_shim_make_longs(ins, 1));
+  return h;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s rec csv\n", argv[0]);
+    return 2;
+  }
+  Java_ml_mxnet_1tpu_LibInfo_randomSeed(ENV, NULL, 7);
+
+  /* ---- MXDataIter("ImageRecordIter", params) ---- */
+  char shape_str[64];
+  snprintf(shape_str, sizeof shape_str, "(3,%d,%d)", IMG, IMG);
+  const char *ik[] = {"path_imgrec", "data_shape", "batch_size",
+                      "shuffle", "scale", "mean_r", "mean_g", "mean_b"};
+  const char *iv[] = {argv[1], shape_str, "8", "True", "0.00784313725",
+                      "127.5", "127.5", "127.5"};
+  jlong it = Java_ml_mxnet_1tpu_LibInfo_iterCreate(
+      ENV, NULL, "ImageRecordIter", jni_shim_make_strs(ik, 8),
+      jni_shim_make_strs(iv, 8));
+
+  /* dataShape reports the C-order batch shape the Scala side captures
+   * on the first next() */
+  Java_ml_mxnet_1tpu_LibInfo_iterBeforeFirst(ENV, NULL, it);
+  if (!Java_ml_mxnet_1tpu_LibInfo_iterNext(ENV, NULL, it)) {
+    fprintf(stderr, "empty iterator\n");
+    return 1;
+  }
+  void *jds = Java_ml_mxnet_1tpu_LibInfo_iterGetDataShape(ENV, NULL, it);
+  if (jni_shim_len(jds) != 4 || jni_shim_ints(jds)[0] != BATCH ||
+      jni_shim_ints(jds)[1] != 3) {
+    fprintf(stderr, "bad data shape\n");
+    return 1;
+  }
+
+  /* ---- conv net, Module.scala symbol construction path ---- */
+  jlong data = Java_ml_mxnet_1tpu_LibInfo_symCreateVariable(ENV, NULL,
+                                                            "data");
+  const char *k_conv[] = {"num_filter", "kernel"};
+  const char *v_conv[] = {"4", "(3, 3)"};
+  jlong conv = apply_op("Convolution", data, "conv1", k_conv, v_conv, 2);
+  const char *k_act[] = {"act_type"};
+  const char *v_act[] = {"relu"};
+  jlong act = apply_op("Activation", conv, "act1", k_act, v_act, 1);
+  jlong flat = apply_op("Flatten", act, "flat", NULL, NULL, 0);
+  const char *k_hid[] = {"num_hidden"};
+  const char *v_hid[] = {"2"};
+  jlong fc = apply_op("FullyConnected", flat, "fc", k_hid, v_hid, 1);
+  jlong net = apply_op("SoftmaxOutput", fc, "softmax", NULL, NULL, 0);
+
+  const char *skeys[] = {"data"};
+  jint indptr[] = {0, 4};
+  jint sdata[] = {BATCH, 3, IMG, IMG};
+  void *flatshapes = Java_ml_mxnet_1tpu_LibInfo_symInferShapes(
+      ENV, NULL, net, jni_shim_make_strs(skeys, 1),
+      jni_shim_make_ints(indptr, 2), jni_shim_make_ints(sdata, 4), 0);
+  /* symInferShapes returns [nargs, then per-arg: ndim, dims...] */
+  jint *fs = jni_shim_ints(flatshapes);
+  int nargs = fs[0];
+  long psize[MAXARGS];
+  {
+    int pos = 1;
+    for (int i = 0; i < nargs; ++i) {
+      int nd = fs[pos++];
+      long n = 1;
+      for (int d = 0; d < nd; ++d) n *= fs[pos++];
+      psize[i] = n;
+    }
+  }
+  void *argnames = Java_ml_mxnet_1tpu_LibInfo_symListArguments(ENV, NULL,
+                                                               net);
+  jlong exec = Java_ml_mxnet_1tpu_LibInfo_execSimpleBind(
+      ENV, NULL, net, 1, 0, jni_shim_make_strs(skeys, 1),
+      jni_shim_make_ints(indptr, 2), jni_shim_make_ints(sdata, 4), 1);
+
+  float *params[MAXARGS], *moms[MAXARGS];
+  for (int i = 0; i < nargs; ++i) {
+    const char *nm = (const char *)jni_shim_objs(argnames)[i];
+    params[i] = calloc(psize[i], sizeof(float));
+    moms[i] = calloc(psize[i], sizeof(float));
+    if (strstr(nm, "weight"))
+      for (long j = 0; j < psize[i]; ++j)
+        params[i][j] = (frand() - 0.5f) * 0.2f;
+    if (strcmp(nm, "data") && strcmp(nm, "softmax_label"))
+      Java_ml_mxnet_1tpu_LibInfo_execSetArg(
+          ENV, NULL, exec, nm,
+          jni_shim_make_floats(params[i], (jsize)psize[i]));
+  }
+
+  const float lr = 0.05f, momentum = 0.9f;
+  float acc = 0.0f;
+  for (int round = 0; round < ROUNDS; ++round) {
+    int correct = 0, seen = 0;
+    Java_ml_mxnet_1tpu_LibInfo_iterBeforeFirst(ENV, NULL, it);
+    while (Java_ml_mxnet_1tpu_LibInfo_iterNext(ENV, NULL, it)) {
+      void *bd = Java_ml_mxnet_1tpu_LibInfo_iterGetData(ENV, NULL, it);
+      void *bl = Java_ml_mxnet_1tpu_LibInfo_iterGetLabel(ENV, NULL, it);
+      if (jni_shim_len(bd) != BATCH * 3 * IMG * IMG) {
+        fprintf(stderr, "bad batch len %d\n", (int)jni_shim_len(bd));
+        return 1;
+      }
+      Java_ml_mxnet_1tpu_LibInfo_execSetArg(ENV, NULL, exec, "data", bd);
+      Java_ml_mxnet_1tpu_LibInfo_execSetArg(ENV, NULL, exec,
+                                            "softmax_label", bl);
+      Java_ml_mxnet_1tpu_LibInfo_execForward(ENV, NULL, exec, 1);
+      Java_ml_mxnet_1tpu_LibInfo_execBackward(ENV, NULL, exec);
+      for (int i = 0; i < nargs; ++i) {
+        const char *nm = (const char *)jni_shim_objs(argnames)[i];
+        if (!strcmp(nm, "data") || !strcmp(nm, "softmax_label")) continue;
+        void *g = Java_ml_mxnet_1tpu_LibInfo_execGetGrad(
+            ENV, NULL, exec, nm, (jint)psize[i]);
+        jfloat *gv = jni_shim_floats(g);
+        for (long j = 0; j < psize[i]; ++j) {
+          moms[i][j] = momentum * moms[i][j] - lr * gv[j];
+          params[i][j] += moms[i][j];
+        }
+        Java_ml_mxnet_1tpu_LibInfo_execSetArg(
+            ENV, NULL, exec, nm,
+            jni_shim_make_floats(params[i], (jsize)psize[i]));
+      }
+      void *out = Java_ml_mxnet_1tpu_LibInfo_execGetOutput(
+          ENV, NULL, exec, 0, BATCH * NCLASS);
+      jfloat *ov = jni_shim_floats(out);
+      jfloat *lv = jni_shim_floats(bl);
+      for (int b = 0; b < BATCH; ++b) {
+        int guess = ov[b * NCLASS] > ov[b * NCLASS + 1] ? 0 : 1;
+        correct += (guess == (int)lv[b]);
+        seen += 1;
+      }
+    }
+    acc = (float)correct / seen;
+  }
+  Java_ml_mxnet_1tpu_LibInfo_iterFree(ENV, NULL, it);
+
+  /* ---- CSVIter exact read-back ---- */
+  const char *ck[] = {"data_csv", "data_shape", "batch_size"};
+  const char *cv[] = {argv[2], "(3,)", "2"};
+  jlong cit = Java_ml_mxnet_1tpu_LibInfo_iterCreate(
+      ENV, NULL, "CSVIter", jni_shim_make_strs(ck, 3),
+      jni_shim_make_strs(cv, 3));
+  Java_ml_mxnet_1tpu_LibInfo_iterBeforeFirst(ENV, NULL, cit);
+  if (!Java_ml_mxnet_1tpu_LibInfo_iterNext(ENV, NULL, cit)) return 1;
+  void *cd = Java_ml_mxnet_1tpu_LibInfo_iterGetData(ENV, NULL, cit);
+  for (int i = 0; i < 6; ++i) {
+    float want = i * 0.5f;
+    float got = jni_shim_floats(cd)[i];
+    if (got < want - 1e-5 || got > want + 1e-5) {
+      fprintf(stderr, "csv[%d]=%f want %f\n", i, got, want);
+      return 1;
+    }
+  }
+  if (Java_ml_mxnet_1tpu_LibInfo_iterGetPadNum(ENV, NULL, cit) != 0)
+    return 1;
+  Java_ml_mxnet_1tpu_LibInfo_iterFree(ENV, NULL, cit);
+
+  printf("final_acc=%f\n", acc);
+  return acc >= 0.9f ? 0 : 1;
+}
