@@ -43,3 +43,14 @@ model <- mx.model.FeedForward.create(
   net, X = train.iter, ctx = mx.cpu(), num.round = 10,
   learning.rate = 0.05, momentum = 0.9,
   eval.metric = mx.metric.accuracy)
+
+# At TPU consumption rates, per-epoch JPEG decode cannot feed the chip;
+# the runtime's decoded-cache iterator (decode once into a uint8 memmap,
+# augment on device) is reachable from R through the same registry:
+#   cache.iter <- mx.io.create("CachedImageRecordIter",
+#     cache.prefix = paste0(rec.file, ".cache"),
+#     data.shape = c(3, 28, 28), batch.size = 128,
+#     rand.crop = TRUE, rand.mirror = TRUE)
+# (build the cache once with python -c
+#  "from mxnet_tpu.io_cache import build_decoded_cache; ..." or let
+#  train_imagenet.py --use-cache create it.)
