@@ -268,3 +268,27 @@ def test_cache_survives_source_deletion(cache, tmp_path):
         io_cache.build_decoded_cache(str(tmp_path / "gone.rec"),
                                      str(tmp_path / "other.cache"),
                                      (3, 32, 32))
+
+
+def test_composes_with_prefetching_iter(cache):
+    """The cache iterator composes with PrefetchingIter (background
+    batch prep overlapping device compute — the full TPU feed stack:
+    memmap gather on a worker thread, augment fused on device)."""
+    from mxnet_tpu.io import PrefetchingIter
+
+    prefix, _ = cache
+    base = io_cache.CachedImageRecordIter(prefix, (3, 28, 28), 8,
+                                          shuffle=True, rand_crop=True,
+                                          scale=1 / 255.0, seed=3)
+    it = PrefetchingIter(base)
+    try:
+        n = 0
+        for b in it:
+            assert b.data[0].shape == (8, 3, 28, 28)
+            n += 1
+        assert n == 3    # 24 records / batch 8
+        it.reset()
+        assert next(it).data[0].shape == (8, 3, 28, 28)
+    finally:
+        if hasattr(it, "close"):
+            it.close()
