@@ -234,13 +234,13 @@ class Module(BaseModule):
             # the cross-device grad reduction already happened inside the
             # training step (GSPMD all-reduce), so the local grads ARE the
             # reduced grads — the reference's _update_params push/pull
-            # (model.py:96) is subsumed.
-            for i, name in enumerate(self._param_names):
-                if name not in group.executor.grad_dict:
-                    continue
-                grad = group.executor.grad_dict[name]
-                weight = group.executor.arg_dict[name]
-                self._updater(i, grad, weight)
+            # (model.py:96) is subsumed. All params update in ONE fused
+            # dispatch (Updater.update_multi) rather than one per param.
+            items = [(i, group.executor.grad_dict[name],
+                      group.executor.arg_dict[name])
+                     for i, name in enumerate(self._param_names)
+                     if name in group.executor.grad_dict]
+            self._updater.update_multi(items)
 
     def update_metric(self, eval_metric, labels):
         self._exec_group.update_metric(eval_metric, labels)

@@ -111,3 +111,103 @@ def test_updater_state():
     updater(0, mx.nd.ones((3,)), w)
     updater(0, mx.nd.ones((3,)), w)
     assert 0 in updater.states
+
+
+def test_update_multi_matches_sequential():
+    """Fused multi-param updates must be numerically identical to the
+    per-param path for every planned optimizer kind, including per-param
+    lr/wd multipliers and Adam's per-index step counts."""
+    import numpy as np
+
+    from mxnet_tpu import nd
+    from mxnet_tpu import optimizer as opt_mod
+
+    rng = np.random.RandomState(0)
+    shapes = [(8, 4), (16,), (3, 3, 2)]
+
+    def make(opt_cls, **kw):
+        o = opt_cls(**kw)
+        o.idx2name = {0: "a_weight", 1: "b_bias", 2: "c_weight"}
+        o.set_lr_mult({"a_weight": 2.0})
+        o.set_wd_mult({"b_bias": 0.0})
+        return o
+
+    for cls, kw in [(opt_mod.SGD, dict(learning_rate=0.1, momentum=0.9,
+                                       wd=1e-3)),
+                    (opt_mod.Adam, dict(learning_rate=0.01, wd=1e-4)),
+                    (opt_mod.RMSProp, dict(learning_rate=0.01)),
+                    (opt_mod.AdaGrad, dict(learning_rate=0.05)),
+                    (opt_mod.AdaDelta, dict()),
+                    (opt_mod.NAG, dict(learning_rate=0.1, momentum=0.8,
+                                       clip_gradient=0.5))]:
+        grads_per_step = [
+            [rng.randn(*s).astype(np.float32) for s in shapes]
+            for _ in range(3)]
+
+        def run(multi):
+            seq_opt = make(cls, **kw)
+            upd = opt_mod.get_updater(seq_opt)
+            ws = [nd.zeros(s) for s in shapes]
+            for step_grads in grads_per_step:
+                items = [(i, nd.array(g), w)
+                         for i, (g, w) in enumerate(zip(step_grads, ws))]
+                if multi:
+                    upd.update_multi(items)
+                else:
+                    for i, g, w in items:
+                        upd(i, g, w)
+            return [w.asnumpy() for w in ws]
+
+        for a, b in zip(run(multi=False), run(multi=True)):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7,
+                                       err_msg=cls.__name__)
+
+
+def test_update_multi_falls_back_for_custom_optimizer():
+    """User optimizers that only override update() (the reference
+    contract) must keep working through update_multi."""
+    import numpy as np
+
+    from mxnet_tpu import nd
+    from mxnet_tpu import optimizer as opt_mod
+
+    calls = []
+
+    class Plain(opt_mod.Optimizer):
+        def update(self, index, weight, grad, state):
+            calls.append(index)
+            weight -= grad * 0.5
+
+    upd = opt_mod.get_updater(Plain())
+    ws = [nd.ones((4,)), nd.ones((2, 2))]
+    upd.update_multi([(0, nd.ones((4,)), ws[0]),
+                      (1, nd.ones((2, 2)), ws[1])])
+    assert calls == [0, 1]
+    np.testing.assert_allclose(ws[0].asnumpy(), np.full(4, 0.5))
+
+
+def test_update_multi_respects_subclass_update_override():
+    """A subclass of a BUILT-IN optimizer that overrides update() (the
+    reference extension contract) must take the sequential path: the
+    inherited plan does not describe its custom math."""
+    import numpy as np
+
+    from mxnet_tpu import nd
+    from mxnet_tpu import optimizer as opt_mod
+
+    class HalvedSGD(opt_mod.SGD):
+        def update(self, index, weight, grad, state):
+            weight -= grad * 0.5      # NOT sgd math
+
+    upd = opt_mod.get_updater(HalvedSGD(learning_rate=123.0))
+    w = nd.ones((4,))
+    upd.update_multi([(0, nd.ones((4,)), w)])
+    np.testing.assert_allclose(w.asnumpy(), np.full(4, 0.5))
+
+    # overriding _plan alone keeps the fused path (plan describes it)
+    class PlannedSGD(opt_mod.SGD):
+        def _plan(self, index, weight, grad, state):
+            return super()._plan(index, weight, grad, state)
+
+    assert PlannedSGD(learning_rate=0.1)._fusable()
+    assert not HalvedSGD(learning_rate=0.1)._fusable()
