@@ -378,8 +378,10 @@ def _bench():
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     import mxnet_tpu as mx
-    from mxnet_tpu import models
+    from mxnet_tpu import models, telemetry
     from mxnet_tpu.parallel import build_sgd_train_step
+
+    telemetry.enable()
 
     devices = jax.devices()
     on_accel = devices[0].platform != "cpu"
@@ -463,8 +465,9 @@ def _bench():
         jax.profiler.start_trace(trace_dir)
     tic = time.time()
     for i in range(steps):
-        outputs, params, aux = jit_step(params, data, aux,
-                                        jax.random.fold_in(key, i))
+        with telemetry.span("bench.step"):
+            outputs, params, aux = jit_step(params, data, aux,
+                                            jax.random.fold_in(key, i))
     _force(params)
     elapsed = time.time() - tic
     if trace_dir:
@@ -634,6 +637,10 @@ def _bench():
         result.update(_bench_recordio(jit_step, params, aux, key, batch,
                                       image, num_classes, steps, rec_env,
                                       _force, layout=layout))
+
+    # framework-side counters/spans for this run (engine, io, executor,
+    # kvstore, bench.step span stats) ride along in the perf record
+    result["telemetry"] = telemetry.snapshot()
 
     # .bench_cache.json is deliberately git-TRACKED: the end-of-round
     # snapshot then preserves the last real on-chip measurement even

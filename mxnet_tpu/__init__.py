@@ -58,3 +58,4 @@ from . import visualization as viz  # noqa: E402
 from . import test_utils      # noqa: E402
 from . import export          # noqa: E402
 from . import profiler        # noqa: E402
+from . import telemetry       # noqa: E402

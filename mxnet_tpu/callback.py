@@ -5,6 +5,8 @@ import logging
 import math
 import time
 
+from . import telemetry as _tel
+
 __all__ = ["Speedometer", "do_checkpoint", "log_train_metric",
            "module_checkpoint", "ProgressBar"]
 
@@ -59,6 +61,9 @@ class Speedometer:
         if self.init:
             if count % self.frequent == 0:
                 speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                if _tel.enabled():
+                    _tel.set_gauge("train.samples_per_sec", speed)
+                    _tel.inc("train.batches", self.frequent)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec" \

@@ -24,9 +24,11 @@ from __future__ import annotations
 import heapq
 import itertools
 import threading
+import time
 from collections import deque
 from typing import Callable, Iterable, List, Optional, Sequence
 
+from . import telemetry as _tel
 from .base import MXNetError, getenv
 
 __all__ = ["Engine", "Var", "get_engine", "set_engine", "NaiveEngine",
@@ -57,7 +59,7 @@ class Var:
 
 class _OprBlock:
     __slots__ = ("fn", "const_vars", "mutable_vars", "priority", "wait",
-                 "lock", "seq", "prop")
+                 "lock", "seq", "prop", "enq_t")
 
     def __init__(self, fn, const_vars, mutable_vars, priority, seq,
                  prop="normal"):
@@ -69,6 +71,7 @@ class _OprBlock:
         self.wait = 0
         self.lock = threading.Lock()
         self.prop = prop
+        self.enq_t = 0.0  # ready-heap entry time (telemetry queue-wait)
 
     def dec_wait(self) -> bool:
         with self.lock:
@@ -155,7 +158,9 @@ class XLAEngine(Engine):
         _check_duplicates(const_vars, mutable_vars)
         if _engine_info_enabled():
             _log_push(self, fn, const_vars, mutable_vars, priority, prop)
+        _tel.inc("engine.push")
         fn()
+        _tel.inc("engine.dispatch")
         _bump_versions(mutable_vars)
 
     def wait_for_var(self, var):
@@ -179,7 +184,9 @@ class NaiveEngine(Engine):
         _check_duplicates(const_vars, mutable_vars)
         if _engine_info_enabled():
             _log_push(self, fn, const_vars, mutable_vars, priority, prop)
+        _tel.inc("engine.push")
         ret = fn()
+        _tel.inc("engine.dispatch")
         _bump_versions(mutable_vars)
         _block_on(ret)
 
@@ -292,6 +299,7 @@ class ThreadedEngine(Engine):
         _check_duplicates(const_vars, mutable_vars)
         if _engine_info_enabled():
             _log_push(self, fn, const_vars, mutable_vars, priority, prop)
+        _tel.inc("engine.push")
         opr = _OprBlock(fn, const_vars, mutable_vars, priority,
                         next(self._seq), prop)
         with self._pending_lock:
@@ -315,6 +323,8 @@ class ThreadedEngine(Engine):
             self._dispatch(opr)
 
     def _dispatch(self, opr: _OprBlock):
+        if _tel.enabled():
+            opr.enq_t = time.perf_counter()
         with self._heap_lock:
             heapq.heappush(self._heap, (-opr.priority, opr.seq, opr))
             self._heap_lock.notify()
@@ -329,6 +339,11 @@ class ThreadedEngine(Engine):
                 if self._shutdown and not heap:
                     return
                 _, _, opr = heapq.heappop(heap)
+            if _tel.enabled():
+                _tel.inc("engine.dispatch")
+                if opr.enq_t:
+                    _tel.observe("engine.queue_wait_ms",
+                                 (time.perf_counter() - opr.enq_t) * 1e3)
             try:
                 opr.fn()
             finally:
@@ -385,6 +400,8 @@ class ThreadedEnginePooled(ThreadedEngine):
         # with no I/O workers (MXNET_CPU_IO_NTHREADS=0), io ops must fall
         # through to the compute pool or they would never run
         if opr.prop in ("io", "copy") and self._io_workers:
+            if _tel.enabled():
+                opr.enq_t = time.perf_counter()
             with self._io_lock:
                 heapq.heappush(self._io_heap, (-opr.priority, opr.seq, opr))
                 self._io_lock.notify()
@@ -428,6 +445,7 @@ class NativeThreadedEngine(Engine):
         def _run(token):
             with self._pending_lock:
                 fn = self._pending.pop(token)
+            _tel.inc("engine.dispatch")
             try:
                 fn()
             except BaseException as e:  # noqa: BLE001
@@ -457,6 +475,7 @@ class NativeThreadedEngine(Engine):
         _check_duplicates(const_vars, mutable_vars)
         if _engine_info_enabled():
             _log_push(self, fn, const_vars, mutable_vars, priority, prop)
+        _tel.inc("engine.push")
         token = next(self._token)
         with self._pending_lock:
             self._pending[token] = fn

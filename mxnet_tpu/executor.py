@@ -29,6 +29,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
+from . import telemetry as _tel
 from .base import MXNetError, getenv
 from .context import Context
 from .engine import get_engine
@@ -288,6 +289,7 @@ class Executor:
     def _build(self):
         import jax
 
+        _tel.inc("executor.bind")
         node_device = None
         if self._group2ctx:
             group2dev = {g: c.jax_device() for g, c in self._group2ctx.items()}
@@ -384,7 +386,14 @@ class Executor:
         def get_fwd_bwd(want_internals):
             k = (want_internals, _donation_ok())
             if k not in fwd_bwd_cache:
+                # a build here means XLA traces + compiles a fresh fused
+                # step — the recompile events the telemetry tier exists
+                # to make visible (a flapping donation decision or
+                # monitor flag shows up as a climbing jit_build count)
+                _tel.inc("executor.jit_build")
                 fwd_bwd_cache[k] = make_fwd_bwd(*k)
+            else:
+                _tel.inc("executor.jit_cache_hit")
             return fwd_bwd_cache[k]
 
         def make_fwd_bwd(want_internals, donate):
@@ -464,6 +473,9 @@ class Executor:
             if name not in self.arg_dict:
                 raise MXNetError("forward: unknown argument '%s'" % name)
             self.arg_dict[name][:] = arr
+        _tel.inc("executor.forward")
+        if is_train:
+            _tel.inc("executor.forward_train")
         self._last_key = self._key()
         if is_train:
             # lazy: the fused fwd+bwd in backward() materializes outputs;
@@ -493,6 +505,7 @@ class Executor:
 
         if not self._train_pending:
             raise MXNetError("backward called without forward(is_train=True)")
+        _tel.inc("executor.backward")
         if out_grads is None:
             import jax
 

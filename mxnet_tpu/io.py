@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from . import telemetry as _tel
 from .base import MXNetError, Registry, getenv
 from .context import Context
 from .ndarray import NDArray, array
@@ -79,6 +80,7 @@ class DataIter:
 
     def next(self) -> DataBatch:
         if self.iter_next():
+            _tel.inc("io.batches")
             return DataBatch(self.getdata(), self.getlabel(),
                              self.getpad(), self.getindex())
         raise StopIteration
@@ -432,7 +434,18 @@ class PrefetchingIter(DataIter):
         self._start()
 
     def iter_next(self):
-        batch = self._queue.get()
+        if _tel.enabled():
+            # time blocked on the queue: nonzero stall means the consumer
+            # outran the producer thread — the pipeline, not the device,
+            # is the bottleneck
+            import time
+
+            t0 = time.perf_counter()
+            batch = self._queue.get()
+            _tel.observe("io.prefetch_stall_ms",
+                         (time.perf_counter() - t0) * 1e3)
+        else:
+            batch = self._queue.get()
         if batch is None:
             return False
         self.current_batch = batch
@@ -741,6 +754,7 @@ class ImageRecordIter(DataIter):
                 rng: np.random.RandomState) -> Tuple[np.ndarray, np.ndarray]:
         from . import recordio as rio
 
+        _tel.inc("io.decoded_records")
         header, img = rio.unpack_img(rec, iscolor=1 if self.data_shape[0] == 3 else 0)
         label = np.asarray(header.label, dtype=np.float32)
         img = img.astype(np.float32)
@@ -786,6 +800,7 @@ class ImageRecordIter(DataIter):
 
     def _decode_batch(self):
         if getattr(self, "_cache_cursor", None) == self.cursor:
+            _tel.inc("io.decode_cache_hit")
             return self._cache
         results = self._gather(self.cursor)
         if self._pool is not None:

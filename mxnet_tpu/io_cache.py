@@ -31,12 +31,14 @@ The stored H/W should be the training crop plus the augmentation margin
 from __future__ import annotations
 
 import json
+import logging
 import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional, Tuple
 
 import numpy as np
 
+from . import telemetry as _tel
 from .base import MXNetError
 from .io import DataBatch, DataDesc, DataIter
 
@@ -131,8 +133,21 @@ def build_decoded_cache(path_imgrec: str, cache_prefix: str,
                                      os.getpid())).encode())
             os.close(fd)
         except FileExistsError:
-            # another rank is building: wait, then re-evaluate
+            # another rank is building: wait, then re-evaluate. The lock
+            # records host:pid, so a waiter on the SAME host can detect a
+            # SIGKILLed builder and break the lock instead of sleeping to
+            # the 24h deadline; cross-host liveness stays unjudgeable and
+            # falls back to the timeout.
             while os.path.exists(lock_path):
+                if _lock_owner_dead(lock_path):
+                    logging.warning(
+                        "io_cache: cache-build lock %s held by a dead "
+                        "local builder; breaking it", lock_path)
+                    try:
+                        os.unlink(lock_path)
+                    except OSError:
+                        pass
+                    break
                 if time.time() > deadline:
                     raise MXNetError(
                         "timed out waiting for another rank's cache "
@@ -157,6 +172,31 @@ def build_decoded_cache(path_imgrec: str, cache_prefix: str,
             os.unlink(lock_path)
         except OSError:
             pass
+
+
+def _lock_owner_dead(lock_path: str) -> bool:
+    """True only when the lock names a builder on THIS host whose pid no
+    longer exists. Unparseable/mid-write lock content and remote hosts
+    read as alive — breaking a live builder's lock would let two ranks
+    write the cache concurrently, which is worse than waiting."""
+    import socket
+
+    try:
+        with open(lock_path) as f:
+            owner = f.read().strip()
+        host, pid = owner.rsplit(":", 1)
+        pid = int(pid)
+    except (OSError, ValueError):
+        return False
+    if host != socket.gethostname():
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return True
+    except OSError:
+        pass
+    return False
 
 
 def _locked_build(path_imgrec, cache_prefix, store_shape,
@@ -189,63 +229,74 @@ def _locked_build(path_imgrec, cache_prefix, store_shape,
     pid_sfx = ".tmp.%s.%d" % (socket.gethostname(), os.getpid())
     data_tmp = cache_prefix + ".data" + pid_sfx
     label_tmp = cache_prefix + ".label" + pid_sfx
-    data_mm = np.lib.format.open_memmap(
-        data_tmp, mode="w+", dtype=np.uint8, shape=(n, h, w, c))
-    labels = np.zeros((n, label_width), dtype=np.float32)
-
-    def _work(args):
-        i, rec = args
-        img, label = _decode_record(rec, (h, w), c)
-        data_mm[i] = img
-        labels[i, :] = label
-
-    threads = max(1, int(preprocess_threads))
-    chunk_size = max(64, 16 * threads)
-    pool = ThreadPoolExecutor(threads) if threads > 1 else None
+    meta_tmp = meta_path + pid_sfx
     try:
-        i, rec = 0, first
-        chunk = []
-        while rec is not None:
-            chunk.append((i, rec))
-            if len(chunk) >= chunk_size:
+        data_mm = np.lib.format.open_memmap(
+            data_tmp, mode="w+", dtype=np.uint8, shape=(n, h, w, c))
+        labels = np.zeros((n, label_width), dtype=np.float32)
+
+        def _work(args):
+            i, rec = args
+            img, label = _decode_record(rec, (h, w), c)
+            data_mm[i] = img
+            labels[i, :] = label
+
+        threads = max(1, int(preprocess_threads))
+        chunk_size = max(64, 16 * threads)
+        pool = ThreadPoolExecutor(threads) if threads > 1 else None
+        try:
+            i, rec = 0, first
+            chunk = []
+            while rec is not None:
+                chunk.append((i, rec))
+                if len(chunk) >= chunk_size:
+                    if pool is not None:
+                        list(pool.map(_work, chunk))
+                    else:
+                        for item in chunk:
+                            _work(item)
+                    chunk = []
+                i += 1
+                rec = reader.read()
+            if chunk:
                 if pool is not None:
                     list(pool.map(_work, chunk))
                 else:
                     for item in chunk:
                         _work(item)
-                chunk = []
-            i += 1
-            rec = reader.read()
-        if chunk:
+        finally:
             if pool is not None:
-                list(pool.map(_work, chunk))
-            else:
-                for item in chunk:
-                    _work(item)
-    finally:
-        if pool is not None:
-            pool.shutdown()
-        reader.close()
-    data_mm.flush()
-    del data_mm
-    np.save(label_tmp, labels)
-    # np.save appends .npy; normalize the tmp name back
-    if os.path.exists(label_tmp + ".npy"):
-        os.replace(label_tmp + ".npy", label_tmp)
+                pool.shutdown()
+            reader.close()
+        data_mm.flush()
+        del data_mm
+        np.save(label_tmp, labels)
+        # np.save appends .npy; normalize the tmp name back
+        if os.path.exists(label_tmp + ".npy"):
+            os.replace(label_tmp + ".npy", label_tmp)
 
-    meta = {"num": n, "height": h, "width": w, "channels": c,
-            "label_width": int(label_width), "version": 1,
-            # staleness fingerprint of the source .rec: a regenerated
-            # rec (different size/mtime) forces a rebuild
-            "src_size": src_stat.st_size,
-            "src_mtime": src_stat.st_mtime_ns}
-    meta_tmp = meta_path + pid_sfx
-    with open(meta_tmp, "w") as f:
-        json.dump(meta, f)
-    # publish data before meta: meta's existence is the completeness marker
-    os.replace(data_tmp, cache_prefix + ".data")
-    os.replace(label_tmp, cache_prefix + ".label")
-    os.replace(meta_tmp, meta_path)
+        meta = {"num": n, "height": h, "width": w, "channels": c,
+                "label_width": int(label_width), "version": 1,
+                # staleness fingerprint of the source .rec: a regenerated
+                # rec (different size/mtime) forces a rebuild
+                "src_size": src_stat.st_size,
+                "src_mtime": src_stat.st_mtime_ns}
+        with open(meta_tmp, "w") as f:
+            json.dump(meta, f)
+        # publish data before meta: meta's existence is the completeness
+        # marker
+        os.replace(data_tmp, cache_prefix + ".data")
+        os.replace(label_tmp, cache_prefix + ".label")
+        os.replace(meta_tmp, meta_path)
+    except BaseException:
+        # a failed build (bad record, decode exception, ^C) must not
+        # leak dataset-sized tmp files into the shared cache dir
+        for p in (data_tmp, label_tmp, label_tmp + ".npy", meta_tmp):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        raise
     return meta
 
 
@@ -331,6 +382,16 @@ class CachedImageRecordIter(DataIter):
         count = per + (1 if part_index < extra else 0)
         self._indices = np.arange(start, start + count)
         self.num_data = count
+        if count % batch_size != 0:
+            # the final batch wraps around and reports the overlap via
+            # getpad() (reference round_batch semantics); silence by
+            # picking a batch_size that divides the shard
+            logging.warning(
+                "CachedImageRecordIter: %d samples in this shard is not "
+                "a multiple of batch_size=%d; the last batch of each "
+                "epoch wraps to the epoch start and reports pad=%d via "
+                "getpad()", count, batch_size,
+                batch_size - count % batch_size)
         self.cursor = -batch_size
         self._order = None
         self._norm_fn = None
@@ -416,7 +477,7 @@ class CachedImageRecordIter(DataIter):
 
     def iter_next(self):
         self.cursor += self.batch_size
-        return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
 
     # C-API / base-DataIter accessor protocol (MXDataIterNext then
     # GetData/GetLabel): the batch for the current cursor is built once
@@ -426,25 +487,38 @@ class CachedImageRecordIter(DataIter):
     def getlabel(self):
         return self._current_batch().label
     def getpad(self):
-        return 0
+        # wrapped samples in the trailing partial batch — consumers
+        # (predict/score) slice them off so every sample counts once
+        return max(0, self.cursor + self.batch_size - self.num_data)
     def getindex(self):
         return self._current_batch().index
 
     def next(self) -> DataBatch:
         if not self.iter_next():
             raise StopIteration
+        _tel.inc("io.batches")
         return self._current_batch()
 
     def _current_batch(self) -> DataBatch:
         if getattr(self, "_batch_cursor", None) != self.cursor:
             self._batch = self._make_batch()
             self._batch_cursor = self.cursor
+        else:
+            _tel.inc("io.batch_cache_hit")
         return self._batch
 
     def _make_batch(self) -> DataBatch:
         from . import ndarray as nd
 
-        idx = self._epoch_order()[self.cursor:self.cursor + self.batch_size]
+        order = self._epoch_order()
+        idx = order[self.cursor:self.cursor + self.batch_size]
+        pad = self.getpad()
+        if pad:
+            # wrap the trailing partial batch to the epoch start
+            # (reference round_batch): every sample is seen exactly once
+            # and the duplicate count is reported through getpad()
+            idx = np.concatenate([idx, np.resize(order, pad)])
+            _tel.inc("io.pad_samples", pad)
         c, h, w = self.data_shape
         sh, sw = self.meta["height"], self.meta["width"]
         rng = np.random.RandomState(
@@ -469,7 +543,7 @@ class CachedImageRecordIter(DataIter):
             labels = np.asarray(self._labels[gidx])
             if self.meta["label_width"] == 1:
                 labels = labels[:, 0]
-            return DataBatch([data], [nd.array(labels)], pad=0,
+            return DataBatch([data], [nd.array(labels)], pad=pad,
                              index=gidx)
 
         out = np.empty((self.batch_size, h, w, c), dtype=np.uint8)
@@ -494,7 +568,7 @@ class CachedImageRecordIter(DataIter):
             if self.output_layout == "NCHW":
                 x = np.transpose(x, (0, 3, 1, 2))
             data = nd.array(x)
-        return DataBatch([data], [nd.array(labels)], pad=0,
+        return DataBatch([data], [nd.array(labels)], pad=pad,
                          index=np.asarray(idx))
 
 

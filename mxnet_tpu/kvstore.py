@@ -26,10 +26,20 @@ from __future__ import annotations
 import pickle
 from typing import Callable, Dict, List, Optional, Sequence, Union
 
+import numpy as np
+
+from . import telemetry as _tel
 from .base import MXNetError
 from .ndarray import NDArray, zeros
 
 __all__ = ["KVStore", "create"]
+
+
+def _nbytes(arr: NDArray) -> int:
+    try:
+        return int(arr.size) * np.dtype(arr.dtype).itemsize
+    except Exception:
+        return 0
 
 
 def _key_list(key):
@@ -125,6 +135,10 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % k)
             merged = self._reduce(vlist)
+            if _tel.enabled():
+                _tel.inc("kvstore.push")
+                _tel.inc("kvstore.push_bytes",
+                         sum(_nbytes(v) for v in vlist))
             if self._updater is not None:
                 self._updater(k, merged, self._store[k])
             else:
@@ -139,6 +153,10 @@ class KVStore:
             if k not in self._store:
                 raise MXNetError("key %s not initialized" % k)
             src = self._store[k]
+            if _tel.enabled():
+                _tel.inc("kvstore.pull")
+                _tel.inc("kvstore.pull_bytes",
+                         _nbytes(src) * len(olist))
             for o in olist:
                 src.copyto(o)
 
@@ -342,6 +360,9 @@ class KVStoreDistAsync(KVStore):
         vals = _val_list(value, len(keys))
         for k, vlist in zip(keys, vals):
             merged = self._reduce(vlist)     # local-device reduce only
+            if _tel.enabled():
+                _tel.inc("kvstore.push")
+                _tel.inc("kvstore.push_bytes", _nbytes(merged))
             self._client.call("push", k, merged.asnumpy())
 
     def pull(self, key, out=None, priority: int = 0):
@@ -354,6 +375,9 @@ class KVStoreDistAsync(KVStore):
         for k, olist in zip(keys, outs):
             cur = self._client.call("pull", k)
             src = nd_array(cur)
+            if _tel.enabled():
+                _tel.inc("kvstore.pull")
+                _tel.inc("kvstore.pull_bytes", _nbytes(src) * len(olist))
             for o in olist:
                 src.copyto(o)
 

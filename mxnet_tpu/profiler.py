@@ -76,12 +76,18 @@ def annotate(name: str):
 class StepTimer:
     """Wall-clock per-step statistics (the reference's Speedometer
     measured throughput; this measures latency percentiles). Use as a
-    context manager around each step."""
+    context manager around each step.
 
-    def __init__(self, sync_fn=None):
+    When telemetry is enabled each step also feeds the
+    ``profiler.step_ms`` histogram, and if ``jsonl_path`` is given a
+    structured record (step index + step_ms + full counter snapshot)
+    is appended there per step via ``telemetry.dump_jsonl``."""
+
+    def __init__(self, sync_fn=None, jsonl_path: Optional[str] = None):
         self._times: List[float] = []
         self._t0 = 0.0
         self._sync_fn = sync_fn
+        self._jsonl_path = jsonl_path
 
     def __enter__(self):
         self._t0 = time.perf_counter()
@@ -90,7 +96,15 @@ class StepTimer:
     def __exit__(self, *exc):
         if self._sync_fn is not None:
             self._sync_fn()
-        self._times.append(time.perf_counter() - self._t0)
+        dt = time.perf_counter() - self._t0
+        self._times.append(dt)
+        from . import telemetry as _tel
+        if _tel.enabled():
+            _tel.inc("profiler.steps")
+            _tel.observe("profiler.step_ms", dt * 1e3)
+            if self._jsonl_path is not None:
+                _tel.dump_jsonl(self._jsonl_path,
+                                extra={"step_ms": dt * 1e3})
         return False
 
     @property

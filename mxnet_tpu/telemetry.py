@@ -1,0 +1,337 @@
+"""Unified telemetry: framework-wide counters, gauges, histograms and
+host-side spans.
+
+The reference's only observability was the ``Monitor`` callback,
+``Speedometer`` and per-op engine logging (SURVEY §5); ``profiler.py``
+added the XLA device trace. Neither instruments the layers where
+regressions actually hide — engine dispatch, the input pipeline, kvstore
+traffic, JIT recompilation. This module is the process-global metric
+registry those layers report through:
+
+* **Counters** — monotonically increasing ints (``engine.push``,
+  ``io.batches``, ``kvstore.push_bytes``).
+* **Gauges** — last-write-wins floats (``train.samples_per_sec``).
+* **Histograms** — bounded: running count/sum/min/max plus a fixed-size
+  reservoir of recent samples for percentiles. Memory is O(capacity)
+  no matter how long the job runs.
+* **Spans** — host-side wall-time intervals (``with telemetry.span(n)``)
+  kept in a bounded ring; when an XLA trace capture is active they also
+  emit ``TraceAnnotation`` so host work lines up with device ops in the
+  same Perfetto view.
+
+Overhead contract: telemetry is DISABLED by default; every recording
+helper starts with one module-level flag check and returns immediately,
+taking no locks and allocating nothing. Enable with
+``MXNET_TPU_TELEMETRY=1`` or :func:`enable`. The write path when enabled
+takes one small per-metric lock (increments from engine worker threads
+must not lose updates); the disabled path takes none.
+
+Exporters::
+
+    telemetry.snapshot()            # nested dict, one leaf per metric
+    telemetry.dump_jsonl(path)      # append ONE step record (crash-safe)
+    telemetry.write_chrome_trace(p) # host spans -> Perfetto-loadable json
+
+See docs/performance.md ("Telemetry") for the metric name table and the
+JSONL schema.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from .base import MXNetError, getenv
+
+__all__ = ["enabled", "enable", "disable", "counter", "gauge", "histogram",
+           "inc", "set_gauge", "observe", "span", "snapshot", "reset",
+           "dump_jsonl", "write_chrome_trace", "Counter", "Gauge",
+           "Histogram"]
+
+_ENABLED = bool(getenv("MXNET_TPU_TELEMETRY", False))
+
+_reg_lock = threading.Lock()
+_metrics: Dict[str, object] = {}
+
+# span ring: bounded so a never-exported long run cannot grow host memory
+_SPAN_CAP = int(getenv("MXNET_TPU_TELEMETRY_SPAN_CAP", 8192))
+_spans: deque = deque(maxlen=_SPAN_CAP)
+# perf_counter -> wall-clock offset, fixed at import so span timestamps
+# from every thread share one epoch (and can be laid next to an XLA
+# trace, which stamps wall time)
+_EPOCH = time.time() - time.perf_counter()
+
+_step_lock = threading.Lock()
+_step = 0
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def enable():
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable():
+    global _ENABLED
+    _ENABLED = False
+
+
+class Counter:
+    """Monotonic counter; thread-safe increments."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def export(self):
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins float."""
+
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+
+    def set(self, v: float):
+        self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def export(self):
+        return self._value
+
+
+class Histogram:
+    """Bounded histogram: exact count/sum/min/max plus a ring of the
+    most recent ``capacity`` samples for percentile estimates."""
+
+    __slots__ = ("name", "capacity", "_lock", "_count", "_sum", "_min",
+                 "_max", "_ring", "_idx")
+
+    def __init__(self, name: str, capacity: int = 512):
+        self.name = name
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+        self._ring = []
+        self._idx = 0
+
+    def observe(self, v: float):
+        v = float(v)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+            if len(self._ring) < self.capacity:
+                self._ring.append(v)
+            else:
+                self._ring[self._idx] = v
+                self._idx = (self._idx + 1) % self.capacity
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def export(self) -> dict:
+        with self._lock:
+            n, s = self._count, self._sum
+            lo, hi = self._min, self._max
+            sample = sorted(self._ring)
+        if n == 0:
+            return {"count": 0}
+        m = len(sample)
+        return {
+            "count": n,
+            "sum": s,
+            "mean": s / n,
+            "min": lo,
+            "max": hi,
+            "p50": sample[m // 2],
+            "p90": sample[min(m - 1, int(m * 0.9))],
+            "p99": sample[min(m - 1, int(m * 0.99))],
+        }
+
+
+def _get(name: str, cls, **kw):
+    m = _metrics.get(name)
+    if m is None:
+        with _reg_lock:
+            m = _metrics.get(name)
+            if m is None:
+                m = cls(name, **kw)
+                _metrics[name] = m
+    if not isinstance(m, cls):
+        raise MXNetError("telemetry metric %r is a %s, not a %s"
+                         % (name, type(m).__name__, cls.__name__))
+    return m
+
+
+def counter(name: str) -> Counter:
+    return _get(name, Counter)
+
+
+def gauge(name: str) -> Gauge:
+    return _get(name, Gauge)
+
+
+def histogram(name: str, capacity: int = 512) -> Histogram:
+    return _get(name, Histogram, capacity=capacity)
+
+
+# -- recording fast path (one flag check, immediate return when off) ----
+def inc(name: str, n: int = 1):
+    if not _ENABLED:
+        return
+    counter(name).inc(n)
+
+
+def set_gauge(name: str, v: float):
+    if not _ENABLED:
+        return
+    gauge(name).set(v)
+
+
+def observe(name: str, v: float):
+    if not _ENABLED:
+        return
+    histogram(name).observe(v)
+
+
+# -- spans ---------------------------------------------------------------
+@contextlib.contextmanager
+def span(name: str):
+    """Host-side named interval. Recorded into the bounded span ring and
+    the ``span.<name>_ms`` histogram; while an XLA trace capture is
+    running it additionally nests a ``TraceAnnotation`` so the interval
+    shows up inside the device trace too."""
+    if not _ENABLED:
+        yield
+        return
+    ann = None
+    try:
+        from . import profiler as _prof
+
+        if _prof.is_running():
+            import jax
+
+            ann = jax.profiler.TraceAnnotation(name)
+            ann.__enter__()
+    except Exception:
+        ann = None
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dur = time.perf_counter() - t0
+        if ann is not None:
+            try:
+                ann.__exit__(None, None, None)
+            except Exception:
+                pass
+        _spans.append((name, threading.get_ident(), t0, dur))
+        observe("span.%s_ms" % name, dur * 1e3)
+
+
+def spans():
+    """The buffered (name, tid, start_perf_counter, duration_s) tuples."""
+    return list(_spans)
+
+
+def write_chrome_trace(path: str):
+    """Write buffered host spans in the chrome trace event format.
+    Timestamps are wall-clock microseconds, the same clock domain the
+    XLA trace stamps, so both load side by side in Perfetto."""
+    events = [{"name": name, "ph": "X", "cat": "host",
+               "pid": os.getpid(), "tid": tid,
+               "ts": (t0 + _EPOCH) * 1e6, "dur": dur * 1e6}
+              for name, tid, t0, dur in list(_spans)]
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return len(events)
+
+
+# -- exporters -----------------------------------------------------------
+def snapshot() -> dict:
+    """All metrics as a nested dict keyed by the dot-split name
+    (``engine.push`` -> ``{"engine": {"push": N}}``). Counters export
+    ints, gauges floats, histograms summary dicts. A name that is both
+    a leaf and a prefix keeps its leaf value under ``"_value"``."""
+    with _reg_lock:
+        items = sorted(_metrics.items())
+    out: dict = {}
+    for name, m in items:
+        parts = name.split(".")
+        node = out
+        for p in parts[:-1]:
+            nxt = node.get(p)
+            if not isinstance(nxt, dict):
+                nxt = {} if nxt is None else {"_value": nxt}
+                node[p] = nxt
+            node = nxt
+        leaf = parts[-1]
+        if isinstance(node.get(leaf), dict):
+            node[leaf]["_value"] = m.export()
+        else:
+            node[leaf] = m.export()
+    return out
+
+
+def dump_jsonl(path: str, extra: Optional[dict] = None) -> dict:
+    """Append ONE step record (timestamp, step index, full snapshot) to
+    ``path``. Append-only and crash-safe: the record is a single
+    ``write`` of one line followed by flush+fsync, so a killed run
+    leaves at worst a truncated final line, never a corrupt file."""
+    global _step
+    with _step_lock:
+        _step += 1
+        step = _step
+    rec = {"ts": round(time.time(), 6), "step": step,
+           "telemetry": snapshot()}
+    if extra:
+        rec.update(extra)
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    return rec
+
+
+def reset():
+    """Clear every metric, span, and the step counter (bench/test
+    isolation). The enabled flag is left as-is."""
+    global _step
+    with _reg_lock:
+        _metrics.clear()
+    _spans.clear()
+    with _step_lock:
+        _step = 0

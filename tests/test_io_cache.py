@@ -270,6 +270,124 @@ def test_cache_survives_source_deletion(cache, tmp_path):
                                      (3, 32, 32))
 
 
+def test_partial_batch_wraps_and_reports_pad(cache):
+    """24 records / batch 7 -> 4 batches; the last wraps 4 samples to
+    the epoch start and reports them via getpad() (reference
+    round_batch semantics)."""
+    prefix, _ = cache
+    it = io_cache.CachedImageRecordIter(prefix, (3, 32, 32), 7,
+                                        shuffle=False)
+    batches = list(it)
+    assert len(batches) == 4
+    assert [b.pad for b in batches] == [0, 0, 0, 4]
+    last = batches[-1]
+    assert last.data[0].shape[0] == 7
+    # the wrapped tail repeats epoch-start samples: every index is seen,
+    # the first `pad` indices twice
+    seen = np.concatenate([np.asarray(b.index) for b in batches])
+    assert len(seen) == 28
+    counts = np.bincount(seen, minlength=24)
+    assert counts.sum() == 28 and (counts >= 1).all()
+    # one epoch ends after the wrap — iteration stops
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_partial_batch_warns_on_mismatch(cache, caplog):
+    import logging as _logging
+
+    prefix, _ = cache
+    with caplog.at_level(_logging.WARNING):
+        io_cache.CachedImageRecordIter(prefix, (3, 32, 32), 7)
+    assert any("not a multiple of batch_size" in r.message
+               for r in caplog.records)
+
+
+def test_partial_batch_device_augment(cache):
+    prefix, _ = cache
+    it = io_cache.CachedImageRecordIter(prefix, (3, 28, 28), 9,
+                                        shuffle=True, rand_crop=True,
+                                        device_augment=True, seed=2,
+                                        scale=1 / 255.0)
+    batches = list(it)
+    assert len(batches) == 3           # 24/9 -> 2 full + 1 wrapped
+    assert batches[-1].pad == 3
+    assert batches[-1].data[0].shape == (9, 3, 28, 28)
+
+
+def test_failed_build_cleans_tmp_files(tmp_path, monkeypatch):
+    """A decode crash mid-build must not leak dataset-sized .tmp files
+    (or the lock) into the shared cache dir."""
+    rec = tmp_path / "t.rec"
+    _write_rec(rec, num=8)
+    prefix = str(tmp_path / "t.cache")
+
+    real = io_cache._decode_record
+    calls = []
+
+    def boom(rec_bytes, store_hw, channels):
+        calls.append(1)
+        if len(calls) > 3:
+            raise RuntimeError("decoder crashed")
+        return real(rec_bytes, store_hw, channels)
+
+    monkeypatch.setattr(io_cache, "_decode_record", boom)
+    with pytest.raises(RuntimeError, match="decoder crashed"):
+        io_cache.build_decoded_cache(str(rec), prefix, (3, 32, 32),
+                                     preprocess_threads=1)
+    leftovers = [f for f in os.listdir(tmp_path)
+                 if ".tmp." in f or f.endswith(".build.lock")]
+    assert leftovers == []
+    assert not os.path.exists(prefix + ".meta.json")
+    # the prefix is immediately reusable once the decoder behaves
+    monkeypatch.undo()
+    meta = io_cache.build_decoded_cache(str(rec), prefix, (3, 32, 32),
+                                        preprocess_threads=1)
+    assert meta["num"] == 8
+
+
+def test_stale_lock_from_dead_builder_is_broken(tmp_path):
+    """A lock naming a dead local pid (SIGKILLed builder) must not make
+    waiters sleep to the 24h deadline."""
+    import socket
+    import subprocess
+    import sys
+
+    rec = tmp_path / "t.rec"
+    _write_rec(rec, num=8)
+    prefix = str(tmp_path / "t.cache")
+    lock = prefix + ".build.lock"
+    # pick a pid that cannot be alive: spawn a trivial child and reap it
+    child = subprocess.Popen([sys.executable, "-c", "pass"])
+    child.wait()
+    with open(lock, "w") as f:
+        f.write("%s:%d" % (socket.gethostname(), child.pid))
+    meta = io_cache.build_decoded_cache(str(rec), prefix, (3, 32, 32),
+                                        preprocess_threads=1)
+    assert meta["num"] == 8
+    assert not os.path.exists(lock)
+
+
+def test_live_lock_is_respected(tmp_path):
+    """A lock naming a LIVE local pid must not be broken (two concurrent
+    builders would corrupt the cache); the waiter times out instead."""
+    import socket
+
+    rec = tmp_path / "t.rec"
+    _write_rec(rec, num=8)
+    prefix = str(tmp_path / "t.cache")
+    lock = prefix + ".build.lock"
+    with open(lock, "w") as f:
+        f.write("%s:%d" % (socket.gethostname(), os.getpid()))
+    try:
+        os.environ["MXTPU_CACHE_BUILD_TIMEOUT"] = "0.1"
+        with pytest.raises(MXNetError, match="timed out waiting"):
+            io_cache.build_decoded_cache(str(rec), prefix, (3, 32, 32))
+    finally:
+        del os.environ["MXTPU_CACHE_BUILD_TIMEOUT"]
+        os.unlink(lock)
+
+
 def test_composes_with_prefetching_iter(cache):
     """The cache iterator composes with PrefetchingIter (background
     batch prep overlapping device compute — the full TPU feed stack:

@@ -183,7 +183,17 @@ def main():
             procs.append(subprocess.Popen(
                 [args.ssh_cmd, "-tt", "-o", "StrictHostKeyChecking=no",
                  host, remote]))
-        sys.exit(monitor(procs))
+        try:
+            rc = monitor(procs)
+        finally:
+            # the staged secret must not outlive the job: any reader on
+            # the shared dir after this point gets the job's HMAC key
+            if secret_file is not None:
+                try:
+                    os.unlink(secret_file)
+                except OSError:
+                    pass
+        sys.exit(rc)
 
 
 if __name__ == "__main__":
