@@ -135,12 +135,60 @@ def _run_child(env_overrides, timeout_s):
     return None
 
 
+def _bench_smoke(procs=4, image=64, num=192, batch=32, seconds=4.0):
+    """Input-pipeline-only smoke bench (``--smoke``): single-thread
+    decode baseline vs N process workers, entirely host-side — no
+    accelerator (or accelerator probe) involved. Prints ONE JSON line
+    with ``input_imgs_per_sec`` plus the io.* telemetry of the process
+    run so stalls/ring occupancy are inspectable from CI logs."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import tempfile
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from pipeline_bench import make_synthetic_rec, measure
+    from mxnet_tpu import telemetry
+
+    tmp = tempfile.mkdtemp(prefix="bench_smoke_")
+    rec = os.path.join(tmp, "synth.rec")
+    make_synthetic_rec(rec, num, image)
+    base = measure(rec, image, batch, 1, seconds, True, mode="thread")
+    telemetry.enable()
+    telemetry.reset()
+    rate = measure(rec, image, batch, procs, seconds, True, mode="process")
+    snap = telemetry.snapshot().get("io", {})
+    telemetry.disable()
+    result = {"metric": "input_imgs_per_sec", "value": round(rate, 1),
+              "unit": "img/s", "procs": procs,
+              "thread1_baseline": round(base, 1),
+              "speedup_vs_thread1": round(rate / base, 2) if base else 0.0,
+              "cpu_count": os.cpu_count(), "image": image,
+              "platform": "cpu", "io_telemetry": snap}
+    print(json.dumps(result))
+    return result
+
+
 def main():
     """Orchestrator. Never imports jax itself, so a wedged accelerator
     backend cannot crash or hang the process that owns the one JSON
     perf line the driver records (round-2 postmortem: the probe passed
     against a half-alive tunnel, then backend init crashed the main
     process and the round's perf record was a stack trace)."""
+    if "--smoke" in sys.argv[1:]:
+        import argparse
+
+        p = argparse.ArgumentParser()
+        p.add_argument("--smoke", action="store_true")
+        p.add_argument("--procs", type=int, default=4)
+        p.add_argument("--image", type=int, default=64)
+        p.add_argument("--num", type=int, default=192)
+        p.add_argument("--batch", type=int, default=32)
+        p.add_argument("--seconds", type=float, default=4.0)
+        a = p.parse_args()
+        return _bench_smoke(a.procs, a.image, a.num, a.batch, a.seconds)
     # NOTE: this environment exports JAX_PLATFORMS=axon globally (the
     # tunnel platform), so "env var present" must NOT mean "skip the
     # orchestration" — that was the round-2 failure: the guard saw a

@@ -41,6 +41,7 @@ from . import optimizer       # noqa: E402
 from . import metric          # noqa: E402
 from . import lr_scheduler    # noqa: E402
 from . import io              # noqa: E402
+from . import io_pipeline     # noqa: E402
 from . import io_cache        # noqa: E402
 from . import recordio        # noqa: E402
 from . import filesystem      # noqa: E402

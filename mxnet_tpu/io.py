@@ -29,7 +29,8 @@ from .context import Context
 from .ndarray import NDArray, array
 
 __all__ = ["DataBatch", "DataIter", "NDArrayIter", "MNISTIter", "CSVIter",
-           "ResizeIter", "PrefetchingIter", "ImageRecordIter", "DataDesc"]
+           "ResizeIter", "PrefetchingIter", "ImageRecordIter", "DataDesc",
+           "RecordDecoder"]
 
 _REG: Registry = Registry.get_registry("data_iter")
 
@@ -352,315 +353,63 @@ class ResizeIter(DataIter):
         return self.current_batch.pad
 
 
-class PrefetchingIter(DataIter):
-    """Background-thread pipelining (reference io.py:235 +
-    ``src/io/iter_prefetcher.h``): decouples host-side batch prep from
-    device compute. Uses the host ThreadedEngine-style worker thread with a
-    bounded queue of ready batches."""
+class RecordDecoder:
+    """Host-side decode+augment engine shared by ImageRecordIter's
+    in-process (thread) path and :mod:`mxnet_tpu.io_pipeline`'s decode
+    worker processes.
 
-    def __init__(self, iters, rename_data=None, rename_label=None,
-                 prefetch_depth: int = 2):
-        super().__init__()
-        if not isinstance(iters, list):
-            iters = [iters]
-        self.iters = iters
-        self.rename_data = rename_data
-        self.rename_label = rename_label
-        self.batch_size = self.provide_data[0].shape[0]
-        self._depth = prefetch_depth
-        self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch_depth)
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        self.current_batch: Optional[DataBatch] = None
-        self._start()
+    Every augmentation draw comes from an RNG keyed by ``(seed, epoch,
+    record index)`` (:meth:`derive_rng`), so the execution vehicle —
+    thread count, process count, decode order — can never change what a
+    record looks like: 1-thread, N-thread and N-process runs are
+    bit-identical. The constructor kwargs round-trip through
+    :meth:`config` (all picklable), which is how a spawned worker
+    rebuilds the exact same decoder."""
 
-    @property
-    def provide_data(self):
-        if self.rename_data is None:
-            descs = []
-            for it in self.iters:
-                descs.extend(it.provide_data)
-            return descs
-        descs = []
-        for r, it in zip(self.rename_data, self.iters):
-            descs.extend(DataDesc(r.get(d.name, d.name), d.shape, d.dtype)
-                         for d in it.provide_data)
-        return descs
-
-    @property
-    def provide_label(self):
-        if self.rename_label is None:
-            descs = []
-            for it in self.iters:
-                descs.extend(it.provide_label)
-            return descs
-        descs = []
-        for r, it in zip(self.rename_label, self.iters):
-            descs.extend(DataDesc(r.get(d.name, d.name), d.shape, d.dtype)
-                         for d in it.provide_label)
-        return descs
-
-    def _start(self):
-        def _run():
-            while not self._stop.is_set():
-                try:
-                    batches = [it.next() for it in self.iters]
-                except StopIteration:
-                    self._queue.put(None)
-                    return
-                data, label = [], []
-                for b in batches:
-                    data.extend(b.data)
-                    label.extend(b.label)
-                merged = DataBatch(data, label, batches[0].pad,
-                                   batches[0].index)
-                while not self._stop.is_set():
-                    try:
-                        self._queue.put(merged, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-        self._thread = threading.Thread(target=_run, daemon=True)
-        self._thread.start()
-
-    def reset(self):
-        self._stop.set()
-        self._thread.join()
-        while not self._queue.empty():
-            self._queue.get_nowait()
-        for it in self.iters:
-            it.reset()
-        self._stop = threading.Event()
-        self._start()
-
-    def iter_next(self):
-        if _tel.enabled():
-            # time blocked on the queue: nonzero stall means the consumer
-            # outran the producer thread — the pipeline, not the device,
-            # is the bottleneck
-            import time
-
-            t0 = time.perf_counter()
-            batch = self._queue.get()
-            _tel.observe("io.prefetch_stall_ms",
-                         (time.perf_counter() - t0) * 1e3)
-        else:
-            batch = self._queue.get()
-        if batch is None:
-            return False
-        self.current_batch = batch
-        return True
-
-    def getdata(self):
-        return self.current_batch.data
-
-    def getlabel(self):
-        return self.current_batch.label
-
-    def getpad(self):
-        return self.current_batch.pad
-
-    def getindex(self):
-        return self.current_batch.index
-
-
-@_REG.register("ImageRecordIter")
-class ImageRecordIter(DataIter):
-    """Image recordio iterator with sharding + augmentation (reference
-    ``src/io/iter_image_recordio.cc:109-455``). Decode via PIL; augmentation
-    covers ``image_aug_default.cc:40-300``: resize, random/center crop,
-    random mirror, mean subtraction, scale, rotation/shear (affine with
-    ``fill_value`` border), padding, and HSL color jitter
-    (``random_h/s/l``, OpenCV units: H in [0,180), S/L in [0,255])."""
-
-    def __init__(self, path_imgrec: str, data_shape, batch_size: int,
-                 path_imgidx: Optional[str] = None, label_width: int = 1,
-                 shuffle: bool = False, num_parts: int = 1, part_index: int = 0,
-                 mean_img: Optional[str] = None, mean_r: float = 0.0,
-                 mean_g: float = 0.0, mean_b: float = 0.0, scale: float = 1.0,
-                 rand_crop: bool = False, rand_mirror: bool = False,
-                 resize: int = -1, round_batch: bool = True, seed: int = 0,
-                 preprocess_threads: int = 4, prefetch_buffer: int = 2,
-                 max_rotate_angle: int = 0, rotate: float = -1.0,
-                 rotate_list=(), max_shear_ratio: float = 0.0,
-                 pad: int = 0, fill_value: int = 255,
-                 random_h: int = 0, random_s: int = 0, random_l: int = 0,
-                 **kwargs):
-        super().__init__()
-        from . import recordio as rio
-
+    def __init__(self, data_shape, seed: int = 0, rand_crop: bool = False,
+                 rand_mirror: bool = False, resize: int = -1,
+                 scale: float = 1.0, max_rotate_angle: int = 0,
+                 rotate: float = -1.0, rotate_list=(),
+                 max_shear_ratio: float = 0.0, pad: int = 0,
+                 fill_value: int = 255, random_h: int = 0, random_s: int = 0,
+                 random_l: int = 0, mean=None, label_width: int = 1):
         self.data_shape = tuple(data_shape)
-        self.batch_size = batch_size
-        # decode-pool parameters (reference iter_image_recordio.cc:188-196
-        # decodes with an OMP pool sized by preprocess_threads; here a
-        # thread pool — PIL's JPEG codec and large-array numpy ufuncs
-        # release the GIL — plus futures-based batch read-ahead sized by
-        # prefetch_buffer so decode overlaps device compute)
-        self.preprocess_threads = max(1, int(preprocess_threads))
-        self.prefetch_buffer = max(1, int(prefetch_buffer))
-        self._pool = None
-        self._inflight = {}
-        self._epoch = 0
-        self._aug_seed = int(seed)
+        self.seed = int(seed)
         self.rand_crop = rand_crop
         self.rand_mirror = rand_mirror
         self.resize = resize
         self.scale = scale
         self.max_rotate_angle = max_rotate_angle
         self.rotate = rotate
-        if isinstance(rotate_list, str):
-            rotate_list = [v for v in rotate_list.split(",") if v.strip()]
-        self.rotate_list = [int(v) for v in rotate_list]
+        self.rotate_list = list(rotate_list)
         self.max_shear_ratio = max_shear_ratio
         self.pad = pad
         self.fill_value = fill_value
         self.random_h = random_h
         self.random_s = random_s
         self.random_l = random_l
-        self.mean = None
-        if mean_img is not None and os.path.isfile(mean_img):
-            from . import ndarray as nd
-            self.mean = list(nd.load(mean_img).values())[0].asnumpy()
-        elif mean_r or mean_g or mean_b:
-            self.mean = np.array([mean_r, mean_g, mean_b],
-                                 dtype=np.float32).reshape(3, 1, 1)
-        self._rng = np.random.RandomState(seed)
-        self._path_imgrec = path_imgrec
-        # load record offsets; shard by record index (InputSplit semantics)
-        self._records: List[bytes] = []
-        reader = rio.MXRecordIO(path_imgrec, "r")
-        i = 0
-        while True:
-            rec = reader.read()
-            if rec is None:
-                break
-            if i % num_parts == part_index:
-                self._records.append(rec)
-            i += 1
-        reader.close()
-        if shuffle:
-            self._rng.shuffle(self._records)
+        self.mean = None if mean is None else np.asarray(mean, dtype=np.float32)
         self.label_width = label_width
-        self.cursor = -batch_size
-        self.num_data = len(self._records)
-        if self.num_data == 0:
-            raise MXNetError("no records found in %s" % path_imgrec)
-        if mean_img is not None and self.mean is None:
-            # first use: compute the dataset mean image and cache it to
-            # disk (reference iter_normalize.h computes + saves mean_img
-            # the same way before training starts)
-            self.mean = self._compute_mean(mean_img)
 
-    def _compute_mean(self, path: str) -> np.ndarray:
-        from . import ndarray as nd
-        from . import recordio as rio
+    def config(self) -> dict:
+        """Picklable kwargs that rebuild this decoder bit-identically in
+        another process."""
+        return {"data_shape": self.data_shape, "seed": self.seed,
+                "rand_crop": self.rand_crop, "rand_mirror": self.rand_mirror,
+                "resize": self.resize, "scale": self.scale,
+                "max_rotate_angle": self.max_rotate_angle,
+                "rotate": self.rotate, "rotate_list": self.rotate_list,
+                "max_shear_ratio": self.max_shear_ratio, "pad": self.pad,
+                "fill_value": self.fill_value, "random_h": self.random_h,
+                "random_s": self.random_s, "random_l": self.random_l,
+                "mean": self.mean, "label_width": self.label_width}
 
-        saved = {k: getattr(self, k) for k in (
-            "rand_crop", "rand_mirror", "scale", "max_rotate_angle",
-            "rotate", "rotate_list", "max_shear_ratio", "random_h",
-            "random_s", "random_l")}
-        # deterministic, unscaled, unaugmented pass (mean lives in raw-pixel
-        # units; _decode applies it before scale) over the FULL dataset —
-        # not just this worker's shard — so every worker agrees on the mean
-        self.rand_crop = self.rand_mirror = False
-        self.scale = 1.0
-        self.max_rotate_angle = self.max_shear_ratio = 0
-        self.rotate, self.rotate_list = -1.0, []
-        self.random_h = self.random_s = self.random_l = 0
-        try:
-            acc = np.zeros(self.data_shape, dtype=np.float64)
-            count = 0
-            reader = rio.MXRecordIO(self._path_imgrec, "r")
-            while True:
-                rec = reader.read()
-                if rec is None:
-                    break
-                img, _ = self._decode(rec, np.random.RandomState(0))
-                acc += img
-                count += 1
-            reader.close()
-        finally:
-            for k, v in saved.items():
-                setattr(self, k, v)
-        logging.info("computed mean image from %d records -> %s",
-                     count, path)
-        mean = (acc / max(count, 1)).astype(np.float32)
-        # atomic publish: a killed run must not leave a torn cache file
-        # that every later construction would crash loading
-        tmp = "%s.tmp.%d" % (path, os.getpid())
-        nd.save(tmp, {"mean_img": nd.array(mean)})
-        os.replace(tmp, path)
-        return mean
-
-    @property
-    def provide_data(self):
-        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
-
-    @property
-    def provide_label(self):
-        shape = (self.batch_size,) if self.label_width == 1 \
-            else (self.batch_size, self.label_width)
-        return [DataDesc("softmax_label", shape)]
-
-    def reset(self):
-        self.cursor = -self.batch_size
-        # augmentation draws are keyed by (epoch, record index), so each
-        # epoch re-augments differently (reference parser RNG keeps
-        # drawing across epochs) while staying reproducible and
-        # independent of the pool size
-        self._epoch += 1
-        # cancel read-ahead from the old epoch so the pool doesn't burn
-        # prefetch_buffer*batch_size decodes that will be discarded
-        for futs in self._inflight.values():
-            for f in futs:
-                f.cancel()
-        self._inflight.clear()
-        self._cache_cursor = None
-
-    def iter_next(self):
-        self.cursor += self.batch_size
-        return self.cursor < self.num_data
-
-    # -- decode pool -------------------------------------------------------
-    def _derive_rng(self, epoch: int, idx: int) -> np.random.RandomState:
-        """Per-(epoch, record) augmentation RNG: decode order (and thread
-        count) cannot change the augmentation a record receives."""
-        mixed = (self._aug_seed * 0x9E3779B1 + epoch * 1000003
+    def derive_rng(self, epoch: int, idx: int) -> np.random.RandomState:
+        """Per-(epoch, record) augmentation RNG: decode order (and pool
+        size) cannot change the augmentation a record receives."""
+        mixed = (self.seed * 0x9E3779B1 + epoch * 1000003
                  + idx * 2654435761) & 0xFFFFFFFF
         return np.random.RandomState(mixed)
-
-    def _ensure_pool(self):
-        if self._pool is None and self.preprocess_threads > 1:
-            from concurrent.futures import ThreadPoolExecutor
-
-            self._pool = ThreadPoolExecutor(
-                max_workers=self.preprocess_threads,
-                thread_name_prefix="imgdec")
-        return self._pool
-
-    def _decode_at(self, epoch: int, idx: int):
-        return self._decode(self._records[idx % self.num_data],
-                            self._derive_rng(epoch, idx))
-
-    def _submit(self, cursor: int):
-        pool = self._pool
-        if pool is None or cursor in self._inflight:
-            return
-        ep = self._epoch
-        self._inflight[cursor] = [
-            pool.submit(self._decode_at, ep, i)
-            for i in range(cursor, cursor + self.batch_size)]
-
-    def _gather(self, cursor: int):
-        futs = self._inflight.pop(cursor, None)
-        if futs is not None:
-            return [f.result() for f in futs]
-        pool = self._ensure_pool()
-        idxs = range(cursor, cursor + self.batch_size)
-        if pool is not None:
-            ep = self._epoch
-            return list(pool.map(lambda i: self._decode_at(ep, i), idxs))
-        return [self._decode_at(self._epoch, i) for i in idxs]
 
     def _affine_augment(self, img: np.ndarray,
                         rng: np.random.RandomState) -> np.ndarray:
@@ -750,12 +499,16 @@ class ImageRecordIter(DataIter):
                         channel(hue - 1 / 3)], axis=-1)
         return (out * 255.0).astype(np.float32)
 
-    def _decode(self, rec: bytes,
-                rng: np.random.RandomState) -> Tuple[np.ndarray, np.ndarray]:
+    def decode(self, rec: bytes,
+               rng: np.random.RandomState) -> Tuple[np.ndarray, np.ndarray]:
+        """One record -> (CHW float32 image in raw-pixel units, label).
+        Mean/scale are applied vectorized at batch level
+        (:meth:`normalize_inplace`)."""
         from . import recordio as rio
 
         _tel.inc("io.decoded_records")
-        header, img = rio.unpack_img(rec, iscolor=1 if self.data_shape[0] == 3 else 0)
+        header, img = rio.unpack_img(
+            rec, iscolor=1 if self.data_shape[0] == 3 else 0)
         label = np.asarray(header.label, dtype=np.float32)
         img = img.astype(np.float32)
         if img.ndim == 2:
@@ -766,7 +519,8 @@ class ImageRecordIter(DataIter):
 
             short = min(img.shape[0], img.shape[1])
             ratio = self.resize / short
-            nh, nw = int(round(img.shape[0] * ratio)), int(round(img.shape[1] * ratio))
+            nh, nw = int(round(img.shape[0] * ratio)), \
+                int(round(img.shape[1] * ratio))
             img = np.asarray(Image.fromarray(img.astype(np.uint8)).resize(
                 (nw, nh))).astype(np.float32)
             if img.ndim == 2:
@@ -794,14 +548,495 @@ class ImageRecordIter(DataIter):
         if self.rand_mirror and rng.rand() < 0.5:
             img = img[:, ::-1]
         img = self._hsl_augment(img, rng)
-        img = img.transpose(2, 0, 1)  # HWC -> CHW
-        # mean/scale are applied vectorized at batch level (_decode_batch)
-        return img, label
+        return img.transpose(2, 0, 1), label  # HWC -> CHW
+
+    def normalize_inplace(self, imgs: np.ndarray) -> np.ndarray:
+        """Mean-subtract + scale a freshly stacked float32 batch in
+        place — one vectorized pass beats per-image python-loop
+        arithmetic for the bandwidth-bound normalize, and the same
+        elementwise float32 ops run in the thread path and in workers,
+        keeping both bit-identical."""
+        if self.mean is not None:
+            imgs -= self.mean
+        if self.scale != 1.0:
+            imgs *= self.scale
+        return imgs
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread pipelining (reference io.py:235 +
+    ``src/io/iter_prefetcher.h``): decouples host-side batch prep from
+    device compute. Uses the host ThreadedEngine-style worker thread with a
+    bounded queue of ready batches.
+
+    Lifecycle: :meth:`close` (or the context-manager form) stops and
+    joins the worker thread, so an exception mid-epoch cannot leak a
+    live background thread; :meth:`reset` is close + restart."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth: int = 2):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = self.provide_data[0].shape[0]
+        self._depth = prefetch_depth
+        self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch_depth)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.current_batch: Optional[DataBatch] = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            descs = []
+            for it in self.iters:
+                descs.extend(it.provide_data)
+            return descs
+        descs = []
+        for r, it in zip(self.rename_data, self.iters):
+            descs.extend(DataDesc(r.get(d.name, d.name), d.shape, d.dtype)
+                         for d in it.provide_data)
+        return descs
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            descs = []
+            for it in self.iters:
+                descs.extend(it.provide_label)
+            return descs
+        descs = []
+        for r, it in zip(self.rename_label, self.iters):
+            descs.extend(DataDesc(r.get(d.name, d.name), d.shape, d.dtype)
+                         for d in it.provide_label)
+        return descs
+
+    def _start(self):
+        def _run():
+            while not self._stop.is_set():
+                try:
+                    batches = [it.next() for it in self.iters]
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                data, label = [], []
+                for b in batches:
+                    data.extend(b.data)
+                    label.extend(b.label)
+                merged = DataBatch(data, label, batches[0].pad,
+                                   batches[0].index)
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(merged, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+
+    def close(self):
+        """Stop and join the producer thread, drain the queue. Safe to
+        call repeatedly and from ``__del__``; after close the iterator
+        reports exhaustion until :meth:`reset`."""
+        th = self._thread
+        if th is None:
+            return
+        self._stop.set()
+        # a producer blocked on the bounded queue polls _stop every
+        # 100ms; draining lets it exit immediately
+        self._drain()
+        th.join()
+        self._thread = None
+        self._drain()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def reset(self):
+        self.close()
+        for it in self.iters:
+            it.reset()
+        self._stop = threading.Event()
+        self._start()
+
+    def iter_next(self):
+        if self._thread is None:
+            return False
+        if _tel.enabled():
+            # time blocked on the queue: nonzero stall means the consumer
+            # outran the producer thread — the pipeline, not the device,
+            # is the bottleneck
+            import time
+
+            t0 = time.perf_counter()
+            batch = self._queue.get()
+            _tel.observe("io.prefetch_stall_ms",
+                         (time.perf_counter() - t0) * 1e3)
+        else:
+            batch = self._queue.get()
+        if batch is None:
+            return False
+        self.current_batch = batch
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getpad(self):
+        return self.current_batch.pad
+
+    def getindex(self):
+        return self.current_batch.index
+
+
+@_REG.register("ImageRecordIter")
+class ImageRecordIter(DataIter):
+    """Image recordio iterator with sharding + augmentation (reference
+    ``src/io/iter_image_recordio.cc:109-455``). Decode via PIL; augmentation
+    covers ``image_aug_default.cc:40-300``: resize, random/center crop,
+    random mirror, mean subtraction, scale, rotation/shear (affine with
+    ``fill_value`` border), padding, and HSL color jitter
+    (``random_h/s/l``, OpenCV units: H in [0,180), S/L in [0,255])."""
+
+    def __init__(self, path_imgrec: str, data_shape, batch_size: int,
+                 path_imgidx: Optional[str] = None, label_width: int = 1,
+                 shuffle: bool = False, num_parts: int = 1, part_index: int = 0,
+                 mean_img: Optional[str] = None, mean_r: float = 0.0,
+                 mean_g: float = 0.0, mean_b: float = 0.0, scale: float = 1.0,
+                 rand_crop: bool = False, rand_mirror: bool = False,
+                 resize: int = -1, round_batch: bool = True, seed: int = 0,
+                 preprocess_threads: int = 4, prefetch_buffer: int = 2,
+                 preprocess_mode: Optional[str] = None,
+                 max_rotate_angle: int = 0, rotate: float = -1.0,
+                 rotate_list=(), max_shear_ratio: float = 0.0,
+                 pad: int = 0, fill_value: int = 255,
+                 random_h: int = 0, random_s: int = 0, random_l: int = 0,
+                 **kwargs):
+        super().__init__()
+        from . import recordio as rio
+
+        self.data_shape = tuple(data_shape)
+        self.batch_size = batch_size
+        # decode-pool parameters (reference iter_image_recordio.cc:188-196
+        # decodes with an OMP pool sized by preprocess_threads; here a
+        # thread pool — PIL's JPEG codec and large-array numpy ufuncs
+        # release the GIL — plus futures-based batch read-ahead sized by
+        # prefetch_buffer so decode overlaps device compute)
+        self.preprocess_threads = max(1, int(preprocess_threads))
+        self.prefetch_buffer = max(1, int(prefetch_buffer))
+        # preprocess_mode="process" (or MXNET_TPU_DECODE_PROCS=N) swaps
+        # the GIL-bound thread pool for io_pipeline's multiprocess decode
+        # into a shared-memory batch ring; results stay bit-identical
+        # because every augmentation draw is keyed by (epoch, record idx)
+        env_procs = int(getenv("MXNET_TPU_DECODE_PROCS", 0))
+        if preprocess_mode is None:
+            preprocess_mode = "process" if env_procs > 0 else "thread"
+        if preprocess_mode not in ("thread", "process"):
+            raise MXNetError("preprocess_mode must be 'thread' or "
+                             "'process', got %r" % (preprocess_mode,))
+        self.preprocess_mode = preprocess_mode
+        self._num_procs = env_procs if env_procs > 0 \
+            else self.preprocess_threads
+        self._proc_pipe = None
+        self._pool = None
+        self._inflight = {}
+        self._epoch = 0
+        self._aug_seed = int(seed)
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.resize = resize
+        self.scale = scale
+        self.max_rotate_angle = max_rotate_angle
+        self.rotate = rotate
+        if isinstance(rotate_list, str):
+            rotate_list = [v for v in rotate_list.split(",") if v.strip()]
+        self.rotate_list = [int(v) for v in rotate_list]
+        self.max_shear_ratio = max_shear_ratio
+        self.pad = pad
+        self.fill_value = fill_value
+        self.random_h = random_h
+        self.random_s = random_s
+        self.random_l = random_l
+        self.mean = None
+        if mean_img is not None and os.path.isfile(mean_img):
+            from . import ndarray as nd
+            self.mean = list(nd.load(mean_img).values())[0].asnumpy()
+        elif mean_r or mean_g or mean_b:
+            self.mean = np.array([mean_r, mean_g, mean_b],
+                                 dtype=np.float32).reshape(3, 1, 1)
+        self._rng = np.random.RandomState(seed)
+        self._path_imgrec = path_imgrec
+        # load record offsets; shard by record index (InputSplit semantics)
+        self._records: List[bytes] = []
+        reader = rio.MXRecordIO(path_imgrec, "r")
+        i = 0
+        while True:
+            rec = reader.read()
+            if rec is None:
+                break
+            if i % num_parts == part_index:
+                self._records.append(rec)
+            i += 1
+        reader.close()
+        if shuffle:
+            self._rng.shuffle(self._records)
+        self.label_width = label_width
+        self.cursor = -batch_size
+        self.num_data = len(self._records)
+        if self.num_data == 0:
+            raise MXNetError("no records found in %s" % path_imgrec)
+        if mean_img is not None and self.mean is None:
+            # first use: compute the dataset mean image and cache it to
+            # disk (reference iter_normalize.h computes + saves mean_img
+            # the same way before training starts)
+            self.mean = self._compute_mean(mean_img)
+        # the decoder is the single source of truth for decode+augment;
+        # its config() ships to io_pipeline workers in process mode
+        self._decoder = RecordDecoder(
+            data_shape=self.data_shape, seed=self._aug_seed,
+            rand_crop=rand_crop, rand_mirror=rand_mirror, resize=resize,
+            scale=scale, max_rotate_angle=max_rotate_angle, rotate=rotate,
+            rotate_list=self.rotate_list, max_shear_ratio=max_shear_ratio,
+            pad=pad, fill_value=fill_value, random_h=random_h,
+            random_s=random_s, random_l=random_l, mean=self.mean,
+            label_width=label_width)
+
+    def _compute_mean(self, path: str) -> np.ndarray:
+        from concurrent.futures import ThreadPoolExecutor
+
+        from . import ndarray as nd
+        from . import recordio as rio
+
+        # deterministic, unscaled, unaugmented pass (mean lives in
+        # raw-pixel units) over the FULL dataset — not just this worker's
+        # shard — so every worker agrees on the mean. A dedicated clean
+        # decoder replaces the old save/mutate/restore dance on self.
+        dec = RecordDecoder(data_shape=self.data_shape, resize=self.resize,
+                            pad=self.pad, fill_value=self.fill_value)
+        workers = self._num_procs if self.preprocess_mode == "process" \
+            else self.preprocess_threads
+        pool = ThreadPoolExecutor(max_workers=workers,
+                                  thread_name_prefix="meandec") \
+            if workers > 1 else None
+        acc = np.zeros(self.data_shape, dtype=np.float64)
+        count = 0
+
+        def _decode_one(rec):
+            return dec.decode(rec, np.random.RandomState(0))[0]
+
+        reader = rio.MXRecordIO(self._path_imgrec, "r")
+        try:
+            chunk: List[bytes] = []
+
+            def _flush():
+                nonlocal acc, count
+                imgs = pool.map(_decode_one, chunk) if pool is not None \
+                    else map(_decode_one, chunk)
+                # accumulate in submission order: the float64 sum is
+                # bit-identical for any pool size
+                for img in imgs:
+                    acc += img
+                    count += 1
+                chunk.clear()
+
+            while True:
+                rec = reader.read()
+                if rec is None:
+                    break
+                chunk.append(rec)
+                if len(chunk) >= max(64, 8 * workers):
+                    _flush()
+            if chunk:
+                _flush()
+        finally:
+            reader.close()
+            if pool is not None:
+                pool.shutdown()
+        logging.info("computed mean image from %d records -> %s",
+                     count, path)
+        mean = (acc / max(count, 1)).astype(np.float32)
+        # atomic publish: a killed run must not leave a torn cache file
+        # that every later construction would crash loading
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        nd.save(tmp, {"mean_img": nd.array(mean)})
+        os.replace(tmp, path)
+        return mean
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        # augmentation draws are keyed by (epoch, record index), so each
+        # epoch re-augments differently (reference parser RNG keeps
+        # drawing across epochs) while staying reproducible and
+        # independent of the pool size
+        self._epoch += 1
+        # cancel read-ahead from the old epoch so the pool doesn't burn
+        # prefetch_buffer*batch_size decodes that will be discarded
+        for futs in self._inflight.values():
+            for f in futs:
+                f.cancel()
+        self._inflight.clear()
+        if self._proc_pipe is not None:
+            # parked ring results belong to the finished epoch; drop them
+            self._proc_pipe.flush()
+        self._cache_cursor = None
+
+    def close(self):
+        """Release the decode machinery: shut down worker processes and
+        their shared-memory segments (process mode) and the thread pool.
+        The iterator stays usable afterwards — the next batch lazily
+        rebuilds whatever it needs."""
+        pipe, self._proc_pipe = self._proc_pipe, None
+        if pipe is not None:
+            pipe.shutdown()
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
+        self._inflight.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    # -- decode pool -------------------------------------------------------
+    def _derive_rng(self, epoch: int, idx: int) -> np.random.RandomState:
+        return self._decoder.derive_rng(epoch, idx)
+
+    def _ensure_pool(self):
+        if self._pool is None and self.preprocess_threads > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.preprocess_threads,
+                thread_name_prefix="imgdec")
+        return self._pool
+
+    def _decode_at(self, epoch: int, idx: int):
+        return self._decode(self._records[idx % self.num_data],
+                            self._derive_rng(epoch, idx))
+
+    def _submit(self, cursor: int):
+        pool = self._pool
+        if pool is None or cursor in self._inflight:
+            return
+        ep = self._epoch
+        self._inflight[cursor] = [
+            pool.submit(self._decode_at, ep, i)
+            for i in range(cursor, cursor + self.batch_size)]
+
+    def _gather(self, cursor: int):
+        futs = self._inflight.pop(cursor, None)
+        if futs is not None:
+            return [f.result() for f in futs]
+        pool = self._ensure_pool()
+        idxs = range(cursor, cursor + self.batch_size)
+        if pool is not None:
+            ep = self._epoch
+            return list(pool.map(lambda i: self._decode_at(ep, i), idxs))
+        return [self._decode_at(self._epoch, i) for i in idxs]
+
+    def _decode(self, rec: bytes,
+                rng: np.random.RandomState) -> Tuple[np.ndarray, np.ndarray]:
+        return self._decoder.decode(rec, rng)
+
+    # -- multi-process pipeline (io_pipeline) ------------------------------
+    def _ensure_pipe(self):
+        """Lazily start the shared-memory decode pipeline; any startup
+        failure falls back to in-process decode instead of raising."""
+        if self.preprocess_mode != "process":
+            return None
+        if self._proc_pipe is None:
+            from . import io_pipeline
+
+            try:
+                self._proc_pipe = io_pipeline.ProcessDecodePipeline(
+                    self._records, self._decoder.config(), self.batch_size,
+                    label_width=self.label_width,
+                    num_workers=self._num_procs)
+            except Exception as e:
+                self._disable_process_mode("pipeline startup failed: %s" % e)
+                return None
+        return self._proc_pipe
+
+    def _disable_process_mode(self, reason: str):
+        """Degrade gracefully: drop the worker pipeline and continue on
+        the in-process decode path. Never hangs the training loop."""
+        logging.warning(
+            "ImageRecordIter: multi-process decode disabled (%s); "
+            "falling back to in-process decode", reason)
+        _tel.inc("io.pipeline.fallbacks")
+        pipe, self._proc_pipe = self._proc_pipe, None
+        self.preprocess_mode = "thread"
+        if pipe is not None:
+            pipe.shutdown()
+
 
     def _decode_batch(self):
         if getattr(self, "_cache_cursor", None) == self.cursor:
             _tel.inc("io.decode_cache_hit")
             return self._cache
+        pipe = self._ensure_pipe()
+        if pipe is not None:
+            from .io_pipeline import PipelineError
+
+            try:
+                imgs, labels = pipe.get_batch(self.cursor, self._epoch,
+                                              limit=self.num_data)
+            except PipelineError as e:
+                # a dead worker (or wedged ring) must never hang the
+                # training loop: count it, fall through to in-process
+                _tel.inc("io.pipeline.worker_crashes")
+                self._disable_process_mode(str(e))
+            else:
+                labels = np.ascontiguousarray(
+                    labels[:, 0] if self.label_width == 1 else labels)
+                self._cache = (imgs, labels)
+                self._cache_cursor = self.cursor
+                return self._cache
         results = self._gather(self.cursor)
         if self._pool is not None:
             # read-ahead: keep the pool decoding the next batches while
@@ -814,12 +1049,7 @@ class ImageRecordIter(DataIter):
         imgs = np.stack([r[0] for r in results])
         labels = [r[1] if self.label_width > 1 else float(r[1].ravel()[0])
                   for r in results]
-        # one vectorized pass over the stacked batch beats per-image
-        # python-loop arithmetic for the bandwidth-bound normalize
-        if self.mean is not None:
-            imgs = imgs - self.mean
-        if self.scale != 1.0:
-            imgs = imgs * self.scale
+        self._decoder.normalize_inplace(imgs)
         self._cache = (imgs, np.asarray(labels, dtype=np.float32))
         self._cache_cursor = self.cursor
         return self._cache

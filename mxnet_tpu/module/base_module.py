@@ -211,6 +211,11 @@ class BaseModule:
             validation_metric = eval_metric
         eval_metric = _metric.create(eval_metric)
 
+        # MXNET_TPU_DEVICE_STAGING=1: device_put batch N+1 while step N
+        # executes, so H2D overlaps compute instead of serializing with it
+        from ..io_pipeline import maybe_wrap_device_staging
+        train_data = maybe_wrap_device_staging(train_data)
+
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()

@@ -24,6 +24,7 @@ import numpy as np
 from .base import MXNetError, Registry, DTYPE_NP_TO_ID, DTYPE_ID_TO_NP, mx_real_t
 from .context import Context, cpu, current_context
 from .engine import get_engine
+from . import telemetry as _tel
 
 __all__ = ["NDArray", "array", "empty", "zeros", "ones", "full", "arange",
            "concatenate", "load", "save", "onehot_encode", "waitall"]
@@ -90,7 +91,11 @@ class NDArray:
 
         self._ctx = ctx if ctx is not None else current_context()
         if not isinstance(data, jax.Array):
-            data = jax.device_put(np.asarray(data), self._ctx.jax_device())
+            host = np.asarray(data)
+            data = jax.device_put(host, self._ctx.jax_device())
+            # attribute feed-loop vs kvstore H2D traffic in snapshots
+            _tel.inc("ndarray.h2d_bytes", host.nbytes)
+            _tel.inc("ndarray.h2d_transfers")
         self._data = data
         self._var = get_engine().new_variable()
         self.writable = writable

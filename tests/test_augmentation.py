@@ -111,7 +111,7 @@ def test_hsl_zero_jitter_is_identity(tmp_path):
 def test_hsl_roundtrip_matches_colorsys(tmp_path):
     """The vectorized RGB<->HLS pair agrees with colorsys on random pixels
     (jitter forced to zero offsets but conversion path exercised)."""
-    it = mio.ImageRecordIter.__new__(mio.ImageRecordIter)
+    it = mio.RecordDecoder.__new__(mio.RecordDecoder)
     it.random_h, it.random_s, it.random_l = 180, 0, 0
     rng_half = type("R", (), {
         "rand": staticmethod(lambda *a: np.float64(0.5))})()  # dh = 0

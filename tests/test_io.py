@@ -202,3 +202,46 @@ def test_imagerecord_mean_img_caching(tmp_path):
     it2 = mx.io.ImageRecordIter(path_imgrec=rec_path, data_shape=(3, 8, 8),
                                 batch_size=3, mean_img=mean_path)
     np.testing.assert_allclose(it2.mean, expected, rtol=1e-5)
+
+
+def test_prefetching_iter_close_joins_thread():
+    """close() stops and joins the background thread — no leak even if
+    the consumer abandons the epoch midway."""
+    import threading
+
+    data = np.random.rand(40, 3).astype(np.float32)
+    base = mio.NDArrayIter(data, np.arange(40, dtype=np.float32),
+                           batch_size=10)
+    pre = mio.PrefetchingIter(base)
+    next(iter(pre))  # abandon mid-epoch with batches still queued
+    worker = pre._thread
+    assert worker is not None and worker.is_alive()
+    pre.close()
+    assert pre._thread is None and not worker.is_alive()
+    assert not any(t is worker for t in threading.enumerate())
+    # closed iterator reports exhaustion rather than hanging
+    assert pre.iter_next() is False
+
+
+def test_prefetching_iter_context_manager():
+    data = np.zeros((20, 2), dtype=np.float32)
+    with mio.PrefetchingIter(
+            mio.NDArrayIter(data, np.zeros(20), batch_size=5)) as pre:
+        assert len(list(pre)) == 4
+        worker = pre._thread
+    assert pre._thread is None
+    assert worker is None or not worker.is_alive()
+
+
+def test_prefetching_iter_reset_after_partial_epoch():
+    """reset() mid-epoch drains safely and the next epoch is complete."""
+    data = np.random.rand(40, 3).astype(np.float32)
+    label = np.arange(40, dtype=np.float32)
+    with mio.PrefetchingIter(
+            mio.NDArrayIter(data, label, batch_size=10)) as pre:
+        next(iter(pre))
+        pre.reset()
+        batches = list(pre)
+        assert len(batches) == 4
+        got = np.concatenate([b.label[0].asnumpy() for b in batches])
+        np.testing.assert_allclose(np.sort(got), label)

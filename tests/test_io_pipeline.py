@@ -111,3 +111,216 @@ def test_pipeline_bench_tool(tmp_path):
                         "--seconds", "1.0", "--threads", "1,2"])
     assert len(results) == 2
     assert all(r["value"] > 100 for r in results), results
+
+
+# ---- multi-process decode + shared-memory batch ring -------------------
+
+import threading
+
+import mxnet_tpu.io_pipeline as iop
+from mxnet_tpu import telemetry
+
+
+def _with_timeout(fn, seconds=90):
+    """Hand-rolled per-test timeout (pytest-timeout is not in the image):
+    run fn on a daemon thread; a hang fails the test instead of wedging
+    the whole tier-1 run."""
+    result = {}
+
+    def run():
+        try:
+            result["value"] = fn()
+        except BaseException as e:  # re-raised on the pytest thread
+            result["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(seconds)
+    assert not t.is_alive(), "pipeline test timed out after %ss" % seconds
+    if "error" in result:
+        raise result["error"]
+    return result.get("value")
+
+
+def test_shm_record_store_roundtrip():
+    recs = [b"alpha", b"", b"x" * 1000, b"tail"]
+    store = iop.ShmRecordStore.create(recs)
+    try:
+        att = iop.ShmRecordStore.attach(store.name)
+        assert len(att) == len(recs)
+        for i, r in enumerate(recs):
+            assert att.get(i) == r
+        att.close()
+    finally:
+        store.close()
+
+
+def test_shm_batch_ring_views():
+    ring = iop.ShmBatchRing(num_slots=2, batch_size=3, data_shape=(3, 4, 4),
+                            label_width=1)
+    try:
+        ring.img_view(0)[:] = 7.0
+        ring.label_view(1)[:] = 2.0
+        att = iop.ShmBatchRing.attach(ring.meta())
+        np.testing.assert_array_equal(att.img_view(0),
+                                      np.full((3, 3, 4, 4), 7.0, np.float32))
+        np.testing.assert_array_equal(att.label_view(1),
+                                      np.full((3, 1), 2.0, np.float32))
+        att.close()
+    finally:
+        ring.close()
+
+
+def test_process_decode_matches_thread(tmp_path):
+    """preprocess_mode='process' (2 spawn workers, shm ring) is
+    bit-identical to the serial thread path for the same seed, across
+    two epochs, with no fallback."""
+    path = _make_rec(tmp_path)
+
+    def body():
+        a = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 12, 12),
+                                batch_size=8, preprocess_threads=1, seed=5,
+                                **AUG)
+        c = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 12, 12),
+                                batch_size=8, preprocess_threads=2,
+                                preprocess_mode="process", seed=5, **AUG)
+        with a, c:
+            for (da, la), (dc, lc) in zip(_epoch(a), _epoch(c)):
+                np.testing.assert_array_equal(da, dc)
+                np.testing.assert_array_equal(la, lc)
+            a.reset()
+            c.reset()
+            for (da, _), (dc, _) in zip(_epoch(a), _epoch(c)):
+                np.testing.assert_array_equal(da, dc)
+            assert c.preprocess_mode == "process", \
+                "fell back to thread decode: %s" % c.preprocess_mode
+
+    _with_timeout(body)
+
+
+def test_process_worker_crash_falls_back(tmp_path):
+    """Killing every decode worker mid-epoch degrades to in-process
+    decode with identical output — never a hang, never a wrong batch."""
+    path = _make_rec(tmp_path, n=96)
+
+    def body():
+        a = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 12, 12),
+                                batch_size=8, preprocess_threads=1, seed=5,
+                                **AUG)
+        c = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 12, 12),
+                                batch_size=8, preprocess_threads=2,
+                                preprocess_mode="process", seed=5, **AUG)
+        with a, c:
+            it_a, it_c = iter(a), iter(c)
+            np.testing.assert_array_equal(next(it_a).data[0].asnumpy(),
+                                          next(it_c).data[0].asnumpy())
+            for p in c._proc_pipe._procs:
+                p.terminate()
+            for p in c._proc_pipe._procs:
+                p.join()
+            served = 1
+            while True:
+                try:
+                    bc = next(it_c)
+                except StopIteration:
+                    break
+                ba = next(it_a)
+                np.testing.assert_array_equal(ba.data[0].asnumpy(),
+                                              bc.data[0].asnumpy())
+                served += 1
+            assert served == 12, served
+            assert c.preprocess_mode == "thread"
+
+    _with_timeout(body)
+
+
+@pytest.mark.slow
+def test_process_decode_four_workers(tmp_path):
+    """Heavier 4-worker sweep (slow tier): worker count still cannot
+    change a single bit of the output."""
+    path = _make_rec(tmp_path, n=64)
+
+    def body():
+        a = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 12, 12),
+                                batch_size=8, preprocess_threads=1, seed=3,
+                                **AUG)
+        c = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 12, 12),
+                                batch_size=8, preprocess_threads=4,
+                                preprocess_mode="process", seed=3, **AUG)
+        with a, c:
+            for (da, la), (dc, lc) in zip(_epoch(a), _epoch(c)):
+                np.testing.assert_array_equal(da, dc)
+                np.testing.assert_array_equal(la, lc)
+            assert c.preprocess_mode == "process"
+
+    _with_timeout(body, seconds=180)
+
+
+def test_decode_procs_env_opts_in(tmp_path, monkeypatch):
+    """MXNET_TPU_DECODE_PROCS turns process mode on without a code
+    change (and wins over preprocess_threads for worker count)."""
+    path = _make_rec(tmp_path)
+    monkeypatch.setenv("MXNET_TPU_DECODE_PROCS", "2")
+    it = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 12, 12),
+                             batch_size=8, preprocess_threads=1, seed=5)
+    with it:
+        assert it.preprocess_mode == "process"
+        assert it._num_procs == 2
+        next(iter(it))
+
+
+def test_device_staging_iter(tmp_path):
+    """DeviceStagingIter yields the same batches as the bare iterator
+    (one batch staged ahead), supports reset, and is what
+    MXNET_TPU_DEVICE_STAGING wraps in."""
+    path = _make_rec(tmp_path)
+    mk = lambda: mio.ImageRecordIter(
+        path_imgrec=path, data_shape=(3, 12, 12), batch_size=8,
+        preprocess_threads=1, seed=5, **AUG)
+    plain = [d for d, _ in _epoch(mk())]
+    staged = iop.DeviceStagingIter(mk())
+    got = [b.data[0].asnumpy().copy() for b in staged]
+    assert len(got) == len(plain)
+    for x, y in zip(plain, got):
+        np.testing.assert_array_equal(x, y)
+    staged.reset()
+    again = [b.data[0].asnumpy().copy() for b in staged]
+    assert len(again) == len(plain)
+
+
+def test_maybe_wrap_device_staging(tmp_path, monkeypatch):
+    path = _make_rec(tmp_path)
+    it = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 12, 12),
+                             batch_size=8, preprocess_threads=1)
+    assert iop.maybe_wrap_device_staging(it) is it
+    monkeypatch.setenv("MXNET_TPU_DEVICE_STAGING", "1")
+    wrapped = iop.maybe_wrap_device_staging(it)
+    assert isinstance(wrapped, iop.DeviceStagingIter)
+    # idempotent: wrapping a wrapper is a no-op
+    assert iop.maybe_wrap_device_staging(wrapped) is wrapped
+
+
+def test_pipeline_telemetry_counters(tmp_path):
+    """The process pipeline reports decode latency, ring occupancy and
+    H2D staging through the PR-1 telemetry registry."""
+    path = _make_rec(tmp_path)
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        it = mio.ImageRecordIter(path_imgrec=path, data_shape=(3, 12, 12),
+                                 batch_size=8, preprocess_threads=2,
+                                 preprocess_mode="process", seed=5, **AUG)
+        with it:
+            staged = iop.DeviceStagingIter(it)
+            for _ in staged:
+                pass
+        snap = telemetry.snapshot()
+        io_m = snap["io"]
+        assert io_m["pipeline"]["decode_ms"]["count"] >= 3
+        assert io_m["staging"]["batches"] == 3
+        assert io_m["staging"]["h2d_ms"]["count"] == 3
+        assert snap["ndarray"]["h2d_transfers"] >= 3
+        assert snap["ndarray"]["h2d_bytes"] > 0
+    finally:
+        telemetry.disable()
+        telemetry.reset()

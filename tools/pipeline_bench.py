@@ -9,11 +9,16 @@ compute side ran 2,504 img/s while decode was single-threaded).
 
 Usage:
   python tools/pipeline_bench.py [--rec PATH] [--threads 1,4,8]
-      [--image 224] [--num 512] [--batch 64] [--seconds 6] [--augment]
+      [--procs 2,4] [--image 224] [--num 512] [--batch 64]
+      [--seconds 6] [--augment]
 
 Prints one JSON line per thread count:
   {"metric": "input_pipeline_imgs_per_sec", "value": N, "unit": "img/s",
    "threads": T, "image": S, "augment": bool}
+and, with --procs, one per process-worker count (preprocess_mode=
+"process": GIL-free decode into the shared-memory batch ring):
+  {"metric": "input_pipeline_proc_imgs_per_sec", "value": N,
+   "unit": "img/s", "procs": P, "image": S, "augment": bool}
 """
 from __future__ import annotations
 
@@ -47,13 +52,15 @@ def make_synthetic_rec(path: str, num: int, image: int, seed: int = 0):
 
 
 def measure(rec_path: str, image: int, batch: int, threads: int,
-            seconds: float, augment: bool) -> float:
+            seconds: float, augment: bool, mode: str = None) -> float:
     from mxnet_tpu import io as mio
 
     kw = {}
     if augment:
         kw.update(rand_crop=True, rand_mirror=True, max_rotate_angle=10,
                   random_h=10, random_s=10, random_l=10)
+    if mode is not None:
+        kw["preprocess_mode"] = mode
     it = mio.ImageRecordIter(
         path_imgrec=rec_path, data_shape=(3, image, image),
         batch_size=batch, preprocess_threads=threads,
@@ -72,7 +79,9 @@ def measure(rec_path: str, image: int, batch: int, threads: int,
         # touch the data so lazy work can't be deferred out of the timing
         _ = b.data[0].asnumpy().ravel()[0]
         n += it.batch_size
-    return n / (time.time() - tic)
+    rate = n / (time.time() - tic)
+    it.close()
+    return rate
 
 
 def measure_cached(rec_path: str, image: int, batch: int, seconds: float,
@@ -118,6 +127,9 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--rec", default=None, help="existing .rec (default: synthesize)")
     p.add_argument("--threads", default="1,%d" % max(2, os.cpu_count() or 1))
+    p.add_argument("--procs", default="",
+                   help="comma-separated process-worker counts to bench "
+                        "(preprocess_mode='process')")
     p.add_argument("--image", type=int, default=224)
     p.add_argument("--num", type=int, default=256)
     p.add_argument("--batch", type=int, default=64)
@@ -139,6 +151,14 @@ def main(argv=None):
                        args.augment)
         line = {"metric": "input_pipeline_imgs_per_sec",
                 "value": round(rate, 1), "unit": "img/s", "threads": t,
+                "image": args.image, "augment": bool(args.augment)}
+        print(json.dumps(line))
+        results.append(line)
+    for np_ in [int(x) for x in str(args.procs).split(",") if x.strip()]:
+        rate = measure(rec, args.image, args.batch, np_, args.seconds,
+                       args.augment, mode="process")
+        line = {"metric": "input_pipeline_proc_imgs_per_sec",
+                "value": round(rate, 1), "unit": "img/s", "procs": np_,
                 "image": args.image, "augment": bool(args.augment)}
         print(json.dumps(line))
         results.append(line)
