@@ -150,7 +150,7 @@ def _bench_smoke(procs=4, image=64, num=192, batch=32, seconds=4.0):
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "tools"))
     from pipeline_bench import make_synthetic_rec, measure
-    from mxnet_tpu import telemetry
+    from mxnet_tpu import telemetry, tracing
 
     tmp = tempfile.mkdtemp(prefix="bench_smoke_")
     rec = os.path.join(tmp, "synth.rec")
@@ -158,15 +158,20 @@ def _bench_smoke(procs=4, image=64, num=192, batch=32, seconds=4.0):
     base = measure(rec, image, batch, 1, seconds, True, mode="thread")
     telemetry.enable()
     telemetry.reset()
+    # MXNET_TPU_METRICS_PORT set -> live /metrics + /healthz during the
+    # measured run (the operator-scrape acceptance path)
+    server = tracing.maybe_init()
     rate = measure(rec, image, batch, procs, seconds, True, mode="process")
     snap = telemetry.snapshot().get("io", {})
-    telemetry.disable()
     result = {"metric": "input_imgs_per_sec", "value": round(rate, 1),
               "unit": "img/s", "procs": procs,
               "thread1_baseline": round(base, 1),
               "speedup_vs_thread1": round(rate / base, 2) if base else 0.0,
               "cpu_count": os.cpu_count(), "image": image,
               "platform": "cpu", "io_telemetry": snap}
+    if server is not None:
+        result["metrics_port"] = server.port
+    telemetry.disable()
     print(json.dumps(result))
     return result
 
@@ -426,10 +431,11 @@ def _bench():
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     import mxnet_tpu as mx
-    from mxnet_tpu import models, telemetry
+    from mxnet_tpu import models, telemetry, tracing
     from mxnet_tpu.parallel import build_sgd_train_step
 
     telemetry.enable()
+    tracing.maybe_init()
 
     devices = jax.devices()
     on_accel = devices[0].platform != "cpu"
@@ -512,10 +518,14 @@ def _bench():
     if trace_dir:
         jax.profiler.start_trace(trace_dir)
     tic = time.time()
+    t_last = time.perf_counter()
     for i in range(steps):
         with telemetry.span("bench.step"):
             outputs, params, aux = jit_step(params, data, aux,
                                             jax.random.fold_in(key, i))
+        now = time.perf_counter()
+        tracing.record_step((now - t_last) * 1e3)
+        t_last = now
     _force(params)
     elapsed = time.time() - tic
     if trace_dir:
@@ -689,6 +699,11 @@ def _bench():
     # framework-side counters/spans for this run (engine, io, executor,
     # kvstore, bench.step span stats) ride along in the perf record
     result["telemetry"] = telemetry.snapshot()
+    # ... and any anomaly events the step-trace detectors raised, so a
+    # recompile-tainted or stall-tainted number is self-labeled
+    events = list(tracing.step_trace().events)
+    if events:
+        result["anomaly_events"] = events
 
     # .bench_cache.json is deliberately git-TRACKED: the end-of-round
     # snapshot then preserves the last real on-chip measurement even

@@ -60,3 +60,4 @@ from . import test_utils      # noqa: E402
 from . import export          # noqa: E402
 from . import profiler        # noqa: E402
 from . import telemetry       # noqa: E402
+from . import tracing         # noqa: E402

@@ -44,7 +44,10 @@ def log_train_metric(period: int, auto_reset: bool = False):
 
 
 class Speedometer:
-    """samples/sec logging (reference Speedometer)."""
+    """samples/sec logging (reference Speedometer), plus a partial
+    tail-window report at epoch end (``epoch_end``, invoked by the fit
+    loop) so the batches after the last frequent boundary are accounted
+    instead of silently dropped."""
 
     def __init__(self, batch_size: int, frequent: int = 50):
         self.batch_size = batch_size
@@ -52,6 +55,27 @@ class Speedometer:
         self.init = False
         self.tic = 0.0
         self.last_count = 0
+        self._tic_count = 0
+
+    def _emit(self, epoch, count, n_batches, elapsed, eval_metric,
+              tail=False):
+        # a sub-clock-resolution window on a very fast loop must not
+        # ZeroDivisionError the whole training run
+        speed = n_batches * self.batch_size / max(elapsed, 1e-9)
+        if _tel.enabled():
+            _tel.set_gauge("train.samples_per_sec", speed)
+            _tel.inc("train.batches", n_batches)
+        where = "Batch [%d]%s" % (count, " tail(%d)" % n_batches
+                                  if tail else "")
+        if eval_metric is not None:
+            msg = "Epoch[%d] %s\tSpeed: %.2f samples/sec" \
+                % (epoch, where, speed)
+            for name, value in eval_metric.get_name_value():
+                msg += "\t%s=%f" % (name, value)
+            logging.info(msg)
+        else:
+            logging.info("Iter[%d] %s\tSpeed: %.2f samples/sec",
+                         epoch, where, speed)
 
     def __call__(self, param):
         count = param.nbatch
@@ -59,25 +83,26 @@ class Speedometer:
             self.init = False
         self.last_count = count
         if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                if _tel.enabled():
-                    _tel.set_gauge("train.samples_per_sec", speed)
-                    _tel.inc("train.batches", self.frequent)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec" \
-                        % (param.epoch, count, speed)
-                    for name, value in name_value:
-                        msg += "\t%s=%f" % (name, value)
-                    logging.info(msg)
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
+            if count % self.frequent == 0 and count > self._tic_count:
+                self._emit(param.epoch, count, count - self._tic_count,
+                           time.time() - self.tic, param.eval_metric)
                 self.tic = time.time()
+                self._tic_count = count
         else:
             self.init = True
             self.tic = time.time()
+            self._tic_count = count
+
+    def epoch_end(self, param):
+        """Report the window still open when the epoch ends off a
+        frequent boundary; the fit loop calls this after its last batch."""
+        if not self.init:
+            return
+        tail = self.last_count - self._tic_count
+        if tail > 0:
+            self._emit(param.epoch, param.nbatch, tail,
+                       time.time() - self.tic, param.eval_metric, tail=True)
+        self.init = False
 
 
 class ProgressBar:

@@ -360,6 +360,12 @@ class ProcessDecodePipeline:
         self.schedule(cursor, epoch)
         if limit is not None:
             self.prefetch(cursor, epoch, limit)
+        if _tel.enabled():
+            # heartbeat: a silently dead worker shows up on the next
+            # scrape as workers_alive < configured count, long before
+            # the stall timeout fires the in-process fallback
+            _tel.set_gauge("io.pipeline.workers_alive",
+                           float(sum(p.is_alive() for p in self._procs)))
         stalled = key not in self._ready
         t0 = time.perf_counter()
         while key not in self._ready:

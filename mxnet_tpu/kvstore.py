@@ -432,11 +432,22 @@ def create(name: str = "local") -> KVStore:
         raise TypeError("name must be a string")
     lname = name.lower()
     if "tpu" in lname or "device" in lname:
-        return TPUSyncKVStore(lname)
-    if "async" in lname:
-        return KVStoreDistAsync(lname)
-    if "dist" in lname:
-        return KVStoreDist(lname)
-    if lname in ("local", "local_update_cpu", "local_allreduce_cpu"):
-        return KVStore(lname)
-    raise MXNetError("unknown kvstore type %s" % name)
+        kv = TPUSyncKVStore(lname)
+    elif "async" in lname:
+        kv = KVStoreDistAsync(lname)
+    elif "dist" in lname:
+        kv = KVStoreDist(lname)
+    elif lname in ("local", "local_update_cpu", "local_allreduce_cpu"):
+        kv = KVStore(lname)
+    else:
+        raise MXNetError("unknown kvstore type %s" % name)
+    if _tel.enabled():
+        # label exported metrics with this worker's rank so dist_async
+        # runs are distinguishable per-process on one scrape dashboard
+        from . import tracing as _tracing
+
+        try:
+            _tracing.set_worker_rank(kv.rank)
+        except Exception:
+            pass
+    return kv

@@ -118,6 +118,11 @@ class FeedForward:
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, monitor=None,
             eval_batch_end_callback=None):
+        # bring the metrics server / flight recorder up before the first
+        # bind+compile (minutes on large graphs) so the run is already
+        # scrapeable while XLA works
+        from . import tracing as _tracing
+        _tracing.maybe_init()
         data = self._init_iter(X, y, is_train=True)
         if eval_data is not None and not isinstance(eval_data, DataIter):
             if isinstance(eval_data, (tuple, list)) and len(eval_data) == 2:

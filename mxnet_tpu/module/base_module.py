@@ -10,6 +10,8 @@ from typing import Dict, List, Optional
 from ..base import MXNetError
 from .. import metric as _metric
 from .. import ndarray as nd
+from .. import telemetry as _tel
+from .. import tracing as _tracing
 from ..initializer import Uniform
 from ..io import DataBatch
 
@@ -216,10 +218,19 @@ class BaseModule:
         from ..io_pipeline import maybe_wrap_device_staging
         train_data = maybe_wrap_device_staging(train_data)
 
+        # env-driven observability (metrics server, flight recorder);
+        # single flag check when telemetry is off
+        _tracing.maybe_init()
+
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             train_data.reset()
+            # step latency is measured boundary-to-boundary so the data
+            # fetch (where input stalls accrue) is attributed to the
+            # step that waited on it, not lost between timers
+            t_last = time.perf_counter() if _tel.enabled() else 0.0
+            nbatch = -1
             for nbatch, data_batch in enumerate(train_data):
                 if monitor is not None:
                     monitor.tic()
@@ -228,12 +239,28 @@ class BaseModule:
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
+                if _tel.enabled():
+                    now = time.perf_counter()
+                    _tracing.record_step((now - t_last) * 1e3,
+                                         extra={"epoch": epoch,
+                                                "nbatch": nbatch})
+                    t_last = now
                 if batch_end_callback is not None:
                     params = BatchEndParam(epoch=epoch, nbatch=nbatch,
                                            eval_metric=eval_metric,
                                            locals=locals())
                     for cb in _as_list(batch_end_callback):
                         cb(params)
+            if batch_end_callback is not None and nbatch >= 0:
+                # callbacks with an epoch_end hook (Speedometer) get to
+                # report their partial tail window instead of dropping it
+                params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                       eval_metric=eval_metric,
+                                       locals=locals())
+                for cb in _as_list(batch_end_callback):
+                    ep_end = getattr(cb, "epoch_end", None)
+                    if callable(ep_end):
+                        ep_end(params)
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch,

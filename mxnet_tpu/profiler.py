@@ -24,6 +24,7 @@ API follows the start/stop convention later MXNet adopted::
 from __future__ import annotations
 
 import contextlib
+import math
 import time
 from typing import List, Optional
 
@@ -114,17 +115,27 @@ class StepTimer:
     def reset(self):
         self._times.clear()
 
+    @staticmethod
+    def _nearest_rank(sorted_ts, q: float) -> float:
+        """Nearest-rank percentile: the ceil(q*n)-th smallest sample
+        (1-indexed). ``int(n*q)`` truncation reads one rank high for
+        small n — e.g. p50 of [1,2,3,4] was 3, not 2."""
+        n = len(sorted_ts)
+        return sorted_ts[max(0, min(n - 1, math.ceil(q * n) - 1))]
+
     def summary(self, skip_first: int = 1) -> dict:
         """Stats excluding the first ``skip_first`` (compile) steps;
-        ``steps: 0`` if nothing remains after skipping."""
-        ts = sorted(self._times[skip_first:])
+        ``{"steps": 0}`` if nothing remains after skipping (including
+        ``skip_first >= len(times)``)."""
+        ts = sorted(self._times[max(0, int(skip_first)):])
         if not ts:
             return {"steps": 0}
         n = len(ts)
         return {
             "steps": n,
             "mean_ms": sum(ts) / n * 1e3,
-            "p50_ms": ts[n // 2] * 1e3,
-            "p90_ms": ts[min(n - 1, int(n * 0.9))] * 1e3,
+            "p50_ms": self._nearest_rank(ts, 0.50) * 1e3,
+            "p90_ms": self._nearest_rank(ts, 0.90) * 1e3,
+            "p99_ms": self._nearest_rank(ts, 0.99) * 1e3,
             "max_ms": ts[-1] * 1e3,
         }

@@ -50,7 +50,7 @@ from .base import MXNetError, getenv
 __all__ = ["enabled", "enable", "disable", "counter", "gauge", "histogram",
            "inc", "set_gauge", "observe", "span", "snapshot", "reset",
            "dump_jsonl", "write_chrome_trace", "Counter", "Gauge",
-           "Histogram"]
+           "Histogram", "peek", "metrics_items"]
 
 _ENABLED = bool(getenv("MXNET_TPU_TELEMETRY", False))
 
@@ -208,6 +208,26 @@ def histogram(name: str, capacity: int = 512) -> Histogram:
     return _get(name, Histogram, capacity=capacity)
 
 
+def peek(name: str, kind: str = "counter"):
+    """Read a metric's current raw value WITHOUT registering it: a
+    counter/gauge value, or a histogram's running sum when
+    ``kind="hist_sum"``. Returns None for an unregistered name. This is
+    the step-trace delta reader — it must not materialize metrics the
+    instrumented layers never touched."""
+    m = _metrics.get(name)
+    if m is None:
+        return None
+    if isinstance(m, Histogram):
+        return m._sum if kind == "hist_sum" else m._count
+    return m._value
+
+
+def metrics_items():
+    """Sorted (name, metric) pairs — the exposition-format reader."""
+    with _reg_lock:
+        return sorted(_metrics.items())
+
+
 # -- recording fast path (one flag check, immediate return when off) ----
 def inc(name: str, n: int = 1):
     if not _ENABLED:
@@ -308,9 +328,14 @@ def snapshot() -> dict:
 
 def dump_jsonl(path: str, extra: Optional[dict] = None) -> dict:
     """Append ONE step record (timestamp, step index, full snapshot) to
-    ``path``. Append-only and crash-safe: the record is a single
-    ``write`` of one line followed by flush+fsync, so a killed run
-    leaves at worst a truncated final line, never a corrupt file."""
+    ``path``. Crash-safe: the whole line goes out in a single
+    ``os.write`` on an ``O_APPEND`` fd — POSIX appends of one write are
+    atomic with respect to other appenders, so a crash (or a concurrent
+    writer) can interleave or truncate at worst the final line, never
+    the middle of an earlier record the flight recorder will read back.
+    ``MXNET_TPU_TELEMETRY_FSYNC=1`` adds an fsync per record for
+    machines where losing the last buffered lines to a power cut
+    matters more than the syscall cost."""
     global _step
     with _step_lock:
         _step += 1
@@ -319,10 +344,14 @@ def dump_jsonl(path: str, extra: Optional[dict] = None) -> dict:
            "telemetry": snapshot()}
     if extra:
         rec.update(extra)
-    with open(path, "a") as f:
-        f.write(json.dumps(rec) + "\n")
-        f.flush()
-        os.fsync(f.fileno())
+    line = (json.dumps(rec) + "\n").encode("utf-8")
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, line)
+        if getenv("MXNET_TPU_TELEMETRY_FSYNC", False):
+            os.fsync(fd)
+    finally:
+        os.close(fd)
     return rec
 
 

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Render a step-trace JSONL (StepTrace.dump_jsonl / telemetry
+dump_jsonl) or a flight-recorder crash-dump directory into a
+human-readable table: the top-k slowest steps with their dominant
+delta, plus any anomaly events and crash metadata.
+
+Usage::
+
+    python tools/trace_report.py RUN.jsonl [--top K]
+    python tools/trace_report.py /tmp/mxnet_tpu_crash/flight-...-pid123-1
+
+Stdlib only — runs on any box the crash dump was copied to.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+DELTA_COLS = ("io_stall_ms", "prefetch_stall_ms", "h2d_bytes",
+              "kv_push_bytes", "kv_pull_bytes", "recompiles")
+
+
+def load_records(path):
+    """Step records from a JSONL file. Accepts both the StepTrace
+    schema (latency_ms + deltas) and telemetry.dump_jsonl records
+    (step_ms, no deltas); skips unparseable lines (a crash may truncate
+    the final one)."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if "latency_ms" not in rec and "step_ms" in rec:
+                rec = dict(rec, latency_ms=rec["step_ms"])
+            if "latency_ms" in rec:
+                records.append(rec)
+    return records
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return "%.0f%s" % (n, unit) if unit == "B" \
+                else "%.1f%s" % (n, unit)
+        n /= 1024.0
+
+
+def render(records, top=10):
+    """Top-``top`` slowest steps as an aligned text table."""
+    if not records:
+        return "no step records\n"
+    slowest = sorted(records, key=lambda r: -r.get("latency_ms", 0.0))[:top]
+    lats = sorted(r["latency_ms"] for r in records)
+    header = ("step", "latency_ms", "dominant", "io_stall_ms",
+              "prefetch_ms", "h2d", "kv_push", "kv_pull", "recompiles")
+    rows = [header]
+    for r in slowest:
+        d = r.get("deltas", {})
+        rows.append((
+            str(r.get("step", "?")),
+            "%.2f" % r["latency_ms"],
+            str(r.get("dominant", "-")),
+            "%.2f" % d.get("io_stall_ms", 0.0),
+            "%.2f" % d.get("prefetch_stall_ms", 0.0),
+            _fmt_bytes(d.get("h2d_bytes", 0)),
+            _fmt_bytes(d.get("kv_push_bytes", 0)),
+            _fmt_bytes(d.get("kv_pull_bytes", 0)),
+            str(d.get("recompiles", 0)),
+        ))
+    widths = [max(len(row[i]) for row in rows) for i in range(len(header))]
+    out = ["%d steps, latency p50=%.2fms max=%.2fms; top %d slowest:"
+           % (len(records), lats[len(lats) // 2], lats[-1], len(slowest)),
+           ""]
+    for j, row in enumerate(rows):
+        out.append("  ".join(c.rjust(w) for c, w in zip(row, widths)))
+        if j == 0:
+            out.append("  ".join("-" * w for w in widths))
+    return "\n".join(out) + "\n"
+
+
+def render_events(events):
+    if not events:
+        return ""
+    out = ["", "%d anomaly events:" % len(events)]
+    for ev in events:
+        detail = ", ".join("%s=%s" % (k, v) for k, v in sorted(ev.items())
+                           if k not in ("type", "step", "ts"))
+        out.append("  step %-6s %-12s %s"
+                   % (ev.get("step", "?"), ev.get("type", "?"), detail))
+    return "\n".join(out) + "\n"
+
+
+def report_crash_dump(dump_dir, top=10):
+    """Full report for one flight-recorder dump directory."""
+    out = []
+    meta_path = os.path.join(dump_dir, "meta.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        out.append("flight recorder dump: %s" % dump_dir)
+        out.append("  reason: %s  pid: %s  rank: %s  steps: %s"
+                   % (meta.get("reason"), meta.get("pid"),
+                      meta.get("rank"), meta.get("steps_recorded")))
+        if meta.get("exception"):
+            out.append("  exception:")
+            out.extend("    " + l for l in
+                       meta["exception"].rstrip().splitlines())
+        out.append("")
+        events = meta.get("events", [])
+    else:
+        events = []
+    steps_path = os.path.join(dump_dir, "steps.jsonl")
+    if os.path.exists(steps_path):
+        out.append(render(load_records(steps_path), top=top))
+    out.append(render_events(events))
+    return "\n".join(out)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("path", help="step-trace .jsonl or crash-dump dir")
+    p.add_argument("--top", type=int, default=10,
+                   help="slowest steps to show (default 10)")
+    a = p.parse_args(argv)
+    if os.path.isdir(a.path):
+        sys.stdout.write(report_crash_dump(a.path, top=a.top))
+    else:
+        sys.stdout.write(render(load_records(a.path), top=a.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
