@@ -188,6 +188,13 @@ class NaiveEngine(Engine):
         ret = fn()
         _tel.inc("engine.dispatch")
         _bump_versions(mutable_vars)
+        if prop == "fused_step" and not getenv("MXNET_TPU_ENGINE_SYNC",
+                                               False):
+            # the fused train step returns freshly-donated outputs; an
+            # unconditional block here would serialize every batch on
+            # the device instead of letting the next dispatch queue.
+            # MXNET_TPU_ENGINE_SYNC=1 restores blocking for debugging.
+            return
         _block_on(ret)
 
     def wait_for_var(self, var):

@@ -37,7 +37,21 @@ from .ndarray import NDArray
 from .ops.registry import OpContext
 from . import random as _random
 
-__all__ = ["Executor", "make_graph_eval"]
+__all__ = ["Executor", "make_graph_eval", "zero_cotangent"]
+
+
+def zero_cotangent(x):
+    """A vjp cotangent of zeros for ``x``: float0 for non-differentiable
+    (integer/bool) primal outputs — a plain zeros_like would make
+    ``jax.vjp`` reject graphs with integer internals (Cast). Shared by
+    the executor's fused fwd+bwd and the whole-batch fused train step
+    (:mod:`mxnet_tpu.fused_step`)."""
+    import jax
+    import jax.numpy as jnp
+
+    if jnp.issubdtype(x.dtype, jnp.inexact):
+        return jnp.zeros_like(x)
+    return np.zeros(x.shape, jax.dtypes.float0)
 
 
 def make_graph_eval(symbol, node_device=None, remat=False):
@@ -350,6 +364,11 @@ class Executor:
             outs, aux_out = res
             return cast_out(outs), aux_out
 
+        # the mixed-precision-aware pure graph function, exposed so the
+        # fused train step (fused_step.py) can trace fwd+bwd+update as
+        # ONE jitted computation with the exact same numerics
+        self._run_graph = run_graph
+
         @jax.jit
         def fwd_infer(args, aux, key):
             outs, _ = run_graph(args, aux, key, False)
@@ -358,16 +377,6 @@ class Executor:
         @jax.jit
         def fwd_train(args, aux, key):
             return run_graph(args, aux, key, True)
-
-        def zero_cotangent(x):
-            # vjp cotangents must be float0 for non-differentiable
-            # (integer/bool) primal outputs — a plain zeros_like would
-            # make jax.vjp reject graphs with integer internals (Cast)
-            import jax.numpy as jnp
-
-            if jnp.issubdtype(x.dtype, jnp.inexact):
-                return jnp.zeros_like(x)
-            return np.zeros(x.shape, jax.dtypes.float0)
 
         # Donate the aux buffers (BN running stats) into the fused train
         # step: backward() always replaces them with aux_out, so XLA can
@@ -506,6 +515,11 @@ class Executor:
         if not self._train_pending:
             raise MXNetError("backward called without forward(is_train=True)")
         _tel.inc("executor.backward")
+        # the fused fwd+bwd below is one XLA computation launch; the
+        # optimizer update and any metric fold launch separately on this
+        # (unfused) path — step.dispatches makes the per-batch dispatch
+        # count measurable against MXNET_TPU_FUSED_STEP=1
+        _tel.inc("step.dispatches")
         if out_grads is None:
             import jax
 

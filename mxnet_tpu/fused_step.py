@@ -1,0 +1,331 @@
+"""Fused train step: one donated XLA dispatch per training batch.
+
+The classic fit() loop issues three host dispatches per batch —
+``forward_backward`` (one fused fwd+bwd computation), ``update`` (one
+donated kernel per optimizer structure group) and ``update_metric``
+(a fold or an eager ``asnumpy`` sync) — and the gaps between them are
+pure host overhead on an accelerator (BENCH_r05: 15.8% model MFU vs
+30.7% XLA-reported MFU, i.e. roughly half the step was dispatch gaps
+and syncs). This module compiles the whole batch into a SINGLE
+``jax.jit`` call:
+
+    params', outputs, aux', opt_states', metric_acc' =
+        step(params, data/labels, aux, opt_states, hyper_vec, acc, key)
+
+* forward+backward via ``jax.vjp`` through the executor's own
+  ``_run_graph`` (same numerics, same mixed-precision casts),
+* the optimizer update via the same ``_update_math`` pure functions the
+  unfused donated kernels use (hyperparameters ride in traced f32
+  matrices, so an LRScheduler never forces a recompile),
+* the metric fold via :meth:`EvalMetric.device_fold` into a cumulative
+  on-device ``(sum, count)`` accumulator (host fetch only in ``get()``).
+
+Params, aux states, optimizer states and the metric accumulator are
+DONATED: XLA writes the new values into the old HBM buffers, so the
+step holds one copy of the training state. The data/label buffers are
+NOT donated — the caller's batch arrays stay readable after the step.
+
+Data parallelism rides for free: the executor group shards the batch
+over its device mesh (GSPMD), so the gradient all-reduce happens inside
+this same computation — there is no separate aggregation phase to fuse.
+
+Opt-in via ``MXNET_TPU_FUSED_STEP=1``; :func:`make_fused_step` returns
+None (-> classic three-phase loop) whenever a precondition fails:
+``dist_*`` kvstores, ``update_on_kvstore``, custom-update optimizers
+without a fusable plan, grad_req "add", ``inputs_need_grad``, or an
+installed monitor (which needs every internal tensor).
+
+Telemetry: ``step.dispatches`` counts XLA computation launches per
+batch on both paths (the fused-vs-unfused delta BENCH_r06 reports);
+``step.fused_recompiles`` counts fresh trace signatures (a shape-driven
+recompile storm trips the tracing RecompileDetector).
+"""
+from __future__ import annotations
+
+import functools
+
+from . import telemetry as _tel
+from .base import getenv
+from .engine import get_engine
+from .executor import zero_cotangent
+
+__all__ = ["enabled", "make_fused_step", "FusedTrainStep"]
+
+
+def enabled() -> bool:
+    """MXNET_TPU_FUSED_STEP=1 requests the fused path (default off)."""
+    return bool(getenv("MXNET_TPU_FUSED_STEP", False))
+
+
+def make_fused_step(module, eval_metric):
+    """Build a :class:`FusedTrainStep` for a bound, optimizer-initialized
+    Module, or None when any precondition fails (fit() then runs the
+    classic forward_backward/update/update_metric loop)."""
+    if not enabled():
+        return None
+    if not module.optimizer_initialized or module._update_on_kvstore:
+        return None
+    # inline-dispatch engines only: the write-back closure assigns
+    # executor/metric state the fit loop reads right back; a threaded
+    # engine would run it on a worker while the loop races ahead
+    from .engine import NaiveEngine, XLAEngine
+
+    if type(get_engine()) not in (XLAEngine, NaiveEngine):
+        return None
+    kv = module._kvstore
+    if kv is not None and not getattr(kv, "fused_step_compatible", False):
+        return None
+    if module.inputs_need_grad:
+        return None
+    ex = module._exec_group.executor
+    if ex._monitor_callback is not None:
+        return None
+    # grad_req "add" accumulates across batches in the grad arrays; the
+    # fused step never materializes per-param grads, so it can't honor it
+    if any(ex._grad_req[ex.arg_names[i]] != "write" for i in ex._grad_idx):
+        return None
+    opt = module._optimizer
+    if not opt._fusable() or not getenv("MXNET_TPU_FUSED_UPDATE", True):
+        return None
+    # every grad-bearing arg must map onto an updater slot
+    param_idx = {n: i for i, n in enumerate(module._param_names)}
+    if any(ex.arg_names[i] not in param_idx for i in ex._grad_idx):
+        return None
+    return FusedTrainStep(module, eval_metric)
+
+
+class FusedTrainStep:
+    """One-dispatch training step bound to a Module's executor group.
+
+    Host work per batch is only what CANNOT trace: ``load_data_batch``
+    (H2D), the optimizer's per-step plan (update counts, lr schedule —
+    plans must not read the gradient, which never exists host-side
+    here), and the engine push of the write-back closure.
+    """
+
+    def __init__(self, module, eval_metric):
+        self._module = module
+        self._group = module._exec_group
+        self._executor = ex = self._group.executor
+        self._optimizer = module._optimizer
+        self._updater = module._updater
+
+        param_idx = {n: i for i, n in enumerate(module._param_names)}
+        self._p_arg_idx = list(ex._grad_idx)
+        in_p = set(self._p_arg_idx)
+        self._o_arg_idx = [i for i in range(len(ex.arg_names))
+                           if i not in in_p]
+        self._p_upd_idx = [param_idx[ex.arg_names[i]]
+                           for i in self._p_arg_idx]
+
+        # label positions within the non-donated arg pack, for the fold
+        o_pos = {arg_i: pos for pos, arg_i in enumerate(self._o_arg_idx)}
+        arg_pos = {n: i for i, n in enumerate(ex.arg_names)}
+        self._label_o_pos = [o_pos[arg_pos[d.name]]
+                             for d in self._group.label_shapes
+                             if d.name in arg_pos]
+        self._fold_leaves = self._foldable_leaves(eval_metric)
+
+        # optimizer states must exist before the first trace
+        for upd_i, arg_i in zip(self._p_upd_idx, self._p_arg_idx):
+            if upd_i not in self._updater.states:
+                self._updater.states[upd_i] = \
+                    self._optimizer.create_state(upd_i,
+                                                 ex.arg_arrays[arg_i])
+
+        self._jit_cache = {}
+        self._seen_sigs = set()
+
+    def _foldable_leaves(self, eval_metric):
+        """The metric's leaves when EVERY one can fold on device (and a
+        label exists per output); None -> metric updates host-side from
+        the step's outputs (still one dispatch for fwd+bwd+update)."""
+        from . import metric as _metric
+
+        leaves = (list(eval_metric.metrics)
+                  if isinstance(eval_metric, _metric.CompositeEvalMetric)
+                  else [eval_metric])
+        if not leaves or not self._label_o_pos:
+            return None
+        if len(self._label_o_pos) != len(self._executor.output_names):
+            return None
+        if not all(lf.has_device_fold and lf.num is None for lf in leaves):
+            return None
+        return leaves
+
+    # ------------------------------------------------------------------
+    def step(self, data_batch, eval_metric):
+        """Run one training batch as one XLA dispatch."""
+        import jax.numpy as jnp
+
+        ex = self._executor
+        self._group.load_data_batch(data_batch)
+
+        opt = self._optimizer
+        states = self._updater.states
+        clip = opt.clip_gradient
+        rescale = opt.rescale_grad
+        # host-side per-step plans (update counts, lr schedule); grouped
+        # by (kind, n_states) exactly like Optimizer.update_multi
+        groups = {}
+        for pos, upd_i in zip(range(len(self._p_arg_idx)),
+                              self._p_upd_idx):
+            w = ex.arg_arrays[self._p_arg_idx[pos]]
+            kind, st, scalars = opt._plan(upd_i, w, w, states[upd_i])
+            full = (rescale,) + tuple(scalars) \
+                + ((clip,) if clip is not None else ())
+            groups.setdefault((kind, len(st)), []).append(
+                (pos, tuple(st), full))
+        specs = []
+        state_nds = []
+        sv_mats = []
+        for (kind, n_states), members in groups.items():
+            specs.append((kind, n_states, tuple(m[0] for m in members)))
+            state_nds.append(tuple(m[1] for m in members))
+            sv_mats.append(jnp.asarray([m[2] for m in members],
+                                       jnp.float32))
+        specs = tuple(specs)
+
+        from .optimizer import _donation_ok
+
+        donate = _donation_ok()
+        fold = self._fold_leaves is not None
+        ck = (specs, clip is not None, donate, fold)
+        fn = self._jit_cache.get(ck)
+        if fn is None:
+            fn = self._build(specs, clip is not None, donate, fold)
+            self._jit_cache[ck] = fn
+
+        key = ex._key()
+        ex._last_key = key
+        p_nds = [ex.arg_arrays[i] for i in self._p_arg_idx]
+        o_nds = [ex.arg_arrays[i] for i in self._o_arg_idx]
+        p_vals = [nd._data for nd in p_nds]
+        o_vals = [nd._data for nd in o_nds]
+        aux_vals = [a._data for a in ex.aux_arrays]
+        st_vals = tuple(
+            tuple(tuple(s._data for s in member) for member in grp)
+            for grp in state_nds)
+        leaves = self._fold_leaves if fold else ()
+        accs = []
+        for leaf in leaves:
+            acc = leaf._device_acc
+            if acc is None:
+                # placed to match the (possibly mesh-sharded) params so
+                # the jit sees one consistent device set; two distinct
+                # buffers because the acc pack is donated
+                from .metric import _replicated_zero
+
+                like = p_vals[0] if p_vals else None
+                acc = (_replicated_zero(like), _replicated_zero(like))
+            accs.append(tuple(acc))
+        accs = tuple(accs)
+
+        # a fresh (shape, dtype, spec) signature means jax retraces and
+        # XLA recompiles — in steady state that's the silent stall the
+        # RecompileDetector turns into an anomaly event
+        sig = ck + (tuple((v.shape, str(v.dtype))
+                          for v in p_vals + o_vals + aux_vals),)
+        if sig not in self._seen_sigs:
+            self._seen_sigs.add(sig)
+            _tel.inc("step.fused_recompiles")
+
+        module = self._module
+        mut = [nd._var for nd in p_nds] \
+            + [a._var for a in ex.aux_arrays] \
+            + [s._var for grp in state_nds for member in grp
+               for s in member]
+
+        def _do():
+            _tel.inc("step.dispatches")
+            new_p, outs, aux_out, new_st, new_accs = fn(
+                p_vals, o_vals, aux_vals, st_vals, sv_mats, accs, key)
+            for nd, v in zip(p_nds, new_p):
+                nd._data = v
+            for nd, v in zip(ex.aux_arrays, aux_out):
+                nd._data = v
+            for grp, new_grp in zip(state_nds, new_st):
+                for member, new_member in zip(grp, new_grp):
+                    for snd, sv in zip(member, new_member):
+                        snd._data = sv
+            for leaf, acc in zip(leaves, new_accs):
+                leaf._device_acc = acc
+            ex._set_outputs(outs)
+            ex._train_pending = False
+            return list(new_p)
+
+        get_engine().push(_do, const_vars=[nd._var for nd in o_nds],
+                          mutable_vars=mut, prop="fused_step")
+        module._params_dirty = True
+        _tel.inc("step.fused_steps")
+        if not fold:
+            # unsupported metric: update host-side from the fused step's
+            # outputs — still one dispatch for fwd+bwd+update
+            eval_metric.update(data_batch.label, ex.outputs)
+
+    # ------------------------------------------------------------------
+    def _build(self, specs, clipped, donate, fold):
+        """Trace+compile the whole-batch step for one (structure,
+        donation, fold) configuration."""
+        import jax
+        import jax.numpy as jnp
+
+        from .optimizer import _update_math
+
+        ex = self._executor
+        run_graph = ex._run_graph
+        n_args = len(ex.arg_names)
+        p_idx = list(self._p_arg_idx)
+        o_idx = list(self._o_arg_idx)
+        label_pos = list(self._label_o_pos)
+        leaves = self._fold_leaves or ()
+        math_fns = {(kind, n): _update_math(kind, n, clipped)
+                    for kind, n, _ in specs}
+
+        _tel.inc("executor.jit_build")
+
+        @functools.partial(jax.jit,
+                           donate_argnums=(0, 2, 3, 5) if donate else ())
+        def step(p_vals, o_vals, aux, st, sv_mats, accs, key):
+            full = [None] * n_args
+            for pos, i in enumerate(o_idx):
+                full[i] = o_vals[pos]
+
+            def f(pv):
+                fl = list(full)
+                for pos, i in enumerate(p_idx):
+                    fl[i] = pv[pos]
+                return run_graph(fl, aux, key, True)
+
+            res, vjp = jax.vjp(f, list(p_vals))
+            outs, aux_out = res
+            heads = [jnp.ones_like(o)
+                     if jnp.issubdtype(o.dtype, jnp.inexact)
+                     else zero_cotangent(o) for o in outs]
+            cts = (heads, jax.tree_util.tree_map(zero_cotangent, aux_out))
+            grads, = vjp(cts)
+            new_p = list(p_vals)
+            new_st = []
+            for gi, (kind, n_states, positions) in enumerate(specs):
+                math_fn = math_fns[(kind, n_states)]
+                grp = []
+                for j, pos in enumerate(positions):
+                    nw, ns = math_fn(new_p[pos], grads[pos], st[gi][j],
+                                     sv_mats[gi][j])
+                    new_p[pos] = nw
+                    grp.append(ns)
+                new_st.append(tuple(grp))
+            new_accs = accs
+            if fold:
+                labels = [o_vals[p] for p in label_pos]
+                new_accs = []
+                for leaf, (s, c) in zip(leaves, accs):
+                    for lab, pred in zip(labels, outs):
+                        ds, dc = leaf.device_fold(lab, pred)
+                        s = s + ds
+                        c = c + dc
+                    new_accs.append((s, c))
+                new_accs = tuple(new_accs)
+            return (tuple(new_p), outs, aux_out, tuple(new_st), new_accs)
+
+        return step

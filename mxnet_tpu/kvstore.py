@@ -78,6 +78,16 @@ class KVStore:
         return self._type
 
     @property
+    def fused_step_compatible(self) -> bool:
+        """True when the fused train step (MXNET_TPU_FUSED_STEP=1) may
+        subsume this store's gradient aggregation: local/device stores
+        and ``tpu_sync`` reduce inside the jitted step (GSPMD), so no
+        explicit push/pull round remains. ``dist_*`` stores move bytes
+        through a server between backward and update — they must keep
+        the unfused three-phase loop."""
+        return "dist" not in self._type
+
+    @property
     def rank(self) -> int:
         try:
             import jax

@@ -1,10 +1,21 @@
-"""Evaluation metrics (reference ``python/mxnet/metric.py``)."""
+"""Evaluation metrics (reference ``python/mxnet/metric.py``).
+
+Device-side accumulation: metrics whose math is expressible as a pure
+per-batch fold (``has_device_fold``) keep a running ``(sum, count)``
+pair ON DEVICE and only fetch it to the host in :meth:`EvalMetric.get`
+(Speedometer / epoch-report cadence). The reference synced every batch:
+each ``update`` called ``asnumpy``, serializing the dispatch queue. Here
+``update`` dispatches one small async fold instead, and the fused train
+step (:mod:`mxnet_tpu.fused_step`) folds the same math INTO the training
+computation so a batch costs zero extra dispatches.
+"""
 from __future__ import annotations
 
 from typing import Callable, List, Optional, Sequence, Union
 
 import numpy as np
 
+from . import telemetry as _tel
 from .base import MXNetError, Registry
 from .ndarray import NDArray
 
@@ -14,6 +25,49 @@ __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MAE", "MSE",
 
 _REG: Registry = Registry.get_registry("metric")
 
+# jitted device folds shared across metric instances, keyed by
+# (_fold_cache_key(), n_pairs): metrics are constructed per fit()/score()
+# call, and a per-instance jit would recompile the same tiny fold for
+# every one of them
+_FOLD_FNS: dict = {}
+
+
+def _replicated_zero(like):
+    """A zero f32 scalar placed compatibly with ``like``: replicated over
+    ``like``'s device set so a jit mixing the accumulator with sharded
+    batch outputs (multi-device executor) sees one consistent mesh."""
+    import jax
+    import jax.numpy as jnp
+
+    z = jnp.zeros((), jnp.float32)
+    sharding = getattr(like, "sharding", None)
+    if sharding is None:
+        return z
+    try:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if isinstance(sharding, NamedSharding):
+            return jax.device_put(
+                z, NamedSharding(sharding.mesh, PartitionSpec()))
+        devs = list(sharding.device_set)
+        if len(devs) == 1:
+            return jax.device_put(z, devs[0])
+    except Exception:
+        pass
+    return z
+
+
+def _device_ids(x):
+    """frozenset of device ids ``x`` is committed to, or None when it
+    carries no sharding (uncommitted / not a jax array)."""
+    sharding = getattr(x, "sharding", None)
+    if sharding is None:
+        return None
+    try:
+        return frozenset(d.id for d in sharding.device_set)
+    except Exception:
+        return None
+
 
 def check_label_shapes(labels, preds):
     if len(labels) != len(preds):
@@ -22,6 +76,11 @@ def check_label_shapes(labels, preds):
 
 
 class EvalMetric:
+    # True on subclasses that implement device_fold; such metrics keep a
+    # cumulative (sum, count) pair on device (self._device_acc) and read
+    # it back only in get()
+    has_device_fold = False
+
     def __init__(self, name: str, num: Optional[int] = None):
         self.name = name
         self.num = num
@@ -34,13 +93,89 @@ class EvalMetric:
         else:
             self.num_inst = [0] * self.num
             self.sum_metric = [0.0] * self.num
+        self._device_acc = None
+        self._fold_fn = None
+
+    def device_fold(self, label, pred):
+        """Pure jnp fold of ONE (label, pred) pair into ``(sum_delta,
+        count_delta)`` f32 scalars — the jit-friendly form of this
+        metric's update math. Traceable inside the fused train step."""
+        raise NotImplementedError
+
+    def _fold_cache_key(self):
+        """Key under which this metric's jitted fold may be shared with
+        other instances; subclasses whose device_fold reads instance
+        config (top_k, eps, ...) must extend it."""
+        return (type(self),)
+
+    def _lazy_update(self, labels, preds) -> bool:
+        """Accumulate this batch on device without any host sync; True
+        when handled (the numpy path must then be skipped). Only for
+        scalar (num is None) metrics with a device fold over NDArray
+        inputs — anything else falls through to the eager path."""
+        if not self.has_device_fold or self.num is not None:
+            return False
+        labels, preds = list(labels), list(preds)
+        if not labels or len(labels) != len(preds):
+            return False
+        if not all(isinstance(a, NDArray) for a in labels + preds):
+            return False
+        # one jit needs one consistent device set: a multi-device
+        # executor shards preds over the mesh while labels sit on one
+        # device — that batch takes the eager numpy path instead
+        # (get() still folds in whatever the accumulator already holds)
+        sets = {_device_ids(a._data) for a in labels + preds}
+        sets.discard(None)
+        if len(sets) > 1:
+            return False
+        if self._device_acc is not None and sets \
+                and _device_ids(self._device_acc[0]) not in (
+                    None, next(iter(sets))):
+            return False
+        import jax
+
+        if self._fold_fn is None:
+            key = self._fold_cache_key()
+            fn = _FOLD_FNS.get(key)
+            if fn is None:
+                fold = self.device_fold
+
+                def accum(acc, labs, ps):
+                    s, c = acc
+                    for lab, p in zip(labs, ps):
+                        ds, dc = fold(lab, p)
+                        s = s + ds
+                        c = c + dc
+                    return s, c
+
+                _FOLD_FNS[key] = fn = jax.jit(accum)
+            self._fold_fn = fn
+        acc = self._device_acc
+        if acc is None:
+            z = _replicated_zero(preds[0]._data)
+            acc = (z, z)
+        _tel.inc("step.dispatches")
+        self._device_acc = self._fold_fn(
+            acc, [a._data for a in labels], [p._data for p in preds])
+        return True
+
+    def _host_totals(self):
+        """(sum, count) with the device accumulator folded in — the ONLY
+        place the accumulator syncs to the host."""
+        s, n = self.sum_metric, self.num_inst
+        if self._device_acc is not None:
+            acc_s, acc_c = self._device_acc
+            s = s + float(acc_s)
+            n = n + float(acc_c)
+        return s, n
 
     def update(self, labels: Sequence[NDArray], preds: Sequence[NDArray]):
         raise NotImplementedError
 
     def get(self):
         if self.num is None:
-            value = self.sum_metric / self.num_inst if self.num_inst else float("nan")
+            s, n = self._host_totals()
+            value = s / n if n else float("nan")
             return self.name, value
         names = ["%s_%d" % (self.name, i) for i in range(self.num)]
         values = [s / n if n else float("nan")
@@ -57,11 +192,23 @@ class EvalMetric:
 @_REG.register("acc")
 @_REG.register("accuracy")
 class Accuracy(EvalMetric):
+    has_device_fold = True
+
     def __init__(self):
         super().__init__("accuracy")
 
+    def device_fold(self, label, pred):
+        import jax.numpy as jnp
+
+        lab = label.astype(jnp.int32).ravel()
+        pl = jnp.argmax(pred, axis=1) if pred.ndim > 1 else pred
+        hits = (pl.astype(jnp.int32).ravel() == lab).sum()
+        return hits.astype(jnp.float32), jnp.float32(lab.size)
+
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
+        if self._lazy_update(labels, preds):
+            return
         for label, pred in zip(labels, preds):
             p = pred.asnumpy()
             pred_label = np.argmax(p, axis=1) if p.ndim > 1 else p
@@ -78,8 +225,23 @@ class TopKAccuracy(EvalMetric):
         if self.top_k <= 1:
             raise MXNetError("top_k should be >1; use Accuracy otherwise")
 
+    has_device_fold = True
+
+    def _fold_cache_key(self):
+        return (type(self), self.top_k)
+
+    def device_fold(self, label, pred):
+        import jax.numpy as jnp
+
+        lab = label.astype(jnp.int32).ravel()
+        topk = jnp.argsort(pred, axis=1)[:, -self.top_k:]
+        hits = (topk == lab[:, None]).any(axis=1).sum()
+        return hits.astype(jnp.float32), jnp.float32(lab.size)
+
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
+        if self._lazy_update(labels, preds):
+            return
         for label, pred in zip(labels, preds):
             p = pred.asnumpy().astype(np.float32)
             lab = label.asnumpy().astype(np.int32)
@@ -116,11 +278,21 @@ class F1(EvalMetric):
 
 @_REG.register("mae")
 class MAE(EvalMetric):
+    has_device_fold = True
+
     def __init__(self):
         super().__init__("mae")
 
+    def device_fold(self, label, pred):
+        import jax.numpy as jnp
+
+        err = jnp.abs(label - pred.reshape(label.shape)).mean()
+        return err.astype(jnp.float32), jnp.float32(1.0)
+
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
+        if self._lazy_update(labels, preds):
+            return
         for label, pred in zip(labels, preds):
             l_np = label.asnumpy()
             p_np = pred.asnumpy().reshape(l_np.shape)
@@ -130,11 +302,21 @@ class MAE(EvalMetric):
 
 @_REG.register("mse")
 class MSE(EvalMetric):
+    has_device_fold = True
+
     def __init__(self):
         super().__init__("mse")
 
+    def device_fold(self, label, pred):
+        import jax.numpy as jnp
+
+        err = ((label - pred.reshape(label.shape)) ** 2).mean()
+        return err.astype(jnp.float32), jnp.float32(1.0)
+
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
+        if self._lazy_update(labels, preds):
+            return
         for label, pred in zip(labels, preds):
             l_np = label.asnumpy()
             p_np = pred.asnumpy().reshape(l_np.shape)
@@ -144,11 +326,21 @@ class MSE(EvalMetric):
 
 @_REG.register("rmse")
 class RMSE(EvalMetric):
+    has_device_fold = True
+
     def __init__(self):
         super().__init__("rmse")
 
+    def device_fold(self, label, pred):
+        import jax.numpy as jnp
+
+        err = jnp.sqrt(((label - pred.reshape(label.shape)) ** 2).mean())
+        return err.astype(jnp.float32), jnp.float32(1.0)
+
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
+        if self._lazy_update(labels, preds):
+            return
         for label, pred in zip(labels, preds):
             l_np = label.asnumpy()
             p_np = pred.asnumpy().reshape(l_np.shape)
@@ -159,12 +351,27 @@ class RMSE(EvalMetric):
 @_REG.register("ce")
 @_REG.register("cross-entropy")
 class CrossEntropy(EvalMetric):
+    has_device_fold = True
+
     def __init__(self, eps: float = 1e-8):
         super().__init__("cross-entropy")
         self.eps = eps
 
+    def _fold_cache_key(self):
+        return (type(self), self.eps)
+
+    def device_fold(self, label, pred):
+        import jax.numpy as jnp
+
+        lab = label.astype(jnp.int32).ravel()
+        prob = jnp.take_along_axis(pred, lab[:, None], axis=1)[:, 0]
+        loss = (-jnp.log(prob + self.eps)).sum()
+        return loss.astype(jnp.float32), jnp.float32(lab.size)
+
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
+        if self._lazy_update(labels, preds):
+            return
         for label, pred in zip(labels, preds):
             lab = label.asnumpy().astype(np.int32).ravel()
             p = pred.asnumpy()

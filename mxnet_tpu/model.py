@@ -130,6 +130,16 @@ class FeedForward:
             else:
                 raise TypeError("eval_data must be DataIter or (X, y)")
         mod = self._make_module(data)
+        # the fused train step (MXNET_TPU_FUSED_STEP=1) flows through
+        # Module.fit below; surface the request here so FeedForward
+        # scripts see in their own log which path the run took
+        from . import fused_step as _fused_step
+
+        if _fused_step.enabled():
+            (logger or logging).info(
+                "MXNET_TPU_FUSED_STEP=1: Module.fit will fuse "
+                "fwd+bwd+update into one dispatch where the "
+                "optimizer/kvstore path allows")
         optimizer = self.optimizer
         optimizer_params = dict(self.kwargs)
         mod.fit(data, eval_data=eval_data, eval_metric=eval_metric,

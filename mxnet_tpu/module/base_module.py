@@ -222,6 +222,12 @@ class BaseModule:
         # single flag check when telemetry is off
         _tracing.maybe_init()
 
+        # MXNET_TPU_FUSED_STEP=1: fwd+bwd+update(+metric fold) compiled
+        # into ONE donated XLA dispatch per batch; None falls back to
+        # the classic three-phase loop (dist kvstores, custom-update
+        # optimizers, monitors, grad_req="add")
+        fused = self._fused_train_step(eval_metric)
+
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -234,9 +240,12 @@ class BaseModule:
             for nbatch, data_batch in enumerate(train_data):
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                self.update_metric(eval_metric, data_batch.label)
+                if fused is not None:
+                    fused.step(data_batch, eval_metric)
+                else:
+                    self.forward_backward(data_batch)
+                    self.update()
+                    self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
                 if _tel.enabled():
@@ -276,6 +285,13 @@ class BaseModule:
                 for name, val in res:
                     self.logger.info("Epoch[%d] Validation-%s=%f",
                                      epoch, name, val)
+
+    def _fused_train_step(self, eval_metric):
+        """Hook: an object with ``.step(data_batch, eval_metric)`` that
+        runs one batch as a single fused dispatch, or None to use the
+        classic forward_backward/update/update_metric loop. Module
+        overrides this; the base has no fused path."""
+        return None
 
     def install_monitor(self, mon):
         raise NotImplementedError

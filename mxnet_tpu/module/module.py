@@ -245,6 +245,21 @@ class Module(BaseModule):
     def update_metric(self, eval_metric, labels):
         self._exec_group.update_metric(eval_metric, labels)
 
+    def _fused_train_step(self, eval_metric):
+        """One-dispatch-per-batch training step (MXNET_TPU_FUSED_STEP=1)
+        or None when the configuration can't fuse — see
+        :func:`mxnet_tpu.fused_step.make_fused_step` for the gates."""
+        from ..fused_step import make_fused_step
+
+        fused = make_fused_step(self, eval_metric)
+        self._fused_step_active = fused is not None
+        if fused is not None:
+            self.logger.info(
+                "fused train step active: forward+backward+update%s "
+                "compiled into one donated XLA dispatch per batch",
+                "+metric" if fused._fold_leaves is not None else "")
+        return fused
+
     def get_outputs(self, merge_multi_context=True):
         return self._exec_group.get_outputs()
 

@@ -13,6 +13,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from . import telemetry as _tel
 from .base import MXNetError, Registry
 from .ndarray import NDArray, zeros
 from .lr_scheduler import LRScheduler
@@ -304,6 +305,7 @@ class Optimizer:
 
         for (kind, n_states), members in groups.items():
             def _do(kind=kind, n_states=n_states, members=members):
+                _tel.inc("step.dispatches")
                 new_ws, new_ss = _apply_update_multi(
                     kind, n_states, clip is not None,
                     tuple(m[0]._data for m in members),
@@ -356,6 +358,7 @@ class Optimizer:
         state_nds = tuple(state_nds)
 
         def _do():
+            _tel.inc("step.dispatches")
             new_w, new_s = _apply_update(
                 kind, weight._data, grad._data,
                 tuple(s._data for s in state_nds),

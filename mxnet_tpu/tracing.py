@@ -72,6 +72,8 @@ DELTA_SOURCES = (
     ("kv_pull_bytes", "kvstore.pull_bytes", "counter"),
     ("decode_cache_hits", "io.decode_cache_hit", "counter"),
     ("recompiles", "executor.jit_build", "counter"),
+    ("dispatches", "step.dispatches", "counter"),
+    ("fused_recompiles", "step.fused_recompiles", "counter"),
 )
 
 _STALL_FIELDS = ("io_stall_ms", "prefetch_stall_ms")
@@ -119,9 +121,15 @@ class RecompileDetector:
 
     def check(self, rec: dict) -> Optional[dict]:
         n = rec["deltas"].get("recompiles", 0)
-        if rec["step"] > self.warmup and n > 0:
-            return {"type": self.type, "recompiles": n,
-                    "latency_ms": round(rec["latency_ms"], 3)}
+        nf = rec["deltas"].get("fused_recompiles", 0)
+        if rec["step"] > self.warmup and (n > 0 or nf > 0):
+            ev = {"type": self.type, "recompiles": n,
+                  "latency_ms": round(rec["latency_ms"], 3)}
+            if nf:
+                # a fused-step retrace past warmup: some batch shape or
+                # optimizer structure drifted mid-run (recompile storm)
+                ev["fused_recompiles"] = nf
+            return ev
         return None
 
 
@@ -280,7 +288,8 @@ class StepTrace:
         """Label the step with what it spent its time on: a recompile
         trumps everything (it IS the latency), then whichever stall
         source claims >25% of the wall time; otherwise compute."""
-        if deltas.get("recompiles", 0) > 0:
+        if deltas.get("recompiles", 0) > 0 \
+                or deltas.get("fused_recompiles", 0) > 0:
             return "recompile"
         stalls = [(deltas.get(f, 0.0), f) for f in _STALL_FIELDS]
         worst, field = max(stalls)

@@ -1,0 +1,275 @@
+"""Fused train step (MXNET_TPU_FUSED_STEP=1): gating, numerical parity
+with the classic loop, donation safety, dispatch/recompile telemetry,
+engine sync semantics, and lazy metric accumulation."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine as eng_mod
+from mxnet_tpu import symbol as sym
+from mxnet_tpu import telemetry
+from mxnet_tpu.fused_step import make_fused_step
+from mxnet_tpu.module import Module
+
+BATCH = 8
+DIM = 6
+CLASSES = 3
+
+
+def _mlp_sym():
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=CLASSES, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _synthetic(n, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, DIM).astype(np.float32)
+    w = rng.randn(DIM, CLASSES)
+    y = X.dot(w).argmax(axis=1).astype(np.float32)
+    return X, y
+
+
+def _seed_params(net, seed=3):
+    """Deterministic initial params so two fits start bit-identical."""
+    arg_shapes, _, _ = net.infer_shape(data=(BATCH, DIM),
+                                       softmax_label=(BATCH,))
+    rng = np.random.RandomState(seed)
+    return {name: mx.nd.array((rng.randn(*shape) * 0.1).astype(np.float32))
+            for name, shape in zip(net.list_arguments(), arg_shapes)
+            if name not in ("data", "softmax_label")}
+
+
+def _fit(nbatches, num_epoch=1, fused=False, monkeypatch=None,
+         optimizer_params=None):
+    if fused:
+        monkeypatch.setenv("MXNET_TPU_FUSED_STEP", "1")
+    else:
+        monkeypatch.delenv("MXNET_TPU_FUSED_STEP", raising=False)
+    net = _mlp_sym()
+    X, y = _synthetic(BATCH * nbatches)
+    data = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    mod = Module(net, context=mx.cpu())
+    mod.fit(data, num_epoch=num_epoch, optimizer="sgd",
+            arg_params=_seed_params(net), initializer=None,
+            optimizer_params=optimizer_params
+            or {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4})
+    assert mod._fused_step_active == fused
+    return mod
+
+
+@pytest.fixture
+def tel():
+    telemetry.reset()
+    telemetry.enable()
+    yield telemetry
+    telemetry.reset()
+    telemetry.disable()
+
+
+def test_fused_step_off_by_default(monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_FUSED_STEP", raising=False)
+    net = _mlp_sym()
+    X, y = _synthetic(BATCH * 2)
+    data = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    mod = Module(net, context=mx.cpu())
+    mod.bind(data.provide_data, data.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    metric = mx.metric.create("acc")
+    assert make_fused_step(mod, metric) is None
+    monkeypatch.setenv("MXNET_TPU_FUSED_STEP", "1")
+    assert make_fused_step(mod, metric) is not None
+
+
+def test_fused_gate_rejects_custom_update_optimizer(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_FUSED_STEP", "1")
+    net = _mlp_sym()
+    X, y = _synthetic(BATCH * 2)
+    data = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    mod = Module(net, context=mx.cpu())
+    mod.bind(data.provide_data, data.provide_label)
+    mod.init_params()
+    # "test" overrides update() with eager python math — no traced plan
+    mod.init_optimizer(optimizer="test")
+    assert make_fused_step(mod, mx.metric.create("acc")) is None
+
+
+def test_fused_unfused_parity(monkeypatch):
+    """Parameter trajectories must be bit-identical after >= 10 batches
+    of momentum SGD (same init, same data, same lr schedule)."""
+    mod_a = _fit(nbatches=5, num_epoch=2, fused=False,
+                 monkeypatch=monkeypatch)
+    mod_b = _fit(nbatches=5, num_epoch=2, fused=True,
+                 monkeypatch=monkeypatch)
+    args_a, _ = mod_a.get_params()
+    args_b, _ = mod_b.get_params()
+    assert set(args_a) == set(args_b)
+    for name in args_a:
+        a, b = args_a[name].asnumpy(), args_b[name].asnumpy()
+        assert np.array_equal(a, b), \
+            "param %s diverged: max |d|=%g" % (name, np.abs(a - b).max())
+
+
+def test_fused_parity_with_clip_and_scheduler(monkeypatch):
+    """Clipping and a per-step lr schedule must not recompile or change
+    numerics vs the classic loop."""
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    def params():
+        return {"learning_rate": 0.05, "momentum": 0.9,
+                "clip_gradient": 0.5,
+                "lr_scheduler": FactorScheduler(step=3, factor=0.5)}
+
+    mod_a = _fit(nbatches=10, fused=False, monkeypatch=monkeypatch,
+                 optimizer_params=params())
+    mod_b = _fit(nbatches=10, fused=True, monkeypatch=monkeypatch,
+                 optimizer_params=params())
+    args_a, _ = mod_a.get_params()
+    args_b, _ = mod_b.get_params()
+    for name in args_a:
+        assert np.array_equal(args_a[name].asnumpy(),
+                              args_b[name].asnumpy()), name
+
+
+def test_fused_one_dispatch_per_batch(tel, monkeypatch):
+    """The acceptance criterion: with MXNET_TPU_FUSED_STEP=1 one batch
+    issues exactly ONE XLA computation for fwd+bwd+update(+metric)."""
+    nbatches = 4
+    before = telemetry.peek("step.dispatches") or 0
+    _fit(nbatches=nbatches, fused=True, monkeypatch=monkeypatch)
+    fused_delta = (telemetry.peek("step.dispatches") or 0) - before
+    assert fused_delta == nbatches
+
+    before = telemetry.peek("step.dispatches") or 0
+    _fit(nbatches=nbatches, fused=False, monkeypatch=monkeypatch)
+    unfused_delta = (telemetry.peek("step.dispatches") or 0) - before
+    # classic loop: fwd+bwd, one optimizer group kernel, one metric fold
+    assert unfused_delta >= 3 * nbatches
+
+
+def test_fused_no_retrace_on_same_shapes(tel, monkeypatch):
+    """Second and later same-shape batches must reuse the compiled step:
+    exactly one fresh trace signature for the whole epoch."""
+    before = telemetry.peek("step.fused_recompiles") or 0
+    _fit(nbatches=4, fused=True, monkeypatch=monkeypatch)
+    assert (telemetry.peek("step.fused_recompiles") or 0) - before == 1
+
+
+def test_fused_step_donation_safety(monkeypatch):
+    """The batch's data/label buffers ride in the NON-donated arg pack:
+    they must stay readable (and unchanged) after donating steps."""
+    monkeypatch.setenv("MXNET_TPU_FUSED_STEP", "1")
+    net = _mlp_sym()
+    X, y = _synthetic(BATCH)
+    data = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    mod = Module(net, context=mx.cpu())
+    mod.bind(data.provide_data, data.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    metric = mx.metric.create("acc")
+    fused = mod._fused_train_step(metric)
+    assert fused is not None
+    batch = next(iter(data))
+    before = batch.data[0].asnumpy().copy()
+    fused.step(batch, metric)
+    fused.step(batch, metric)  # same buffers through a second donation
+    np.testing.assert_array_equal(batch.data[0].asnumpy(), before)
+    batch.label[0].asnumpy()  # label buffer alive too
+
+
+def test_naive_engine_skips_block_for_fused_step(monkeypatch):
+    class _Ret:
+        calls = 0
+
+        def block_until_ready(self):
+            self.calls += 1
+
+    monkeypatch.delenv("MXNET_TPU_ENGINE_SYNC", raising=False)
+    e = eng_mod.NaiveEngine()
+    r = _Ret()
+    e.push(lambda: r, prop="fused_step")
+    assert r.calls == 0  # donated outputs: no serializing block
+    e.push(lambda: r)
+    assert r.calls == 1  # default prop still blocks
+    monkeypatch.setenv("MXNET_TPU_ENGINE_SYNC", "1")
+    e.push(lambda: r, prop="fused_step")
+    assert r.calls == 2  # debug switch restores blocking
+
+
+def test_metric_lazy_device_accumulation():
+    """Accuracy.update over NDArrays must not sync to host; get() is the
+    only fetch point and matches the numpy computation."""
+    rng = np.random.RandomState(11)
+    lab_np = rng.randint(0, CLASSES, (BATCH,)).astype(np.float32)
+    pred_np = rng.rand(BATCH, CLASSES).astype(np.float32)
+    m = mx.metric.create("acc")
+    m.update([mx.nd.array(lab_np)], [mx.nd.array(pred_np)])
+    assert m.sum_metric == 0.0 and m.num_inst == 0  # host untouched
+    assert m._device_acc is not None
+    m.update([mx.nd.array(lab_np)], [mx.nd.array(pred_np)])
+    _, val = m.get()
+    expected = float((pred_np.argmax(axis=1) == lab_np).mean())
+    assert val == pytest.approx(expected)
+    m.reset()
+    assert m._device_acc is None
+    assert np.isnan(m.get()[1])
+
+
+def test_metric_device_folds_match_numpy():
+    """Every has_device_fold metric's fold must agree with its own
+    eager numpy update path."""
+    rng = np.random.RandomState(5)
+    cls_lab = rng.randint(0, CLASSES, (BATCH,)).astype(np.float32)
+    cls_pred = rng.rand(BATCH, CLASSES).astype(np.float32)
+    cls_pred /= cls_pred.sum(axis=1, keepdims=True)
+    reg_lab = rng.randn(BATCH).astype(np.float32)
+    reg_pred = rng.randn(BATCH, 1).astype(np.float32)
+    cases = [(mx.metric.Accuracy(), cls_lab, cls_pred),
+             (mx.metric.CrossEntropy(), cls_lab, cls_pred),
+             (mx.metric.TopKAccuracy(top_k=2), cls_lab, cls_pred),
+             (mx.metric.MSE(), reg_lab, reg_pred),
+             (mx.metric.MAE(), reg_lab, reg_pred),
+             (mx.metric.RMSE(), reg_lab, reg_pred)]
+    for lazy, lab_np, pred_np in cases:
+        eager = type(lazy)(top_k=lazy.top_k) \
+            if isinstance(lazy, mx.metric.TopKAccuracy) else type(lazy)()
+        # instance attr shadows the class flag -> eager numpy path
+        eager.has_device_fold = False
+        lazy.update([mx.nd.array(lab_np)], [mx.nd.array(pred_np)])
+        eager.update([mx.nd.array(lab_np)], [mx.nd.array(pred_np)])
+        assert lazy._device_acc is not None
+        assert eager._device_acc is None
+        assert lazy.get()[1] == pytest.approx(eager.get()[1], rel=1e-5), \
+            type(lazy).__name__
+
+
+def test_fused_metric_matches_host_metric(monkeypatch):
+    """The in-step metric fold must produce the same epoch accuracy as
+    the classic host-side update."""
+    mod_a = _fit(nbatches=6, fused=False, monkeypatch=monkeypatch)
+    mod_b = _fit(nbatches=6, fused=True, monkeypatch=monkeypatch)
+    X, y = _synthetic(BATCH * 6)
+    data = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    sa = mod_a.score(data, "acc")[0][1]
+    sb = mod_b.score(data, "acc")[0][1]
+    assert sa == pytest.approx(sb)
+
+
+def test_trace_report_shows_dispatch_columns():
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from trace_report import render
+
+    out = render([{"step": 1, "latency_ms": 10.0, "dominant": "compute",
+                   "deltas": {"dispatches": 1, "fused_recompiles": 1}}])
+    header = out.splitlines()[2]
+    assert "dispatch" in header and "fused_rc" in header

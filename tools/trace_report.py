@@ -19,7 +19,8 @@ import os
 import sys
 
 DELTA_COLS = ("io_stall_ms", "prefetch_stall_ms", "h2d_bytes",
-              "kv_push_bytes", "kv_pull_bytes", "recompiles")
+              "kv_push_bytes", "kv_pull_bytes", "recompiles",
+              "dispatches", "fused_recompiles")
 
 
 def load_records(path):
@@ -59,7 +60,8 @@ def render(records, top=10):
     slowest = sorted(records, key=lambda r: -r.get("latency_ms", 0.0))[:top]
     lats = sorted(r["latency_ms"] for r in records)
     header = ("step", "latency_ms", "dominant", "io_stall_ms",
-              "prefetch_ms", "h2d", "kv_push", "kv_pull", "recompiles")
+              "prefetch_ms", "h2d", "kv_push", "kv_pull", "recompiles",
+              "dispatch", "fused_rc")
     rows = [header]
     for r in slowest:
         d = r.get("deltas", {})
@@ -73,6 +75,8 @@ def render(records, top=10):
             _fmt_bytes(d.get("kv_push_bytes", 0)),
             _fmt_bytes(d.get("kv_pull_bytes", 0)),
             str(d.get("recompiles", 0)),
+            str(d.get("dispatches", 0)),
+            str(d.get("fused_recompiles", 0)),
         ))
     widths = [max(len(row[i]) for row in rows) for i in range(len(header))]
     out = ["%d steps, latency p50=%.2fms max=%.2fms; top %d slowest:"
