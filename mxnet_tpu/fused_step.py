@@ -124,6 +124,13 @@ class FusedTrainStep:
         self._label_o_pos = [o_pos[arg_pos[d.name]]
                              for d in self._group.label_shapes
                              if d.name in arg_pos]
+        # data positions, for the device-feed mode: a CachedImageRecordIter
+        # batch with ``batch.aug`` ships raw uint8 frames that ride these
+        # slots of the non-donated pack; cast+crop+mirror+normalize run
+        # inside the jit before the forward pass
+        self._data_o_pos = [o_pos[arg_pos[d.name]]
+                            for d in self._group.data_shapes
+                            if d.name in arg_pos]
         self._fold_leaves = self._foldable_leaves(eval_metric)
 
         # optimizer states must exist before the first trace
@@ -159,7 +166,21 @@ class FusedTrainStep:
         import jax.numpy as jnp
 
         ex = self._executor
-        self._group.load_data_batch(data_batch)
+        aug = getattr(data_batch, "aug", None)
+        if aug is not None and len(self._data_o_pos) != 1:
+            # in-graph augmentation is defined for the single image input
+            # the cached iterators produce; anything else materializes
+            from .io_cache import materialize_device_feed
+
+            data_batch = materialize_device_feed(data_batch)
+            aug = None
+        if aug is None:
+            self._group.load_data_batch(data_batch)
+        else:
+            # device feed: only the labels go through the normal loader;
+            # the raw uint8 frames bypass the executor's (float, cropped)
+            # data buffer and ride the non-donated pack directly
+            self._group.load_label_batch(data_batch)
 
         opt = self._optimizer
         states = self._updater.states
@@ -190,10 +211,22 @@ class FusedTrainStep:
 
         donate = _donation_ok()
         fold = self._fold_leaves is not None
-        ck = (specs, clip is not None, donate, fold)
+        feed = None
+        if aug is not None:
+            # static augmentation config; the per-batch offsets/flags and
+            # mean/scale are traced arguments, so a new batch (or an lr-
+            # style mean/scale change) never recompiles
+            d0 = self._group.data_shapes[0].shape
+            nchw = aug["layout"] == "NCHW"
+            if nchw:
+                c, h, w = d0[1], d0[2], d0[3]
+            else:
+                h, w, c = d0[1], d0[2], d0[3]
+            feed = (nchw, h, w, c)
+        ck = (specs, clip is not None, donate, fold, feed)
         fn = self._jit_cache.get(ck)
         if fn is None:
-            fn = self._build(specs, clip is not None, donate, fold)
+            fn = self._build(specs, clip is not None, donate, fold, feed)
             self._jit_cache[ck] = fn
 
         key = ex._key()
@@ -202,6 +235,25 @@ class FusedTrainStep:
         o_nds = [ex.arg_arrays[i] for i in self._o_arg_idx]
         p_vals = [nd._data for nd in p_nds]
         o_vals = [nd._data for nd in o_nds]
+        aug_vals = None
+        if aug is not None:
+            grp = self._group
+            # uint8 frames, batch-sharded like any data arg (the H2D
+            # moved 1/4 the float bytes; nd.array counted it already)
+            o_vals[self._data_o_pos[0]] = \
+                grp._place(data_batch.data[0], 0)._data
+            import numpy as _np
+
+            aug_vals = (
+                grp._place(_np.asarray(aug["tops"], _np.int32), 0)._data,
+                grp._place(_np.asarray(aug["lefts"], _np.int32), 0)._data,
+                grp._place(_np.asarray(aug["mirror"], bool), 0)._data,
+                grp._place(_np.asarray(aug["mean"], _np.float32),
+                           None)._data,
+                grp._place(_np.asarray(aug["scale"], _np.float32),
+                           None)._data,
+            )
+            _tel.inc("step.fused_feed_batches")
         aux_vals = [a._data for a in ex.aux_arrays]
         st_vals = tuple(
             tuple(tuple(s._data for s in member) for member in grp)
@@ -238,8 +290,13 @@ class FusedTrainStep:
 
         def _do():
             _tel.inc("step.dispatches")
-            new_p, outs, aux_out, new_st, new_accs = fn(
-                p_vals, o_vals, aux_vals, st_vals, sv_mats, accs, key)
+            if aug_vals is not None:
+                new_p, outs, aux_out, new_st, new_accs = fn(
+                    p_vals, o_vals, aux_vals, st_vals, sv_mats, accs,
+                    key, aug_vals)
+            else:
+                new_p, outs, aux_out, new_st, new_accs = fn(
+                    p_vals, o_vals, aux_vals, st_vals, sv_mats, accs, key)
             for nd, v in zip(p_nds, new_p):
                 nd._data = v
             for nd, v in zip(ex.aux_arrays, aux_out):
@@ -264,9 +321,14 @@ class FusedTrainStep:
             eval_metric.update(data_batch.label, ex.outputs)
 
     # ------------------------------------------------------------------
-    def _build(self, specs, clipped, donate, fold):
+    def _build(self, specs, clipped, donate, fold, feed=None):
         """Trace+compile the whole-batch step for one (structure,
-        donation, fold) configuration."""
+        donation, fold, feed) configuration. With ``feed`` set the data
+        slot of the non-donated pack holds raw uint8 stored frames and
+        ``aug`` carries (tops, lefts, mirror, mean, scale): cast + crop +
+        mirror + normalize + layout run in-graph, the same math (and so
+        the same bits) as CachedImageRecordIter._device_augment, fused
+        into the one donated dispatch."""
         import jax
         import jax.numpy as jnp
 
@@ -278,18 +340,33 @@ class FusedTrainStep:
         p_idx = list(self._p_arg_idx)
         o_idx = list(self._o_arg_idx)
         label_pos = list(self._label_o_pos)
+        data_pos = self._data_o_pos[0] if self._data_o_pos else None
         leaves = self._fold_leaves or ()
         math_fns = {(kind, n): _update_math(kind, n, clipped)
                     for kind, n, _ in specs}
 
         _tel.inc("executor.jit_build")
 
+        def _augment(x, aug):
+            nchw, h, w, c = feed
+            tops, lefts, mirror, mean, scale = aug
+
+            def one(img, t, l, mi):
+                crop = jax.lax.dynamic_slice(img, (t, l, 0), (h, w, c))
+                return jnp.where(mi, crop[:, ::-1], crop)
+
+            y = jax.vmap(one)(x, tops, lefts, mirror)
+            y = (y.astype(jnp.float32) - mean) * scale
+            return jnp.transpose(y, (0, 3, 1, 2)) if nchw else y
+
         @functools.partial(jax.jit,
                            donate_argnums=(0, 2, 3, 5) if donate else ())
-        def step(p_vals, o_vals, aux, st, sv_mats, accs, key):
+        def step(p_vals, o_vals, aux, st, sv_mats, accs, key, aug=None):
             full = [None] * n_args
             for pos, i in enumerate(o_idx):
                 full[i] = o_vals[pos]
+            if feed is not None:
+                full[o_idx[data_pos]] = _augment(o_vals[data_pos], aug)
 
             def f(pv):
                 fl = list(full)

@@ -39,10 +39,11 @@ from typing import Optional, Tuple
 import numpy as np
 
 from . import telemetry as _tel
-from .base import MXNetError
+from .base import MXNetError, getenv
 from .io import DataBatch, DataDesc, DataIter
 
-__all__ = ["build_decoded_cache", "CachedImageRecordIter"]
+__all__ = ["build_decoded_cache", "CachedImageRecordIter",
+           "materialize_device_feed"]
 
 
 def _decode_record(rec: bytes, store_hw: Tuple[int, int], channels: int):
@@ -322,6 +323,7 @@ class CachedImageRecordIter(DataIter):
                  mean_b: float = 0.0, scale: float = 1.0,
                  device_normalize: bool = True,
                  device_augment: bool = False,
+                 device_feed: Optional[bool] = None,
                  output_layout: str = "NCHW",
                  label_name: str = "softmax_label"):
         super().__init__()
@@ -358,6 +360,18 @@ class CachedImageRecordIter(DataIter):
         # the device step. The host-crop mode (~3k img/s/core) stays the
         # default for CPU-only runs where device cycles are host cycles.
         self.device_augment = device_augment
+        # device_feed defers EVERYTHING to the training dispatch: the
+        # batch ships the full stored frames as raw uint8 (4x fewer H2D
+        # bytes than float32, and (sh*sw)/(4*h*w) of the host-crop float
+        # path) and the crop offsets / mirror flags / mean / scale ride
+        # along in ``batch.aug`` so the fused train step (fused_step.py)
+        # can run cast+crop+mirror+normalize+layout INSIDE the one
+        # donated XLA call — a cached epoch is memmap -> one dispatch ->
+        # metrics. The same host RNG draws as device_augment mode keep
+        # the two bit-identical in what the model sees.
+        if device_feed is None:
+            device_feed = bool(getenv("MXNET_TPU_DEVICE_FEED", False))
+        self.device_feed = bool(device_feed)
         # NHWC consumers (channels-last towers) read batches without the
         # NCHW transpose — emitting their layout directly avoids a
         # cancelling transpose pair per batch in the consumer
@@ -525,7 +539,7 @@ class CachedImageRecordIter(DataIter):
             (self._seed * 2654435761 + self._epoch * 1000003
              + self.cursor) & 0xFFFFFFFF)
 
-        if self.device_augment:
+        if self.device_feed or self.device_augment:
             # order within a batch is irrelevant to SGD; sorting the
             # gather improves memmap locality
             gidx = np.sort(idx)
@@ -538,11 +552,27 @@ class CachedImageRecordIter(DataIter):
                 lefts = np.full(self.batch_size, (sw - w) // 2)
             mirror = (rng.rand(self.batch_size) < 0.5) if self.rand_mirror \
                 else np.zeros(self.batch_size, bool)
-            data = nd.NDArray(self._device_augment(full, tops, lefts,
-                                                   mirror))
             labels = np.asarray(self._labels[gidx])
             if self.meta["label_width"] == 1:
                 labels = labels[:, 0]
+            if self.device_feed:
+                # raw uint8 crosses the link (ndarray.h2d_bytes counts
+                # it); augmentation params ride host-side in batch.aug —
+                # the consumer (fused step, or materialize_device_feed
+                # for eager loops) owns the device math
+                batch = DataBatch([nd.array(full)], [nd.array(labels)],
+                                  pad=pad, index=gidx)
+                batch.aug = {"tops": tops.astype(np.int32),
+                             "lefts": lefts.astype(np.int32),
+                             "mirror": mirror,
+                             "mean": self.mean,
+                             "scale": float(self.scale),
+                             "layout": self.output_layout,
+                             "crop": (h, w)}
+                _tel.inc("io.feed_batches")
+                return batch
+            data = nd.NDArray(self._device_augment(full, tops, lefts,
+                                                   mirror))
             return DataBatch([data], [nd.array(labels)], pad=pad,
                              index=gidx)
 
@@ -570,6 +600,54 @@ class CachedImageRecordIter(DataIter):
             data = nd.array(x)
         return DataBatch([data], [nd.array(labels)], pad=pad,
                          index=np.asarray(idx))
+
+
+_MATERIALIZE_CACHE: dict = {}
+
+
+def materialize_device_feed(batch: DataBatch) -> DataBatch:
+    """Eagerly apply a device-feed batch's deferred augmentation.
+
+    Fallback for consumers without in-graph augmentation (the classic
+    three-phase fit loop, score/predict): runs the SAME kernel math the
+    fused step traces — dynamic-slice crop, mirror, (x - mean) * scale,
+    layout — as its own jitted dispatch, and returns an ordinary batch.
+    A batch without ``aug`` passes through untouched."""
+    aug = getattr(batch, "aug", None)
+    if aug is None:
+        return batch
+    import jax
+    import jax.numpy as jnp
+
+    from . import ndarray as nd
+
+    h, w = aug["crop"]
+    x = batch.data[0]
+    c = x.shape[3]
+    nchw = aug["layout"] == "NCHW"
+    ck = (h, w, c, nchw)
+    fn = _MATERIALIZE_CACHE.get(ck)
+    if fn is None:
+        @jax.jit
+        def fn(x, tops, lefts, mirror, mean, scale):
+            def one(img, t, l, mi):
+                crop = jax.lax.dynamic_slice(img, (t, l, 0), (h, w, c))
+                return jnp.where(mi, crop[:, ::-1], crop)
+
+            y = jax.vmap(one)(x, tops, lefts, mirror)
+            y = (y.astype(jnp.float32) - mean) * scale
+            return jnp.transpose(y, (0, 3, 1, 2)) if nchw else y
+
+        _MATERIALIZE_CACHE[ck] = fn
+    data = nd.NDArray(fn(x._data, np.asarray(aug["tops"], np.int32),
+                         np.asarray(aug["lefts"], np.int32),
+                         np.asarray(aug["mirror"], bool),
+                         np.asarray(aug["mean"], np.float32),
+                         np.asarray(aug["scale"], np.float32)))
+    return DataBatch([data], batch.label, pad=batch.pad,
+                     index=batch.index,
+                     provide_data=batch.provide_data,
+                     provide_label=batch.provide_label)
 
 
 # registry entry: reachable from the C API (MXListDataIters /

@@ -42,6 +42,7 @@ import multiprocessing
 import os
 import queue as _queue
 import struct
+import threading
 import time
 import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -53,7 +54,7 @@ from .base import MXNetError, getenv
 from .io import DataBatch, DataIter, RecordDecoder
 
 __all__ = ["ShmRecordStore", "ShmBatchRing", "ProcessDecodePipeline",
-           "DeviceStagingIter", "PipelineError"]
+           "DeviceStagingIter", "FeedScheduler", "PipelineError"]
 
 
 class PipelineError(MXNetError):
@@ -504,9 +505,15 @@ class DeviceStagingIter(DataIter):
             _tel.observe("io.staging.h2d_ms",
                          (time.perf_counter() - t0) * 1e3)
             _tel.inc("io.staging.batches")
-        return DataBatch(data, label, batch.pad, batch.index,
-                         provide_data=batch.provide_data,
-                         provide_label=batch.provide_label)
+        staged = DataBatch(data, label, batch.pad, batch.index,
+                           provide_data=batch.provide_data,
+                           provide_label=batch.provide_label)
+        # device-feed batches carry their deferred augmentation params;
+        # dropping them here would feed raw stored frames to the model
+        aug = getattr(batch, "aug", None)
+        if aug is not None:
+            staged.aug = aug
+        return staged
 
     def next(self) -> DataBatch:
         if self._staged is None:
@@ -555,12 +562,182 @@ class DeviceStagingIter(DataIter):
 
 def maybe_wrap_device_staging(data_iter: DataIter) -> DataIter:
     """Fit-loop hook: wrap ``data_iter`` in :class:`DeviceStagingIter`
-    when ``MXNET_TPU_DEVICE_STAGING=1`` (idempotent)."""
+    when ``MXNET_TPU_DEVICE_STAGING=1`` (idempotent). A
+    :class:`FeedScheduler` already stages on its worker thread, so it is
+    never double-wrapped."""
     if not getenv("MXNET_TPU_DEVICE_STAGING", False):
         return data_iter
-    if isinstance(data_iter, DeviceStagingIter):
+    if isinstance(data_iter, (DeviceStagingIter, FeedScheduler)):
         return data_iter
     logging.getLogger(__name__).info(
         "device staging enabled: wrapping %s in DeviceStagingIter",
         type(data_iter).__name__)
     return DeviceStagingIter(data_iter)
+
+
+# ---------------------------------------------------------------------------
+# feed scheduler
+# ---------------------------------------------------------------------------
+
+class FeedScheduler(DataIter):
+    """Keeps up to ``depth`` staged batches in flight ahead of the
+    training loop.
+
+    A generalization of :class:`DeviceStagingIter`'s double buffer: a
+    worker thread pulls batches from the base iterator, stages them to
+    device (``device_put`` issue — H2D overlaps compute, device-feed
+    ``batch.aug`` params preserved), and parks them in a bounded queue.
+    ``next()`` pops, and the time the fit loop spends BLOCKED on an
+    empty queue is recorded as the ``io.feed_stall_ms`` histogram — the
+    signal StepTrace's dominant-cause labeling uses to call a step
+    input-starved rather than compute-bound. ``io.feed.in_flight``
+    gauges queue occupancy; ``io.feed.batches`` counts deliveries.
+
+    Enable in the fit loop with ``MXNET_TPU_FEED_DEPTH=N`` (N >= 1) or
+    wrap an iterator explicitly. Depth buys tolerance to host-side
+    jitter (a slow memmap gather, a GC pause) at N batches of extra
+    host+device memory; 2-4 covers most of it."""
+
+    _END = object()
+
+    def __init__(self, base: DataIter, depth: int = 2, ctx=None):
+        super().__init__()
+        self.base = base
+        self.depth = max(1, int(depth))
+        self._ctx = ctx
+        self.batch_size = getattr(base, "batch_size", 0)
+        self._q = _queue.Queue(maxsize=self.depth)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._err: Optional[BaseException] = None
+        self._exhausted = False
+
+    @property
+    def provide_data(self):
+        return self.base.provide_data
+
+    @property
+    def provide_label(self):
+        return self.base.provide_label
+
+    # staging reuses the DeviceStagingIter conversion/telemetry path
+    _to_device = DeviceStagingIter._to_device
+    _stage = DeviceStagingIter._stage
+
+    def _worker(self):
+        try:
+            while not self._stop.is_set():
+                try:
+                    batch = self.base.next()
+                except StopIteration:
+                    self._put(self._END)
+                    return
+                self._put(self._stage(batch))
+        except BaseException as e:   # surfaced on the consumer's next()
+            self._err = e
+            self._put(self._END)
+
+    def _put(self, item):
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return
+            except _queue.Full:
+                continue
+
+    def _ensure_thread(self):
+        if self._thread is None:
+            self._stop.clear()
+            self._err = None
+            self._thread = threading.Thread(
+                target=self._worker, name="mxtpu-feed-scheduler",
+                daemon=True)
+            self._thread.start()
+
+    def next(self) -> DataBatch:
+        if self._exhausted:
+            raise StopIteration
+        self._ensure_thread()
+        t0 = time.perf_counter() if _tel.enabled() else 0.0
+        item = self._q.get()
+        if _tel.enabled():
+            _tel.observe("io.feed_stall_ms",
+                         (time.perf_counter() - t0) * 1e3)
+            _tel.set_gauge("io.feed.in_flight", self._q.qsize())
+        if item is self._END:
+            self._exhausted = True
+            if self._err is not None:
+                err, self._err = self._err, None
+                raise err
+            raise StopIteration
+        _tel.inc("io.feed.batches")
+        return item
+
+    def _drain(self):
+        # stop first: a worker blocked on a full queue polls the event
+        # inside _put and exits; only then is the queue safe to drain
+        # (no late put can land a stale batch in the next epoch)
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        while True:
+            try:
+                self._q.get_nowait()
+            except _queue.Empty:
+                break
+
+    def reset(self):
+        self._drain()
+        self.base.reset()
+        self._err = None
+        self._exhausted = False
+        # thread restarts lazily on the first next() of the new epoch
+
+    def iter_next(self) -> bool:
+        try:
+            self._current = self.next()
+            return True
+        except StopIteration:
+            return False
+
+    def getdata(self):
+        return self._current.data
+
+    def getlabel(self):
+        return self._current.label
+
+    def getpad(self):
+        return self._current.pad
+
+    def getindex(self):
+        return self._current.index
+
+    def close(self):
+        self._drain()
+        close = getattr(self.base, "close", None)
+        if callable(close):
+            close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def maybe_wrap_feed_scheduler(data_iter: DataIter) -> DataIter:
+    """Fit-loop hook: wrap ``data_iter`` in :class:`FeedScheduler` when
+    ``MXNET_TPU_FEED_DEPTH`` >= 1 (idempotent; subsumes device
+    staging)."""
+    depth = int(getenv("MXNET_TPU_FEED_DEPTH", 0))
+    if depth <= 0:
+        return data_iter
+    if isinstance(data_iter, FeedScheduler):
+        return data_iter
+    if isinstance(data_iter, DeviceStagingIter):
+        data_iter = data_iter.base   # scheduler stages; unwrap the buffer
+    logging.getLogger(__name__).info(
+        "feed scheduler enabled: %d staged batches in flight ahead of "
+        "%s", depth, type(data_iter).__name__)
+    return FeedScheduler(data_iter, depth=depth)

@@ -213,9 +213,15 @@ class BaseModule:
             validation_metric = eval_metric
         eval_metric = _metric.create(eval_metric)
 
-        # MXNET_TPU_DEVICE_STAGING=1: device_put batch N+1 while step N
-        # executes, so H2D overlaps compute instead of serializing with it
-        from ..io_pipeline import maybe_wrap_device_staging
+        # MXNET_TPU_FEED_DEPTH=N: a worker thread keeps N staged batches
+        # in flight and io.feed_stall_ms records how long each step
+        # blocked waiting for input (StepTrace's input-starved signal).
+        # Falls back to MXNET_TPU_DEVICE_STAGING=1 single-batch double
+        # buffering: device_put batch N+1 while step N executes, so H2D
+        # overlaps compute instead of serializing with it.
+        from ..io_pipeline import (maybe_wrap_device_staging,
+                                   maybe_wrap_feed_scheduler)
+        train_data = maybe_wrap_feed_scheduler(train_data)
         train_data = maybe_wrap_device_staging(train_data)
 
         # env-driven observability (metrics server, flight recorder);
@@ -243,6 +249,8 @@ class BaseModule:
                 if fused is not None:
                     fused.step(data_batch, eval_metric)
                 else:
+                    # device-feed batches (batch.aug) are materialized
+                    # eagerly inside load_data_batch on this path
                     self.forward_backward(data_batch)
                     self.update()
                     self.update_metric(eval_metric, data_batch.label)

@@ -182,7 +182,10 @@ class DataParallelExecutorGroup:
             from ..ndarray import _shares_buffer
 
             placed = self._place(arr, None)._data
-            if isinstance(arr, NDArray) and _shares_buffer(placed, arr._data):
+            if isinstance(arr, NDArray) \
+                    and _shares_buffer(placed, arr._data) is not False:
+                # None (unverifiable aliasing) copies too — see
+                # ndarray._shares_buffer
                 placed = jnp.copy(placed)
             return placed
 
@@ -207,10 +210,23 @@ class DataParallelExecutorGroup:
     # here: one device_put with batch sharding)
     # ------------------------------------------------------------------
     def load_data_batch(self, data_batch):
+        if getattr(data_batch, "aug", None) is not None:
+            # device-feed batch reaching a classic (non-fused) consumer:
+            # the raw uint8 frames don't fit the float crop-shaped data
+            # buffer, so run the deferred augmentation eagerly first
+            from ..io_cache import materialize_device_feed
+            data_batch = materialize_device_feed(data_batch)
         for desc, arr in zip(self.data_shapes, data_batch.data):
             dst = self.executor.arg_dict[desc.name]
             baxis = DataDesc.get_batch_axis(desc.layout)
             dst._data = self._place(arr, baxis)._data
+        self.load_label_batch(data_batch)
+
+    def load_label_batch(self, data_batch):
+        """Load ONLY the labels. The fused device-feed path uses this:
+        raw uint8 frames bypass the executor's float data buffer (they
+        ride the train jit's non-donated pack and are augmented
+        in-graph), but labels still land in their arg slots."""
         if self.label_shapes:
             for desc, arr in zip(self.label_shapes, data_batch.label):
                 dst = self.executor.arg_dict[desc.name]

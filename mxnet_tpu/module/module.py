@@ -44,6 +44,7 @@ class Module(BaseModule):
 
         self._arg_params: Optional[Dict[str, nd.NDArray]] = None
         self._aux_params: Optional[Dict[str, nd.NDArray]] = None
+        self._shared_owner: Optional["Module"] = None
         self._params_dirty = False
         self._exec_group: Optional[DataParallelExecutorGroup] = None
         self._optimizer = None
@@ -52,6 +53,28 @@ class Module(BaseModule):
         self._update_on_kvstore = False
 
     # -- properties --------------------------------------------------------
+    @property
+    def _params_dirty(self) -> bool:
+        """Device-params-newer-than-host flag, routed through the module
+        that OWNS the shared param arrays. Modules bound with
+        ``shared_module=`` share executor-tier NDArrays and the host
+        ``_arg_params`` dicts with the owner, so dirtiness is a property
+        of the owner's training activity — a by-value snapshot at bind
+        time would let a non-active bucket module hand out stale host
+        params after the owner trains."""
+        owner = getattr(self, "_shared_owner", None)
+        if owner is not None:
+            return owner._params_dirty
+        return getattr(self, "_params_dirty_flag", False)
+
+    @_params_dirty.setter
+    def _params_dirty(self, value: bool):
+        owner = getattr(self, "_shared_owner", None)
+        if owner is not None:
+            owner._params_dirty = value
+        else:
+            self._params_dirty_flag = bool(value)
+
     @property
     def data_names(self):
         return self._data_names
@@ -114,7 +137,12 @@ class Module(BaseModule):
             # new-bucket bind.
             self._arg_params = shared_module._arg_params
             self._aux_params = shared_module._aux_params
-            self._params_dirty = shared_module._params_dirty
+            # dirty tracking routes through the OWNING module (chase one
+            # level so chains share a single root): when the owner
+            # trains, every sharing module sees fresh dirtiness instead
+            # of a stale bind-time snapshot
+            self._shared_owner = getattr(shared_module, "_shared_owner",
+                                         None) or shared_module
             self.params_initialized = True
         elif self.params_initialized:
             # params loaded before bind (Module.load path)

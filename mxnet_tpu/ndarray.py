@@ -64,21 +64,38 @@ def _require_dtype(dtype):
     return dt
 
 
-def _shares_buffer(a, b) -> bool:
-    """True when two jax arrays alias the same device buffer.
+def _shares_buffer(a, b) -> Optional[bool]:
+    """Tri-state aliasing check for two jax arrays.
 
     ``jax.device_put`` (and no-op ``astype``) on a same-device array may
     return a NEW ``jax.Array`` handle to the SAME underlying buffer, so an
     identity check is insufficient: donating one handle deletes the data
-    both see. Sharded arrays have no single buffer pointer — there
-    ``device_put`` across shardings is a real copy, so answering False is
-    correct."""
+    both see.
+
+    Returns ``True``/``False`` when aliasing can be VERIFIED via buffer
+    pointers — single-buffer arrays through ``unsafe_buffer_pointer``,
+    sharded arrays by intersecting per-shard pointers from
+    ``addressable_shards``. Returns ``None`` when no pointer is
+    obtainable (backend without the API, committed-elsewhere shards):
+    callers guarding donation must treat ``None`` as possibly-aliased
+    and copy defensively (``is not False``), not assume distinct."""
     if a is b:
         return True
     try:
         return a.unsafe_buffer_pointer() == b.unsafe_buffer_pointer()
     except Exception:
-        return False
+        pass
+    try:
+        def ptrs(x):
+            return {s.data.unsafe_buffer_pointer()
+                    for s in x.addressable_shards}
+
+        pa, pb = ptrs(a), ptrs(b)
+        if not pa or not pb:
+            return None
+        return bool(pa & pb)
+    except Exception:
+        return None
 
 
 class NDArray:
@@ -177,11 +194,12 @@ class NDArray:
         def _do():
             new = jax.device_put(
                 self._data.astype(other.dtype), other._ctx.jax_device())
-            if _shares_buffer(new, self._data):
+            if _shares_buffer(new, self._data) is not False:
                 # device_put is a no-copy on same-device transfers; copyto
                 # must yield a DISTINCT buffer, or donating either array
                 # (optimizer / executor-aux donation) would delete the
-                # other's data
+                # other's data. None (unverifiable) copies too: a spare
+                # copy is cheap, a deleted live buffer is not
                 import jax.numpy as jnp
 
                 new = jnp.copy(new)

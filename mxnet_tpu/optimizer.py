@@ -51,6 +51,9 @@ def register(name_or_cls=None, override: bool = False):
 _JIT_UPDATES: Dict[tuple, Any] = {}
 
 
+_DONATION_WARNED = False
+
+
 def _donation_ok() -> bool:
     """Donate only under engines that run host closures inline (XLAEngine /
     NaiveEngine, the defaults). A threaded engine may interleave a direct
@@ -63,7 +66,20 @@ def _donation_ok() -> bool:
         return False
     # allowlist, not a not-ThreadedEngine check: native or third-party
     # engines that run closures on worker threads must stay excluded too
-    return type(get_engine()) in (XLAEngine, NaiveEngine)
+    if type(get_engine()) in (XLAEngine, NaiveEngine):
+        return True
+    global _DONATION_WARNED
+    if not _DONATION_WARNED:
+        _DONATION_WARNED = True
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "buffer donation disabled: engine %s runs closures off-thread, "
+            "so in-place param/state updates are unsafe. Training holds a "
+            "transient SECOND copy of params + optimizer state in HBM. Use "
+            "MXNET_ENGINE_TYPE=XLAEngine (or NaiveEngine) to restore "
+            "donation.", type(get_engine()).__name__)
+    return False
 
 
 def _update_math(kind: str, n_states: int, clipped: bool):

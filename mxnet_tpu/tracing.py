@@ -67,6 +67,7 @@ _log = logging.getLogger(__name__)
 DELTA_SOURCES = (
     ("io_stall_ms", "io.pipeline.stall_ms", "hist_sum"),
     ("prefetch_stall_ms", "io.prefetch_stall_ms", "hist_sum"),
+    ("feed_stall_ms", "io.feed_stall_ms", "hist_sum"),
     ("h2d_bytes", "ndarray.h2d_bytes", "counter"),
     ("kv_push_bytes", "kvstore.push_bytes", "counter"),
     ("kv_pull_bytes", "kvstore.pull_bytes", "counter"),
@@ -76,7 +77,7 @@ DELTA_SOURCES = (
     ("fused_recompiles", "step.fused_recompiles", "counter"),
 )
 
-_STALL_FIELDS = ("io_stall_ms", "prefetch_stall_ms")
+_STALL_FIELDS = ("io_stall_ms", "prefetch_stall_ms", "feed_stall_ms")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,295 @@
+"""Device-feed fast path (round-6 tentpole): CachedImageRecordIter ships
+raw uint8 frames + deferred augmentation params, and the fused train
+step runs cast/crop/mirror/normalize/layout INSIDE its one donated XLA
+dispatch. Gates: bit-identical params vs the eager device-augment path,
+exactly one dispatch per batch, uint8 H2D <= 1/3 of the float32 bytes,
+and feed-stall telemetry for StepTrace's dominant-cause labeling."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io_cache, recordio as rio, telemetry
+from mxnet_tpu.io import DataBatch, DataIter, DataDesc
+from mxnet_tpu.io_pipeline import FeedScheduler, maybe_wrap_feed_scheduler
+
+BATCH = 8
+# geometry mirrors the 256-store/224-crop ImageNet ratio: uint8 stored
+# frames must move <= 1/3 the bytes of float32 crops, i.e.
+# store^2 * 1B <= (1/3) * crop^2 * 4B -> 36^2/(4*32^2) ~= 0.316
+STORE = 36
+CROP = 32
+
+
+def _write_rec(path, num=24, size=48):
+    rng = np.random.RandomState(11)
+    w = rio.MXRecordIO(str(path), "w")
+    for i in range(num):
+        img = rng.randint(0, 255, (size, size, 3)).astype(np.uint8)
+        w.write(rio.pack_img(rio.IRHeader(0, float(i % 5), i, 0), img,
+                             quality=95))
+    w.close()
+
+
+@pytest.fixture(scope="module")
+def cache(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("feed")
+    rec = tmp / "t.rec"
+    _write_rec(rec)
+    prefix = str(tmp / "t.cache")
+    io_cache.build_decoded_cache(str(rec), prefix, (3, STORE, STORE),
+                                 preprocess_threads=2)
+    return prefix
+
+
+@pytest.fixture()
+def tel():
+    telemetry.reset()
+    telemetry.enable()
+    yield telemetry
+    telemetry.reset()
+    telemetry.disable()
+
+
+def _net():
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=5, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _seed_params(net, data_shape, seed=3):
+    arg_shapes, _, _ = net.infer_shape(data=data_shape,
+                                       softmax_label=(BATCH,))
+    rng = np.random.RandomState(seed)
+    return {name: mx.nd.array((rng.randn(*shape) * 0.1).astype(np.float32))
+            for name, shape in zip(net.list_arguments(), arg_shapes)
+            if name not in ("data", "softmax_label")}
+
+
+def _iter(prefix, **mode):
+    return io_cache.CachedImageRecordIter(
+        prefix, (3, CROP, CROP), BATCH, shuffle=True, seed=7,
+        rand_crop=True, rand_mirror=True, scale=1.0 / 255.0, **mode)
+
+
+def _fit(prefix, monkeypatch, num_epoch=2, fused=True, **mode):
+    if fused:
+        monkeypatch.setenv("MXNET_TPU_FUSED_STEP", "1")
+    else:
+        monkeypatch.delenv("MXNET_TPU_FUSED_STEP", raising=False)
+    it = _iter(prefix, **mode)
+    net = _net()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=num_epoch, optimizer="sgd",
+            arg_params=_seed_params(net, (BATCH, 3, CROP, CROP)),
+            initializer=None,
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
+    assert mod._fused_step_active == fused
+    arg, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in arg.items()}
+
+
+# ---------------------------------------------------------------------------
+# iterator-level device-feed mode
+# ---------------------------------------------------------------------------
+
+def test_device_feed_batch_shape_and_aug(cache, tel):
+    it = _iter(cache, device_feed=True)
+    b = next(it)
+    # raw stored frames, uint8, NHWC — NOT the crop shape
+    assert b.data[0].shape == (BATCH, STORE, STORE, 3)
+    assert b.data[0].dtype == np.uint8
+    aug = b.aug
+    assert aug["crop"] == (CROP, CROP)
+    assert aug["tops"].shape == (BATCH,) and aug["lefts"].shape == (BATCH,)
+    assert aug["mirror"].shape == (BATCH,)
+    assert tel.peek("io.feed_batches") >= 1
+    # provide_data still advertises the CROP shape the graph will see
+    assert it.provide_data[0].shape == (BATCH, 3, CROP, CROP)
+
+
+def test_materialize_matches_device_augment(cache):
+    b_eager = next(_iter(cache, device_augment=True))
+    b_feed = next(_iter(cache, device_feed=True))
+    assert np.array_equal(b_eager.label[0].asnumpy(),
+                          b_feed.label[0].asnumpy())
+    m = io_cache.materialize_device_feed(b_feed)
+    assert getattr(m, "aug", None) is None
+    assert np.array_equal(b_eager.data[0].asnumpy(), m.data[0].asnumpy())
+
+
+def test_device_feed_env_gate(cache, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_DEVICE_FEED", "1")
+    it = io_cache.CachedImageRecordIter(
+        cache, (3, CROP, CROP), BATCH, scale=1.0 / 255.0)
+    assert it.device_feed
+    b = next(it)
+    assert b.data[0].dtype == np.uint8 and b.aug is not None
+
+
+# ---------------------------------------------------------------------------
+# fused-step integration: parity + dispatch count
+# ---------------------------------------------------------------------------
+
+def test_fused_feed_bit_identical_to_eager_cached(cache, tel, monkeypatch):
+    p_eager = _fit(cache, monkeypatch, device_augment=True)
+    p_feed = _fit(cache, monkeypatch, device_feed=True)
+    assert set(p_eager) == set(p_feed)
+    for k in p_eager:
+        assert np.array_equal(p_eager[k], p_feed[k]), \
+            "param %s diverged between eager and device-feed paths" % k
+
+
+def test_fused_feed_one_dispatch_per_batch(cache, tel, monkeypatch):
+    before = tel.peek("step.dispatches") or 0
+    _fit(cache, monkeypatch, num_epoch=2, device_feed=True)
+    dispatches = (tel.peek("step.dispatches") or 0) - before
+    nbatches = 2 * (24 // BATCH)
+    assert dispatches == nbatches
+    assert tel.peek("step.fused_feed_batches") == nbatches
+
+
+def test_classic_loop_materializes_feed_batches(cache, tel, monkeypatch):
+    # non-fused consumers must still train (and agree with the eager
+    # iterator bit-for-bit): load_data_batch materializes batch.aug
+    p_eager = _fit(cache, monkeypatch, fused=False, device_augment=True)
+    p_feed = _fit(cache, monkeypatch, fused=False, device_feed=True)
+    for k in p_eager:
+        assert np.array_equal(p_eager[k], p_feed[k])
+
+
+def test_fused_vs_classic_feed_parity(cache, tel, monkeypatch):
+    p_classic = _fit(cache, monkeypatch, fused=False, device_feed=True)
+    p_fused = _fit(cache, monkeypatch, fused=True, device_feed=True)
+    for k in p_classic:
+        assert np.array_equal(p_classic[k], p_fused[k])
+
+
+# ---------------------------------------------------------------------------
+# H2D byte accounting
+# ---------------------------------------------------------------------------
+
+def test_uint8_feed_h2d_bytes_at_most_one_third_of_f32(cache, tel):
+    telemetry.reset()
+    telemetry.enable()
+    for _ in _iter(cache, device_feed=True):
+        pass
+    u8_bytes = telemetry.peek("ndarray.h2d_bytes")
+    telemetry.reset()
+    telemetry.enable()
+    for _ in _iter(cache, device_normalize=False):
+        pass
+    f32_bytes = telemetry.peek("ndarray.h2d_bytes")
+    assert u8_bytes and f32_bytes
+    assert u8_bytes / f32_bytes <= 1.0 / 3.0, \
+        "uint8 feed moved %d bytes vs %d f32 (ratio %.3f > 1/3)" % (
+            u8_bytes, f32_bytes, u8_bytes / f32_bytes)
+
+
+# ---------------------------------------------------------------------------
+# feed scheduler
+# ---------------------------------------------------------------------------
+
+class _SlowIter(DataIter):
+    """Tiny deterministic iterator with a controllable per-batch delay."""
+
+    def __init__(self, nbatches=4, delay=0.0):
+        super().__init__()
+        self.nbatches = nbatches
+        self.delay = delay
+        self.cursor = 0
+        self.batch_size = 2
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (2, 3))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (2,))]
+
+    def reset(self):
+        self.cursor = 0
+
+    def next(self):
+        if self.cursor >= self.nbatches:
+            raise StopIteration
+        if self.delay:
+            time.sleep(self.delay)
+        i = self.cursor
+        self.cursor += 1
+        return DataBatch([mx.nd.array(np.full((2, 3), i, np.float32))],
+                         [mx.nd.array(np.zeros(2, np.float32))], 0, None)
+
+
+def test_feed_scheduler_order_and_reset(tel):
+    sched = FeedScheduler(_SlowIter(nbatches=4), depth=2)
+    seen = [int(b.data[0].asnumpy()[0, 0]) for b in sched]
+    assert seen == [0, 1, 2, 3]
+    sched.reset()
+    seen2 = [int(b.data[0].asnumpy()[0, 0]) for b in sched]
+    assert seen2 == [0, 1, 2, 3]
+    sched.close()
+    assert telemetry.peek("io.feed.batches") == 8
+
+
+def test_feed_scheduler_stall_telemetry(tel):
+    sched = FeedScheduler(_SlowIter(nbatches=3, delay=0.05), depth=1)
+    for _ in sched:
+        pass
+    sched.close()
+    # the consumer is instant, the producer sleeps 50 ms/batch: the
+    # stall histogram must see (most of) that wait
+    assert telemetry.peek("io.feed_stall_ms", "hist_sum") > 50.0
+    assert telemetry.peek("io.feed.batches") == 3
+
+
+def test_feed_scheduler_propagates_worker_error():
+    class _Boom(_SlowIter):
+        def next(self):
+            if self.cursor == 1:
+                raise RuntimeError("decode exploded")
+            return super().next()
+
+    sched = FeedScheduler(_Boom(nbatches=3), depth=2)
+    next(sched)
+    with pytest.raises(RuntimeError, match="decode exploded"):
+        while True:
+            next(sched)
+    sched.close()
+
+
+def test_feed_scheduler_preserves_aug(cache):
+    sched = FeedScheduler(_iter(cache, device_feed=True), depth=2)
+    b = next(sched)
+    assert b.aug is not None and b.data[0].dtype == np.uint8
+    sched.close()
+
+
+def test_feed_scheduler_env_gate(monkeypatch):
+    it = _SlowIter()
+    monkeypatch.delenv("MXNET_TPU_FEED_DEPTH", raising=False)
+    assert maybe_wrap_feed_scheduler(it) is it
+    monkeypatch.setenv("MXNET_TPU_FEED_DEPTH", "3")
+    w = maybe_wrap_feed_scheduler(it)
+    assert isinstance(w, FeedScheduler) and w.depth == 3
+    # idempotent
+    assert maybe_wrap_feed_scheduler(w) is w
+    w.close()
+
+
+def test_feed_scheduler_fit_integration(cache, tel, monkeypatch):
+    # end to end through module.fit: scheduler + device feed + fused
+    # step, still bit-identical to the plain eager path
+    p_eager = _fit(cache, monkeypatch, device_augment=True)
+    monkeypatch.setenv("MXNET_TPU_FEED_DEPTH", "2")
+    p_feed = _fit(cache, monkeypatch, device_feed=True)
+    monkeypatch.delenv("MXNET_TPU_FEED_DEPTH")
+    for k in p_eager:
+        assert np.array_equal(p_eager[k], p_feed[k])
+    assert telemetry.peek("io.feed.batches") == 2 * (24 // BATCH)
+    assert telemetry.peek("io.feed_stall_ms", "hist_sum") is not None

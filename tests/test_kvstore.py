@@ -86,3 +86,27 @@ def test_kvstore_aggregation_exact():
     out = mx.nd.zeros((2, 3))
     kv.pull(9, out=out)
     np.testing.assert_allclose(out.asnumpy(), np.full((2, 3), 4.0))
+
+
+def test_reduce_tree_sum_matches_pairwise():
+    """The jitted balanced tree reduce must agree with a host sum for
+    any fan-in (odd counts exercise the carry leg)."""
+    rng = np.random.RandomState(5)
+    kv = kvstore.create("local")
+    for n in (2, 3, 5, 8):
+        arrs = [rng.randn(4, 3).astype(np.float32) for _ in range(n)]
+        merged = kv._reduce([mx.nd.array(a) for a in arrs])
+        np.testing.assert_allclose(merged.asnumpy(), sum(arrs), rtol=1e-6)
+
+
+def test_reduce_single_dispatch(monkeypatch):
+    """Fan-in N must cost ONE fused-reduce call, not N-1 eager adds."""
+    from mxnet_tpu import telemetry
+
+    telemetry.reset()
+    telemetry.enable()
+    kv = kvstore.create("local")
+    kv._reduce([mx.nd.ones((2, 2)) for _ in range(6)])
+    assert telemetry.peek("kvstore.fused_reduce") == 1
+    telemetry.reset()
+    telemetry.disable()

@@ -199,3 +199,42 @@ def test_get_params_after_backward_without_update():
     arg_params, aux_params = mod.get_params()
     for name, arr in list(arg_params.items()) + list(aux_params.items()):
         assert np.isfinite(arr.asnumpy()).all()
+
+
+def test_shared_module_dirty_tracking_routes_to_owner():
+    """A module bound with shared_module= shares the owner's param
+    NDArrays; its dirty flag must TRACK the owner, not snapshot it at
+    bind time — otherwise get_params() on the sharer returns stale host
+    params after the owner trains (reference bucketing contract)."""
+    X, y = _synthetic(n=64)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    owner = Module(_mlp_sym(), context=mx.cpu())
+    owner.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+               for_training=True)
+    owner.init_params()
+    owner.init_optimizer(optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1})
+
+    sharer = Module(_mlp_sym(), context=mx.cpu())
+    sharer.bind(data_shapes=it.provide_data,
+                label_shapes=it.provide_label, for_training=True,
+                shared_module=owner)
+    assert sharer._params_dirty == owner._params_dirty
+
+    before = {k: v.asnumpy().copy()
+              for k, v in owner.get_params()[0].items()}
+    batch = next(it)
+    owner.forward_backward(batch)
+    owner.update()
+    # owner trained -> BOTH modules must see dirty device params
+    assert owner._params_dirty and sharer._params_dirty
+    after_shared = {k: v.asnumpy()
+                    for k, v in sharer.get_params()[0].items()}
+    changed = any(not np.array_equal(before[k], after_shared[k])
+                  for k in before)
+    assert changed, "sharer returned stale pre-update host params"
+    # get_params() synced host copies: the flag clears for both views
+    assert not owner._params_dirty and not sharer._params_dirty
+    # sharer-side writes route back to the owner too
+    sharer._params_dirty = True
+    assert owner._params_dirty

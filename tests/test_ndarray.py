@@ -229,3 +229,65 @@ def test_array_implicit_uint64_takes_default():
     a = nd.array(np.array([2 ** 40, 1], dtype=np.uint64))
     assert a.dtype == np.float32
     np.testing.assert_allclose(a.asnumpy(), [float(2 ** 40), 1.0])
+
+
+def test_shares_buffer_tristate():
+    """_shares_buffer: True/False only when VERIFIED via buffer
+    pointers; None when unverifiable (callers must copy defensively)."""
+    import jax
+
+    from mxnet_tpu.ndarray import _shares_buffer
+
+    a = mx.nd.ones((2, 2))._data
+    b = mx.nd.ones((2, 2))._data
+    assert _shares_buffer(a, a) is True
+    assert _shares_buffer(a, b) is False
+    # device_put onto the same device may alias: whatever it returns,
+    # the answer must be verified, never None, on a single local device
+    c = jax.device_put(a, list(a.devices())[0])
+    assert _shares_buffer(a, c) in (True, False)
+
+    class _NoPointer:
+        """Array-like with neither unsafe_buffer_pointer nor shards."""
+
+    assert _shares_buffer(_NoPointer(), _NoPointer()) is None
+
+
+def test_shares_buffer_sharded_via_addressable_shards():
+    """Arrays whose only pointer access is per-shard (sharded arrays:
+    unsafe_buffer_pointer raises) are verified by shard-pointer
+    intersection instead of answering False blindly."""
+    from mxnet_tpu.ndarray import _shares_buffer
+
+    class _Shard:
+        def __init__(self, ptr):
+            self.data = self
+            self._ptr = ptr
+
+        def unsafe_buffer_pointer(self):
+            return self._ptr
+
+    class _Sharded:
+        def __init__(self, ptrs):
+            self.addressable_shards = [_Shard(p) for p in ptrs]
+
+        def unsafe_buffer_pointer(self):
+            raise RuntimeError("sharded array has no single buffer")
+
+    assert _shares_buffer(_Sharded([1, 2]), _Sharded([2, 3])) is True
+    assert _shares_buffer(_Sharded([1, 2]), _Sharded([3, 4])) is False
+    assert _shares_buffer(_Sharded([]), _Sharded([1])) is None
+
+
+def test_copyto_defensive_on_unverifiable_aliasing(monkeypatch):
+    """When aliasing cannot be verified, copyto must still produce a
+    buffer that survives donation of the source — i.e. it copies."""
+    from mxnet_tpu import ndarray as ndmod
+
+    monkeypatch.setattr(ndmod, "_shares_buffer", lambda a, b: None)
+    src = mx.nd.array(np.arange(4, dtype=np.float32))
+    dst = mx.nd.zeros((4,))
+    src.copyto(dst)
+    assert dst._data is not src._data
+    np.testing.assert_array_equal(dst.asnumpy(),
+                                  np.arange(4, dtype=np.float32))

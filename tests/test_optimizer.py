@@ -211,3 +211,39 @@ def test_update_multi_respects_subclass_update_override():
 
     assert PlannedSGD(learning_rate=0.1)._fusable()
     assert not HalvedSGD(learning_rate=0.1)._fusable()
+
+
+def test_donation_disabled_by_engine_warns_once(monkeypatch, caplog):
+    """An engine outside the inline allowlist silently doubles transient
+    param HBM; _donation_ok must say so, once, not per step."""
+    import logging
+
+    from mxnet_tpu import engine as eng
+    from mxnet_tpu import optimizer as optmod
+
+    class FakeThreadedEngine:
+        pass
+
+    monkeypatch.setattr(optmod, "_DONATION_WARNED", False)
+    monkeypatch.setattr(eng, "get_engine", lambda: FakeThreadedEngine())
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.optimizer"):
+        assert optmod._donation_ok() is False
+        assert optmod._donation_ok() is False
+    warns = [r for r in caplog.records
+             if "donation disabled" in r.getMessage()]
+    assert len(warns) == 1
+    assert "FakeThreadedEngine" in warns[0].getMessage()
+
+
+def test_donation_env_off_does_not_warn(monkeypatch, caplog):
+    """MXNET_TPU_DONATE=0 is an explicit user choice — no nagging."""
+    import logging
+
+    from mxnet_tpu import optimizer as optmod
+
+    monkeypatch.setattr(optmod, "_DONATION_WARNED", False)
+    monkeypatch.setenv("MXNET_TPU_DONATE", "0")
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.optimizer"):
+        assert optmod._donation_ok() is False
+    assert not [r for r in caplog.records
+                if "donation disabled" in r.getMessage()]

@@ -505,3 +505,21 @@ def test_dump_jsonl_append_only_and_fsync_opt_in(tmp_path, monkeypatch):
         recs = [json.loads(line) for line in f]
     assert [r["step"] for r in recs] == [1, 2]
     assert recs[1]["note"] == "fsynced"
+
+
+def test_feed_stall_is_a_tracked_stall_field():
+    """io.feed_stall_ms (FeedScheduler queue waits) must flow into step
+    deltas, dominant-cause labeling, and the input-stall detector."""
+    st = tracing.StepTrace(capacity=8, detectors=[])
+    telemetry.observe("io.feed_stall_ms", 60.0)
+    rec = st.record(100.0)
+    assert rec["deltas"]["feed_stall_ms"] == pytest.approx(60.0)
+    assert rec["dominant"] == "feed_stall_ms"
+
+    st2 = tracing.StepTrace(
+        capacity=8, event_cooldown=1,
+        detectors=[tracing.InputStallDetector(frac=0.5)])
+    telemetry.observe("io.feed_stall_ms", 9.0)
+    st2.record(10.0)
+    assert [e["type"] for e in st2.events] == ["input_stall"]
+    assert st2.events[0]["stall_frac"] == pytest.approx(0.9)

@@ -44,6 +44,9 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+# sibling tools (mfu_experiments.validate) resolve even when this file
+# is imported as a module rather than run as a script
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def log(msg):
@@ -56,6 +59,31 @@ def probe(timeout_s=240):
     from bench import _accelerator_reachable
 
     return _accelerator_reachable(timeout_s)
+
+
+def _scrub_jsonl(text):
+    """Last line of defense for measurement artifacts: drop physically
+    impossible rows (mfu_pct > 100, step time below the analytic floor)
+    from jsonl-bound stdout. mfu_experiments refuses to print them
+    itself, but an older checkout or a hand-run child could still emit
+    one — the artifact stays garbage-free either way."""
+    from mfu_experiments import validate
+
+    kept = []
+    for line in text.splitlines():
+        if line.strip():
+            try:
+                row = json.loads(line)
+            except ValueError:
+                row = None
+            if isinstance(row, dict) and row.get("valid") is not False:
+                reason = validate(row)
+                if reason:
+                    log("DROPPING physically impossible row (%s): %s"
+                        % (reason, line.strip()))
+                    continue
+        kept.append(line)
+    return "".join(l + "\n" for l in kept)
 
 
 def _run(cmd, timeout_s, env_overrides=None, outfile=None,
@@ -84,8 +112,12 @@ def _run(cmd, timeout_s, env_overrides=None, outfile=None,
     if r.stderr:
         sys.stderr.write(r.stderr[-2000:])
     if outfile and r.stdout.strip():
-        with open(os.path.join(REPO, outfile), "a") as f:
-            f.write(r.stdout)
+        out = r.stdout
+        if outfile.endswith(".jsonl"):
+            out = _scrub_jsonl(out)
+        if out.strip():
+            with open(os.path.join(REPO, outfile), "a") as f:
+                f.write(out)
     if r.returncode != 0:
         log("stage failed rc=%d" % r.returncode)
         if keep_output and r.stdout:

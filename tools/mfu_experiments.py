@@ -50,6 +50,67 @@ def _chip_peak(kind):
     return peak(kind)
 
 
+def validate(result):
+    """Physical-plausibility gate for a measurement row. Returns None
+    when the row could be real, else a reason string.
+
+    Two invariants no correct measurement can break: model FLOP
+    utilization cannot exceed the chip's peak (mfu_pct <= 100), and a
+    ResNet-50 train step cannot finish faster than the analytic floor
+    ``batch * 12.267 GFLOP / peak`` — the time the chip would need at
+    100% utilization. Rows that break either (the 2026-07-31 pre-fence
+    lines: 1.46 ms "steps" for batch-256, mfu 1095%) measured dispatch
+    latency, not training."""
+    mfu = result.get("mfu_pct")
+    if mfu is not None and mfu > 100.0:
+        return "mfu_pct %.1f exceeds 100%% of chip peak" % mfu
+    batch = result.get("batch")
+    step_ms = result.get("step_time_ms")
+    image = result.get("image", 0)
+    if batch and step_ms and image >= 224:
+        try:
+            peak = _chip_peak(result.get("chip", ""))
+        except Exception:
+            peak = None
+        if peak:
+            floor_ms = batch * RESNET50_TRAIN_GFLOPS_PER_IMG / peak
+            if step_ms < floor_ms:
+                return ("step_time_ms %.2f below analytic floor %.2f ms "
+                        "(batch %d ResNet-50 train at %.0f peak TFLOPS)"
+                        % (step_ms, floor_ms, batch, peak))
+    return None
+
+
+def retag(path):
+    """Rewrite a results .jsonl, tagging physically impossible rows that
+    carry no ``valid`` field with ``"valid": false`` + the reason.
+    Already-tagged rows and plausible rows pass through byte-identical.
+    Returns the number of rows tagged."""
+    out, tagged = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if not line.strip():
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                out.append(line)
+                continue
+            if isinstance(row, dict) and "valid" not in row:
+                reason = validate(row)
+                if reason:
+                    row["valid"] = False
+                    row["invalid_reason"] = reason
+                    line = json.dumps(row)
+                    tagged += 1
+            out.append(line)
+    with open(path, "w") as f:
+        for line in out:
+            f.write(line + "\n")
+    return tagged
+
+
 def build_variant(variant, batch, image, num_classes, small):
     from mxnet_tpu import models
 
@@ -147,6 +208,10 @@ def measure(variant, batch, image, num_classes, steps, dtype_name):
     if peak and image >= 224:
         tflops = imgs * RESNET50_TRAIN_GFLOPS_PER_IMG / 1e3
         result["mfu_pct"] = round(100.0 * tflops / peak, 1)
+    reason = validate(result)
+    if reason:
+        result["valid"] = False
+        result["invalid_reason"] = reason
     return result
 
 
@@ -169,8 +234,18 @@ def main(argv=None):
                         "alone); spaces inside one shell-quoted value "
                         "compose a combined set "
                         "(--sweep-flags='--flag1 --flag2')")
+    p.add_argument("--retag", metavar="PATH",
+                   help="rewrite an existing results .jsonl, tagging "
+                        "physically impossible untagged rows with "
+                        "\"valid\": false, then exit")
     p.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     args = p.parse_args(argv)
+
+    if args.retag:
+        n = retag(args.retag)
+        sys.stderr.write("mfu_experiments: tagged %d row(s) invalid in %s\n"
+                         % (n, args.retag))
+        return n
 
     if args.sweep_flags is not None and not args._child:
         sweep_variants = [args.variant] if args.variant != "all" \
@@ -215,7 +290,16 @@ def main(argv=None):
     results = []
     for v in variants:
         r = measure(v, batch, image, num_classes, steps, dtype)
-        print(json.dumps(r))
+        if r.get("valid") is False:
+            # stdout is what chip_watch appends to MFU_EXPERIMENTS.jsonl;
+            # a physically impossible measurement is evidence of a broken
+            # fence, not of performance — refuse to record it
+            sys.stderr.write(
+                "mfu_experiments: REFUSING to record physically "
+                "impossible row (%s): %s\n"
+                % (r["invalid_reason"], json.dumps(r)))
+        else:
+            print(json.dumps(r))
         results.append(r)
     return results
 
