@@ -48,7 +48,11 @@ $(PREDICT_LIB): $(PREDICT_SRCS) $(wildcard include/mxnet_tpu/*.h) $(wildcard src
 test: $(LIB)
 	python -m pytest tests/ -q
 
+lint:
+	python tools/graftlint.py mxnet_tpu tools bench.py \
+	    --baseline tools/graftlint_baseline.json --check-env-docs
+
 clean:
 	rm -rf mxnet_tpu/_native perl-package/blib
 
-.PHONY: all predict perl test clean
+.PHONY: all predict perl test lint clean

@@ -200,10 +200,15 @@ def main():
     # truthy JAX_PLATFORMS, ran the bench in-process, and a half-alive
     # tunnel turned the perf record into a stack trace. Only an explicit
     # cpu platform (or the inner-child marker) runs in-process.
+    # the orchestrator must not import mxnet_tpu (package import
+    # initializes jax; a wedged backend would hang the parent), so these
+    # two reads stay on os.environ rather than the env registry
+    # graft: env-ok
     if os.environ.get("MXNET_TPU_BENCH_INNER") \
             or os.environ.get("JAX_PLATFORMS") == "cpu":
         return _bench()
 
+    # graft: env-ok
     timeout_s = int(os.environ.get("MXNET_TPU_BENCH_TIMEOUT", 2400))
     result = None
     if _accelerator_reachable():
@@ -313,8 +318,11 @@ def _bench_recordio(jit_step, params, aux, key, batch, image, num_classes,
         tmp = tempfile.mkdtemp(prefix="bench_rec_")
         rec = os.path.join(tmp, "synth.rec")
         make_synthetic_rec(rec, max(2 * batch, 128), image)
-    threads = int(os.environ.get("MXNET_TPU_BENCH_THREADS",
-                                 os.cpu_count() or 1))
+    from mxnet_tpu import env as _env
+
+    threads = _env.get("MXNET_TPU_BENCH_THREADS",
+                       default=os.cpu_count() or 1) \
+        or (os.cpu_count() or 1)
     it = mio.ImageRecordIter(
         path_imgrec=rec, data_shape=(3, image, image), batch_size=batch,
         preprocess_threads=threads, rand_crop=True, rand_mirror=True,
@@ -369,7 +377,7 @@ def _bench_recordio(jit_step, params, aux, key, batch, image, num_classes,
     # decode), so it requires the explicit MXNET_TPU_BENCH_CACHE=1
     # opt-in; the bench's own synthetic rec is always small enough.
     if os.path.isfile(rec_env) \
-            and not os.environ.get("MXNET_TPU_BENCH_CACHE"):
+            and not _env.get("MXNET_TPU_BENCH_CACHE"):
         sys.stderr.write(
             "bench.py: skipping cached e2e tier for user rec %s "
             "(set MXNET_TPU_BENCH_CACHE=1 to decode it into an "
@@ -499,12 +507,16 @@ def _bench():
 
     devices = jax.devices()
     on_accel = devices[0].platform != "cpu"
-    batch = int(os.environ.get("MXNET_TPU_BENCH_BATCH",
-                               256 if on_accel else 8))
+    from mxnet_tpu import env as _env
+
+    batch = _env.get("MXNET_TPU_BENCH_BATCH",
+                     default=256 if on_accel else 8) \
+        or (256 if on_accel else 8)
     image = 224 if on_accel else 32
     num_classes = 1000 if on_accel else 16
-    steps = int(os.environ.get("MXNET_TPU_BENCH_STEPS",
-                               20 if on_accel else 2))
+    steps = _env.get("MXNET_TPU_BENCH_STEPS",
+                     default=20 if on_accel else 2) \
+        or (20 if on_accel else 2)
 
     net = models.get_resnet50(num_classes=num_classes,
                               small_input=not on_accel)
@@ -541,8 +553,8 @@ def _bench():
     # bf16 activations/matmuls with f32 master weights — the idiomatic
     # TPU precision (MXU native); override with MXNET_TPU_BENCH_DTYPE
     import jax.numpy as jnp
-    dtype_name = os.environ.get("MXNET_TPU_BENCH_DTYPE",
-                                "bfloat16" if on_accel else "float32")
+    dtype_name = _env.get("MXNET_TPU_BENCH_DTYPE") \
+        or ("bfloat16" if on_accel else "float32")
     compute_dtype = None if dtype_name == "float32" \
         else getattr(jnp, dtype_name)
     step, _ = build_sgd_train_step(net, ["data"], ["softmax_label"],
@@ -574,7 +586,7 @@ def _bench():
                                     jax.random.fold_in(key, steps + 1))
     _force(params)
 
-    trace_dir = os.environ.get("MXNET_TPU_BENCH_TRACE")
+    trace_dir = _env.get("MXNET_TPU_BENCH_TRACE")
     if trace_dir:
         jax.profiler.start_trace(trace_dir)
     tic = time.time()
@@ -598,8 +610,8 @@ def _bench():
     # MXNET_TPU_BENCH_FORCE_EXPERIMENTS=1 exercises the accelerator-only
     # experiment paths on CPU so CI covers the code that will run the
     # moment a chip answers
-    run_experiments = on_accel or bool(
-        os.environ.get("MXNET_TPU_BENCH_FORCE_EXPERIMENTS"))
+    run_experiments = on_accel \
+        or _env.get("MXNET_TPU_BENCH_FORCE_EXPERIMENTS")
     if run_experiments:
         # round-3 measured experiment, run opportunistically whenever a
         # real chip answers: time the SAME step with the channels-last
@@ -750,7 +762,7 @@ def _bench():
     if peak and tflops_xla:
         result["mfu_pct_xla"] = round(100.0 * tflops_xla / peak, 1)
 
-    rec_env = os.environ.get("MXNET_TPU_BENCH_INPUT")
+    rec_env = _env.get("MXNET_TPU_BENCH_INPUT")
     if rec_env:
         result.update(_bench_recordio(jit_step, params, aux, key, batch,
                                       image, num_classes, steps, rec_env,
@@ -760,8 +772,7 @@ def _bench():
     # inherited env, so `MXNET_TPU_FUSED_STEP=1 python bench.py` emits a
     # record self-labeled with the mode AND the measured dispatch count
     # behind it (expect ~1.0 fused vs 3+ classic)
-    result["fused"] = bool(int(
-        os.environ.get("MXNET_TPU_FUSED_STEP", "0") or "0"))
+    result["fused"] = _env.get("MXNET_TPU_FUSED_STEP")
     try:
         result["dispatches_per_step"] = _bench_fused_dispatch()
     except Exception as e:

@@ -12,7 +12,7 @@ import os
 import subprocess
 import threading
 
-from .base import getenv
+from . import env as _env
 
 _lock = threading.Lock()
 _lib = None
@@ -76,7 +76,7 @@ def get_lib():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if getenv("MXNET_TPU_NO_NATIVE", False):
+        if _env.get("MXNET_TPU_NO_NATIVE"):
             return None
         if not os.path.exists(_LIB_PATH) and not _build():
             return None

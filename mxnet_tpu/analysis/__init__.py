@@ -1,0 +1,16 @@
+"""Static analysis (graftlint) + runtime sanitizers for JAX hazards.
+
+``graftlint`` is the AST pass (host-sync / donation / tracer /
+env-registry rule families, baseline-gated in tier-1 via
+``tests/test_graftlint.py``; CLI at ``tools/graftlint.py``).
+``sanitizers`` is the runtime half, armed with ``MXNET_TPU_SANITIZE``.
+See docs/static_analysis.md.
+"""
+from . import graftlint, sanitizers  # noqa: F401
+from .graftlint import Config, Finding, analyze_paths, analyze_source
+from .sanitizers import (DonationSanitizer, RetraceSanitizer,
+                         SanitizerError)
+
+__all__ = ["graftlint", "sanitizers", "Config", "Finding",
+           "analyze_paths", "analyze_source", "SanitizerError",
+           "RetraceSanitizer", "DonationSanitizer"]

@@ -1,0 +1,182 @@
+"""Opt-in runtime sanitizers: the dynamic half of graftlint.
+
+The static passes (:mod:`.graftlint`) catch what an AST can prove; the
+hazards that depend on runtime configuration — which engine, whether
+donation armed, what shapes arrive — are checked here, armed via
+``MXNET_TPU_SANITIZE`` (comma list, or ``all``):
+
+``transfer``
+    Arms ``jax.transfer_guard("disallow")`` around the fused step
+    loop: any *implicit* host<->device transfer inside a step (a numpy
+    array leaking into the dispatch, a Python scalar mixed into an
+    eager device op, device-value truthiness) raises at the step that
+    caused it. Explicit transfers (``jax.device_put`` /
+    ``jax.device_get`` — everything our sanctioned H2D/fetch APIs use)
+    stay allowed; the small intentional host marshalling inside the
+    step (optimizer hyper-param mats, metric accumulator zeros) is
+    wrapped in :func:`intentional_transfer`.
+
+``retrace``
+    Raises :class:`SanitizerError` when the fused step sees a fresh
+    trace signature after ``MXNET_TPU_SANITIZE_WARMUP`` steps — the
+    silent steady-state recompile that shows up only as an
+    inexplicably slow step (``step.fused_recompiles``).
+
+``donation``
+    After a donating dispatch, verifies the donated buffers were
+    actually consumed (``jax.Array.is_deleted``). A donated-but-alive
+    buffer means XLA kept a copy: the memory headroom the fused step
+    promises (one copy of the training state) silently does not exist.
+
+Every trip increments ``sanitizer.trips`` and
+``sanitizer.trips.<kind>`` before raising, so a supervised run's
+telemetry (and ``tools/trace_report.py``) shows which sanitizer fired
+even when the raise was swallowed by a retry harness.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from .. import env as _env
+from .. import telemetry as _tel
+from ..base import MXNetError
+
+__all__ = ["SanitizerError", "enabled", "enabled_kinds", "step_guard",
+           "intentional_transfer", "record_trip", "RetraceSanitizer",
+           "DonationSanitizer", "is_transfer_guard_error", "KINDS"]
+
+KINDS = ("transfer", "retrace", "donation")
+
+
+class SanitizerError(MXNetError):
+    """A runtime sanitizer detected the hazard it guards against."""
+
+
+def enabled_kinds() -> frozenset:
+    """The armed sanitizer kinds, parsed fresh from the environment
+    (tests toggle it per module; this is read per fit/step-object, not
+    per step)."""
+    raw = _env.get("MXNET_TPU_SANITIZE").strip().lower()
+    if not raw:
+        return frozenset()
+    kinds = {k.strip() for k in raw.split(",") if k.strip()}
+    if "all" in kinds:
+        return frozenset(KINDS)
+    unknown = kinds - set(KINDS)
+    if unknown:
+        raise SanitizerError(
+            "MXNET_TPU_SANITIZE: unknown sanitizer(s) %s (valid: %s, all)"
+            % (sorted(unknown), ", ".join(KINDS)))
+    return frozenset(kinds)
+
+
+def enabled(kind: str) -> bool:
+    return kind in enabled_kinds()
+
+
+def record_trip(kind: str) -> None:
+    """Count a trip (always, even when the raise is caught upstream)."""
+    _tel.inc("sanitizer.trips")
+    _tel.inc("sanitizer.trips.%s" % kind)
+
+
+# ---------------------------------------------------------------------------
+# transfer sanitizer
+# ---------------------------------------------------------------------------
+
+def step_guard():
+    """Context manager for the step loop: ``jax.transfer_guard
+    ("disallow")`` when the transfer sanitizer is armed, else a no-op."""
+    if not enabled("transfer"):
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.transfer_guard("disallow")
+
+
+def intentional_transfer():
+    """Context manager marking a reviewed host<->device interaction
+    (the runtime analogue of graftlint's ``# graft: host-sync``
+    annotation): re-allows transfers inside an armed step guard. No-op
+    when the transfer sanitizer is off."""
+    if not enabled("transfer"):
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.transfer_guard("allow")
+
+
+def is_transfer_guard_error(exc: BaseException) -> bool:
+    """True when ``exc`` is jax's transfer-guard rejection (an
+    XlaRuntimeError whose message names the disallowed transfer)."""
+    text = str(exc)
+    return "transfer" in text.lower() and "disallow" in text.lower()
+
+
+# ---------------------------------------------------------------------------
+# retrace sanitizer
+# ---------------------------------------------------------------------------
+
+class RetraceSanitizer:
+    """Raises when a fused-step retrace happens after warmup.
+
+    ``check(recompiles)`` is called once per step with the cumulative
+    fresh-signature count (``len(FusedTrainStep._seen_sigs)`` — counted
+    directly, not via telemetry, so the sanitizer works with telemetry
+    disabled). The first ``warmup`` steps may retrace freely (shape
+    buckets, donation/fold config); after that a growing count IS the
+    silent recompile stall graftlint's static pass cannot see."""
+
+    def __init__(self, warmup: int = None):
+        self.warmup = (warmup if warmup is not None
+                       else _env.get("MXNET_TPU_SANITIZE_WARMUP"))
+        self._steps = 0
+        self._baseline = None
+
+    def check(self, recompiles: int) -> None:
+        self._steps += 1
+        if self._steps <= self.warmup:
+            self._baseline = recompiles
+            return
+        if self._baseline is None:
+            self._baseline = recompiles
+            return
+        if recompiles > self._baseline:
+            record_trip("retrace")
+            raise SanitizerError(
+                "retrace sanitizer: fused step recompiled at step %d "
+                "(%d -> %d trace signatures) after a %d-step warmup — a "
+                "steady-state retrace means some per-batch value is "
+                "changing the trace (shape, dtype, or a Python-level "
+                "config read). Inspect step.fused_recompiles / the "
+                "RecompileDetector anomaly for the signature."
+                % (self._steps, self._baseline, recompiles, self.warmup))
+
+
+# ---------------------------------------------------------------------------
+# donation sanitizer
+# ---------------------------------------------------------------------------
+
+class DonationSanitizer:
+    """Verifies donated buffers were actually consumed by XLA."""
+
+    @staticmethod
+    def check(label: str, leaves) -> None:
+        """``leaves``: the jax arrays that were passed in donated
+        positions of a dispatch that just ran. Any still-alive buffer
+        means the donation silently did not happen (backend refusal,
+        aliasing mismatch): the one-copy memory contract is broken."""
+        leaves = list(leaves)
+        alive = sum(1 for v in leaves
+                    if v is not None and hasattr(v, "is_deleted")
+                    and not v.is_deleted())
+        if alive:
+            record_trip("donation")
+            raise SanitizerError(
+                "donation sanitizer: %d of %d buffers donated to %s are "
+                "still alive after the dispatch — XLA did not consume "
+                "them, so the step is holding two copies of that state "
+                "(donation refused: check input/output layout or "
+                "sharding mismatches, or a backend that ignores "
+                "donate_argnums)."
+                % (alive, len(list(leaves)), label))

@@ -29,6 +29,7 @@ from collections import deque
 from typing import Callable, Iterable, List, Optional, Sequence
 
 from . import telemetry as _tel
+from . import env as _env
 from .base import MXNetError, getenv
 
 __all__ = ["Engine", "Var", "get_engine", "set_engine", "NaiveEngine",
@@ -188,8 +189,8 @@ class NaiveEngine(Engine):
         ret = fn()
         _tel.inc("engine.dispatch")
         _bump_versions(mutable_vars)
-        if prop == "fused_step" and not getenv("MXNET_TPU_ENGINE_SYNC",
-                                               False):
+        if prop == "fused_step" \
+                and not _env.get("MXNET_TPU_ENGINE_SYNC"):
             # the fused train step returns freshly-donated outputs; an
             # unconditional block here would serialize every batch on
             # the device instead of letting the next dispatch queue.
@@ -212,7 +213,9 @@ def _block_on(ret):
             _block_on(r)
         return
     if hasattr(ret, "block_until_ready"):
-        ret.block_until_ready()
+        # the engine's one sanctioned device sync (ENGINE_SYNC debug
+        # path and non-fused result barriers)
+        ret.block_until_ready()  # graft: host-sync
 
 
 class ThreadedEngine(Engine):

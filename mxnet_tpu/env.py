@@ -1,0 +1,384 @@
+"""Central registry for ``MXNET_TPU_*`` environment variables.
+
+PRs 4 and 5 each grew knobs faster than ``docs/env_vars.md`` tracked
+them (37 reads in code vs 31 documented at the PR 6 audit). The
+reference framework never had this problem because ``dmlc::GetEnv``
+call sites were greppable C++ and the docs were generated review
+gates; our Python equivalent drifted. This module makes drift
+impossible by construction:
+
+* every ``MXNET_TPU_*`` variable is **declared once** here with its
+  name, type, default and doc string;
+* every **read** goes through :func:`get` (reading an undeclared name
+  raises, and ``tools/graftlint.py``'s env-registry pass statically
+  rejects any ``os.environ`` / ``base.getenv`` read of a
+  ``MXNET_TPU_*`` literal outside this file);
+* the ``MXNET_TPU_*`` section of ``docs/env_vars.md`` is **generated**
+  from these declarations (:func:`generate_docs` / :func:`sync_docs`),
+  and ``tests/test_graftlint.py`` fails tier-1 when the checked-in doc
+  block differs from the registry.
+
+Writes (``os.environ[...] = ...`` for child processes, bench env
+overrides) are intentionally out of scope: the registry governs how
+configuration is *consumed*, not how harnesses stage it.
+
+Non-``MXNET_TPU_`` variables (``MXNET_ENGINE_TYPE``, ``MXTPU_PS_*``,
+``JAX_PLATFORMS``) keep their hand-written doc sections and the plain
+:func:`mxnet_tpu.base.getenv` accessor.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+__all__ = ["EnvVar", "declare", "get", "is_set", "declared", "var",
+           "generate_docs", "sync_docs", "DOC_BEGIN", "DOC_END"]
+
+_UNSET = object()
+
+
+class EnvVar:
+    """One declared environment variable: the (name, type, default,
+    doc) record the docs table and the lint pass are generated from."""
+
+    __slots__ = ("name", "type", "default", "doc", "section")
+
+    def __init__(self, name: str, type_: type, default, doc: str,
+                 section: str):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.doc = doc
+        self.section = section
+
+    def coerce(self, raw: str):
+        if self.type is bool:
+            return raw.lower() in ("1", "true", "yes", "on")
+        if self.type is int:
+            return int(raw)
+        if self.type is float:
+            return float(raw)
+        return raw
+
+
+_REGISTRY: Dict[str, EnvVar] = {}
+# section insertion order -> docs section order
+_SECTIONS: List[str] = []
+
+
+def declare(name: str, type_: type, default, doc: str,
+            section: str = "General") -> EnvVar:
+    """Register ``name``; call once per variable, at module definition
+    below (third parties may declare their own under a distinct
+    prefix)."""
+    if name in _REGISTRY:
+        raise ValueError("env var %r declared twice" % name)
+    v = EnvVar(name, type_, default, doc, section)
+    _REGISTRY[name] = v
+    if section not in _SECTIONS:
+        _SECTIONS.append(section)
+    return v
+
+
+def var(name: str) -> EnvVar:
+    """The declaration record for ``name`` (KeyError if undeclared)."""
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            "env var %r is not declared in mxnet_tpu/env.py; declare it "
+            "there (name, type, default, doc) before reading it" % name)
+
+
+def get(name: str, default: Any = _UNSET):
+    """Read a declared variable with type coercion from its declaration.
+
+    ``default`` overrides the declared default for call sites whose
+    fallback is dynamic (e.g. host CPU count); the declared default is
+    what the docs table shows.
+    """
+    v = var(name)
+    raw = os.environ.get(name)
+    if raw is None:
+        return v.default if default is _UNSET else default
+    return v.coerce(raw)
+
+
+def is_set(name: str) -> bool:
+    """True when the (declared) variable is present in the environment."""
+    var(name)
+    return name in os.environ
+
+
+def declared() -> Dict[str, EnvVar]:
+    """Name -> declaration, for the docs generator and the lint pass."""
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# declarations
+# ---------------------------------------------------------------------------
+
+_B = "Bench"
+
+declare("MXNET_TPU_FUSED_STEP", bool, False,
+        "`Module.fit` (and `FeedForward.fit` through it) compiles forward "
+        "+ backward + optimizer update — and, when every metric supports "
+        "it, the metric fold — into ONE donated XLA dispatch per batch "
+        "instead of three-plus. Falls back to the classic loop (silently, "
+        "per-configuration) for `dist_*` kvstores, custom-Python-`update` "
+        "optimizers, installed monitors, `inputs_need_grad=True`, "
+        "`grad_req=\"add\"`, and threaded engines. See \"Fused train "
+        "step\" in `performance.md`.",
+        section="Fused train step")
+declare("MXNET_TPU_FUSED_UPDATE", bool, True,
+        "Set to 0 to disable the stacked multi-param optimizer update "
+        "kernel (one XLA call per param group); also disables the fused "
+        "train step, which builds on it.",
+        section="Fused train step")
+declare("MXNET_TPU_ENGINE_SYNC", bool, False,
+        "Re-enable the engine's `block_until_ready` on fused-step "
+        "results. The fused step normally skips that block (its outputs "
+        "are freshly donated buffers; blocking would serialize every "
+        "batch on device completion) — set when debugging to surface "
+        "device errors at the step that caused them.",
+        section="Fused train step")
+declare("MXNET_TPU_DONATE", bool, True,
+        "Set to 0 to disable buffer donation in the fused optimizer "
+        "update kernels and the executor's fused fwd+bwd (aux). Default "
+        "ON under the inline engines (XLAEngine / NaiveEngine): XLA "
+        "writes new params/optimizer state/BN stats into the old HBM "
+        "buffers, so training holds one copy instead of a transient two. "
+        "Donation auto-disables under threaded engines (a queued reader "
+        "could observe a deleted buffer).",
+        section="Memory / donation")
+
+declare("MXNET_TPU_DECODE_PROCS", int, 0,
+        "Decode with N multiprocessing workers writing into the "
+        "shared-memory batch ring (same as constructing "
+        "`ImageRecordIter(..., preprocess_mode=\"process\")`; the env "
+        "var also sets the worker count). Default 0: the thread pool "
+        "(`preprocess_threads`) remains the in-process default. See "
+        "\"Input pipeline tuning\" in `performance.md`.",
+        section="Input pipeline")
+declare("MXNET_TPU_DECODE_RING", int, 0,
+        "Batch slots in the shared-memory ring (default "
+        "`max(2, 2 x workers)`); the decode-ahead depth, at "
+        "`slots x batch_bytes` of /dev/shm.",
+        section="Input pipeline")
+declare("MXNET_TPU_DECODE_START", str, "spawn",
+        "Multiprocessing start method for decode workers (`fork` is "
+        "unsafe next to a live TPU client).",
+        section="Input pipeline")
+declare("MXNET_TPU_DECODE_TIMEOUT", float, 120.0,
+        "Seconds the consumer waits on the ring before declaring the "
+        "pipeline wedged and falling back to in-process decode.",
+        section="Input pipeline")
+declare("MXNET_TPU_DEVICE_STAGING", bool, False,
+        "`fit()` wraps the training iterator in `DeviceStagingIter`: "
+        "`device_put` for batch N+1 is issued while step N executes, "
+        "overlapping H2D with compute.",
+        section="Input pipeline")
+declare("MXNET_TPU_DEVICE_FEED", bool, False,
+        "`CachedImageRecordIter` ships raw uint8 stored frames with "
+        "deferred augmentation params (`batch.aug`) instead of eagerly "
+        "augmented float32 crops: <= 1/3 the H2D bytes, and the fused "
+        "train step runs the augmentation inside its single donated "
+        "dispatch. Same as constructing the iterator with "
+        "`device_feed=True`. Non-fused consumers materialize the batch "
+        "transparently; results are bit-identical either way. See "
+        "\"Feeding the chip\" in `performance.md`.",
+        section="Input pipeline")
+declare("MXNET_TPU_FEED_DEPTH", int, 0,
+        "`fit()` wraps the training iterator in a `FeedScheduler`: a "
+        "worker thread keeps N staged batches in flight ahead of the "
+        "step loop (generalizes `MXNET_TPU_DEVICE_STAGING`'s double "
+        "buffer; subsumes it when both are set). The time each step "
+        "blocks on an empty queue lands in the `io.feed_stall_ms` "
+        "histogram for StepTrace's dominant-cause labeling. Default 0 "
+        "(off); 2-4 absorbs most host-side jitter at N batches of extra "
+        "memory.",
+        section="Input pipeline")
+
+declare("MXNET_TPU_SANITIZE", str, "",
+        "Comma-separated list of runtime sanitizers to arm (`transfer`, "
+        "`retrace`, `donation`, or `all`). `transfer` wraps the fused "
+        "step loop in `jax.transfer_guard(\"disallow\")` so any implicit "
+        "host<->device transfer (a numpy array leaking into the "
+        "dispatch, Python control flow on a device value) raises at the "
+        "step that caused it; `retrace` raises when "
+        "`step.fused_recompiles` grows after warmup (a silent "
+        "steady-state recompile); `donation` verifies donated buffers "
+        "were actually consumed by XLA. Trips are counted under "
+        "`sanitizer.trips`. See docs/static_analysis.md.",
+        section="Runtime sanitizers")
+declare("MXNET_TPU_SANITIZE_WARMUP", int, 3,
+        "Steps the retrace sanitizer treats as warmup before a fresh "
+        "fused-step trace signature becomes an error (shape buckets and "
+        "donation/fold config changes legitimately retrace early).",
+        section="Runtime sanitizers")
+
+declare("MXNET_TPU_BENCH_INPUT", str, "",
+        "Opt-in `bench.py` end-to-end tier: set to `1` (synthetic "
+        "recordio) or a `.rec` path to also train from `ImageRecordIter` "
+        "and report `input_imgs_per_sec` / `e2e_imgs_per_sec` beside the "
+        "device-resident number.", section=_B)
+declare("MXNET_TPU_BENCH_CACHE", bool, False,
+        "Allow the cache-fed tier to decode a USER-supplied .rec into a "
+        "full on-disk uint8 cache (ImageNet scale: ~250 GB — hence the "
+        "explicit opt-in; the bench's synthetic rec never needs it).",
+        section=_B)
+declare("MXNET_TPU_BENCH_THREADS", int, 0,
+        "Decode pool size for the end-to-end tier (default: host CPU "
+        "count).", section=_B)
+declare("MXNET_TPU_BENCH_TIMEOUT", int, 2400,
+        "Seconds the bench orchestrator gives the accelerator child "
+        "before falling back to CPU.", section=_B)
+declare("MXNET_TPU_BENCH_BATCH", int, 0,
+        "Override the per-device batch size of the device-resident bench "
+        "tier (default: the model recipe's batch).", section=_B)
+declare("MXNET_TPU_BENCH_STEPS", int, 0,
+        "Override the measured step count per bench tier (default: the "
+        "recipe's step budget).", section=_B)
+declare("MXNET_TPU_BENCH_DTYPE", str, "",
+        "Compute dtype for the bench model (default `bfloat16` on TPU — "
+        "MXU native — and `float32` elsewhere).", section=_B)
+declare("MXNET_TPU_BENCH_TRACE", str, "",
+        "Directory to capture a jax profiler trace of the measured bench "
+        "window into (empty: no trace).", section=_B)
+declare("MXNET_TPU_BENCH_INNER", bool, False,
+        "Set by the bench orchestrator in the child it spawns; marks the "
+        "process that actually measures (the parent only supervises the "
+        "timeout/CPU fallback). Not meant to be set by hand.", section=_B)
+declare("MXNET_TPU_BENCH_FORCE_EXPERIMENTS", bool, False,
+        "Run the accelerator-only MFU experiment grid even off-TPU "
+        "(produces `valid:false` rows; for exercising the harness).",
+        section=_B)
+declare("MXNET_TPU_STRICT_FEED_GATE", bool, False,
+        "Make the feed-the-chip test enforce the absolute host-feed-rate "
+        "bar (nightly boxes); unset, the bar is reported but only the "
+        "relative cached-vs-JPEG ratio is enforced.", section=_B)
+
+declare("MXNET_TPU_TELEMETRY", bool, False,
+        "Enable the framework-wide metric registry "
+        "(`mxnet_tpu.telemetry`): engine push/dispatch counters and "
+        "queue-wait histograms, io batch/prefetch-stall/decode-cache "
+        "metrics, executor forward/backward and JIT cache-hit counters, "
+        "kvstore op and byte counters, host-side spans. Off by default; "
+        "the disabled path is one module-flag check per call site (no "
+        "locks, no allocation). `telemetry.enable()` does the same at "
+        "runtime.", section="Telemetry")
+declare("MXNET_TPU_TELEMETRY_SPAN_CAP", int, 8192,
+        "Bound on the buffered host-span ring; oldest spans are dropped "
+        "first.", section="Telemetry")
+declare("MXNET_TPU_TELEMETRY_FSYNC", bool, False,
+        "fsync after every `telemetry.dump_jsonl` record. The append "
+        "itself is already crash-safe (one `os.write` on an `O_APPEND` "
+        "fd); the fsync is for machines where losing the last "
+        "OS-buffered lines to a power cut matters more than a syscall "
+        "per step.", section="Telemetry")
+
+_T = "Tracing / flight recorder (all require telemetry enabled)"
+declare("MXNET_TPU_METRICS_PORT", str, "",
+        "Start the live metrics server on this port at `fit()`/bench "
+        "entry: Prometheus text format at `/metrics` (every sample "
+        "labeled `rank=\"N\"`), liveness JSON at `/healthz`. Port `0` "
+        "binds an ephemeral port (tests). Unset: no server thread.",
+        section=_T)
+declare("MXNET_TPU_TRACE_ON_ANOMALY", bool, False,
+        "Anomaly events (slow step, steady-state recompile, "
+        "input-stalled step) auto-start a short XLA trace window while "
+        "the evidence is still happening.", section=_T)
+declare("MXNET_TPU_TRACE_DIR", str, "",
+        "Where anomaly trace windows are written (default "
+        "`$TMPDIR/mxnet_tpu_anomaly_trace/step<N>_<type>`).", section=_T)
+declare("MXNET_TPU_TRACE_WINDOW", int, 8,
+        "Steps an anomaly-triggered capture stays open.", section=_T)
+declare("MXNET_TPU_TRACE_COOLDOWN", float, 300.0,
+        "Seconds between anomaly-triggered captures; triggers inside the "
+        "cooldown are counted (`tracing.auto_trace_suppressed`) but not "
+        "traced.", section=_T)
+declare("MXNET_TPU_TRACE_RING", int, 512,
+        "Per-step records kept in the step-trace ring.", section=_T)
+declare("MXNET_TPU_TRACE_EVENT_COOLDOWN", int, 10,
+        "Minimum steps between two anomaly events of the same type, "
+        "bounding event spam from a persistently degraded run.",
+        section=_T)
+declare("MXNET_TPU_FLIGHT_RECORDER", bool, False,
+        "Install the crash-dump hooks at `fit()`/bench entry: unhandled "
+        "exception, SIGTERM (dump then terminate normally) and SIGUSR1 "
+        "(dump and keep running) write the last-N step records, "
+        "all-thread stacks and a telemetry snapshot into the crash "
+        "directory. See \"Interpreting step traces\" in "
+        "`performance.md`.", section=_T)
+declare("MXNET_TPU_CRASH_DIR", str, "",
+        "Where flight-recorder dumps land (default "
+        "`$TMPDIR/mxnet_tpu_crash`).", section=_T)
+
+declare("MXNET_TPU_NO_NATIVE", bool, False,
+        "Disable the C++ runtime library (pure-Python recordio + engines "
+        "only).", section="Native library / Pallas")
+declare("MXNET_TPU_NO_PALLAS", bool, False,
+        "Hard-disable all Pallas usage. (The former `MXNET_TPU_PALLAS` "
+        "fast-path gate is retired: on-chip measurement showed XLA wins "
+        "at every size, see docs/pallas.md; the kernels remain available "
+        "explicitly via `ops.pallas_kernels`, `rtc`, ring/Ulysses "
+        "attention.)", section="Native library / Pallas")
+
+
+# ---------------------------------------------------------------------------
+# docs generation
+# ---------------------------------------------------------------------------
+
+DOC_BEGIN = ("<!-- BEGIN MXNET_TPU ENV REGISTRY "
+             "(generated from mxnet_tpu/env.py; run "
+             "`python tools/graftlint.py --write-env-docs`; do not edit "
+             "by hand) -->")
+DOC_END = "<!-- END MXNET_TPU ENV REGISTRY -->"
+
+
+def _fmt_default(v: EnvVar) -> str:
+    if v.type is bool:
+        return "`1`" if v.default else "`0`"
+    if v.type is str:
+        return "unset" if v.default == "" else "`%s`" % v.default
+    return "`%s`" % (v.default,)
+
+
+def generate_docs() -> str:
+    """The generated `MXNET_TPU_*` block of docs/env_vars.md: every
+    declared variable, grouped by section, in declaration order."""
+    out = [DOC_BEGIN, ""]
+    for section in _SECTIONS:
+        out.append("## %s" % section)
+        out.append("")
+        for v in _REGISTRY.values():
+            if v.section != section:
+                continue
+            out.append("- `%s` (%s, default %s) — %s"
+                       % (v.name, v.type.__name__, _fmt_default(v), v.doc))
+        out.append("")
+    out.append(DOC_END)
+    return "\n".join(out)
+
+
+def sync_docs(path: str, check: bool = False) -> bool:
+    """Rewrite (or with ``check=True`` just verify) the generated block
+    between :data:`DOC_BEGIN` / :data:`DOC_END` markers in ``path``.
+    Returns True when the file already matched."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        head, rest = text.split(DOC_BEGIN, 1)
+        _, tail = rest.split(DOC_END, 1)
+    except ValueError:
+        raise ValueError("%s has no %r...%r markers" %
+                         (path, DOC_BEGIN[:30], DOC_END))
+    new = head + generate_docs() + tail
+    if new == text:
+        return True
+    if check:
+        return False
+    with open(path, "w") as f:
+        f.write(new)
+    return False

@@ -45,7 +45,8 @@ from __future__ import annotations
 import functools
 
 from . import telemetry as _tel
-from .base import getenv
+from . import env as _env
+from .analysis import sanitizers as _san
 from .engine import get_engine
 from .executor import zero_cotangent
 
@@ -54,7 +55,7 @@ __all__ = ["enabled", "make_fused_step", "FusedTrainStep"]
 
 def enabled() -> bool:
     """MXNET_TPU_FUSED_STEP=1 requests the fused path (default off)."""
-    return bool(getenv("MXNET_TPU_FUSED_STEP", False))
+    return _env.get("MXNET_TPU_FUSED_STEP")
 
 
 def make_fused_step(module, eval_metric):
@@ -85,7 +86,7 @@ def make_fused_step(module, eval_metric):
     if any(ex._grad_req[ex.arg_names[i]] != "write" for i in ex._grad_idx):
         return None
     opt = module._optimizer
-    if not opt._fusable() or not getenv("MXNET_TPU_FUSED_UPDATE", True):
+    if not opt._fusable() or not _env.get("MXNET_TPU_FUSED_UPDATE"):
         return None
     # every grad-bearing arg must map onto an updater slot
     param_idx = {n: i for i, n in enumerate(module._param_names)}
@@ -142,6 +143,8 @@ class FusedTrainStep:
 
         self._jit_cache = {}
         self._seen_sigs = set()
+        self._retrace_san = (_san.RetraceSanitizer()
+                             if _san.enabled("retrace") else None)
 
     def _foldable_leaves(self, eval_metric):
         """The metric's leaves when EVERY one can fold on device (and a
@@ -200,11 +203,16 @@ class FusedTrainStep:
         specs = []
         state_nds = []
         sv_mats = []
-        for (kind, n_states), members in groups.items():
-            specs.append((kind, n_states, tuple(m[0] for m in members)))
-            state_nds.append(tuple(m[1] for m in members))
-            sv_mats.append(jnp.asarray([m[2] for m in members],
-                                       jnp.float32))
+        # sanctioned H2D: the host-side update plans become one small
+        # device mat per param group (graftlint: jnp.asarray of a host
+        # list; transfer sanitizer: explicit allow window)
+        with _san.intentional_transfer():
+            for (kind, n_states), members in groups.items():
+                specs.append((kind, n_states,
+                              tuple(m[0] for m in members)))
+                state_nds.append(tuple(m[1] for m in members))
+                sv_mats.append(jnp.asarray([m[2] for m in members],
+                                           jnp.float32))
         specs = tuple(specs)
 
         from .optimizer import _donation_ok
@@ -229,7 +237,9 @@ class FusedTrainStep:
             fn = self._build(specs, clip is not None, donate, fold, feed)
             self._jit_cache[ck] = fn
 
-        key = ex._key()
+        with _san.intentional_transfer():
+            # fold_in of the host step counter: the one int H2D per step
+            key = ex._key()
         ex._last_key = key
         p_nds = [ex.arg_arrays[i] for i in self._p_arg_idx]
         o_nds = [ex.arg_arrays[i] for i in self._o_arg_idx]
@@ -245,13 +255,16 @@ class FusedTrainStep:
             import numpy as _np
 
             aug_vals = (
-                grp._place(_np.asarray(aug["tops"], _np.int32), 0)._data,
-                grp._place(_np.asarray(aug["lefts"], _np.int32), 0)._data,
-                grp._place(_np.asarray(aug["mirror"], bool), 0)._data,
-                grp._place(_np.asarray(aug["mean"], _np.float32),
-                           None)._data,
-                grp._place(_np.asarray(aug["scale"], _np.float32),
-                           None)._data,
+                grp._place(_np.asarray(aug["tops"],  # graft: host-sync
+                                       _np.int32), 0)._data,
+                grp._place(_np.asarray(aug["lefts"],  # graft: host-sync
+                                       _np.int32), 0)._data,
+                grp._place(_np.asarray(aug["mirror"],  # graft: host-sync
+                                       bool), 0)._data,
+                grp._place(_np.asarray(aug["mean"],  # graft: host-sync
+                                       _np.float32), None)._data,
+                grp._place(_np.asarray(aug["scale"],  # graft: host-sync
+                                       _np.float32), None)._data,
             )
             _tel.inc("step.fused_feed_batches")
         aux_vals = [a._data for a in ex.aux_arrays]
@@ -269,7 +282,9 @@ class FusedTrainStep:
                 from .metric import _replicated_zero
 
                 like = p_vals[0] if p_vals else None
-                acc = (_replicated_zero(like), _replicated_zero(like))
+                with _san.intentional_transfer():
+                    acc = (_replicated_zero(like),
+                           _replicated_zero(like))
             accs.append(tuple(acc))
         accs = tuple(accs)
 
@@ -281,6 +296,8 @@ class FusedTrainStep:
         if sig not in self._seen_sigs:
             self._seen_sigs.add(sig)
             _tel.inc("step.fused_recompiles")
+        if self._retrace_san is not None:
+            self._retrace_san.check(len(self._seen_sigs))
 
         module = self._module
         mut = [nd._var for nd in p_nds] \
@@ -309,6 +326,13 @@ class FusedTrainStep:
                 leaf._device_acc = acc
             ex._set_outputs(outs)
             ex._train_pending = False
+            if donate and _san.enabled("donation"):
+                # argnums (0, 2, 3, 5): params, aux, opt states, accs
+                _san.DonationSanitizer.check(
+                    "the fused step",
+                    p_vals + aux_vals
+                    + [s for g in st_vals for m in g for s in m]
+                    + [a for acc in accs for a in acc])
             return list(new_p)
 
         get_engine().push(_do, const_vars=[nd._var for nd in o_nds],

@@ -24,7 +24,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from . import telemetry as _tel
-from .base import MXNetError, Registry, getenv
+from . import env as _env
+from .base import MXNetError, Registry
 from .context import Context
 from .ndarray import NDArray, array
 
@@ -751,7 +752,7 @@ class ImageRecordIter(DataIter):
         # the GIL-bound thread pool for io_pipeline's multiprocess decode
         # into a shared-memory batch ring; results stay bit-identical
         # because every augmentation draw is keyed by (epoch, record idx)
-        env_procs = int(getenv("MXNET_TPU_DECODE_PROCS", 0))
+        env_procs = _env.get("MXNET_TPU_DECODE_PROCS")
         if preprocess_mode is None:
             preprocess_mode = "process" if env_procs > 0 else "thread"
         if preprocess_mode not in ("thread", "process"):

@@ -39,7 +39,8 @@ from typing import Optional, Tuple
 import numpy as np
 
 from . import telemetry as _tel
-from .base import MXNetError, getenv
+from . import env as _env
+from .base import MXNetError
 from .io import DataBatch, DataDesc, DataIter
 
 __all__ = ["build_decoded_cache", "CachedImageRecordIter",
@@ -370,7 +371,7 @@ class CachedImageRecordIter(DataIter):
         # metrics. The same host RNG draws as device_augment mode keep
         # the two bit-identical in what the model sees.
         if device_feed is None:
-            device_feed = bool(getenv("MXNET_TPU_DEVICE_FEED", False))
+            device_feed = _env.get("MXNET_TPU_DEVICE_FEED")
         self.device_feed = bool(device_feed)
         # NHWC consumers (channels-last towers) read batches without the
         # NCHW transpose — emitting their layout directly avoids a
@@ -430,7 +431,11 @@ class CachedImageRecordIter(DataIter):
                 return jnp.transpose(y, (0, 3, 1, 2)) if nchw else y
 
             self._norm_fn = norm
-        return self._norm_fn(batch_u8)
+        from .analysis import sanitizers as _san
+
+        # sanctioned H2D: the uint8 batch enters the device here
+        with _san.intentional_transfer():
+            return self._norm_fn(batch_u8)
 
     def _device_augment(self, full_u8, tops, lefts, mirror):
         """uint8 NHWC full frames + per-image crop offsets/mirror mask ->
@@ -456,7 +461,11 @@ class CachedImageRecordIter(DataIter):
                 return jnp.transpose(y, (0, 3, 1, 2)) if nchw else y
 
             self._aug_fn = aug
-        return self._aug_fn(full_u8, tops, lefts, mirror)
+        from .analysis import sanitizers as _san
+
+        # sanctioned H2D: stored frames + crop params enter the device
+        with _san.intentional_transfer():
+            return self._aug_fn(full_u8, tops, lefts, mirror)
 
     # -- DataIter interface ---------------------------------------------
     @property

@@ -50,7 +50,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import telemetry as _tel
-from .base import MXNetError, getenv
+from . import env as _env
+from .base import MXNetError
 from .io import DataBatch, DataIter, RecordDecoder
 
 __all__ = ["ShmRecordStore", "ShmBatchRing", "ProcessDecodePipeline",
@@ -268,12 +269,12 @@ class ProcessDecodePipeline:
                  timeout: Optional[float] = None):
         self.batch_size = int(batch_size)
         self.num_workers = max(1, int(num_workers))
-        method = start_method or getenv("MXNET_TPU_DECODE_START", "spawn")
+        method = start_method or _env.get("MXNET_TPU_DECODE_START")
         ctx = multiprocessing.get_context(method)
-        slots = num_slots or int(getenv("MXNET_TPU_DECODE_RING", 0)) \
+        slots = num_slots or _env.get("MXNET_TPU_DECODE_RING") \
             or max(2, 2 * self.num_workers)
         self.timeout = timeout if timeout is not None \
-            else float(getenv("MXNET_TPU_DECODE_TIMEOUT", 120.0))
+            else _env.get("MXNET_TPU_DECODE_TIMEOUT")
         self._store = ShmRecordStore.create(records)
         self._ring = ShmBatchRing(slots, batch_size,
                                   decoder_cfg["data_shape"], label_width)
@@ -565,7 +566,7 @@ def maybe_wrap_device_staging(data_iter: DataIter) -> DataIter:
     when ``MXNET_TPU_DEVICE_STAGING=1`` (idempotent). A
     :class:`FeedScheduler` already stages on its worker thread, so it is
     never double-wrapped."""
-    if not getenv("MXNET_TPU_DEVICE_STAGING", False):
+    if not _env.get("MXNET_TPU_DEVICE_STAGING"):
         return data_iter
     if isinstance(data_iter, (DeviceStagingIter, FeedScheduler)):
         return data_iter
@@ -730,7 +731,7 @@ def maybe_wrap_feed_scheduler(data_iter: DataIter) -> DataIter:
     """Fit-loop hook: wrap ``data_iter`` in :class:`FeedScheduler` when
     ``MXNET_TPU_FEED_DEPTH`` >= 1 (idempotent; subsumes device
     staging)."""
-    depth = int(getenv("MXNET_TPU_FEED_DEPTH", 0))
+    depth = _env.get("MXNET_TPU_FEED_DEPTH")
     if depth <= 0:
         return data_iter
     if isinstance(data_iter, FeedScheduler):

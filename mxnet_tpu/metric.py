@@ -152,7 +152,10 @@ class EvalMetric:
             self._fold_fn = fn
         acc = self._device_acc
         if acc is None:
-            z = _replicated_zero(preds[0]._data)
+            from .analysis import sanitizers as _san
+
+            with _san.intentional_transfer():
+                z = _replicated_zero(preds[0]._data)
             acc = (z, z)
         _tel.inc("step.dispatches")
         self._device_acc = self._fold_fn(
@@ -162,11 +165,14 @@ class EvalMetric:
     def _host_totals(self):
         """(sum, count) with the device accumulator folded in — the ONLY
         place the accumulator syncs to the host."""
+        from .analysis import sanitizers as _san
+
         s, n = self.sum_metric, self.num_inst
         if self._device_acc is not None:
             acc_s, acc_c = self._device_acc
-            s = s + float(acc_s)
-            n = n + float(acc_c)
+            with _san.intentional_transfer():
+                s = s + float(acc_s)  # graft: host-sync
+                n = n + float(acc_c)  # graft: host-sync
         return s, n
 
     def update(self, labels: Sequence[NDArray], preds: Sequence[NDArray]):
@@ -210,9 +216,9 @@ class Accuracy(EvalMetric):
         if self._lazy_update(labels, preds):
             return
         for label, pred in zip(labels, preds):
-            p = pred.asnumpy()
+            p = pred.asnumpy()  # graft: host-sync
             pred_label = np.argmax(p, axis=1) if p.ndim > 1 else p
-            lab = label.asnumpy().astype(np.int32).ravel()
+            lab = label.asnumpy().astype(np.int32).ravel()  # graft: host-sync
             self.sum_metric += int((pred_label.astype(np.int32).ravel() == lab).sum())
             self.num_inst += len(lab)
 
@@ -243,8 +249,8 @@ class TopKAccuracy(EvalMetric):
         if self._lazy_update(labels, preds):
             return
         for label, pred in zip(labels, preds):
-            p = pred.asnumpy().astype(np.float32)
-            lab = label.asnumpy().astype(np.int32)
+            p = pred.asnumpy().astype(np.float32)  # graft: host-sync
+            lab = label.asnumpy().astype(np.int32)  # graft: host-sync
             topk = np.argsort(p, axis=1)[:, -self.top_k:]
             for i in range(len(lab)):
                 self.sum_metric += int(lab[i] in topk[i])
@@ -261,8 +267,8 @@ class F1(EvalMetric):
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
-            p = np.argmax(pred.asnumpy(), axis=1)
-            lab = label.asnumpy().astype(np.int32).ravel()
+            p = np.argmax(pred.asnumpy(), axis=1)  # graft: host-sync
+            lab = label.asnumpy().astype(np.int32).ravel()  # graft: host-sync
             if len(np.unique(lab)) > 2:
                 raise MXNetError("F1 supports binary classification only")
             tp = int(((p == 1) & (lab == 1)).sum())
@@ -294,8 +300,8 @@ class MAE(EvalMetric):
         if self._lazy_update(labels, preds):
             return
         for label, pred in zip(labels, preds):
-            l_np = label.asnumpy()
-            p_np = pred.asnumpy().reshape(l_np.shape)
+            l_np = label.asnumpy()  # graft: host-sync
+            p_np = pred.asnumpy().reshape(l_np.shape)  # graft: host-sync
             self.sum_metric += float(np.abs(l_np - p_np).mean())
             self.num_inst += 1
 
@@ -318,8 +324,8 @@ class MSE(EvalMetric):
         if self._lazy_update(labels, preds):
             return
         for label, pred in zip(labels, preds):
-            l_np = label.asnumpy()
-            p_np = pred.asnumpy().reshape(l_np.shape)
+            l_np = label.asnumpy()  # graft: host-sync
+            p_np = pred.asnumpy().reshape(l_np.shape)  # graft: host-sync
             self.sum_metric += float(((l_np - p_np) ** 2).mean())
             self.num_inst += 1
 
@@ -342,8 +348,8 @@ class RMSE(EvalMetric):
         if self._lazy_update(labels, preds):
             return
         for label, pred in zip(labels, preds):
-            l_np = label.asnumpy()
-            p_np = pred.asnumpy().reshape(l_np.shape)
+            l_np = label.asnumpy()  # graft: host-sync
+            p_np = pred.asnumpy().reshape(l_np.shape)  # graft: host-sync
             self.sum_metric += float(np.sqrt(((l_np - p_np) ** 2).mean()))
             self.num_inst += 1
 
@@ -373,8 +379,8 @@ class CrossEntropy(EvalMetric):
         if self._lazy_update(labels, preds):
             return
         for label, pred in zip(labels, preds):
-            lab = label.asnumpy().astype(np.int32).ravel()
-            p = pred.asnumpy()
+            lab = label.asnumpy().astype(np.int32).ravel()  # graft: host-sync
+            p = pred.asnumpy()  # graft: host-sync
             prob = p[np.arange(lab.shape[0]), lab]
             self.sum_metric += float((-np.log(prob + self.eps)).sum())
             self.num_inst += len(lab)
@@ -422,6 +428,7 @@ class CustomMetric(EvalMetric):
         if not self._allow_extra_outputs:
             check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
+            # graft: host-sync
             reval = self._feval(label.asnumpy(), pred.asnumpy())
             if isinstance(reval, tuple):
                 sum_metric, num_inst = reval

@@ -2,6 +2,7 @@
 (reference ``python/mxnet/module/base_module.py``)."""
 from __future__ import annotations
 
+import contextlib as _contextlib
 import logging
 import time
 from collections import namedtuple
@@ -12,6 +13,7 @@ from .. import metric as _metric
 from .. import ndarray as nd
 from .. import telemetry as _tel
 from .. import tracing as _tracing
+from ..analysis import sanitizers as _san
 from ..initializer import Uniform
 from ..io import DataBatch
 
@@ -243,31 +245,48 @@ class BaseModule:
             # step that waited on it, not lost between timers
             t_last = time.perf_counter() if _tel.enabled() else 0.0
             nbatch = -1
-            for nbatch, data_batch in enumerate(train_data):
-                if monitor is not None:
-                    monitor.tic()
-                if fused is not None:
-                    fused.step(data_batch, eval_metric)
-                else:
-                    # device-feed batches (batch.aug) are materialized
-                    # eagerly inside load_data_batch on this path
-                    self.forward_backward(data_batch)
-                    self.update()
-                    self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if _tel.enabled():
-                    now = time.perf_counter()
-                    _tracing.record_step((now - t_last) * 1e3,
-                                         extra={"epoch": epoch,
-                                                "nbatch": nbatch})
-                    t_last = now
-                if batch_end_callback is not None:
-                    params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                           eval_metric=eval_metric,
-                                           locals=locals())
-                    for cb in _as_list(batch_end_callback):
-                        cb(params)
+            # MXNET_TPU_SANITIZE=transfer (fused path only: the classic
+            # loop updates metrics host-side by design): any implicit
+            # host<->device transfer inside the step loop raises at the
+            # batch that caused it; sanctioned marshalling sits inside
+            # intentional_transfer() windows
+            guard = (_san.step_guard() if fused is not None
+                     else _contextlib.nullcontext())
+            try:
+                with guard:
+                    for nbatch, data_batch in enumerate(train_data):
+                        if monitor is not None:
+                            monitor.tic()
+                        if fused is not None:
+                            fused.step(data_batch, eval_metric)
+                        else:
+                            # device-feed batches (batch.aug) are
+                            # materialized eagerly inside
+                            # load_data_batch on this path
+                            self.forward_backward(data_batch)
+                            self.update()
+                            self.update_metric(eval_metric,
+                                               data_batch.label)
+                        if monitor is not None:
+                            monitor.toc_print()
+                        if _tel.enabled():
+                            now = time.perf_counter()
+                            _tracing.record_step(
+                                (now - t_last) * 1e3,
+                                extra={"epoch": epoch,
+                                       "nbatch": nbatch})
+                            t_last = now
+                        if batch_end_callback is not None:
+                            params = BatchEndParam(
+                                epoch=epoch, nbatch=nbatch,
+                                eval_metric=eval_metric,
+                                locals=locals())
+                            for cb in _as_list(batch_end_callback):
+                                cb(params)
+            except Exception as e:
+                if _san.is_transfer_guard_error(e):
+                    _san.record_trip("transfer")
+                raise
             if batch_end_callback is not None and nbatch >= 0:
                 # callbacks with an epoch_end hook (Speedometer) get to
                 # report their partial tail window instead of dropping it

@@ -247,7 +247,17 @@ class NDArray:
 
         def _do():
             if full_write and not np.isscalar(val):
-                v = jnp.asarray(val, dtype=self.dtype)
+                if isinstance(val, np.ndarray):
+                    # own the storage: jnp.asarray zero-copy borrows
+                    # host memory on CPU, so the array would alias the
+                    # caller's buffer — a later caller mutation writes
+                    # through us, and if the source is a view of a
+                    # device buffer (asnumpy), the borrow pins that
+                    # buffer against donation (the fused step then
+                    # silently holds two copies of the state)
+                    v = jnp.array(val, dtype=self.dtype)
+                else:
+                    v = jnp.asarray(val, dtype=self.dtype)
                 if v.shape != self.shape:
                     v = jnp.broadcast_to(v, self.shape)
                 self._data = v

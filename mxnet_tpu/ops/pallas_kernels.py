@@ -21,7 +21,7 @@ from typing import Optional
 
 import numpy as np
 
-from ..base import getenv
+from .. import env as _env
 
 __all__ = ["fused_linear", "flash_attention", "pallas_available"]
 
@@ -33,7 +33,7 @@ TILE_K = 128
 
 @functools.lru_cache(None)
 def pallas_available() -> bool:
-    if getenv("MXNET_TPU_NO_PALLAS", False):
+    if _env.get("MXNET_TPU_NO_PALLAS"):
         return False
     try:
         import jax

@@ -59,10 +59,10 @@ def _donation_ok() -> bool:
     NaiveEngine, the defaults). A threaded engine may interleave a direct
     ``_data`` read between the donating dispatch and the write-back, and
     donation turns that stale read into a deleted-buffer error."""
-    from .base import getenv
+    from . import env as _env
     from .engine import NaiveEngine, XLAEngine, get_engine
 
-    if not getenv("MXNET_TPU_DONATE", True):
+    if not _env.get("MXNET_TPU_DONATE"):
         return False
     # allowlist, not a not-ThreadedEngine check: native or third-party
     # engines that run closures on worker threads must stay excluded too
@@ -301,10 +301,10 @@ class Optimizer:
         Falls back to sequential update() when no plan describes the
         effective update() or fusion is disabled
         (MXNET_TPU_FUSED_UPDATE=0)."""
-        from .base import getenv
+        from . import env as _env
 
         if not self._fusable() \
-                or not getenv("MXNET_TPU_FUSED_UPDATE", True):
+                or not _env.get("MXNET_TPU_FUSED_UPDATE"):
             for i, w, g, s in items:
                 self.update(i, w, g, s)
             return

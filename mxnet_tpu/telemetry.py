@@ -45,20 +45,21 @@ import time
 from collections import deque
 from typing import Dict, Optional
 
-from .base import MXNetError, getenv
+from . import env as _env
+from .base import MXNetError
 
 __all__ = ["enabled", "enable", "disable", "counter", "gauge", "histogram",
            "inc", "set_gauge", "observe", "span", "snapshot", "reset",
            "dump_jsonl", "write_chrome_trace", "Counter", "Gauge",
            "Histogram", "peek", "metrics_items"]
 
-_ENABLED = bool(getenv("MXNET_TPU_TELEMETRY", False))
+_ENABLED = _env.get("MXNET_TPU_TELEMETRY")
 
 _reg_lock = threading.Lock()
 _metrics: Dict[str, object] = {}
 
 # span ring: bounded so a never-exported long run cannot grow host memory
-_SPAN_CAP = int(getenv("MXNET_TPU_TELEMETRY_SPAN_CAP", 8192))
+_SPAN_CAP = _env.get("MXNET_TPU_TELEMETRY_SPAN_CAP")
 _spans: deque = deque(maxlen=_SPAN_CAP)
 # perf_counter -> wall-clock offset, fixed at import so span timestamps
 # from every thread share one epoch (and can be laid next to an XLA
@@ -348,7 +349,7 @@ def dump_jsonl(path: str, extra: Optional[dict] = None) -> dict:
     fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
     try:
         os.write(fd, line)
-        if getenv("MXNET_TPU_TELEMETRY_FSYNC", False):
+        if _env.get("MXNET_TPU_TELEMETRY_FSYNC"):
             os.fsync(fd)
     finally:
         os.close(fd)

@@ -51,7 +51,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from . import telemetry as _tel
-from .base import getenv
+from . import env as _env
 
 __all__ = ["StepTrace", "SlowStepDetector", "RecompileDetector",
            "InputStallDetector", "AnomalyProfiler", "FlightRecorder",
@@ -75,6 +75,7 @@ DELTA_SOURCES = (
     ("recompiles", "executor.jit_build", "counter"),
     ("dispatches", "step.dispatches", "counter"),
     ("fused_recompiles", "step.fused_recompiles", "counter"),
+    ("sanitizer_trips", "sanitizer.trips", "counter"),
 )
 
 _STALL_FIELDS = ("io_stall_ms", "prefetch_stall_ms", "feed_stall_ms")
@@ -177,13 +178,14 @@ class AnomalyProfiler:
                  cooldown_s: Optional[float] = None,
                  start_fn: Optional[Callable] = None,
                  stop_fn: Optional[Callable] = None):
-        self.trace_dir = trace_dir or getenv(
+        self.trace_dir = trace_dir or _env.get(
             "MXNET_TPU_TRACE_DIR",
-            os.path.join(tempfile.gettempdir(), "mxnet_tpu_anomaly_trace"))
+            default=os.path.join(tempfile.gettempdir(),
+                                 "mxnet_tpu_anomaly_trace"))
         self.window_steps = int(window_steps if window_steps is not None
-                                else getenv("MXNET_TPU_TRACE_WINDOW", 8))
+                                else _env.get("MXNET_TPU_TRACE_WINDOW"))
         self.cooldown_s = float(cooldown_s if cooldown_s is not None
-                                else getenv("MXNET_TPU_TRACE_COOLDOWN", 300.0))
+                                else _env.get("MXNET_TPU_TRACE_COOLDOWN"))
         self._start_fn = start_fn
         self._stop_fn = stop_fn
         self._last_start: Optional[float] = None
@@ -263,20 +265,20 @@ class StepTrace:
                  profiler: Optional[AnomalyProfiler] = None,
                  event_cooldown: Optional[int] = None):
         cap = int(capacity if capacity is not None
-                  else getenv("MXNET_TPU_TRACE_RING", 512))
+                  else _env.get("MXNET_TPU_TRACE_RING"))
         self._ring: deque = deque(maxlen=max(1, cap))
         self._lock = threading.Lock()
         self._step = 0
         self._prev = self._raw_values()
         self.detectors = (default_detectors() if detectors is None
                           else list(detectors))
-        if profiler is None and getenv("MXNET_TPU_TRACE_ON_ANOMALY", False):
+        if profiler is None and _env.get("MXNET_TPU_TRACE_ON_ANOMALY"):
             profiler = AnomalyProfiler()
         self.profiler = profiler
         self.events: deque = deque(maxlen=256)
         self.event_cooldown = int(
             event_cooldown if event_cooldown is not None
-            else getenv("MXNET_TPU_TRACE_EVENT_COOLDOWN", 10))
+            else _env.get("MXNET_TPU_TRACE_EVENT_COOLDOWN"))
         self._last_event_step: Dict[str, int] = {}
 
     @staticmethod
@@ -398,9 +400,9 @@ class FlightRecorder:
     terminates with default semantics."""
 
     def __init__(self, crash_dir: Optional[str] = None, trace=None):
-        self.crash_dir = crash_dir or getenv(
+        self.crash_dir = crash_dir or _env.get(
             "MXNET_TPU_CRASH_DIR",
-            os.path.join(tempfile.gettempdir(), "mxnet_tpu_crash"))
+            default=os.path.join(tempfile.gettempdir(), "mxnet_tpu_crash"))
         self._trace = trace
         self._installed = False
         self._prev_excepthook = None
@@ -644,7 +646,7 @@ def maybe_init():
         return None
     global _metrics_server, _flight_recorder
     with _init_lock:
-        port = os.environ.get("MXNET_TPU_METRICS_PORT")
+        port = _env.get("MXNET_TPU_METRICS_PORT")
         if _metrics_server is None and port:
             try:
                 _metrics_server = MetricsServer(int(port))
@@ -654,7 +656,7 @@ def maybe_init():
                 _log.warning("metrics server failed to start on %r: %s",
                              port, e)
         if _flight_recorder is None \
-                and getenv("MXNET_TPU_FLIGHT_RECORDER", False):
+                and _env.get("MXNET_TPU_FLIGHT_RECORDER"):
             _flight_recorder = FlightRecorder().install()
     return _metrics_server
 

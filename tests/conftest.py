@@ -24,3 +24,20 @@ os.environ.setdefault("MXTPU_PS_SECRET", "test-suite-token")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+# modules exercising the fused one-dispatch step run with the transfer
+# sanitizer armed: jax.transfer_guard("disallow") around every fit's
+# step loop, so an implicit host<->device transfer regression in the
+# fused path fails these suites at the batch that caused it (see
+# docs/static_analysis.md)
+_TRANSFER_SANITIZED = {"test_fused_step", "test_fused_feed"}
+
+
+@pytest.fixture(autouse=True)
+def _arm_transfer_sanitizer(request, monkeypatch):
+    if request.module.__name__.rpartition(".")[2] in _TRANSFER_SANITIZED \
+            and "MXNET_TPU_SANITIZE" not in os.environ:
+        monkeypatch.setenv("MXNET_TPU_SANITIZE", "transfer")
+    yield

@@ -20,7 +20,7 @@ import sys
 
 DELTA_COLS = ("io_stall_ms", "prefetch_stall_ms", "h2d_bytes",
               "kv_push_bytes", "kv_pull_bytes", "recompiles",
-              "dispatches", "fused_recompiles")
+              "dispatches", "fused_recompiles", "sanitizer_trips")
 
 
 def load_records(path):
@@ -61,7 +61,7 @@ def render(records, top=10):
     lats = sorted(r["latency_ms"] for r in records)
     header = ("step", "latency_ms", "dominant", "io_stall_ms",
               "prefetch_ms", "h2d", "kv_push", "kv_pull", "recompiles",
-              "dispatch", "fused_rc")
+              "dispatch", "fused_rc", "san_trips")
     rows = [header]
     for r in slowest:
         d = r.get("deltas", {})
@@ -77,6 +77,7 @@ def render(records, top=10):
             str(d.get("recompiles", 0)),
             str(d.get("dispatches", 0)),
             str(d.get("fused_recompiles", 0)),
+            str(d.get("sanitizer_trips", 0)),
         ))
     widths = [max(len(row[i]) for row in rows) for i in range(len(header))]
     out = ["%d steps, latency p50=%.2fms max=%.2fms; top %d slowest:"
