@@ -1,16 +1,22 @@
-"""Static analysis (graftlint) + runtime sanitizers for JAX hazards.
+"""Static analysis (graftlint/graftrace) + runtime sanitizers.
 
-``graftlint`` is the AST pass (host-sync / donation / tracer /
-env-registry rule families, baseline-gated in tier-1 via
-``tests/test_graftlint.py``; CLI at ``tools/graftlint.py``).
+``graftlint`` is the AST pass for JAX hazards (host-sync / donation /
+tracer / env-registry rule families); ``graftrace`` registers the
+concurrency families (lock-order / blocking-under-lock /
+thread-lifecycle / fork-safety) into the same driver. Both are
+baseline-gated in tier-1 (``tests/test_graftlint.py`` /
+``tests/test_graftrace.py``; CLI at ``tools/graftlint.py``).
 ``sanitizers`` is the runtime half, armed with ``MXNET_TPU_SANITIZE``.
 See docs/static_analysis.md.
 """
 from . import graftlint, sanitizers  # noqa: F401
+from . import graftrace  # noqa: F401  (registers concurrency rules)
 from .graftlint import Config, Finding, analyze_paths, analyze_source
-from .sanitizers import (DonationSanitizer, RetraceSanitizer,
+from .sanitizers import (DeadlockWatchdog, DonationSanitizer,
+                         InstrumentedLock, RetraceSanitizer,
                          SanitizerError)
 
-__all__ = ["graftlint", "sanitizers", "Config", "Finding",
+__all__ = ["graftlint", "graftrace", "sanitizers", "Config", "Finding",
            "analyze_paths", "analyze_source", "SanitizerError",
-           "RetraceSanitizer", "DonationSanitizer"]
+           "RetraceSanitizer", "DonationSanitizer", "InstrumentedLock",
+           "DeadlockWatchdog"]

@@ -28,6 +28,26 @@ donation armed, what shapes arrive — are checked here, armed via
     buffer means XLA kept a copy: the memory headroom the fused step
     promises (one copy of the training state) silently does not exist.
 
+``locks``
+    The runtime half of graftrace's ``lock-order`` rule:
+    :func:`maybe_instrument` wraps the threaded plane's locks in
+    :class:`InstrumentedLock`, which records per-thread acquisition
+    stacks into a process-global :class:`LockOrderRegistry` and raises
+    *before* acquiring when the acquisition would invert an order the
+    process has already exhibited — the ABBA deadlock surfaces as a
+    ``SanitizerError`` with both witness stacks instead of a hang.
+    Also feeds ``lock.wait_ms`` / ``lock.wait_ms.<name>`` contention
+    histograms (see ``tools/trace_report.py``).
+
+``deadlock``
+    A :class:`DeadlockWatchdog` daemon thread (started by
+    ``tracing.maybe_init``, stopped by ``tracing.shutdown``) polls a
+    progress signal (default: the global step counter) every
+    ``MXNET_TPU_WATCHDOG_INTERVAL`` seconds; when it stalls past
+    ``MXNET_TPU_WATCHDOG_S`` it counts ``sanitizer.trips.deadlock``
+    and dumps all-thread stacks through the FlightRecorder. It never
+    raises (it is not on any useful thread); the dump is the product.
+
 Every trip increments ``sanitizer.trips`` and
 ``sanitizer.trips.<kind>`` before raising, so a supervised run's
 telemetry (and ``tools/trace_report.py``) shows which sanitizer fired
@@ -36,6 +56,9 @@ even when the raise was swallowed by a retry harness.
 from __future__ import annotations
 
 import contextlib
+import threading
+import time
+import traceback
 
 from .. import env as _env
 from .. import telemetry as _tel
@@ -43,9 +66,11 @@ from ..base import MXNetError
 
 __all__ = ["SanitizerError", "enabled", "enabled_kinds", "step_guard",
            "intentional_transfer", "record_trip", "RetraceSanitizer",
-           "DonationSanitizer", "is_transfer_guard_error", "KINDS"]
+           "DonationSanitizer", "is_transfer_guard_error", "KINDS",
+           "LockOrderRegistry", "InstrumentedLock", "maybe_instrument",
+           "DeadlockWatchdog", "lock_order_registry"]
 
-KINDS = ("transfer", "retrace", "donation")
+KINDS = ("transfer", "retrace", "donation", "locks", "deadlock")
 
 
 class SanitizerError(MXNetError):
@@ -180,3 +205,222 @@ class DonationSanitizer:
                 "sharding mismatches, or a backend that ignores "
                 "donate_argnums)."
                 % (alive, len(list(leaves)), label))
+
+
+# ---------------------------------------------------------------------------
+# lock-order sanitizer
+# ---------------------------------------------------------------------------
+
+class LockOrderRegistry:
+    """Process-global record of observed lock-acquisition order.
+
+    ``check_acquire(name)`` is called by :class:`InstrumentedLock`
+    *before* blocking on the raw lock: for every lock the calling
+    thread already holds, the pair ``(held, name)`` becomes a directed
+    order edge. If the reverse edge ``(name, held)`` was ever observed
+    — by any thread, any time earlier in the process — the acquisition
+    is a lock-order inversion that can deadlock under the right
+    interleaving, and we raise *instead of acquiring* (a report beats a
+    hang). Both witness stacks (the historical edge's and the current
+    one) ride in the error.
+
+    Held sets are tracked per-thread at acquire/release time only; a
+    ``Condition.wait()`` briefly releasing its inner lock is invisible
+    here, which only makes the checker conservative about order, never
+    about correctness of the report.
+    """
+
+    def __init__(self):
+        self._tls = threading.local()
+        self._edges = {}            # (first, second) -> witness stack str
+        self._reg_lock = threading.Lock()
+
+    def _held(self):
+        h = getattr(self._tls, "held", None)
+        if h is None:
+            h = self._tls.held = []
+        return h
+
+    def check_acquire(self, name: str) -> None:
+        held = self._held()
+        if name in held:    # re-entrant (RLock) — no new edge
+            return
+        stack = "".join(traceback.format_stack(limit=12)[:-2])
+        with self._reg_lock:
+            for h in held:
+                prior = self._edges.get((name, h))
+                if prior is not None:
+                    record_trip("locks")
+                    raise SanitizerError(
+                        "lock-order sanitizer: acquiring %r while "
+                        "holding %r, but the opposite order was "
+                        "observed earlier in this process — an ABBA "
+                        "inversion that deadlocks under the right "
+                        "interleaving.\n--- earlier %r-then-%r "
+                        "acquisition ---\n%s--- this acquisition ---\n%s"
+                        % (name, h, name, h, prior, stack))
+            for h in held:
+                self._edges.setdefault((h, name), stack)
+
+    def note_acquired(self, name: str) -> None:
+        self._held().append(name)
+
+    def note_released(self, name: str) -> None:
+        held = self._held()
+        if name in held:
+            # remove the innermost occurrence (LIFO discipline)
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] == name:
+                    del held[i]
+                    break
+
+    def reset(self):
+        """Forget all edges (tests)."""
+        with self._reg_lock:
+            self._edges.clear()
+
+
+_lock_registry = LockOrderRegistry()
+
+
+def lock_order_registry() -> LockOrderRegistry:
+    return _lock_registry
+
+
+class InstrumentedLock:
+    """Delegating wrapper around a ``Lock``/``RLock``/``Condition``
+    that feeds :class:`LockOrderRegistry` and the ``lock.wait_ms``
+    contention histograms. Everything not intercepted (``wait``,
+    ``notify``, ``notify_all``, ...) passes through to the raw object,
+    so a wrapped ``Condition`` keeps full CV semantics."""
+
+    def __init__(self, raw, name: str, registry=None):
+        self._raw = raw
+        self._name = name
+        self._registry = registry or _lock_registry
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if blocking:
+            self._registry.check_acquire(self._name)
+        t0 = time.perf_counter()
+        if timeout is None or timeout < 0:
+            ok = self._raw.acquire(blocking)
+        else:
+            ok = self._raw.acquire(blocking, timeout)
+        if ok:
+            wait_ms = (time.perf_counter() - t0) * 1e3
+            _tel.observe("lock.wait_ms", wait_ms)
+            _tel.observe("lock.wait_ms.%s" % self._name, wait_ms)
+            self._registry.note_acquired(self._name)
+        return ok
+
+    def release(self):
+        self._raw.release()
+        self._registry.note_released(self._name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, attr):
+        return getattr(self._raw, attr)
+
+    def __repr__(self):
+        return "InstrumentedLock(%r, %r)" % (self._name, self._raw)
+
+
+def maybe_instrument(raw, name: str):
+    """Wrap ``raw`` in an :class:`InstrumentedLock` when the ``locks``
+    sanitizer is armed; return it untouched otherwise. Call sites (the
+    engine's condition pair, ps's lock/barrier) pay one env check at
+    construction, zero per acquisition when off."""
+    if not enabled("locks"):
+        return raw
+    return InstrumentedLock(raw, name)
+
+
+# ---------------------------------------------------------------------------
+# deadlock watchdog
+# ---------------------------------------------------------------------------
+
+class DeadlockWatchdog:
+    """Daemon thread that trips when a progress signal stalls.
+
+    ``progress_fn`` returns any comparable value; while it keeps
+    changing the watchdog is quiet. Once it has been flat for
+    ``threshold_s`` the watchdog counts ``sanitizer.trips.deadlock``
+    and dumps all-thread stacks through the FlightRecorder (the
+    installed one if tracing armed it, else a throwaway instance — the
+    dump directory is the product either way), then re-arms only after
+    progress resumes so a long stall produces one dump, not one per
+    poll. It never raises: a watchdog thread has nobody to catch."""
+
+    def __init__(self, progress_fn=None, threshold_s: float = None,
+                 interval_s: float = None):
+        if progress_fn is None:
+            from .. import tracing as _tracing
+            progress_fn = lambda: _tracing.step_trace().step  # noqa: E731
+        self._progress_fn = progress_fn
+        self._threshold = (threshold_s if threshold_s is not None
+                           else float(_env.get("MXNET_TPU_WATCHDOG_S")))
+        self._interval = (interval_s if interval_s is not None
+                          else float(
+                              _env.get("MXNET_TPU_WATCHDOG_INTERVAL")))
+        self._stop = threading.Event()
+        self._thread = None
+        self.trips = 0
+        self.last_dump = None
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="mxtpu-watchdog", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=max(5.0, 2 * self._interval))
+        self._thread = None
+
+    def _dump(self, stalled_s: float, value):
+        from .. import tracing as _tracing
+        fr = _tracing.flight_recorder()
+        if fr is None:
+            fr = _tracing.FlightRecorder()
+        try:
+            return fr.dump("deadlock-watchdog: no progress for %.1fs "
+                           "(signal stuck at %r)" % (stalled_s, value))
+        except Exception:   # the dump must never kill the watchdog
+            return None
+
+    def _run(self):
+        try:
+            last = self._progress_fn()
+        except Exception:
+            last = None
+        last_change = time.monotonic()
+        tripped = False
+        while not self._stop.wait(self._interval):
+            try:
+                cur = self._progress_fn()
+            except Exception:
+                continue
+            now = time.monotonic()
+            if cur != last:
+                last, last_change, tripped = cur, now, False
+                continue
+            stalled = now - last_change
+            if stalled >= self._threshold and not tripped:
+                tripped = True
+                self.trips += 1
+                record_trip("deadlock")
+                self.last_dump = self._dump(stalled, cur)

@@ -229,11 +229,14 @@ class ThreadedEngine(Engine):
     """
 
     def __init__(self, num_workers: Optional[int] = None):
+        from .analysis import sanitizers as _san
         self._num_workers = num_workers or getenv("MXNET_CPU_WORKER_NTHREADS", 4)
         self._heap: List = []
-        self._heap_lock = threading.Condition()
+        self._heap_lock = _san.maybe_instrument(threading.Condition(),
+                                                "engine-heap")
         self._pending = 0
-        self._pending_lock = threading.Condition()
+        self._pending_lock = _san.maybe_instrument(threading.Condition(),
+                                                   "engine-pending")
         self._seq = itertools.count()
         self._shutdown = False
         self._workers = []
@@ -393,8 +396,10 @@ class ThreadedEnginePooled(ThreadedEngine):
     def __init__(self, num_workers: Optional[int] = None,
                  num_io_workers: Optional[int] = None):
         super().__init__(num_workers)
+        from .analysis import sanitizers as _san
         self._io_heap: List = []
-        self._io_lock = threading.Condition()
+        self._io_lock = _san.maybe_instrument(threading.Condition(),
+                                              "engine-io")
         n_io = (num_io_workers if num_io_workers is not None
                 else getenv("MXNET_CPU_IO_NTHREADS", 1))
         self._io_workers = []

@@ -202,20 +202,37 @@ declare("MXNET_TPU_FEED_DEPTH", int, 0,
 
 declare("MXNET_TPU_SANITIZE", str, "",
         "Comma-separated list of runtime sanitizers to arm (`transfer`, "
-        "`retrace`, `donation`, or `all`). `transfer` wraps the fused "
+        "`retrace`, `donation`, `locks`, `deadlock`, or `all`). "
+        "`transfer` wraps the fused "
         "step loop in `jax.transfer_guard(\"disallow\")` so any implicit "
         "host<->device transfer (a numpy array leaking into the "
         "dispatch, Python control flow on a device value) raises at the "
         "step that caused it; `retrace` raises when "
         "`step.fused_recompiles` grows after warmup (a silent "
         "steady-state recompile); `donation` verifies donated buffers "
-        "were actually consumed by XLA. Trips are counted under "
-        "`sanitizer.trips`. See docs/static_analysis.md.",
+        "were actually consumed by XLA; `locks` wraps the threaded "
+        "plane's locks to raise on observed lock-order inversion and "
+        "feed `lock.wait_ms` contention histograms; `deadlock` runs a "
+        "watchdog thread that dumps all-thread stacks through the "
+        "flight recorder when step progress stalls. Trips are counted "
+        "under `sanitizer.trips`. See docs/static_analysis.md.",
         section="Runtime sanitizers")
 declare("MXNET_TPU_SANITIZE_WARMUP", int, 3,
         "Steps the retrace sanitizer treats as warmup before a fresh "
         "fused-step trace signature becomes an error (shape buckets and "
         "donation/fold config changes legitimately retrace early).",
+        section="Runtime sanitizers")
+declare("MXNET_TPU_WATCHDOG_S", float, 120.0,
+        "Deadlock-watchdog stall threshold in seconds: when the "
+        "`deadlock` sanitizer is armed and the step counter makes no "
+        "progress for this long, the watchdog counts "
+        "`sanitizer.trips.deadlock` and dumps all-thread stacks "
+        "through the flight recorder (one dump per stall, re-armed "
+        "when progress resumes).",
+        section="Runtime sanitizers")
+declare("MXNET_TPU_WATCHDOG_INTERVAL", float, 5.0,
+        "Seconds between deadlock-watchdog polls of the progress "
+        "signal.",
         section="Runtime sanitizers")
 
 declare("MXNET_TPU_BENCH_INPUT", str, "",

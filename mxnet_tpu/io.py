@@ -653,12 +653,16 @@ class PrefetchingIter(DataIter):
         th = self._thread
         if th is None:
             return
+        self._thread = None
         self._stop.set()
         # a producer blocked on the bounded queue polls _stop every
         # 100ms; draining lets it exit immediately
         self._drain()
-        th.join()
-        self._thread = None
+        th.join(timeout=5.0)
+        if th.is_alive():
+            logging.warning("PrefetchingIter.close: producer thread did "
+                            "not exit within 5s; leaking the (daemon) "
+                            "thread rather than hanging teardown")
         self._drain()
 
     def __enter__(self):

@@ -612,6 +612,7 @@ class FeedScheduler(DataIter):
         self._stop = threading.Event()
         self._err: Optional[BaseException] = None
         self._exhausted = False
+        self._closed = False
 
     @property
     def provide_data(self):
@@ -693,6 +694,7 @@ class FeedScheduler(DataIter):
         self.base.reset()
         self._err = None
         self._exhausted = False
+        self._closed = False
         # thread restarts lazily on the first next() of the new epoch
 
     def iter_next(self) -> bool:
@@ -715,6 +717,9 @@ class FeedScheduler(DataIter):
         return self._current.index
 
     def close(self):
+        if self._closed:    # idempotent: __exit__ + explicit close
+            return
+        self._closed = True
         self._drain()
         close = getattr(self.base, "close", None)
         if callable(close):

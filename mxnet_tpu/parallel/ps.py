@@ -158,11 +158,15 @@ class ParameterServer:
         self._opt_states = {}
         self._alive = {}          # rank -> live connection count
         self._seen = set()        # ranks that ever said hello
-        self._lock = threading.Lock()
+        from ..analysis import sanitizers as _san
+        self._lock = _san.maybe_instrument(threading.Lock(), "ps-store")
         self._barrier_count = 0
         self._barrier_gen = 0
-        self._barrier_cv = threading.Condition()
+        self._barrier_cv = _san.maybe_instrument(threading.Condition(),
+                                                 "ps-barrier")
         self._stop = threading.Event()
+        self._closed = False
+        self._serve_threads = []  # appended only by the accept thread
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -172,7 +176,10 @@ class ParameterServer:
         self._thread.start()
 
     def _accept_loop(self):
-        self._sock.settimeout(0.2)
+        try:
+            self._sock.settimeout(0.2)
+        except OSError:
+            return    # close() won the race to the listening socket
         while not self._stop.is_set():
             try:
                 conn, _ = self._sock.accept()
@@ -180,8 +187,15 @@ class ParameterServer:
                 continue
             except OSError:
                 return
-            threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
+            # 0.5s poll timeout: an idle serve thread wakes to check
+            # _stop instead of parking in recv() forever — what lets
+            # close() join them with a bounded timeout
+            conn.settimeout(0.5)
+            th = threading.Thread(target=self._serve, args=(conn,),
+                                  daemon=True)
+            self._serve_threads = \
+                [t for t in self._serve_threads if t.is_alive()] + [th]
+            th.start()
 
     def _apply_push(self, key, grad):
         from ..ndarray import array as nd_array
@@ -198,7 +212,11 @@ class ParameterServer:
             if key not in self._opt_states:
                 self._opt_states[key] = self._opt.create_state(key, weight)
             self._opt.update(key, weight, gnd, self._opt_states[key])
-            self._store[key] = weight.asnumpy()
+            # per-push serialization under the store lock IS the async
+            # tier's semantics (reference applies each push atomically);
+            # the arrays are host-backed so this is a memcpy, not a
+            # device sync
+            self._store[key] = weight.asnumpy()  # graft: blocking-ok
 
     def _serve(self, conn):
         hello_rank = None
@@ -206,6 +224,8 @@ class ParameterServer:
             while not self._stop.is_set():
                 try:
                     msg = _recv_msg(conn)
+                except socket.timeout:
+                    continue    # idle poll tick: re-check _stop
                 except (ConnectionError, OSError):
                     return
                 except (pickle.UnpicklingError, EOFError, ValueError,
@@ -316,11 +336,36 @@ class ParameterServer:
             conn.close()
 
     def close(self):
+        """Graceful shutdown: signal ``_stop``, wake barrier waiters,
+        close the listening socket, then join the accept thread and
+        every live serve thread with a bounded timeout (they poll
+        ``_stop`` every 0.2s/0.5s respectively). Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
         self._stop.set()
+        with self._barrier_cv:
+            # a worker parked in the barrier predicate loop re-checks
+            # _stop on wake; without this it would idle until its 0.2s
+            # wait timeout instead of leaving immediately
+            self._barrier_cv.notify_all()
         try:
             self._sock.close()
         except OSError:
             pass
+        self._thread.join(timeout=5.0)
+        stragglers = 0
+        for th in list(self._serve_threads):
+            th.join(timeout=2.0)
+            stragglers += th.is_alive()
+        self._serve_threads = []
+        if self._thread.is_alive() or stragglers:
+            import logging
+            logging.getLogger(__name__).warning(
+                "ParameterServer.close: %d thread(s) still alive after "
+                "bounded join; leaking daemon thread(s) rather than "
+                "hanging teardown",
+                stragglers + self._thread.is_alive())
 
 
 class PSClient:
@@ -341,12 +386,17 @@ class PSClient:
                         "cannot reach parameter server %s:%d (%s)"
                         % (host, port, last))
                 time.sleep(0.1)
-        self._lock = threading.Lock()
+        from ..analysis import sanitizers as _san
+        self._lock = _san.maybe_instrument(threading.Lock(), "ps-client")
 
     def call(self, *msg):
+        # the lock serializes whole request/response exchanges on the
+        # one connection (interleaved frames from two threads would
+        # corrupt the protocol); both directions are bounded by the
+        # socket timeouts (600s connect-level, 60s mid-frame)
         with self._lock:
-            _send_msg(self._sock, msg)
-            resp = _recv_msg(self._sock)
+            _send_msg(self._sock, msg)      # graft: blocking-ok
+            resp = _recv_msg(self._sock)    # graft: blocking-ok
         if resp[0] != "ok":
             raise MXNetError("parameter server error: %s" % (resp[1],))
         return resp[1] if len(resp) > 1 else None

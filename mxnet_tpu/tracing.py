@@ -589,12 +589,27 @@ class MetricsServer:
             daemon=True)
         self._thread.start()
 
-    def close(self):
+    def stop(self):
+        """Shut down the HTTP server AND join its serve thread: after
+        this returns no ``mxtpu-metrics`` thread is alive (the
+        thread/process-leak fixture in tests/conftest.py depends on
+        that). Idempotent."""
         try:
             self._httpd.shutdown()
             self._httpd.server_close()
         except Exception:
             pass
+        th = self._thread
+        if th is not None:
+            self._thread = None
+            th.join(timeout=5.0)
+            if th.is_alive():
+                _log.warning("MetricsServer.stop: serve thread still "
+                             "alive after 5s join; leaking the (daemon) "
+                             "thread rather than hanging teardown")
+
+    # historical name, kept for callers that treat this like a file
+    close = stop
 
 
 # ---------------------------------------------------------------------------
@@ -605,6 +620,8 @@ _init_lock = threading.Lock()
 _recorder: Optional[StepTrace] = None
 _metrics_server: Optional[MetricsServer] = None
 _flight_recorder: Optional[FlightRecorder] = None
+_watchdog = None                 # sanitizers.DeadlockWatchdog
+_atexit_registered = False
 _worker_rank = int(os.environ.get("MXTPU_WORKER_RANK", "0") or 0)
 
 
@@ -640,11 +657,16 @@ def record_step(latency_ms: float, extra: Optional[dict] = None):
 def maybe_init():
     """Env-driven one-shot setup, called at fit()/bench entry: start
     the metrics server when ``MXNET_TPU_METRICS_PORT`` is set, install
-    the flight recorder when ``MXNET_TPU_FLIGHT_RECORDER=1``.
-    Idempotent; one flag check while telemetry is disabled."""
+    the flight recorder when ``MXNET_TPU_FLIGHT_RECORDER=1``, start
+    the deadlock watchdog when ``MXNET_TPU_SANITIZE`` includes
+    ``deadlock``. Registers :func:`shutdown` with atexit on first use,
+    so a fit() that never reaches explicit teardown still stops the
+    server/watchdog threads. Idempotent; one flag check while
+    telemetry is disabled."""
     if not _tel._ENABLED:
         return None
-    global _metrics_server, _flight_recorder
+    global _metrics_server, _flight_recorder, _watchdog, \
+        _atexit_registered
     with _init_lock:
         port = _env.get("MXNET_TPU_METRICS_PORT")
         if _metrics_server is None and port:
@@ -658,6 +680,16 @@ def maybe_init():
         if _flight_recorder is None \
                 and _env.get("MXNET_TPU_FLIGHT_RECORDER"):
             _flight_recorder = FlightRecorder().install()
+        if _watchdog is None:
+            from .analysis import sanitizers as _san
+            if _san.enabled("deadlock"):
+                _watchdog = _san.DeadlockWatchdog().start()
+                _log.info("deadlock watchdog armed (threshold %.0fs)",
+                          _watchdog._threshold)
+        if not _atexit_registered:
+            import atexit
+            atexit.register(shutdown)
+            _atexit_registered = True
     return _metrics_server
 
 
@@ -670,14 +702,21 @@ def flight_recorder() -> Optional[FlightRecorder]:
 
 
 def shutdown():
-    """Tear down global state (tests / end of run): stop the server,
-    uninstall flight-recorder hooks, drop the recorder."""
-    global _recorder, _metrics_server, _flight_recorder
+    """Tear down global state (tests / end of run / atexit): stop the
+    server (joining its thread), stop the watchdog, uninstall
+    flight-recorder hooks, drop the recorder. Idempotent."""
+    global _recorder, _metrics_server, _flight_recorder, _watchdog
     with _init_lock:
-        if _metrics_server is not None:
-            _metrics_server.close()
-            _metrics_server = None
+        server, _metrics_server = _metrics_server, None
+        watchdog, _watchdog = _watchdog, None
         if _flight_recorder is not None:
             _flight_recorder.uninstall()
             _flight_recorder = None
         _recorder = None
+    # join threads OUTSIDE _init_lock: the watchdog's progress probe
+    # takes _init_lock via step_trace(), so joining it under the lock
+    # would stall shutdown until the join timeout
+    if server is not None:
+        server.stop()
+    if watchdog is not None:
+        watchdog.stop()

@@ -1,7 +1,9 @@
 """Test configuration: force an 8-device CPU platform so multi-device
 sharding paths run without TPU hardware (the reference's analogue: CPU-only
 multi-device tests like tests/python/unittest/test_multi_device_exec.py)."""
+import multiprocessing
 import os
+import time
 
 # force CPU: the session may default to a TPU platform (axon), but tests run
 # on the virtual 8-device CPU mesh
@@ -41,3 +43,36 @@ def _arm_transfer_sanitizer(request, monkeypatch):
             and "MXNET_TPU_SANITIZE" not in os.environ:
         monkeypatch.setenv("MXNET_TPU_SANITIZE", "transfer")
     yield
+
+
+@pytest.fixture(autouse=True)
+def _no_thread_or_process_leaks(request):
+    """Every test must clean up after itself on the concurrency plane:
+    no new non-daemon threads and no live child processes may survive a
+    test (graftrace's runtime counterpart — a leaked thread here is
+    exactly the lifecycle hazard the static rules flag). Daemon threads
+    (engine/feed workers live process-long by design) are exempt; brief
+    stragglers get a join grace before we call them a leak."""
+    import threading
+
+    before = {t.ident for t in threading.enumerate()}
+    yield
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t.ident not in before and t.is_alive()
+                  and not t.daemon]
+        if not leaked:
+            break
+        for t in leaked:
+            t.join(timeout=0.2)
+    else:
+        pytest.fail("test leaked non-daemon thread(s): %s"
+                    % ", ".join(t.name for t in leaked))
+    procs = [p for p in multiprocessing.active_children() if p.is_alive()]
+    for p in procs:
+        p.join(timeout=5.0)
+    procs = [p for p in procs if p.is_alive()]
+    assert not procs, ("test leaked child process(es): %s"
+                       % ", ".join("%s(pid=%s)" % (p.name, p.pid)
+                                   for p in procs))

@@ -345,8 +345,13 @@ def test_jni_spark_dist_training_two_workers(tmp_path):
         os.killpg(proc.pid, signal.SIGKILL)
         stdout, stderr = proc.communicate()
         raise
-    if proc.returncode != 0 and "distributed" in (stderr or "").lower() \
-            and "final_acc" not in stdout:
+    err_l = (stderr or "").lower()
+    if proc.returncode != 0 and "final_acc" not in stdout and (
+            "distributed" in err_l
+            or "multiprocess computations aren't implemented" in err_l):
+        # the second message is the CPU backend refusing multi-process
+        # collectives outright — same "no distributed runtime here" skip,
+        # just reported after jax.distributed.initialize succeeds
         pytest.skip("jax.distributed unavailable: %s" % stderr[-200:])
     assert proc.returncode == 0, (stdout[-1000:], stderr[-2000:])
     accs = [float(x.split()[0]) for x in stdout.split("final_acc=")[1:]]

@@ -102,6 +102,63 @@ def render_events(events):
     return "\n".join(out) + "\n"
 
 
+def _hist_rows(node, prefix=""):
+    """Flatten telemetry snapshot subtree into (name, summary) pairs.
+    A name that is both leaf and prefix keeps its own summary under
+    ``_value`` (see telemetry.snapshot)."""
+    rows = []
+    if not isinstance(node, dict):
+        return rows
+    if "count" in node and not isinstance(node.get("count"), dict):
+        return [(prefix or "(all)", node)]
+    for k, v in sorted(node.items()):
+        name = prefix if k == "_value" else \
+            ("%s.%s" % (prefix, k) if prefix else k)
+        if k == "_value":
+            rows.extend(_hist_rows(v, name or "(all)"))
+        else:
+            rows.extend(_hist_rows(v, name))
+    return rows
+
+
+def render_locks(telemetry):
+    """Lock-contention (``lock.wait_ms`` histograms, fed by the
+    `locks` sanitizer's instrumented locks) and ``sanitizer.trips``
+    counters from a telemetry snapshot."""
+    out = []
+    wait = telemetry.get("lock", {}).get("wait_ms")
+    rows = [(n, s) for n, s in _hist_rows(wait)
+            if s.get("count", 0) > 0]
+    if rows:
+        out.append("lock contention (lock.wait_ms):")
+        header = ("lock", "acquires", "mean_ms", "p50_ms", "p90_ms",
+                  "max_ms")
+        table = [header]
+        for name, s in rows:
+            table.append((name, str(s["count"]), "%.3f" % s["mean"],
+                          "%.3f" % s["p50"], "%.3f" % s["p90"],
+                          "%.3f" % s["max"]))
+        widths = [max(len(r[i]) for r in table)
+                  for i in range(len(header))]
+        for j, r in enumerate(table):
+            out.append("  " + "  ".join(c.rjust(w)
+                                        for c, w in zip(r, widths)))
+            if j == 0:
+                out.append("  " + "  ".join("-" * w for w in widths))
+    trips = telemetry.get("sanitizer", {}).get("trips")
+    if trips is not None:
+        if isinstance(trips, dict):
+            total = trips.get("_value", 0)
+            detail = ", ".join("%s=%s" % (k, v)
+                               for k, v in sorted(trips.items())
+                               if k != "_value")
+            out.append("sanitizer trips: %s%s"
+                       % (total, " (%s)" % detail if detail else ""))
+        elif trips:
+            out.append("sanitizer trips: %s" % trips)
+    return "\n".join(out) + "\n" if out else ""
+
+
 def report_crash_dump(dump_dir, top=10):
     """Full report for one flight-recorder dump directory."""
     out = []
@@ -124,6 +181,12 @@ def report_crash_dump(dump_dir, top=10):
     steps_path = os.path.join(dump_dir, "steps.jsonl")
     if os.path.exists(steps_path):
         out.append(render(load_records(steps_path), top=top))
+    tel_path = os.path.join(dump_dir, "telemetry.json")
+    if os.path.exists(tel_path):
+        with open(tel_path) as f:
+            locks = render_locks(json.load(f))
+        if locks:
+            out.append(locks)
     out.append(render_events(events))
     return "\n".join(out)
 
