@@ -52,7 +52,12 @@ lint:
 	python tools/graftlint.py mxnet_tpu tools bench.py \
 	    --baseline tools/graftlint_baseline.json --check-env-docs
 
+# xprof views over the newest BENCH / chip_watch artifacts in the repo
+# root (compile registry, op-category FLOPs, HBM, device-time table)
+profile-report:
+	python tools/trace_report.py --profile-report
+
 clean:
 	rm -rf mxnet_tpu/_native perl-package/blib
 
-.PHONY: all predict perl test lint clean
+.PHONY: all predict perl test lint profile-report clean

@@ -171,6 +171,10 @@ def _bench_smoke(procs=4, image=64, num=192, batch=32, seconds=4.0):
               "platform": "cpu", "io_telemetry": snap}
     if server is not None:
         result["metrics_port"] = server.port
+    try:
+        result.update(_smoke_xprof_tier())
+    except Exception as e:
+        sys.stderr.write("bench.py: smoke xprof tier failed: %s\n" % e)
     telemetry.disable()
     print(json.dumps(result))
     return result
@@ -465,6 +469,36 @@ def _bench_recordio(jit_step, params, aux, key, batch, image, num_classes,
     return result
 
 
+def _smoke_xprof_tier(batch=8, nbatches=8):
+    """Tiny fused-step train with the xprof registry armed: the smoke
+    BENCH record carries ``compile_time_s`` / ``analytic_mfu`` /
+    ``peak_hbm_bytes`` plus the per-site compile summaries (op-category
+    breakdown included), so a CPU tier-1 run exercises the whole device
+    observability plane end to end."""
+    from mxnet_tpu import xprof
+
+    os.environ["MXNET_TPU_FUSED_STEP"] = "1"
+    xprof.enable()
+    xprof.reset()
+    hbm = xprof.HbmWatermark()
+    t0 = time.time()
+    dps = _bench_fused_dispatch(batch=batch, nbatches=nbatches)
+    elapsed = time.time() - t0
+    hbm.sample()
+    xp = xprof.summary()
+    last = (xp["sites"].get("fused_step") or {}).get("last") or {}
+    compile_s = xp["totals"]["compile_time_s"]
+    xp["bench_analysis"] = xprof.analyze(
+        last.get("flops"), last.get("bytes_accessed"),
+        step_time_s=max(elapsed - compile_s, 1e-9) / nbatches)
+    return {"compile_time_s": round(compile_s, 3),
+            "analytic_mfu":
+                xp["bench_analysis"].get("analytic_mfu_pct") or 0.0,
+            "peak_hbm_bytes": int(hbm.peak),
+            "dispatches_per_step": dps,
+            "xprof": xp}
+
+
 def _bench_fused_dispatch(batch=8, nbatches=8):
     """XLA dispatches per training batch through Module.fit: ~1.0 when
     the fused train step (MXNET_TPU_FUSED_STEP=1) is active, 3+ on the
@@ -499,11 +533,15 @@ def _bench():
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     import mxnet_tpu as mx
-    from mxnet_tpu import models, telemetry, tracing
+    from mxnet_tpu import models, telemetry, tracing, xprof
     from mxnet_tpu.parallel import build_sgd_train_step
 
     telemetry.enable()
     tracing.maybe_init()
+    # arm the device observability plane: every step-path compile below
+    # lands in the registry, and the BENCH record carries the summary
+    xprof.enable()
+    xprof.reset()
 
     devices = jax.devices()
     on_accel = devices[0].platform != "cpu"
@@ -563,14 +601,19 @@ def _bench():
     jit_step = jax.jit(step, donate_argnums=(0, 2))
     key = jax.random.PRNGKey(0)
 
-    # XLA's own flop count of the compiled whole-graph train step
+    # XLA's own flop count of the compiled whole-graph train step, with
+    # the compile wall time, memory analysis and op-category breakdown
+    # recorded through the xprof compile registry
     xla_flops = 0.0
+    compile_time_s = None
+    bench_rec = None
     try:
-        cost = jit_step.lower(params, data, aux, key).compile() \
-            .cost_analysis()
-        if isinstance(cost, (list, tuple)):   # older jax: one per device
-            cost = cost[0] if cost else {}
-        xla_flops = float((cost or {}).get("flops", 0.0))
+        tic_c = time.time()
+        compiled = jit_step.lower(params, data, aux, key).compile()
+        compile_time_s = time.time() - tic_c
+        bench_rec = xprof.record_compile("bench.train_step", compiled,
+                                         compile_time_s)
+        xla_flops = bench_rec.flops or 0.0
     except Exception:
         pass
 
@@ -585,6 +628,10 @@ def _bench():
     outputs, params, aux = jit_step(params, data, aux,
                                     jax.random.fold_in(key, steps + 1))
     _force(params)
+    # live-buffer watermark, sampled outside the timed window so the
+    # accounting never perturbs the throughput number
+    hbm_wm = xprof.HbmWatermark()
+    hbm_wm.sample()
 
     trace_dir = _env.get("MXNET_TPU_BENCH_TRACE")
     if trace_dir:
@@ -761,6 +808,25 @@ def _bench():
         result["mfu_pct"] = round(100.0 * tflops_model / peak, 1)
     if peak and tflops_xla:
         result["mfu_pct_xla"] = round(100.0 * tflops_xla / peak, 1)
+
+    # device observability plane: compile analytics + roofline + HBM
+    # watermark. analytic_mfu is MFU from the executable's true FLOP
+    # count (cost_analysis) and the measured step time — 0.0 where the
+    # chip peak is unknown (CPU), with the FLOPs still recorded.
+    hbm_wm.sample()
+    xp = xprof.summary()
+    xp["bench_analysis"] = xprof.analyze(
+        xla_flops or None,
+        bench_rec.bytes_accessed if bench_rec else None,
+        step_time_s=elapsed / steps,
+        device_kind=getattr(devices[0], "device_kind", "")
+        if on_accel else None)
+    result["compile_time_s"] = round(compile_time_s, 3) \
+        if compile_time_s else 0.0
+    result["analytic_mfu"] = \
+        xp["bench_analysis"].get("analytic_mfu_pct") or 0.0
+    result["peak_hbm_bytes"] = int(hbm_wm.peak)
+    result["xprof"] = xp
 
     rec_env = _env.get("MXNET_TPU_BENCH_INPUT")
     if rec_env:

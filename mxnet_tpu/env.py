@@ -332,6 +332,34 @@ declare("MXNET_TPU_CRASH_DIR", str, "",
         "Where flight-recorder dumps land (default "
         "`$TMPDIR/mxnet_tpu_crash`).", section=_T)
 
+_X = "Device observability (xprof)"
+declare("MXNET_TPU_XPROF", bool, False,
+        "Route every step-path jit compile (fused step, executor "
+        "fwd+bwd, metric folds, kvstore reduce) through the compile "
+        "registry (`mxnet_tpu.xprof`): compile wall-time, "
+        "`cost_analysis` FLOPs/bytes, `memory_analysis` peak bytes and "
+        "the HLO op-category breakdown land in `compile.*` telemetry "
+        "and BENCH records, and recompiles carry a retrace-cause diff "
+        "naming the changed argument avals. The wrapper dispatches "
+        "through the AOT executable it measured, so instrumentation "
+        "adds zero extra compiles or dispatches. `xprof.enable()` does "
+        "the same at runtime (bench does so itself).", section=_X)
+declare("MXNET_TPU_XPROF_OPS", bool, True,
+        "Parse each recorded executable's optimized HLO into the "
+        "conv/dot/fusion/collective/transpose/elementwise FLOP+bytes "
+        "breakdown (`trace_report.py --view ops`). Set to 0 to skip "
+        "the parse on very large modules; compile timing and memory "
+        "analysis still record.", section=_X)
+declare("MXNET_TPU_XPROF_PREFLIGHT", bool, True,
+        "Pre-flight OOM check: when the device reports an HBM limit, "
+        "a recorded executable whose `memory_analysis` peak cannot fit "
+        "raises before the first dispatch instead of OOM-ing minutes "
+        "into a run. No-op where no limit is known (CPU).", section=_X)
+declare("MXNET_TPU_XPROF_RECORDS", int, 256,
+        "Bound on the compile registry ring; oldest CompileRecords are "
+        "dropped first (per-site summaries keep their totals).",
+        section=_X)
+
 declare("MXNET_TPU_NO_NATIVE", bool, False,
         "Disable the C++ runtime library (pure-Python recordio + engines "
         "only).", section="Native library / Pallas")

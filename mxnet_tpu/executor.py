@@ -24,7 +24,6 @@ not update moving stats).
 """
 from __future__ import annotations
 
-import functools
 from typing import Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -410,8 +409,6 @@ class Executor:
             # with want_internals the SAME fused fwd+bwd also emits every
             # internal output, so a monitored batch costs one forward
             # (the naive monitor-forward-then-train scheme doubled it)
-            @functools.partial(jax.jit,
-                               donate_argnums=(1,) if donate else ())
             def step(args, aux, key, head_grads):
                 garr = [args[i] for i in grad_idx]
 
@@ -432,7 +429,15 @@ class Executor:
                 grads, = vjp(cts)
                 return res + (grads,)
 
-            return step
+            # compile registry site (xprof off -> plain jax.jit; the
+            # wrapper keeps .lower() for the HLO regression gates)
+            from . import xprof as _xprof
+
+            return _xprof.jit(
+                step, site="executor.fwd_bwd",
+                arg_names=(tuple(self.arg_names), tuple(self.aux_names),
+                           "rng_key", "head_grads"),
+                donate_argnums=(1,) if donate else ())
 
         def fwd_bwd(args, aux, key, head_grads):
             outs, aux_out, grads = get_fwd_bwd(False)(args, aux, key,

@@ -42,10 +42,9 @@ recompile storm trips the tracing RecompileDetector).
 """
 from __future__ import annotations
 
-import functools
-
 from . import telemetry as _tel
 from . import env as _env
+from . import xprof as _xprof
 from .analysis import sanitizers as _san
 from .engine import get_engine
 from .executor import zero_cotangent
@@ -383,8 +382,6 @@ class FusedTrainStep:
             y = (y.astype(jnp.float32) - mean) * scale
             return jnp.transpose(y, (0, 3, 1, 2)) if nchw else y
 
-        @functools.partial(jax.jit,
-                           donate_argnums=(0, 2, 3, 5) if donate else ())
         def step(p_vals, o_vals, aux, st, sv_mats, accs, key, aug=None):
             full = [None] * n_args
             for pos, i in enumerate(o_idx):
@@ -429,4 +426,18 @@ class FusedTrainStep:
                 new_accs = tuple(new_accs)
             return (tuple(new_p), outs, aux_out, tuple(new_st), new_accs)
 
-        return step
+        # route the compile through the device observability plane: a
+        # plain jax.jit when xprof is off, else the AOT wrapper that
+        # times the compile, records FLOPs/memory/op breakdown and the
+        # retrace-cause diff — still the same one donated dispatch.
+        # leaf names come from the executor, so a retrace diff says
+        # "batch.data" / "params.fc1_weight" instead of "arg1[0]"
+        names = [ex.arg_names[i] for i in self._p_arg_idx]
+        batch_names = [ex.arg_names[i] for i in self._o_arg_idx]
+        return _xprof.jit(
+            step, site="fused_step",
+            arg_names=(tuple("params." + n for n in names),
+                       tuple("batch." + n for n in batch_names),
+                       "aux", "opt_state", "hyper", "metric_acc",
+                       "rng_key", "aug"),
+            donate_argnums=(0, 2, 3, 5) if donate else ())

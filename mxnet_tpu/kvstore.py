@@ -52,9 +52,6 @@ def _tree_sum(bufs):
     fan-in, vs n-1 eager adds for the pairwise loop."""
     global _TREE_SUM
     if _TREE_SUM is None:
-        import jax
-
-        @jax.jit
         def tree_sum(xs):
             while len(xs) > 1:
                 half, odd = divmod(len(xs), 2)
@@ -64,7 +61,10 @@ def _tree_sum(bufs):
                 xs = paired
             return xs[0]
 
-        _TREE_SUM = tree_sum
+        from . import xprof as _xprof
+
+        _TREE_SUM = _xprof.jit(tree_sum, site="kvstore.reduce",
+                               arg_names=("grads",))
     return _TREE_SUM(list(bufs))
 
 

@@ -148,7 +148,11 @@ class EvalMetric:
                         c = c + dc
                     return s, c
 
-                _FOLD_FNS[key] = fn = jax.jit(accum)
+                from . import xprof as _xprof
+
+                _FOLD_FNS[key] = fn = _xprof.jit(
+                    accum, site="metric.fold",
+                    arg_names=("acc", "labels", "preds"))
             self._fold_fn = fn
         acc = self._device_acc
         if acc is None:

@@ -76,6 +76,11 @@ DELTA_SOURCES = (
     ("dispatches", "step.dispatches", "counter"),
     ("fused_recompiles", "step.fused_recompiles", "counter"),
     ("sanitizer_trips", "sanitizer.trips", "counter"),
+    # xprof compile registry: measured XLA compiles this step and the
+    # wall time they took (the time_ms histogram's sum delta IS the ms
+    # this step spent compiling)
+    ("compiles", "compile.count", "counter"),
+    ("compile_ms", "compile.time_ms", "hist_sum"),
 )
 
 _STALL_FIELDS = ("io_stall_ms", "prefetch_stall_ms", "feed_stall_ms")
@@ -124,13 +129,28 @@ class RecompileDetector:
     def check(self, rec: dict) -> Optional[dict]:
         n = rec["deltas"].get("recompiles", 0)
         nf = rec["deltas"].get("fused_recompiles", 0)
-        if rec["step"] > self.warmup and (n > 0 or nf > 0):
+        nc = rec["deltas"].get("compiles", 0)
+        if rec["step"] > self.warmup and (n > 0 or nf > 0 or nc > 0):
             ev = {"type": self.type, "recompiles": n,
                   "latency_ms": round(rec["latency_ms"], 3)}
             if nf:
                 # a fused-step retrace past warmup: some batch shape or
                 # optimizer structure drifted mid-run (recompile storm)
                 ev["fused_recompiles"] = nf
+            if nc:
+                ev["compiles"] = nc
+                ev["compile_ms"] = rec["deltas"].get("compile_ms", 0.0)
+            # with the xprof registry armed, name the avals that drifted
+            # ("(64,3,224,224)f32 -> (32,...)f32 on batch.data") instead
+            # of just flagging that something retraced
+            try:
+                from . import xprof as _xprof
+
+                cause = _xprof.last_retrace_cause()
+            except Exception:
+                cause = None
+            if cause:
+                ev["cause"] = cause
             return ev
         return None
 
@@ -288,9 +308,14 @@ class StepTrace:
 
     @staticmethod
     def _dominant(deltas: Dict[str, float], latency_ms: float) -> str:
-        """Label the step with what it spent its time on: a recompile
-        trumps everything (it IS the latency), then whichever stall
-        source claims >25% of the wall time; otherwise compute."""
+        """Label the step with what it spent its time on: a measured
+        compile (xprof registry) or a recompile trumps everything (it
+        IS the latency), then whichever stall source claims >25% of
+        the wall time; otherwise compute."""
+        if deltas.get("compiles", 0) > 0:
+            # xprof measured the compile itself — the most specific
+            # label available (its CompileRecord carries the cause)
+            return "compile"
         if deltas.get("recompiles", 0) > 0 \
                 or deltas.get("fused_recompiles", 0) > 0:
             return "recompile"

@@ -76,3 +76,15 @@ def _no_thread_or_process_leaks(request):
     assert not procs, ("test leaked child process(es): %s"
                        % ", ".join("%s(pid=%s)" % (p.name, p.pid)
                                    for p in procs))
+    # profiler sessions are process-global singletons in jax: one left
+    # open poisons every later capture attempt with "already active"
+    import sys as _sys
+
+    prof = _sys.modules.get("mxnet_tpu.profiler")
+    if prof is not None and prof.is_running():
+        try:
+            prof.stop()
+        except Exception:
+            pass
+        pytest.fail("test left a profiler trace session open "
+                    "(call profiler.stop() or use the context manager)")

@@ -21,6 +21,8 @@ watchdog makes the window-catching automatic:
             3. tools/mfu_experiments.py   -> MFU_EXPERIMENTS.jsonl
                (baseline/nhwc/s2d + latency-hiding flag sweep)
             4. tools/tpu_consistency.py   -> TPU_CONSISTENCY.txt
+            5. xprof device-time merge    -> XPROF_DEVICE_TIME.json
+               (profiler-trace op table x analytic FLOP breakdown)
   commit  git-commit the artifacts so the evidence survives even if the
           tunnel dies again before round end.
 
@@ -133,7 +135,60 @@ def _run(cmd, timeout_s, env_overrides=None, outfile=None,
 
 ARTIFACTS = ["BENCH_watch.json", ".bench_cache.json",
              ".bench_trace_summary.json", "MFU_EXPERIMENTS.jsonl",
-             "TPU_CONSISTENCY.txt"]
+             "TPU_CONSISTENCY.txt", "XPROF_DEVICE_TIME.json"]
+
+
+def xprof_device_time(stamp):
+    """Stage 5: merge the profiler-trace device-time summary
+    (.bench_trace_summary.json, written by bench.py from its
+    jax.profiler.trace window) with the analytic op-category FLOP
+    breakdown from the newest BENCH xprof record into one
+    XPROF_DEVICE_TIME.json line.  INCOMPLETE-safe: a missing trace
+    summary (profiler capture needs the chip) still emits a row with
+    the analytic half and an `incomplete` marker, so a CPU run or a
+    half-dead window never produces a silently empty artifact."""
+    from trace_report import (categorize_op, latest_xprof_record,
+                              load_bench_records, _main_site)
+
+    row = {"stamp": stamp}
+    ts_path = os.path.join(REPO, ".bench_trace_summary.json")
+    if os.path.exists(ts_path):
+        try:
+            with open(ts_path) as f:
+                summary = json.load(f)
+            cats = {}
+            for op in summary.get("top_ops") or []:
+                c = categorize_op(op.get("op", ""))
+                cats[c] = cats.get(c, 0.0) + float(
+                    op.get("ms_per_step", 0.0))
+            row["device_time_by_category"] = {
+                c: round(ms, 4) for c, ms in cats.items()}
+            row["device_ms_per_step"] = summary.get("device_ms_per_step")
+            row["chip"] = summary.get("chip")
+        except (ValueError, OSError) as e:
+            row["incomplete"] = "trace summary unreadable: %s" % e
+    else:
+        row["incomplete"] = ("no .bench_trace_summary.json — profiler "
+                             "capture did not run (CPU, or the window "
+                             "died before the trace stage)")
+    bw_path = os.path.join(REPO, "BENCH_watch.json")
+    if os.path.exists(bw_path):
+        rec = latest_xprof_record(load_bench_records(bw_path))
+        if rec is not None:
+            site, s = _main_site(rec.get("xprof") or {})
+            last = (s.get("last") or {})
+            row["analytic_site"] = site
+            row["analytic_flops_by_category"] = {
+                c: v.get("flops", 0)
+                for c, v in (last.get("op_breakdown") or {}).items()}
+            row["analytic_mfu"] = rec.get("analytic_mfu")
+            row["peak_hbm_bytes"] = rec.get("peak_hbm_bytes")
+    with open(os.path.join(REPO, "XPROF_DEVICE_TIME.json"), "a") as f:
+        f.write(json.dumps(row, sort_keys=True) + "\n")
+    log("xprof device-time row: %s" % (
+        "INCOMPLETE (%s)" % row["incomplete"] if "incomplete" in row
+        else "%d categories" % len(row.get("device_time_by_category",
+                                           {}))))
 
 
 def _commit(stage, stamp):
@@ -201,6 +256,13 @@ def fire():
         with open(os.path.join(REPO, "TPU_CONSISTENCY.txt"), "a") as f:
             f.write("== chip_watch %s ==\n%s" % (stamp, out))
     _commit("op consistency sweep", stamp)
+    # 5. op-category device-time table: profiler trace window merged
+    # with the analytic xprof breakdown (INCOMPLETE-safe on its own)
+    try:
+        xprof_device_time(stamp)
+    except Exception as e:                       # noqa: BLE001
+        log("xprof device-time stage failed: %s" % e)
+    _commit("xprof device-time", stamp)
 
 
 def main(argv=None):

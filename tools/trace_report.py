@@ -159,6 +159,262 @@ def render_locks(telemetry):
     return "\n".join(out) + "\n" if out else ""
 
 
+# ---------------------------------------------------------------------------
+# xprof views (compile / ops / memory) over BENCH records
+# ---------------------------------------------------------------------------
+
+def load_bench_records(path):
+    """Dict records from a BENCH file (bench.py prints one JSON object
+    per line; BENCH_watch.json interleaves stage markers — any dict
+    line is kept, unparseable lines skipped)."""
+    recs = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(r, dict):
+                recs.append(r)
+    return recs
+
+
+def latest_xprof_record(recs):
+    """The newest record carrying an xprof compile-registry summary."""
+    for r in reversed(recs):
+        if isinstance(r.get("xprof"), dict):
+            return r
+    return None
+
+
+def _main_site(xp):
+    """(site_name, site_summary) of the executable that owns the step:
+    bench.train_step when present, else the site with the most FLOPs."""
+    sites = xp.get("sites") or {}
+    if "bench.train_step" in sites:
+        return "bench.train_step", sites["bench.train_step"]
+    best = None
+    for name, s in sorted(sites.items()):
+        fl = ((s.get("last") or {}).get("flops")) or 0
+        if best is None or fl > best[2]:
+            best = (name, s, fl)
+    return (best[0], best[1]) if best else (None, {})
+
+
+def _table(rows):
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    out = []
+    for j, r in enumerate(rows):
+        out.append("  " + "  ".join(c.rjust(w) for c, w in zip(r, widths)))
+        if j == 0:
+            out.append("  " + "  ".join("-" * w for w in widths))
+    return out
+
+
+def render_bench_summary(rec):
+    """The one-line "analytic vs measured MFU, gap attributed to
+    <category>" headline for the top of the bench report."""
+    xp = rec.get("xprof") or {}
+    ana = xp.get("bench_analysis") or {}
+    measured = rec.get("mfu_pct")
+    analytic = rec.get("analytic_mfu", ana.get("analytic_mfu_pct"))
+    _site, s = _main_site(xp)
+    bd = ((s.get("last") or {}).get("op_breakdown")) or {}
+    bound = ana.get("bound", "unknown")
+    # blame the category that owns the executable: the biggest
+    # byte-mover when bandwidth-bound, else the biggest FLOP owner
+    key = "bytes" if bound == "bandwidth" else "flops"
+    total_fl = sum(v.get("flops", 0) for v in bd.values()) or 1
+    cat = max(bd, key=lambda c: bd[c].get(key, 0)) if bd else None
+    blame = "unattributed (no op breakdown)"
+    if cat:
+        blame = "%s (%.0f%% of FLOPs, %s-bound)" % (
+            cat, 100.0 * bd[cat].get("flops", 0) / total_fl,
+            bound if bound != "unknown" else "unknown")
+    fmt = lambda v: "%.1f%%" % v if v is not None else "n/a"  # noqa: E731
+    gap = ("%.1fpt" % abs(analytic - measured)
+           if analytic is not None and measured is not None else "n/a")
+    return ("analytic MFU %s vs measured %s — gap %s, attributed to %s\n"
+            % (fmt(analytic), fmt(measured), gap, blame))
+
+
+def render_compile(rec):
+    """Per-site compile registry table."""
+    xp = rec.get("xprof") or {}
+    sites = xp.get("sites") or {}
+    if not sites:
+        return "no xprof compile records\n"
+    rows = [("site", "compiles", "total_s", "last_s", "flops",
+             "peak_bytes")]
+    for name, s in sorted(sites.items()):
+        last = s.get("last") or {}
+        rows.append((name, str(s.get("compiles", 0)),
+                     "%.3f" % s.get("compile_time_s", 0.0),
+                     "%.3f" % (last.get("compile_time_s") or 0.0),
+                     "%.3g" % (last.get("flops") or 0),
+                     _fmt_bytes(last.get("peak_bytes") or 0)))
+    out = ["compile registry (%d sites, %d compiles, %.3fs total):"
+           % (len(sites), (xp.get("totals") or {}).get("compiles", 0),
+              (xp.get("totals") or {}).get("compile_time_s", 0.0)), ""]
+    out += _table(rows)
+    causes = [(n, (s.get("last") or {}).get("retrace_cause"))
+              for n, s in sorted(sites.items())]
+    causes = [(n, c) for n, c in causes if c]
+    if causes:
+        out.append("")
+        out.append("retrace causes:")
+        out += ["  %s: %s" % (n, c) for n, c in causes]
+    return "\n".join(out) + "\n"
+
+
+def render_ops(rec):
+    """Per-category FLOP+bytes breakdown of the main executable; the
+    TOTAL row equals the sum of the category rows by construction."""
+    xp = rec.get("xprof") or {}
+    site, s = _main_site(xp)
+    bd = ((s.get("last") or {}).get("op_breakdown")) or {}
+    if not bd:
+        return "no op-category breakdown recorded\n"
+    total_fl = sum(v.get("flops", 0) for v in bd.values())
+    total_by = sum(v.get("bytes", 0) for v in bd.values())
+    total_n = sum(v.get("count", 0) for v in bd.values())
+    rows = [("category", "flops", "share", "bytes", "ops")]
+    for cat, v in sorted(bd.items(), key=lambda kv: -kv[1].get("flops", 0)):
+        rows.append((cat, str(v.get("flops", 0)),
+                     "%.1f%%" % (100.0 * v.get("flops", 0)
+                                 / total_fl if total_fl else 0.0),
+                     _fmt_bytes(v.get("bytes", 0)),
+                     str(v.get("count", 0))))
+    rows.append(("TOTAL", str(total_fl), "100.0%",
+                 _fmt_bytes(total_by), str(total_n)))
+    out = ["op categories for %s:" % site, ""] + _table(rows)
+    ana = xp.get("bench_analysis") or {}
+    if ana.get("arithmetic_intensity") is not None:
+        out.append("")
+        out.append("arithmetic intensity %.2f FLOP/B (ridge %s) -> %s"
+                   % (ana["arithmetic_intensity"],
+                      "%.2f" % ana["ridge_intensity"]
+                      if ana.get("ridge_intensity") else "unknown",
+                      "%s-bound" % ana.get("bound", "unknown")))
+    return "\n".join(out) + "\n"
+
+
+def render_memory(rec):
+    """Per-site memory_analysis table + the HBM watermark/headroom."""
+    xp = rec.get("xprof") or {}
+    sites = xp.get("sites") or {}
+    out = []
+    if sites:
+        rows = [("site", "arg", "out", "temp", "peak")]
+        for name, s in sorted(sites.items()):
+            last = s.get("last") or {}
+            rows.append((name,
+                         _fmt_bytes(last.get("argument_bytes") or 0),
+                         _fmt_bytes(last.get("output_bytes") or 0),
+                         _fmt_bytes(last.get("temp_bytes") or 0),
+                         _fmt_bytes(last.get("peak_bytes") or 0)))
+        out += ["memory analysis per executable:", ""] + _table(rows)
+    hbm = xp.get("hbm") or {}
+    peak = rec.get("peak_hbm_bytes")
+    if hbm or peak is not None:
+        out.append("")
+        out.append("hbm: live %s  run-peak %s  limit %s  headroom %s "
+                   "(source: %s)"
+                   % (_fmt_bytes(hbm.get("live_bytes") or 0),
+                      _fmt_bytes(peak or 0),
+                      _fmt_bytes(hbm["limit_bytes"])
+                      if hbm.get("limit_bytes") else "n/a",
+                      _fmt_bytes(hbm["limit_bytes"]
+                                 - (hbm.get("live_bytes") or 0))
+                      if hbm.get("limit_bytes") else "n/a",
+                      hbm.get("source", "?")))
+    return ("\n".join(out) + "\n") if out else "no xprof memory data\n"
+
+
+def render_bench_report(rec, top=10):
+    """Full bench view: the MFU-gap headline first, then compile, ops
+    and memory."""
+    return "\n".join([render_bench_summary(rec), render_compile(rec),
+                      render_ops(rec), render_memory(rec)])
+
+
+def categorize_op(name):
+    """Map a profiler-trace op name (trace_top rows) onto the same
+    categories the HLO breakdown uses, so device time and analytic
+    FLOPs line up in one table."""
+    n = name.lower()
+    if "conv" in n:
+        return "conv"
+    if "dot" in n or "einsum" in n or "matmul" in n:
+        return "dot"
+    if any(k in n for k in ("all-reduce", "all-gather", "all-to-all",
+                            "reduce-scatter", "collective", "permute",
+                            "allreduce", "allgather")):
+        return "collective"
+    if "fusion" in n:
+        return "fusion"
+    if any(k in n for k in ("transpose", "copy", "reshape", "broadcast",
+                            "slice", "concatenate", "pad", "gather",
+                            "scatter", "bitcast", "iota")):
+        return "transpose"
+    if any(k in n for k in ("add", "sub", "mul", "div", "max", "min",
+                            "exp", "log", "tanh", "sqrt", "rsqrt",
+                            "compare", "select", "convert", "reduce",
+                            "rng", "neg", "abs")):
+        return "elementwise"
+    return "other"
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def profile_report(top=10):
+    """`make profile-report`: run the xprof views against the newest
+    BENCH / chip_watch artifacts in the repo root."""
+    root = _repo_root()
+    candidates = [os.path.join(root, "BENCH_watch.json"),
+                  os.path.join(root, ".bench_cache.json")]
+    import glob
+
+    candidates += sorted(glob.glob(os.path.join(root, "BENCH_r*.json")),
+                         reverse=True)
+    out = []
+    rec = None
+    for path in candidates:
+        if not os.path.exists(path):
+            continue
+        rec = latest_xprof_record(load_bench_records(path))
+        if rec is not None:
+            out.append("bench artifact: %s\n" % os.path.basename(path))
+            break
+    if rec is None:
+        out.append("no BENCH artifact with an xprof summary found "
+                   "(run bench.py, or bench.py --smoke)\n")
+    else:
+        out.append(render_bench_report(rec, top=top))
+    dev = os.path.join(root, "XPROF_DEVICE_TIME.json")
+    if os.path.exists(dev):
+        rows = load_bench_records(dev)
+        if rows:
+            last = rows[-1]
+            out.append("chip_watch device-time artifact "
+                       "(XPROF_DEVICE_TIME.json):\n")
+            cats = last.get("device_time_by_category") or {}
+            if cats:
+                t = [("category", "ms/step", "share")]
+                tot = sum(cats.values()) or 1.0
+                for c, ms in sorted(cats.items(), key=lambda kv: -kv[1]):
+                    t.append((c, "%.2f" % ms, "%.1f%%" % (100 * ms / tot)))
+                out.append("\n".join(_table(t)) + "\n")
+            if last.get("incomplete"):
+                out.append("  INCOMPLETE: %s\n" % last["incomplete"])
+    return "\n".join(out)
+
+
 def report_crash_dump(dump_dir, top=10):
     """Full report for one flight-recorder dump directory."""
     out = []
@@ -193,10 +449,36 @@ def report_crash_dump(dump_dir, top=10):
 
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("path", help="step-trace .jsonl or crash-dump dir")
+    p.add_argument("path", nargs="?",
+                   help="step-trace .jsonl, BENCH .json, or crash-dump "
+                        "dir (optional with --profile-report)")
     p.add_argument("--top", type=int, default=10,
                    help="slowest steps to show (default 10)")
+    p.add_argument("--view", default="steps",
+                   choices=("steps", "compile", "ops", "memory", "bench"),
+                   help="steps (default): slowest-step trace table; "
+                        "compile/ops/memory/bench: xprof views over a "
+                        "BENCH record file")
+    p.add_argument("--profile-report", action="store_true",
+                   help="auto-discover the newest BENCH / chip_watch "
+                        "artifacts in the repo root and render the "
+                        "bench view (used by `make profile-report`)")
     a = p.parse_args(argv)
+    if a.profile_report:
+        sys.stdout.write(profile_report(top=a.top))
+        return 0
+    if a.path is None:
+        p.error("path is required unless --profile-report is given")
+    if a.view != "steps":
+        rec = latest_xprof_record(load_bench_records(a.path))
+        if rec is None:
+            sys.stdout.write("no record with an xprof summary in %s\n"
+                             % a.path)
+            return 1
+        fn = {"compile": render_compile, "ops": render_ops,
+              "memory": render_memory, "bench": render_bench_report}
+        sys.stdout.write(fn[a.view](rec))
+        return 0
     if os.path.isdir(a.path):
         sys.stdout.write(report_crash_dump(a.path, top=a.top))
     else:
