@@ -57,7 +57,12 @@ lint:
 profile-report:
 	python tools/trace_report.py --profile-report
 
+# dp-scaling smoke on 8 simulated devices: the sharded fused step
+# (device_sync kvstore) measured at dp=1,2,4,8 -> MULTICHIP_scaling.json
+multichip:
+	python bench.py multichip
+
 clean:
 	rm -rf mxnet_tpu/_native perl-package/blib
 
-.PHONY: all predict perl test lint profile-report clean
+.PHONY: all predict perl test lint profile-report multichip clean

@@ -125,11 +125,21 @@ declare("MXNET_TPU_FUSED_STEP", bool, False,
         "`Module.fit` (and `FeedForward.fit` through it) compiles forward "
         "+ backward + optimizer update — and, when every metric supports "
         "it, the metric fold — into ONE donated XLA dispatch per batch "
-        "instead of three-plus. Falls back to the classic loop (silently, "
-        "per-configuration) for `dist_*` kvstores, custom-Python-`update` "
-        "optimizers, installed monitors, `inputs_need_grad=True`, "
-        "`grad_req=\"add\"`, and threaded engines. See \"Fused train "
-        "step\" in `performance.md`.",
+        "instead of three-plus. Falls back to the classic loop for "
+        "`dist_*` kvstores, custom-Python-`update` optimizers, installed "
+        "monitors, `inputs_need_grad=True`, `grad_req=\"add\"`, and "
+        "threaded engines — each fallback counts "
+        "`step.fused_fallback.<reason>` and warns once naming the "
+        "reason. Default ON (no opt-in needed) under a `device_sync` "
+        "kvstore on a multi-device mesh. See \"Fused train step\" and "
+        "\"Sharded fused step\" in `performance.md`.",
+        section="Fused train step")
+declare("MXNET_TPU_DEVICE_SYNC_FUSED", bool, True,
+        "Under a `device_sync` kvstore on a multi-device mesh the fused "
+        "step is the DEFAULT path: the gradient exchange runs as a "
+        "mean-psum GSPMD all-reduce inside the single donated dispatch "
+        "(see \"Sharded fused step\" in `performance.md`). Set to 0 to "
+        "require the explicit `MXNET_TPU_FUSED_STEP=1` opt-in instead.",
         section="Fused train step")
 declare("MXNET_TPU_FUSED_UPDATE", bool, True,
         "Set to 0 to disable the stacked multi-param optimizer update "
@@ -188,6 +198,14 @@ declare("MXNET_TPU_DEVICE_FEED", bool, False,
         "`device_feed=True`. Non-fused consumers materialize the batch "
         "transparently; results are bit-identical either way. See "
         "\"Feeding the chip\" in `performance.md`.",
+        section="Input pipeline")
+declare("MXNET_TPU_AUG_REPLICAS", int, 0,
+        "Data-parallel replica count for `CachedImageRecordIter`'s "
+        "deferred augmentation draws (same as constructing with "
+        "`aug_replicas=N`): crop/mirror params are keyed per (epoch, "
+        "batch, replica) so each `dp` shard of a device-feed batch "
+        "augments from an independent stream. Default 0 (single "
+        "stream, the historical draws).",
         section="Input pipeline")
 declare("MXNET_TPU_FEED_DEPTH", int, 0,
         "`fit()` wraps the training iterator in a `FeedScheduler`: a "

@@ -29,16 +29,24 @@ Data parallelism rides for free: the executor group shards the batch
 over its device mesh (GSPMD), so the gradient all-reduce happens inside
 this same computation — there is no separate aggregation phase to fuse.
 
-Opt-in via ``MXNET_TPU_FUSED_STEP=1``; :func:`make_fused_step` returns
+Opt-in via ``MXNET_TPU_FUSED_STEP=1`` — or DEFAULT under a
+``device_sync`` kvstore (the in-jit GSPMD gradient exchange: batch
+sharded along the ``dp`` mesh axis, params/optimizer state replicated,
+and the vjp gradients pinned to a replicated ``NamedSharding`` so the
+mean-psum all-reduce runs inside this one dispatch; gate with
+``MXNET_TPU_DEVICE_SYNC_FUSED=0``). :func:`make_fused_step` returns
 None (-> classic three-phase loop) whenever a precondition fails:
 ``dist_*`` kvstores, ``update_on_kvstore``, custom-update optimizers
 without a fusable plan, grad_req "add", ``inputs_need_grad``, or an
-installed monitor (which needs every internal tensor).
+installed monitor (which needs every internal tensor). A
+requested-but-failed precondition counts
+``step.fused_fallback[.reason]`` and warns once naming the reason.
 
 Telemetry: ``step.dispatches`` counts XLA computation launches per
 batch on both paths (the fused-vs-unfused delta BENCH_r06 reports);
 ``step.fused_recompiles`` counts fresh trace signatures (a shape-driven
-recompile storm trips the tracing RecompileDetector).
+recompile storm trips the tracing RecompileDetector);
+``step.fused_fallback`` counts requested-but-refused configurations.
 """
 from __future__ import annotations
 
@@ -57,40 +65,93 @@ def enabled() -> bool:
     return _env.get("MXNET_TPU_FUSED_STEP")
 
 
+_FALLBACK_WARNED = set()
+
+
+def _fallback(module, reason, detail):
+    """A config requested the fused step but a precondition failed: count
+    it (`step.fused_fallback` + per-reason key, the trace_report
+    `fallbacks` column) and warn ONCE per reason naming what to change —
+    the old silent None meant exactly the configs that matter at scale
+    quietly ran the three-dispatch loop."""
+    _tel.inc("step.fused_fallback")
+    _tel.inc("step.fused_fallback." + reason)
+    if reason not in _FALLBACK_WARNED:
+        _FALLBACK_WARNED.add(reason)
+        import logging
+
+        getattr(module, "logger", logging).warning(
+            "fused train step requested but falling back to the classic "
+            "three-phase loop: %s [reason=%s]", detail, reason)
+    return None
+
+
 def make_fused_step(module, eval_metric):
     """Build a :class:`FusedTrainStep` for a bound, optimizer-initialized
     Module, or None when any precondition fails (fit() then runs the
-    classic forward_backward/update/update_metric loop)."""
-    if not enabled():
-        return None
+    classic forward_backward/update/update_metric loop). The fused path
+    is requested by MXNET_TPU_FUSED_STEP=1 — or by default under a
+    ``device_sync`` kvstore (in-jit GSPMD gradient exchange; gate with
+    MXNET_TPU_DEVICE_SYNC_FUSED=0). A requested-but-failed precondition
+    is NOT silent: it counts ``step.fused_fallback[.reason]`` and warns
+    once per reason."""
+    kv = module._kvstore
+    requested = enabled()
+    if not requested:
+        # device_sync asks for the fused path by contract: its gradient
+        # exchange IS the in-jit collective, there is no push/pull round
+        # for the classic loop to ride
+        requested = (getattr(kv, "in_jit_gradient_exchange", False)
+                     and _env.get("MXNET_TPU_DEVICE_SYNC_FUSED"))
+    if not requested:
+        return None   # not a fallback: fused was never asked for
     if not module.optimizer_initialized or module._update_on_kvstore:
-        return None
+        return _fallback(module, "kvstore_update",
+                         "the optimizer update runs on the kvstore "
+                         "(dist server-side update), which the fused "
+                         "step cannot subsume")
     # inline-dispatch engines only: the write-back closure assigns
     # executor/metric state the fit loop reads right back; a threaded
     # engine would run it on a worker while the loop races ahead
     from .engine import NaiveEngine, XLAEngine
 
     if type(get_engine()) not in (XLAEngine, NaiveEngine):
-        return None
-    kv = module._kvstore
+        return _fallback(module, "threaded_engine",
+                         "a threaded engine is active; the fused step "
+                         "needs an inline engine (MXNET_ENGINE_TYPE="
+                         "XLAEngine or NaiveEngine)")
     if kv is not None and not getattr(kv, "fused_step_compatible", False):
-        return None
+        return _fallback(module, "dist_kvstore",
+                         "kvstore %r moves gradient bytes between "
+                         "dispatches; use a local/device/device_sync "
+                         "store to fuse" % kv.type)
     if module.inputs_need_grad:
-        return None
+        return _fallback(module, "inputs_need_grad",
+                         "inputs_need_grad=True requires materialized "
+                         "input gradients the fused step never builds")
     ex = module._exec_group.executor
     if ex._monitor_callback is not None:
-        return None
+        return _fallback(module, "monitor",
+                         "an installed monitor needs every internal "
+                         "tensor; the fused step keeps them in-graph")
     # grad_req "add" accumulates across batches in the grad arrays; the
     # fused step never materializes per-param grads, so it can't honor it
     if any(ex._grad_req[ex.arg_names[i]] != "write" for i in ex._grad_idx):
-        return None
+        return _fallback(module, "grad_req",
+                         "grad_req != \"write\" accumulates into grad "
+                         "arrays the fused step never materializes")
     opt = module._optimizer
     if not opt._fusable() or not _env.get("MXNET_TPU_FUSED_UPDATE"):
-        return None
+        return _fallback(module, "optimizer",
+                         "optimizer %s has no fusable update plan (or "
+                         "MXNET_TPU_FUSED_UPDATE=0)"
+                         % type(opt).__name__)
     # every grad-bearing arg must map onto an updater slot
     param_idx = {n: i for i, n in enumerate(module._param_names)}
     if any(ex.arg_names[i] not in param_idx for i in ex._grad_idx):
-        return None
+        return _fallback(module, "unmapped_grad_arg",
+                         "a grad-bearing arg has no updater slot "
+                         "(param list out of sync with the graph)")
     return FusedTrainStep(module, eval_metric)
 
 
@@ -205,13 +266,23 @@ class FusedTrainStep:
         # sanctioned H2D: the host-side update plans become one small
         # device mat per param group (graftlint: jnp.asarray of a host
         # list; transfer sanitizer: explicit allow window)
+        mesh = getattr(self._group, "_mesh", None)
         with _san.intentional_transfer():
+            rep = None
+            if mesh is not None:
+                # pre-place replicated on the mesh: leaving the mats on
+                # device 0 would make every dispatch an implicit d2d
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec
+                rep = NamedSharding(mesh, PartitionSpec())
             for (kind, n_states), members in groups.items():
                 specs.append((kind, n_states,
                               tuple(m[0] for m in members)))
                 state_nds.append(tuple(m[1] for m in members))
-                sv_mats.append(jnp.asarray([m[2] for m in members],
-                                           jnp.float32))
+                mat = jnp.asarray([m[2] for m in members], jnp.float32)
+                if rep is not None:
+                    mat = jax.device_put(mat, rep)
+                sv_mats.append(mat)
         specs = tuple(specs)
 
         from .optimizer import _donation_ok
@@ -360,6 +431,20 @@ class FusedTrainStep:
         ex = self._executor
         run_graph = ex._run_graph
         n_args = len(ex.arg_names)
+        # in-jit gradient exchange: with the batch sharded over the dp
+        # mesh axis and params replicated, pinning each vjp gradient to
+        # a replicated NamedSharding makes GSPMD lower the exchange to a
+        # mean-psum all-reduce INSIDE this dispatch (rescale_grad is
+        # 1/global_batch, so the sum over shards is the mean). Without
+        # the constraint the partitioner may defer the reduce into the
+        # update — correct but unpinned; with it the collective is a
+        # guaranteed, xprof-visible op between backward and update.
+        grad_sharding = None
+        mesh = getattr(self._group, "_mesh", None)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            grad_sharding = NamedSharding(mesh, PartitionSpec())
         p_idx = list(self._p_arg_idx)
         o_idx = list(self._o_arg_idx)
         label_pos = list(self._label_o_pos)
@@ -402,6 +487,9 @@ class FusedTrainStep:
                      else zero_cotangent(o) for o in outs]
             cts = (heads, jax.tree_util.tree_map(zero_cotangent, aux_out))
             grads, = vjp(cts)
+            if grad_sharding is not None:
+                grads = [jax.lax.with_sharding_constraint(g, grad_sharding)
+                         for g in grads]
             new_p = list(p_vals)
             new_st = []
             for gi, (kind, n_states, positions) in enumerate(specs):

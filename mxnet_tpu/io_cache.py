@@ -326,7 +326,8 @@ class CachedImageRecordIter(DataIter):
                  device_augment: bool = False,
                  device_feed: Optional[bool] = None,
                  output_layout: str = "NCHW",
-                 label_name: str = "softmax_label"):
+                 label_name: str = "softmax_label",
+                 aug_replicas: Optional[int] = None):
         super().__init__()
         meta_path = cache_prefix + ".meta.json"
         if not os.path.exists(meta_path):
@@ -373,6 +374,20 @@ class CachedImageRecordIter(DataIter):
         if device_feed is None:
             device_feed = _env.get("MXNET_TPU_DEVICE_FEED")
         self.device_feed = bool(device_feed)
+        # data-parallel aug independence: with the batch sharded along a
+        # dp mesh axis, the crop/mirror draws are keyed per (epoch,
+        # cursor, replica) so each replica's rows come from its OWN
+        # stream — replicas never apply one shared crop schedule to
+        # different shards, and a replica's stream is stable however
+        # the other shards change. aug_replicas=1 (the default) is
+        # bit-identical to the historical single-stream draws.
+        if aug_replicas is None:
+            aug_replicas = _env.get("MXNET_TPU_AUG_REPLICAS") or 1
+        self.aug_replicas = max(1, int(aug_replicas))
+        if batch_size % self.aug_replicas:
+            raise MXNetError(
+                "batch_size %d not divisible by aug_replicas %d"
+                % (batch_size, self.aug_replicas))
         # NHWC consumers (channels-last towers) read batches without the
         # NCHW transpose — emitting their layout directly avoids a
         # cancelling transpose pair per batch in the consumer
@@ -530,6 +545,33 @@ class CachedImageRecordIter(DataIter):
             _tel.inc("io.batch_cache_hit")
         return self._batch
 
+    def _aug_params(self, sh, sw, h, w):
+        """Per-sample crop offsets and mirror flags for one batch, drawn
+        per REPLICA: replica r's rows [r*B/R, (r+1)*B/R) come from a
+        RandomState keyed (seed, epoch, cursor, r), so when ``batch.aug``
+        is sharded along ``dp`` (batch axis 0, contiguous blocks) every
+        replica augments its shard from an independent stream.
+        ``aug_replicas=1`` reproduces the historical single-stream draws
+        bit-for-bit. Shared by the device_feed and device_augment paths,
+        which therefore stay bit-identical to each other."""
+        R = self.aug_replicas
+        shard = self.batch_size // R
+        tops_l, lefts_l, mir_l = [], [], []
+        for r in range(R):
+            rs = np.random.RandomState(
+                (self._seed * 2654435761 + self._epoch * 1000003
+                 + self.cursor + r * 0x85EBCA6B) & 0xFFFFFFFF)
+            if self.rand_crop and (sh > h or sw > w):
+                tops_l.append(rs.randint(0, sh - h + 1, shard))
+                lefts_l.append(rs.randint(0, sw - w + 1, shard))
+            else:
+                tops_l.append(np.full(shard, (sh - h) // 2))
+                lefts_l.append(np.full(shard, (sw - w) // 2))
+            mir_l.append((rs.rand(shard) < 0.5) if self.rand_mirror
+                         else np.zeros(shard, bool))
+        return (np.concatenate(tops_l), np.concatenate(lefts_l),
+                np.concatenate(mir_l))
+
     def _make_batch(self) -> DataBatch:
         from . import ndarray as nd
 
@@ -553,14 +595,7 @@ class CachedImageRecordIter(DataIter):
             # gather improves memmap locality
             gidx = np.sort(idx)
             full = np.ascontiguousarray(self._data[gidx])
-            if self.rand_crop and (sh > h or sw > w):
-                tops = rng.randint(0, sh - h + 1, self.batch_size)
-                lefts = rng.randint(0, sw - w + 1, self.batch_size)
-            else:
-                tops = np.full(self.batch_size, (sh - h) // 2)
-                lefts = np.full(self.batch_size, (sw - w) // 2)
-            mirror = (rng.rand(self.batch_size) < 0.5) if self.rand_mirror \
-                else np.zeros(self.batch_size, bool)
+            tops, lefts, mirror = self._aug_params(sh, sw, h, w)
             labels = np.asarray(self._labels[gidx])
             if self.meta["label_width"] == 1:
                 labels = labels[:, 0]

@@ -52,7 +52,7 @@ import numpy as np
 from . import telemetry as _tel
 from . import env as _env
 from .base import MXNetError
-from .io import DataBatch, DataIter, RecordDecoder
+from .io import DataBatch, DataDesc, DataIter, RecordDecoder
 
 __all__ = ["ShmRecordStore", "ShmBatchRing", "ProcessDecodePipeline",
            "DeviceStagingIter", "FeedScheduler", "PipelineError"]
@@ -468,10 +468,15 @@ class DeviceStagingIter(DataIter):
     Enable in the fit loop with ``MXNET_TPU_DEVICE_STAGING=1`` or wrap an
     iterator explicitly."""
 
-    def __init__(self, base: DataIter, ctx=None):
+    def __init__(self, base: DataIter, ctx=None, group=None):
         super().__init__()
         self.base = base
         self._ctx = ctx
+        # executor group (or anything with `_mesh` + `_place`): batches
+        # staged here land batch-sharded along the group's `dp` mesh
+        # axis, so the fused sharded step's own `_place` is a no-copy
+        # re-handle instead of a late cross-device reshard
+        self._group = group
         self.batch_size = getattr(base, "batch_size", 0)
         self._staged: Optional[DataBatch] = None
         self._exhausted = False
@@ -489,19 +494,33 @@ class DeviceStagingIter(DataIter):
         self._staged = None
         self._exhausted = False
 
-    def _to_device(self, x):
+    def _to_device(self, x, batch_axis=0):
         from .ndarray import NDArray, array
 
+        grp = self._group
+        if grp is not None and getattr(grp, "_mesh", None) is not None:
+            return grp._place(x, batch_axis)
         if isinstance(x, NDArray):
             if self._ctx is not None and x.context != self._ctx:
                 return x.as_in_context(self._ctx)
             return x
         return array(x, ctx=self._ctx)
 
+    @staticmethod
+    def _batch_axis(descs, i):
+        try:
+            return DataDesc.get_batch_axis(descs[i].layout)
+        except (AttributeError, IndexError, TypeError):
+            return 0
+
     def _stage(self, batch: DataBatch) -> DataBatch:
         t0 = time.perf_counter() if _tel.enabled() else 0.0
-        data = [self._to_device(d) for d in batch.data]
-        label = [self._to_device(l) for l in batch.label]
+        d_descs = batch.provide_data or self.provide_data or []
+        l_descs = batch.provide_label or self.provide_label or []
+        data = [self._to_device(d, self._batch_axis(d_descs, i))
+                for i, d in enumerate(batch.data)]
+        label = [self._to_device(l, self._batch_axis(l_descs, i))
+                 for i, l in enumerate(batch.label)]
         if _tel.enabled():
             _tel.observe("io.staging.h2d_ms",
                          (time.perf_counter() - t0) * 1e3)
@@ -561,11 +580,12 @@ class DeviceStagingIter(DataIter):
         self.close()
 
 
-def maybe_wrap_device_staging(data_iter: DataIter) -> DataIter:
+def maybe_wrap_device_staging(data_iter: DataIter, group=None) -> DataIter:
     """Fit-loop hook: wrap ``data_iter`` in :class:`DeviceStagingIter`
     when ``MXNET_TPU_DEVICE_STAGING=1`` (idempotent). A
     :class:`FeedScheduler` already stages on its worker thread, so it is
-    never double-wrapped."""
+    never double-wrapped. ``group`` (the bound executor group) makes the
+    staging mesh-aware: batches land dp-sharded."""
     if not _env.get("MXNET_TPU_DEVICE_STAGING"):
         return data_iter
     if isinstance(data_iter, (DeviceStagingIter, FeedScheduler)):
@@ -573,7 +593,7 @@ def maybe_wrap_device_staging(data_iter: DataIter) -> DataIter:
     logging.getLogger(__name__).info(
         "device staging enabled: wrapping %s in DeviceStagingIter",
         type(data_iter).__name__)
-    return DeviceStagingIter(data_iter)
+    return DeviceStagingIter(data_iter, group=group)
 
 
 # ---------------------------------------------------------------------------
@@ -601,11 +621,13 @@ class FeedScheduler(DataIter):
 
     _END = object()
 
-    def __init__(self, base: DataIter, depth: int = 2, ctx=None):
+    def __init__(self, base: DataIter, depth: int = 2, ctx=None,
+                 group=None):
         super().__init__()
         self.base = base
         self.depth = max(1, int(depth))
         self._ctx = ctx
+        self._group = group   # see DeviceStagingIter: mesh-sharded staging
         self.batch_size = getattr(base, "batch_size", 0)
         self._q = _queue.Queue(maxsize=self.depth)
         self._thread: Optional[threading.Thread] = None
@@ -624,6 +646,7 @@ class FeedScheduler(DataIter):
 
     # staging reuses the DeviceStagingIter conversion/telemetry path
     _to_device = DeviceStagingIter._to_device
+    _batch_axis = staticmethod(DeviceStagingIter._batch_axis)
     _stage = DeviceStagingIter._stage
 
     def _worker(self):
@@ -732,10 +755,11 @@ class FeedScheduler(DataIter):
         self.close()
 
 
-def maybe_wrap_feed_scheduler(data_iter: DataIter) -> DataIter:
+def maybe_wrap_feed_scheduler(data_iter: DataIter, group=None) -> DataIter:
     """Fit-loop hook: wrap ``data_iter`` in :class:`FeedScheduler` when
     ``MXNET_TPU_FEED_DEPTH`` >= 1 (idempotent; subsumes device
-    staging)."""
+    staging). ``group`` makes the worker's staging mesh-aware (see
+    :func:`maybe_wrap_device_staging`)."""
     depth = _env.get("MXNET_TPU_FEED_DEPTH")
     if depth <= 0:
         return data_iter
@@ -746,4 +770,4 @@ def maybe_wrap_feed_scheduler(data_iter: DataIter) -> DataIter:
     logging.getLogger(__name__).info(
         "feed scheduler enabled: %d staged batches in flight ahead of "
         "%s", depth, type(data_iter).__name__)
-    return FeedScheduler(data_iter, depth=depth)
+    return FeedScheduler(data_iter, depth=depth, group=group)

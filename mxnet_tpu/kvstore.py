@@ -462,6 +462,34 @@ class TPUSyncKVStore(KVStore):
         return super()._reduce(vlist)
 
 
+class DeviceSyncKVStore(TPUSyncKVStore):
+    """``device_sync``: multi-device single-process data parallelism with
+    the gradient exchange INSIDE the donated fused jit. The store keeps
+    the push/pull API (jitted tree-sum reduce) for explicit use, but its
+    training-path contract is different: the module shards the batch
+    over the executor group's ``dp`` mesh axis, replicates params and
+    optimizer state, and the fused step pins the vjp gradients to a
+    replicated ``NamedSharding`` — GSPMD lowers that to a mean-``psum``
+    all-reduce between backward and update, one collective per step,
+    zero extra dispatches. This is the TPU-native answer to the
+    reference's ps-lite push/pull round: bytes move on ICI inside the
+    step instead of host-side between dispatches."""
+
+    def __init__(self, kv_type: str = "device_sync"):
+        super().__init__(kv_type)
+
+    @property
+    def fused_step_compatible(self) -> bool:
+        return True
+
+    @property
+    def in_jit_gradient_exchange(self) -> bool:
+        """Marker consulted by ``make_fused_step``: this store asks for
+        the fused path by default (no MXNET_TPU_FUSED_STEP opt-in) and
+        for the in-jit replicated-gradient constraint."""
+        return True
+
+
 def create(name: str = "local") -> KVStore:
     """Factory (reference ``src/kvstore/kvstore.cc:17-45`` string-typed
     creation: any name containing 'device' -> device comm, 'dist' ->
@@ -469,7 +497,9 @@ def create(name: str = "local") -> KVStore:
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     lname = name.lower()
-    if "tpu" in lname or "device" in lname:
+    if lname == "device_sync":
+        kv = DeviceSyncKVStore(lname)
+    elif "tpu" in lname or "device" in lname:
         kv = TPUSyncKVStore(lname)
     elif "async" in lname:
         kv = KVStoreDistAsync(lname)

@@ -223,8 +223,12 @@ class BaseModule:
         # overlaps compute instead of serializing with it.
         from ..io_pipeline import (maybe_wrap_device_staging,
                                    maybe_wrap_feed_scheduler)
-        train_data = maybe_wrap_feed_scheduler(train_data)
-        train_data = maybe_wrap_device_staging(train_data)
+        # the bound executor group (when this module has one) makes the
+        # staging wrappers mesh-aware: batches land dp-sharded, so the
+        # sharded fused step re-handles them instead of resharding
+        _group = getattr(self, "_exec_group", None)
+        train_data = maybe_wrap_feed_scheduler(train_data, group=_group)
+        train_data = maybe_wrap_device_staging(train_data, group=_group)
 
         # env-driven observability (metrics server, flight recorder);
         # single flag check when telemetry is off

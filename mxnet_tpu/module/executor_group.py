@@ -74,11 +74,14 @@ class DataParallelExecutorGroup:
 
     # ------------------------------------------------------------------
     def _make_mesh(self):
-        import jax
-        from jax.sharding import Mesh
+        # one shared mesh constructor (parallel/sharding.py) so the
+        # module path and the explicit-sharding API agree on axis names
+        # and device-count validation — the fused step's in-jit gradient
+        # exchange keys off this mesh's "dp" axis
+        from ..parallel.sharding import make_mesh
 
         devices = [c.jax_device() for c in self.contexts]
-        return Mesh(np.array(devices), ("dp",))
+        return make_mesh({"dp": len(devices)}, devices=devices)
 
     def _sharding(self, batch_axis: Optional[int]):
         """NamedSharding for a batch-sharded (or replicated, axis None)

@@ -75,6 +75,7 @@ DELTA_SOURCES = (
     ("recompiles", "executor.jit_build", "counter"),
     ("dispatches", "step.dispatches", "counter"),
     ("fused_recompiles", "step.fused_recompiles", "counter"),
+    ("fallbacks", "step.fused_fallback", "counter"),
     ("sanitizer_trips", "sanitizer.trips", "counter"),
     # xprof compile registry: measured XLA compiles this step and the
     # wall time they took (the time_ms histogram's sum delta IS the ms

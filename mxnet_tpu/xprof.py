@@ -95,7 +95,7 @@ class CompileRecord:
     __slots__ = ("site", "seq", "compile_time_s", "signature", "flops",
                  "bytes_accessed", "argument_bytes", "output_bytes",
                  "temp_bytes", "peak_bytes", "generated_code_bytes",
-                 "op_breakdown", "retrace_cause", "ts")
+                 "op_breakdown", "retrace_cause", "num_devices", "ts")
 
     def __init__(self, **kw):
         for k in self.__slots__:
@@ -203,6 +203,16 @@ def _cost_dict(compiled) -> dict:
     return dict(c) if c else {}
 
 
+def _device_count(compiled) -> Optional[int]:
+    """Devices the executable was SPMD-partitioned over (1 for an
+    unsharded step; the dp mesh size for the sharded fused step) — the
+    compile-registry witness that GSPMD actually partitioned a site."""
+    try:
+        return len(compiled.runtime_executable().local_devices())
+    except Exception:
+        return None
+
+
 def _memory_dict(compiled) -> Optional[dict]:
     try:
         m = compiled.memory_analysis()
@@ -265,6 +275,7 @@ def record_compile(site: str, compiled, compile_time_s: float,
             peak_bytes=mem.get("peak_bytes"),
             generated_code_bytes=mem.get("generated_code_bytes"),
             op_breakdown=breakdown, retrace_cause=cause,
+            num_devices=_device_count(compiled),
             ts=round(time.time(), 6))
         st["compiles"] += 1
         st["time_s"] += float(compile_time_s)

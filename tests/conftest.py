@@ -34,7 +34,25 @@ import pytest  # noqa: E402
 # step loop, so an implicit host<->device transfer regression in the
 # fused path fails these suites at the batch that caused it (see
 # docs/static_analysis.md)
-_TRANSFER_SANITIZED = {"test_fused_step", "test_fused_feed"}
+_TRANSFER_SANITIZED = {"test_fused_step", "test_fused_feed",
+                       "test_sharded_fused"}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "multichip: needs the forced 8-device cpu mesh (skipped when the "
+        "backend refused --xla_force_host_platform_device_count)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if len(jax.devices()) >= 8:
+        return
+    skip = pytest.mark.skip(
+        reason="backend refused the forced 8-device cpu platform")
+    for item in items:
+        if "multichip" in item.keywords:
+            item.add_marker(skip)
 
 
 @pytest.fixture(autouse=True)

@@ -410,3 +410,48 @@ def test_composes_with_prefetching_iter(cache):
     finally:
         if hasattr(it, "close"):
             it.close()
+
+
+def test_aug_replicas_draw_independent_streams(cache):
+    """Sharded-feed aug independence: with aug_replicas=R the crop/
+    mirror draws come from a per-(epoch, cursor, replica) keyed stream,
+    so replicas never share one crop schedule across different shards."""
+    prefix, _ = cache
+    it = io_cache.CachedImageRecordIter(prefix, (3, 24, 24), 8,
+                                        rand_crop=True, rand_mirror=True,
+                                        seed=7, aug_replicas=4)
+    tops, lefts, mirror = it._aug_params(32, 32, 24, 24)
+    assert tops.shape == lefts.shape == mirror.shape == (8,)
+    shards = [(tuple(tops[i:i + 2]), tuple(lefts[i:i + 2]))
+              for i in range(0, 8, 2)]
+    assert len(set(shards)) > 1, "all replicas drew identical aug params"
+    # the stream is keyed, not positional: same (epoch, cursor) redraws
+    # identically, the next batch draws fresh
+    again = it._aug_params(32, 32, 24, 24)
+    assert np.array_equal(tops, again[0])
+    it.cursor += it.batch_size
+    moved = it._aug_params(32, 32, 24, 24)
+    assert not np.array_equal(tops, moved[0])
+
+
+def test_aug_replicas_r1_matches_historical_stream(cache):
+    """aug_replicas=1 (the default) reproduces the single-stream draws
+    bit for bit, so existing device_feed/device_augment parity holds."""
+    prefix, _ = cache
+    a = io_cache.CachedImageRecordIter(prefix, (3, 24, 24), 8,
+                                       rand_crop=True, rand_mirror=True,
+                                       seed=9)
+    b = io_cache.CachedImageRecordIter(prefix, (3, 24, 24), 8,
+                                       rand_crop=True, rand_mirror=True,
+                                       seed=9, aug_replicas=1)
+    assert a.aug_replicas == 1
+    for x, y in zip(a._aug_params(32, 32, 24, 24),
+                    b._aug_params(32, 32, 24, 24)):
+        assert np.array_equal(x, y)
+
+
+def test_aug_replicas_must_divide_batch(cache):
+    prefix, _ = cache
+    with pytest.raises(MXNetError):
+        io_cache.CachedImageRecordIter(prefix, (3, 24, 24), 8,
+                                       aug_replicas=3)

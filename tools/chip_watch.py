@@ -135,7 +135,8 @@ def _run(cmd, timeout_s, env_overrides=None, outfile=None,
 
 ARTIFACTS = ["BENCH_watch.json", ".bench_cache.json",
              ".bench_trace_summary.json", "MFU_EXPERIMENTS.jsonl",
-             "TPU_CONSISTENCY.txt", "XPROF_DEVICE_TIME.json"]
+             "TPU_CONSISTENCY.txt", "XPROF_DEVICE_TIME.json",
+             "MULTICHIP_scaling.json"]
 
 
 def xprof_device_time(stamp):
@@ -263,6 +264,21 @@ def fire():
     except Exception as e:                       # noqa: BLE001
         log("xprof device-time stage failed: %s" % e)
     _commit("xprof device-time", stamp)
+    # 6. multichip dp-scaling tier (simulated devices, so it runs in
+    # any window): sharded fused step measured at dp=1,2,4,8 ->
+    # MULTICHIP_scaling.json. bench.py marks the record "incomplete"
+    # itself when its child dies; a wedged/timed-out orchestrator gets
+    # one written here so a stale record can't pass as this window's
+    out = _run([py, os.path.join(REPO, "bench.py"), "multichip"], 2000)
+    if out is None:
+        with open(os.path.join(REPO, "MULTICHIP_scaling.json"),
+                  "w") as f:
+            json.dump({"metric": "multichip_imgs_per_sec", "value": 0,
+                       "incomplete": "chip_watch multichip stage timed "
+                                     "out or crashed",
+                       "chip_watch_stamp": stamp}, f)
+            f.write("\n")
+    _commit("multichip dp scaling", stamp)
 
 
 def main(argv=None):

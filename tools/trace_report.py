@@ -20,7 +20,8 @@ import sys
 
 DELTA_COLS = ("io_stall_ms", "prefetch_stall_ms", "h2d_bytes",
               "kv_push_bytes", "kv_pull_bytes", "recompiles",
-              "dispatches", "fused_recompiles", "sanitizer_trips")
+              "dispatches", "fused_recompiles", "fallbacks",
+              "sanitizer_trips")
 
 
 def load_records(path):
@@ -61,7 +62,7 @@ def render(records, top=10):
     lats = sorted(r["latency_ms"] for r in records)
     header = ("step", "latency_ms", "dominant", "io_stall_ms",
               "prefetch_ms", "h2d", "kv_push", "kv_pull", "recompiles",
-              "dispatch", "fused_rc", "san_trips")
+              "dispatch", "fused_rc", "fallbacks", "san_trips")
     rows = [header]
     for r in slowest:
         d = r.get("deltas", {})
@@ -77,6 +78,7 @@ def render(records, top=10):
             str(d.get("recompiles", 0)),
             str(d.get("dispatches", 0)),
             str(d.get("fused_recompiles", 0)),
+            str(d.get("fallbacks", 0)),
             str(d.get("sanitizer_trips", 0)),
         ))
     widths = [max(len(row[i]) for row in rows) for i in range(len(header))]
@@ -237,8 +239,41 @@ def render_bench_summary(rec):
     fmt = lambda v: "%.1f%%" % v if v is not None else "n/a"  # noqa: E731
     gap = ("%.1fpt" % abs(analytic - measured)
            if analytic is not None and measured is not None else "n/a")
-    return ("analytic MFU %s vs measured %s — gap %s, attributed to %s\n"
-            % (fmt(analytic), fmt(measured), gap, blame))
+    out = ("analytic MFU %s vs measured %s — gap %s, attributed to %s\n"
+           % (fmt(analytic), fmt(measured), gap, blame))
+    coll = collective_fraction(rec)
+    if coll is not None:
+        out += ("collective (gradient exchange): %.1f%% of FLOPs, "
+                "%.1f%% of bytes moved\n"
+                % (100.0 * coll["flop_fraction"],
+                   100.0 * coll["byte_fraction"]))
+    return out
+
+
+def collective_fraction(rec):
+    """Fraction of the main executable's FLOPs/bytes in the
+    ``collective`` HLO category (all-reduce/all-gather/...): the cost of
+    the sharded fused step's in-jit gradient exchange. None when no op
+    breakdown (or no collective ops) was recorded."""
+    xp = rec.get("xprof") or {}
+    _site, s = _main_site(xp)
+    bd = ((s.get("last") or {}).get("op_breakdown")) or {}
+    if not bd or "collective" not in bd:
+        # multichip records carry the precomputed fraction directly
+        c = rec.get("collective")
+        if isinstance(c, dict) and "byte_fraction" in c:
+            return {"flop_fraction": c.get("flop_fraction", 0.0),
+                    "byte_fraction": c.get("byte_fraction", 0.0),
+                    "ops": c.get("ops", 0)}
+        return None
+    total_fl = sum(v.get("flops", 0) for v in bd.values())
+    total_by = sum(v.get("bytes", 0) for v in bd.values())
+    c = bd["collective"]
+    return {"flop_fraction": (c.get("flops", 0) / total_fl
+                              if total_fl else 0.0),
+            "byte_fraction": (c.get("bytes", 0) / total_by
+                              if total_by else 0.0),
+            "ops": c.get("count", 0)}
 
 
 def render_compile(rec):
