@@ -62,7 +62,13 @@ profile-report:
 multichip:
 	python bench.py multichip
 
+# continuous-batching serving tier: open-loop Poisson load swept until
+# the tail-latency SLO breaks -> SERVE_bench.json (goodput, p50/p99,
+# batch occupancy, zero-retrace proof)
+serve-bench:
+	python bench.py serve
+
 clean:
 	rm -rf mxnet_tpu/_native perl-package/blib
 
-.PHONY: all predict perl test lint profile-report multichip clean
+.PHONY: all predict perl test lint profile-report multichip serve-bench clean

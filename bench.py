@@ -175,6 +175,10 @@ def _bench_smoke(procs=4, image=64, num=192, batch=32, seconds=4.0):
         result.update(_smoke_xprof_tier())
     except Exception as e:
         sys.stderr.write("bench.py: smoke xprof tier failed: %s\n" % e)
+    try:
+        result.update(_smoke_serve_tier())
+    except Exception as e:
+        sys.stderr.write("bench.py: smoke serve tier failed: %s\n" % e)
     telemetry.disable()
     print(json.dumps(result))
     return result
@@ -193,6 +197,14 @@ def main():
         return _bench_multichip()
     if "multichip" in sys.argv[1:]:
         return _multichip_main()
+    # the serving tier: continuous-batching inference under open-loop
+    # Poisson load on the 8-device mesh ("serve" before the generic
+    # --smoke check so `bench.py serve --smoke` routes here)
+    # graft: env-ok
+    if os.environ.get("MXNET_TPU_BENCH_SERVE"):
+        return _bench_serve()
+    if "serve" in sys.argv[1:]:
+        return _serve_main()
     if "--smoke" in sys.argv[1:]:
         import argparse
 
@@ -672,6 +684,223 @@ def _multichip_main():
         pass
     print(json.dumps(result))
     return result
+
+
+def _serve_main():
+    """Orchestrator for ``bench.py serve [--smoke]``: run the serving
+    tier in a child interpreter forced onto 8 simulated cpu devices,
+    write the record to SERVE_bench.json, print the one JSON line.
+    Like :func:`main` it never imports jax itself."""
+    # graft: env-ok
+    timeout_s = int(os.environ.get("MXNET_TPU_BENCH_TIMEOUT", 1500))
+    # graft: env-ok
+    xla = os.environ.get("XLA_FLAGS", "")
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS":
+            (xla + " --xla_force_host_platform_device_count=8").strip(),
+        "MXNET_TPU_BENCH_SERVE": "1",
+    }
+    if "--smoke" in sys.argv[1:]:
+        env["MXNET_TPU_BENCH_SERVE_SMOKE"] = "1"
+    result = _run_child(env, timeout_s)
+    if result is None:
+        result = {"metric": "serve_goodput_rps", "value": 0,
+                  "unit": "req/s",
+                  "incomplete": "serve bench child failed/timed out"}
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "SERVE_bench.json")
+    try:
+        with open(out, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass
+    print(json.dumps(result))
+    return result
+
+
+def _serve_tier(srv, rate, duration, slo_ms, rng):
+    """One open-loop load tier: Poisson arrivals at ``rate`` req/s for
+    ``duration`` seconds, submissions never waiting on completions
+    (overload shows up as queue growth -> tail latency, exactly like a
+    real load balancer feeding a replica). Returns the tier record."""
+    dim = srv._data_shapes[0][1:]
+    row = rng.rand(1, *dim).astype(np.float32)
+    reqs = []
+    t_next = time.perf_counter()
+    t_end = t_next + duration
+    while t_next < t_end:
+        now = time.perf_counter()
+        if t_next > now:
+            time.sleep(t_next - now)
+        reqs.append(srv.submit([row]))
+        t_next += rng.exponential(1.0 / rate)
+    lat, failures = [], 0
+    for r in reqs:
+        try:
+            r.get(120)
+            lat.append(r.latency_ms)
+        except Exception:
+            failures += 1
+    lat.sort()
+
+    def q(p):
+        return round(lat[min(len(lat) - 1, int(p * len(lat)))], 2) \
+            if lat else None
+
+    ok = sum(1 for v in lat if v <= slo_ms)
+    tier = {"offered_rps": rate, "served": len(lat),
+            "failures": failures,
+            "achieved_rps": round(len(lat) / duration, 1),
+            "goodput_rps": round(ok / duration, 1),
+            "p50_ms": q(0.50), "p99_ms": q(0.99), "p999_ms": q(0.999)}
+    tier["slo_ok"] = bool(lat) and tier["p99_ms"] <= slo_ms \
+        and not failures
+    return tier
+
+
+def _bench_serve():
+    """The measured serving tier (inner child, forced-cpu mesh): a
+    dp-sharded MLP served through ``serving.InferenceServer``, every
+    bucket rung warmed once (all the compiles steady state will ever
+    need), then an ascending open-loop Poisson sweep until the p99 SLO
+    breaks. The record is the serving counterpart of
+    MULTICHIP_scaling.json: requests/sec, goodput at SLO, tail
+    latency, occupancy, the per-request latency decomposition, and the
+    zero-steady-state-retrace proof off the xprof registry."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # graft: env-ok (same pre-import reapply as _bench)
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving, telemetry, tracing, xprof
+
+    telemetry.enable()
+    tracing.maybe_init()
+    xprof.enable()
+    xprof.reset()
+    # graft: env-ok
+    smoke = bool(os.environ.get("MXNET_TPU_BENCH_SERVE_SMOKE"))
+
+    n_dev = len(jax.devices())
+    dp = min(8, n_dev)
+    dim, classes, hidden = 64, 16, 128
+    max_batch = 32 if smoke else 64
+    max_wait_ms = 2.0
+    slo_ms = 100.0
+
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=[mx.cpu(i) for i in range(dp)])
+    mod.bind(data_shapes=[("data", (max_batch, dim))],
+             label_shapes=[("softmax_label", (max_batch,))],
+             for_training=False)
+    mod.init_params(mx.initializer.Uniform(0.07))
+    srv = serving.InferenceServer(mod, top_k=1, max_batch=max_batch,
+                                  max_wait_ms=max_wait_ms, slo_ms=slo_ms)
+    rng = np.random.RandomState(0)
+    try:
+        # warm every ladder rung ONCE — after this, steady state must
+        # never compile again, whatever batch mix the load produces
+        for b in srv.buckets:
+            srv._fused([np.zeros((b, dim), np.float32)])
+        xp0 = (xprof.summary()["sites"].get("fused_infer")
+               or {}).get("compiles", 0)
+        rc0 = telemetry.peek("infer.recompiles") or 0
+        di0 = telemetry.peek("infer.dispatches") or 0
+        ba0 = telemetry.peek("serve.batches") or 0
+
+        rates = [50, 150] if smoke else [25, 50, 100, 200, 400, 800]
+        duration = 1.5 if smoke else 4.0
+        tiers = []
+        for rate in rates:
+            tier = _serve_tier(srv, rate, duration, slo_ms, rng)
+            tiers.append(tier)
+            if not tier["slo_ok"]:
+                break
+
+        xp1 = (xprof.summary()["sites"].get("fused_infer")
+               or {}).get("compiles", 0)
+        rc1 = telemetry.peek("infer.recompiles") or 0
+        di1 = telemetry.peek("infer.dispatches") or 0
+        ba1 = telemetry.peek("serve.batches") or 0
+        stats = srv.stats()
+        buckets = list(srv.buckets)
+        compiles = srv.compiles
+    finally:
+        srv.close()
+
+    good = [t for t in tiers if t["slo_ok"]]
+    best = good[-1] if good else tiers[-1]
+    decomp = {}
+    for k in ("queue_ms", "h2d_ms", "dispatch_ms", "d2h_ms",
+              "pad_waste_ms", "request_ms"):
+        exp = telemetry.histogram("serve." + k).export()
+        if exp.get("count"):
+            decomp[k] = {"mean": round(exp["mean"], 3),
+                         "p50": round(exp["p50"], 3),
+                         "p99": round(exp["p99"], 3)}
+    batches = ba1 - ba0
+    result = {
+        "metric": "serve_goodput_rps",
+        "value": best["goodput_rps"], "unit": "req/s",
+        "platform": jax.devices()[0].platform,
+        "n_devices": n_dev, "dp": dp,
+        "buckets": buckets, "max_batch": max_batch,
+        "max_wait_ms": max_wait_ms, "slo_ms": slo_ms,
+        "requests_per_sec": best["achieved_rps"],
+        "goodput_rps_at_slo": best["goodput_rps"],
+        "p50_ms": best["p50_ms"], "p99_ms": best["p99_ms"],
+        "p999_ms": best["p999_ms"],
+        "mean_batch_occupancy": stats.get("mean_occupancy", 0.0),
+        "compiles": compiles,
+        "steady_state_retraces": (rc1 - rc0) + (xp1 - xp0),
+        "zero_steady_state_retraces": rc1 == rc0 and xp1 == xp0,
+        "dispatches_per_request_batch":
+            round((di1 - di0) / batches, 3) if batches else 0.0,
+        "latency_decomposition_ms": decomp,
+        "tiers": tiers, "smoke": smoke,
+    }
+    print(json.dumps(result))
+    return result
+
+
+def _smoke_serve_tier(seconds=1.5, rate=80):
+    """Mini serving tier for the generic ``--smoke`` record: a tiny
+    single-device server under a short Poisson load; the smoke BENCH
+    record then carries serving rps/latency next to the io and xprof
+    tiers, so CI exercises the batcher end to end."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=32, name="fc1")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (16, 24))],
+             label_shapes=[("softmax_label", (16,))], for_training=False)
+    mod.init_params(mx.initializer.Uniform(0.07))
+    srv = serving.InferenceServer(mod, top_k=1, max_batch=16,
+                                  max_wait_ms=2.0, slo_ms=250.0)
+    rng = np.random.RandomState(1)
+    try:
+        for b in srv.buckets:
+            srv._fused([np.zeros((b, 24), np.float32)])
+        tier = _serve_tier(srv, rate, seconds, 250.0, rng)
+        stats = srv.stats()
+    finally:
+        srv.close()
+    return {"serve": {"requests_per_sec": tier["achieved_rps"],
+                      "p50_ms": tier["p50_ms"], "p99_ms": tier["p99_ms"],
+                      "mean_batch_occupancy": stats.get("mean_occupancy"),
+                      "compiles": stats.get("compiles"),
+                      "buckets": stats.get("buckets")}}
 
 
 def _bench():

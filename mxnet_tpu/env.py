@@ -378,6 +378,38 @@ declare("MXNET_TPU_XPROF_RECORDS", int, 256,
         "dropped first (per-site summaries keep their totals).",
         section=_X)
 
+_S = "Serving"
+declare("MXNET_TPU_SERVE_PORT", str, "",
+        "Start the serving-tier metrics/health server on this port when "
+        "an `InferenceServer` comes up (same endpoints as "
+        "`MXNET_TPU_METRICS_PORT`: `/metrics`, `/healthz`). Port `0` "
+        "binds an ephemeral port (tests). Unset: reuse a server already "
+        "started via `MXNET_TPU_METRICS_PORT`, else none.", section=_S)
+declare("MXNET_TPU_SERVE_MAX_BATCH", int, 64,
+        "Upper bound on how many in-flight requests the continuous "
+        "batcher coalesces into one `fused_infer` dispatch; also the "
+        "top rung of the padded bucket ladder. Under a `dp` mesh it is "
+        "rounded up to a multiple of the mesh size so every bucket "
+        "shards evenly.", section=_S)
+declare("MXNET_TPU_SERVE_MAX_WAIT_MS", float, 2.0,
+        "How long the batcher holds an incomplete batch open for more "
+        "arrivals before dispatching what it has. Larger values raise "
+        "occupancy (throughput) and p50/p99 latency together; see the "
+        "\"Serving\" section of `docs/performance.md` for the "
+        "tradeoff.", section=_S)
+declare("MXNET_TPU_SERVE_BUCKETS", str, "",
+        "Comma-separated padded batch-size ladder (e.g. `1,2,4,8,16`). "
+        "Every dispatched batch is padded up to the next rung so mixed "
+        "request rates compile at most `len(buckets)` executables, "
+        "ever. Unset: powers of two from 1 (or the mesh size) up to "
+        "`MXNET_TPU_SERVE_MAX_BATCH`.", section=_S)
+declare("MXNET_TPU_SERVE_SLO_MS", float, 0.0,
+        "Per-request latency SLO in milliseconds. When the observed "
+        "p99 over the sliding SLO window exceeds it, `/healthz` flips "
+        "to `degraded` (HTTP 503) and a `slow_request` anomaly fires "
+        "through the step-trace detectors. `0` disables SLO "
+        "enforcement (latency is still measured).", section=_S)
+
 declare("MXNET_TPU_NO_NATIVE", bool, False,
         "Disable the C++ runtime library (pure-Python recordio + engines "
         "only).", section="Native library / Pallas")

@@ -55,7 +55,8 @@ from .base import MXNetError
 from .io import DataBatch, DataDesc, DataIter, RecordDecoder
 
 __all__ = ["ShmRecordStore", "ShmBatchRing", "ProcessDecodePipeline",
-           "DeviceStagingIter", "FeedScheduler", "PipelineError"]
+           "DeviceStagingIter", "FeedScheduler", "RequestStager",
+           "PipelineError"]
 
 
 class PipelineError(MXNetError):
@@ -771,3 +772,54 @@ def maybe_wrap_feed_scheduler(data_iter: DataIter, group=None) -> DataIter:
         "feed scheduler enabled: %d staged batches in flight ahead of "
         "%s", depth, type(data_iter).__name__)
     return FeedScheduler(data_iter, depth=depth, group=group)
+
+
+# ---------------------------------------------------------------------------
+# serving-tier request staging
+# ---------------------------------------------------------------------------
+
+class RequestStager:
+    """Staged H2D for serving request batches (``mxnet_tpu.serving``).
+
+    One scheduled batch = the queued request payloads concatenated
+    along the batch axis and padded up to the scheduled bucket size
+    (zero rows, sliced off again after the dispatch), then device-
+    placed through the caller's mesh-aware ``place`` function (the
+    ``FusedInfer.place_batch`` NamedSharding path: batch sharded along
+    ``dp``, params already replicated). Padding to a ladder rung is
+    what keeps every dispatch one of at most ``len(buckets)`` stable
+    shapes — mixed request rates never retrace.
+
+    Telemetry: ``serve.h2d_ms`` (histogram, pack+place wall time),
+    ``serve.h2d_bytes``, and ``serve.pad_rows`` so the mean-occupancy
+    number in ``SERVE_bench.json`` stays honest about pad waste.
+    """
+
+    def __init__(self, place=None):
+        self._place = place
+
+    def stage(self, rows: Sequence[Sequence[np.ndarray]], bucket: int):
+        """``rows`` is one payload tuple per queued request (arrays of
+        shape ``(k, ...)``, normally k=1), all with the same arity.
+        Returns ``(placed_arrays, pad)`` where ``pad`` is the number of
+        zero rows added to reach ``bucket``."""
+        t0 = time.perf_counter()
+        n = sum(int(r[0].shape[0]) for r in rows)
+        if n > bucket:
+            raise MXNetError("request batch of %d rows scheduled into a "
+                             "bucket of %d" % (n, bucket))
+        cols = list(zip(*rows))
+        batch = [np.concatenate([np.asarray(a) for a in c],  # graft: host-sync
+                                axis=0)
+                 for c in cols]
+        pad = bucket - n
+        if pad:
+            batch = [np.concatenate(
+                [b, np.zeros((pad,) + b.shape[1:], b.dtype)], axis=0)
+                for b in batch]
+        placed = self._place(batch) if self._place is not None else batch
+        _tel.observe("serve.h2d_ms", (time.perf_counter() - t0) * 1e3)
+        _tel.inc("serve.h2d_bytes", sum(int(b.nbytes) for b in batch))
+        if pad:
+            _tel.inc("serve.pad_rows", pad)
+        return placed, pad

@@ -131,6 +131,41 @@ class BaseModule:
                 raise MXNetError("invalid param file %s" % fname)
         self.set_params(arg_params, aux_params)
 
+    def _pad_partial_batch(self, eval_batch):
+        """Pad-and-slice for the final partial batch: an iterator that
+        yields a SMALLER last batch would retrace the compiled forward
+        for that one-off shape (a fresh XLA compile to serve a handful
+        of rows). Instead the batch axis is padded up to the bound
+        batch size with zero rows and ``pad`` is extended, so
+        predict/score slice the fake rows back off (``getpad``
+        semantics) and every batch reuses the one compiled executable.
+        Returns ``(batch, extra_rows)`` — (the original batch, 0) when
+        shapes already match."""
+        shapes = getattr(self, "_data_shapes", None)
+        if not shapes or not eval_batch.data:
+            return eval_batch, 0
+        bound = shapes[0].shape[0]
+        rows = eval_batch.data[0].shape[0]
+        if rows >= bound:
+            return eval_batch, 0
+        extra = bound - rows
+        import numpy as np
+
+        def _pad(arrs):
+            out = []
+            for a in arrs or []:
+                h = a.asnumpy() if hasattr(a, "asnumpy") else np.asarray(a)
+                out.append(nd.array(np.concatenate(
+                    [h, np.zeros((extra,) + h.shape[1:], h.dtype)],
+                    axis=0)))
+            return out
+
+        _tel.inc("module.pad_batches")
+        padded = DataBatch(_pad(eval_batch.data), _pad(eval_batch.label),
+                           pad=eval_batch.pad + extra,
+                           index=eval_batch.index)
+        return padded, extra
+
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, reset=True, epoch=0):
         if not self.binded or not self.params_initialized:
@@ -142,8 +177,17 @@ class BaseModule:
         for nbatch, eval_batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
+            padded, extra = self._pad_partial_batch(eval_batch)
+            self.forward(padded, is_train=False)
+            if extra:
+                # metric must only see the real rows: slice the padded
+                # outputs and pair them with the ORIGINAL labels — same
+                # numbers the per-shape retrace used to produce
+                outs = [out[0:out.shape[0] - extra]
+                        for out in self.get_outputs()]
+                eval_metric.update(eval_batch.label, outs)
+            else:
+                self.update_metric(eval_metric, eval_batch.label)
             if batch_end_callback is not None:
                 params = BatchEndParam(epoch=epoch, nbatch=nbatch,
                                        eval_metric=eval_metric, locals=locals())
@@ -161,8 +205,9 @@ class BaseModule:
         for nbatch, eval_batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
+            padded, _ = self._pad_partial_batch(eval_batch)
+            self.forward(padded, is_train=False)
+            pad = padded.pad
             outputs = [out[0:out.shape[0] - pad] for out in self.get_outputs()]
             output_list.append(outputs)
         if len(output_list) == 0:
@@ -185,8 +230,9 @@ class BaseModule:
         for nbatch, eval_batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
+            padded, _ = self._pad_partial_batch(eval_batch)
+            self.forward(padded, is_train=False)
+            pad = padded.pad
             outputs = [out[0:out.shape[0] - pad] for out in self.get_outputs()]
             yield outputs, nbatch, eval_batch
 

@@ -5,6 +5,15 @@ TPU-native equivalent of the reference's C predict API
 create a predictor from a symbol JSON + param blob, set inputs, forward,
 fetch outputs — the minimal surface used by the reference's
 amalgamation/mobile deployments.
+
+``forward`` dispatches through a cached
+:class:`~mxnet_tpu.fused_step.FusedInfer` executable (params packed
+once at construction, one XLA dispatch per call, nothing donated), so
+repeated predict calls never rebuild or retrace. An input whose shape
+is outside the declared ``input_shapes`` raises a clear
+:class:`MXNetError` pointing at :meth:`Predictor.reshape` — the
+reference silently recompiled per call instead. ``predict.recompiles``
+counts executable builds (exactly one per bound shape set).
 """
 from __future__ import annotations
 
@@ -12,6 +21,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from . import telemetry as _tel
 from .base import MXNetError
 from .context import Context, cpu
 
@@ -74,22 +84,57 @@ class Predictor:
                 aux.append(nd.zeros(shape, ctx=self._ctx))
         self._executor = symbol.bind(self._ctx, args, grad_req="null",
                                      aux_states=aux)
+        self._input_shapes = {n: tuple(input_shapes[n])
+                              for n in self._input_names}
+        self._input_vals = {n: np.zeros(self._input_shapes[n], np.float32)
+                            for n in self._input_names}
+        self._fused = None
         self._outputs = None
+
+    def _fused_infer(self):
+        """The cached single-dispatch executable: built once per bound
+        shape set (the `predict.recompiles` count), reused for every
+        subsequent forward."""
+        if self._fused is None:
+            from .fused_step import make_fused_infer
+
+            self._fused = make_fused_infer(self._executor,
+                                           self._input_names)
+            _tel.inc("predict.recompiles")
+        return self._fused
 
     def set_input(self, name: str, value):
         if name not in self._executor.arg_dict:
             raise MXNetError("unknown input '%s'" % name)
-        self._executor.arg_dict[name][:] = np.asarray(value, dtype=np.float32)
+        value = np.asarray(value, dtype=np.float32)
+        declared = self._input_shapes.get(name)
+        if declared is not None and tuple(value.shape) != declared:
+            # refusing here is the feature: the old path silently
+            # retraced + recompiled the executable on every odd-shaped
+            # call, which at serving rates is a stall storm
+            raise MXNetError(
+                "input '%s' has shape %r but the predictor was bound "
+                "for %r; use Predictor.reshape({%r: %r}) to bind a new "
+                "shape (each bound shape compiles once)"
+                % (name, tuple(value.shape), declared, name,
+                   tuple(value.shape)))
+        self._input_vals[name] = value
+        self._executor.arg_dict[name][:] = value
 
     def forward(self, **inputs):
         for name, value in inputs.items():
             self.set_input(name, value)
-        self._outputs = self._executor.forward(is_train=False)
+        fused = self._fused_infer()
+        outs, _ = fused([self._input_vals[n] for n in self._input_names])
+        self._outputs = list(outs)
 
     def get_output(self, index: int) -> np.ndarray:
         if self._outputs is None:
             raise MXNetError("call forward first")
-        return self._outputs[index].asnumpy()
+        out = self._outputs[index]
+        if hasattr(out, "asnumpy"):
+            return out.asnumpy()
+        return np.asarray(out)   # graft: host-sync
 
     def reshape(self, input_shapes: Dict[str, tuple]) -> "Predictor":
         """New predictor bound to new input shapes, sharing unchanged
@@ -101,5 +146,11 @@ class Predictor:
         # must never write through to the original's arrays
         new._executor = self._executor.reshape(
             fresh_args=self._input_names, **input_shapes)
+        new._input_shapes = dict(self._input_shapes)
+        new._input_shapes.update(
+            {n: tuple(s) for n, s in input_shapes.items()})
+        new._input_vals = {n: np.zeros(new._input_shapes[n], np.float32)
+                           for n in new._input_names}
+        new._fused = None
         new._outputs = None
         return new

@@ -1,0 +1,466 @@
+"""Continuous-batching inference serving tier.
+
+The reference framework stopped at a predict-only C ABI (one
+synchronous forward per caller); this module is the throughput/latency
+path the ROADMAP's "millions of users" north star actually needs. It
+composes pieces that already exist — the single-dispatch
+:class:`~mxnet_tpu.fused_step.FusedInfer` executable, the ``dp`` device
+mesh + NamedSharding batch placement from the executor group, the xprof
+compile registry and the Prometheus :class:`~mxnet_tpu.tracing.MetricsServer`
+— into three layers:
+
+* :class:`BatchScheduler` — a continuous batcher: in-flight requests
+  coalesce up to ``max_batch`` or ``max_wait_ms`` (whichever first),
+  and every dispatched batch is padded up to a small ladder of bucket
+  sizes (default powers of two), so mixed request rates compile at most
+  ``len(buckets)`` executables EVER and steady state runs retrace-free
+  at exactly one XLA dispatch per served batch.
+* :class:`InferenceServer` — wires a bound Module to a FusedInfer
+  (params packed once, replicated across the mesh; request batches
+  sharded along ``dp``), owns the scheduler, exports `/metrics` +
+  `/healthz`, and registers the SLO health probe: when the sliding-
+  window p99 exceeds ``MXNET_TPU_SERVE_SLO_MS``, `/healthz` flips to
+  ``degraded`` (HTTP 503) and a ``slow_request`` anomaly fires through
+  the step-trace detectors.
+* latency decomposition — every request's wall time splits into queue
+  wait / H2D+pad / dispatch / D2H histograms (``serve.queue_ms``,
+  ``serve.h2d_ms``, ``serve.pad_waste_ms``, ``serve.dispatch_ms``,
+  ``serve.d2h_ms``, ``serve.request_ms``) with p50/p99 exported through
+  the metrics server and summarized by ``trace_report --view serve``.
+
+Shutdown contract: ``close()`` stops intake, DRAINS every queued
+request (each gets a result or an error — nothing hangs a caller), and
+joins the worker thread; the tests' thread/process leak gate holds.
+
+``bench.py serve`` drives this with an open-loop Poisson load sweep and
+writes ``SERVE_bench.json`` (requests/sec, goodput at SLO, p50/p99/p999
+latency, mean batch occupancy).
+"""
+from __future__ import annotations
+
+import logging
+import queue as _queue
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import env as _env
+from . import telemetry as _tel
+from . import tracing as _tracing
+from .base import MXNetError
+from .io_pipeline import RequestStager
+
+__all__ = ["bucket_ladder", "Request", "BatchScheduler",
+           "InferenceServer"]
+
+_log = logging.getLogger(__name__)
+
+
+def bucket_ladder(max_batch: int, dp: int = 1,
+                  spec: Optional[str] = None) -> Tuple[int, ...]:
+    """The padded batch-size ladder: every dispatched batch rounds up
+    to the next rung, so the serving path compiles at most
+    ``len(ladder)`` executables total. Default rungs are powers of two
+    from ``dp`` up to ``max_batch``; an explicit ``spec`` (or
+    ``MXNET_TPU_SERVE_BUCKETS``) is a comma list. Under a ``dp`` mesh
+    every rung is rounded up to a multiple of ``dp`` so the batch axis
+    always shards evenly."""
+    dp = max(1, int(dp))
+    if spec is None:
+        spec = _env.get("MXNET_TPU_SERVE_BUCKETS")
+    if spec:
+        rungs = [int(s) for s in str(spec).split(",") if s.strip()]
+    else:
+        rungs, b = [], 1
+        while b < max_batch:
+            rungs.append(b)
+            b *= 2
+        rungs.append(max_batch)
+    ladder = sorted({max(dp, -(-r // dp) * dp) for r in rungs})
+    if any(r <= 0 for r in ladder) or not ladder:
+        raise MXNetError("invalid bucket ladder %r" % (ladder,))
+    if ladder[-1] < max_batch:
+        ladder.append(-(-max_batch // dp) * dp)
+    return tuple(ladder)
+
+
+class Request:
+    """One in-flight inference request: the payload arrays (one per
+    data name, leading axis = rows, normally 1) plus the completion
+    event the scheduler signals once results (or an error) land."""
+
+    __slots__ = ("arrays", "rows", "t_enq", "_done", "result", "error",
+                 "queue_ms", "latency_ms")
+
+    def __init__(self, arrays: Sequence[np.ndarray]):
+        self.arrays = [np.asarray(a) for a in arrays]
+        self.rows = int(self.arrays[0].shape[0])
+        self.t_enq = time.perf_counter()
+        self._done = threading.Event()
+        self.result: Optional[List[np.ndarray]] = None
+        self.error: Optional[BaseException] = None
+        self.queue_ms = 0.0
+        self.latency_ms = 0.0
+
+    def get(self, timeout: Optional[float] = None) -> List[np.ndarray]:
+        """Block until the scheduler served this request; returns the
+        per-row result arrays (post-processing outputs when the server
+        was built with ``top_k``, else the raw forward outputs)."""
+        if not self._done.wait(timeout):
+            raise MXNetError("inference request timed out after %ss"
+                             % timeout)
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class BatchScheduler:
+    """Continuous batcher in front of a compiled-once infer callable.
+
+    ``infer_fn(placed_arrays) -> (outs, post)`` is dispatched once per
+    coalesced batch (a :class:`~mxnet_tpu.fused_step.FusedInfer`); the
+    scheduler owns request coalescing, the bucket ladder, padding (via
+    :class:`~mxnet_tpu.io_pipeline.RequestStager`), per-request result
+    slicing, the latency decomposition and the SLO window. One daemon
+    worker thread ("mxtpu-serve-batcher") runs the loop; ``close()``
+    joins it after draining the queue.
+    """
+
+    def __init__(self, infer_fn, data_shapes: Sequence[tuple],
+                 max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 slo_ms: Optional[float] = None,
+                 dp: int = 1, place=None, slo_window: int = 512):
+        self._infer = infer_fn
+        self._data_shapes = [tuple(s) for s in data_shapes]
+        dp = max(1, int(dp))
+        if max_batch is None:
+            max_batch = _env.get("MXNET_TPU_SERVE_MAX_BATCH")
+        max_batch = max(dp, -(-int(max_batch) // dp) * dp)
+        self.max_batch = max_batch
+        self.max_wait_ms = float(
+            _env.get("MXNET_TPU_SERVE_MAX_WAIT_MS")
+            if max_wait_ms is None else max_wait_ms)
+        if buckets is None:
+            self.buckets = bucket_ladder(max_batch, dp=dp)
+        else:
+            self.buckets = bucket_ladder(max_batch, dp=dp,
+                                         spec=",".join(map(str, buckets)))
+        self.slo_ms = float(_env.get("MXNET_TPU_SERVE_SLO_MS")
+                            if slo_ms is None else slo_ms)
+        self._stager = RequestStager(place=place)
+        self._q: _queue.Queue = _queue.Queue()
+        self._carry: Optional[Request] = None
+        self._stop = threading.Event()
+        self._closed = False
+        self._lock = threading.Lock()
+        self._lat: List[float] = []
+        self._lat_cap = int(slo_window)
+        self._served = 0
+        self._batches = 0
+        self._occ_sum = 0.0
+        self._worker = threading.Thread(target=self._run,
+                                        name="mxtpu-serve-batcher",
+                                        daemon=True)
+        self._worker.start()
+
+    # -- intake ------------------------------------------------------------
+    def submit(self, arrays: Sequence[np.ndarray]) -> Request:
+        """Enqueue one request (arrays follow the server's data names;
+        leading axis = rows). Returns immediately; block on
+        ``Request.get()``."""
+        req = Request(arrays)
+        if len(req.arrays) != len(self._data_shapes):
+            raise MXNetError("expected %d input arrays, got %d"
+                             % (len(self._data_shapes), len(req.arrays)))
+        for a, shape in zip(req.arrays, self._data_shapes):
+            if tuple(a.shape[1:]) != tuple(shape[1:]):
+                raise MXNetError(
+                    "request row shape %r does not match the served "
+                    "model's %r (batch ladder only pads the batch "
+                    "axis; other dims would retrace)"
+                    % (tuple(a.shape[1:]), tuple(shape[1:])))
+        if req.rows > self.max_batch:
+            raise MXNetError("request of %d rows exceeds max_batch=%d"
+                             % (req.rows, self.max_batch))
+        if self._closed:
+            raise MXNetError("BatchScheduler is closed")
+        _tel.inc("serve.requests")
+        self._q.put(req)
+        return req
+
+    def infer(self, arrays: Sequence[np.ndarray],
+              timeout: Optional[float] = 60.0) -> List[np.ndarray]:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(arrays).get(timeout)
+
+    # -- scheduling loop ---------------------------------------------------
+    def _gather(self) -> Optional[List[Request]]:
+        """Block for the first request, then hold the batch open for
+        more arrivals until max_batch or max_wait_ms. After close() the
+        wait is skipped: drain whatever is already queued."""
+        first = self._carry
+        self._carry = None
+        while first is None:
+            try:
+                first = self._q.get(timeout=0.1)
+            except _queue.Empty:
+                if self._stop.is_set():
+                    return None
+        batch, rows = [first], first.rows
+        deadline = time.perf_counter() + self.max_wait_ms / 1e3
+        while rows < self.max_batch:
+            wait = deadline - time.perf_counter()
+            if self._stop.is_set():
+                wait = 0.0
+            try:
+                req = (self._q.get_nowait() if wait <= 0
+                       else self._q.get(timeout=wait))
+            except _queue.Empty:
+                break
+            if rows + req.rows > self.max_batch:
+                self._carry = req   # keeps FIFO order for the next batch
+                break
+            batch.append(req)
+            rows += req.rows
+        return batch
+
+    def _run(self):
+        while True:
+            batch = self._gather()
+            if batch is None:
+                break
+            try:
+                self._dispatch(batch)
+            except BaseException as e:   # noqa: BLE001 (fail the batch,
+                _tel.inc("serve.errors")  # not the serving loop)
+                for req in batch:
+                    req.error = e
+                    req._done.set()
+                _log.exception("serve batch failed (%d requests)",
+                               len(batch))
+
+    def _dispatch(self, batch: List[Request]):
+        import jax
+
+        t0 = time.perf_counter()
+        rows = sum(r.rows for r in batch)
+        bucket = next(b for b in self.buckets if b >= rows)
+        for req in batch:
+            req.queue_ms = (t0 - req.t_enq) * 1e3
+            _tel.observe("serve.queue_ms", req.queue_ms)
+        placed, pad = self._stager.stage([r.arrays for r in batch],
+                                         bucket)
+        t1 = time.perf_counter()
+        outs, post = self._infer(placed)
+        results = list(post) if post else list(outs)
+        jax.block_until_ready(results)   # graft: host-sync
+        t2 = time.perf_counter()
+        host = [np.asarray(a) for a in results]   # graft: host-sync
+        t3 = time.perf_counter()
+
+        dispatch_ms = (t2 - t1) * 1e3
+        occupancy = rows / float(bucket)
+        _tel.observe("serve.dispatch_ms", dispatch_ms)
+        _tel.observe("serve.pad_waste_ms", dispatch_ms * (1 - occupancy))
+        _tel.observe("serve.d2h_ms", (t3 - t2) * 1e3)
+        _tel.observe("serve.batch_occupancy", occupancy)
+        _tel.inc("serve.batches")
+
+        off, worst = 0, 0.0
+        for req in batch:
+            req.result = [h[off:off + req.rows] for h in host]
+            off += req.rows
+            req.latency_ms = (t3 - req.t_enq) * 1e3
+            worst = max(worst, req.latency_ms)
+            _tel.observe("serve.request_ms", req.latency_ms)
+            req._done.set()
+        with self._lock:
+            self._served += rows
+            self._batches += 1
+            self._occ_sum += occupancy
+            self._lat.extend(r.latency_ms for r in batch)
+            if len(self._lat) > self._lat_cap:
+                del self._lat[:len(self._lat) - self._lat_cap]
+        # the serving step record: the SlowRequestDetector keys off
+        # request_ms/slo_ms, and the /healthz anomaly count moves
+        _tracing.record_step((t3 - t0) * 1e3, extra={
+            "request_ms": round(worst, 3),
+            "slo_ms": self.slo_ms,
+            "serve_rows": rows, "serve_bucket": bucket})
+
+    # -- SLO / stats -------------------------------------------------------
+    def latency_quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            lat = sorted(self._lat)
+        if not lat:
+            return None
+        return lat[min(len(lat) - 1, int(q * len(lat)))]
+
+    def slo_probe(self) -> Optional[dict]:
+        """Health probe for /healthz: failing detail once the sliding
+        p99 exceeds the SLO, None while healthy (or SLO unset)."""
+        if not self.slo_ms:
+            return None
+        p99 = self.latency_quantile(0.99)
+        if p99 is not None and p99 > self.slo_ms:
+            return {"p99_ms": round(p99, 3), "slo_ms": self.slo_ms}
+        return None
+
+    def stats(self) -> dict:
+        with self._lock:
+            batches = self._batches
+            served = self._served
+            occ = self._occ_sum / batches if batches else 0.0
+        out = {"requests_served": served, "batches": batches,
+               "mean_occupancy": round(occ, 4)}
+        for name, q in (("p50_ms", 0.50), ("p99_ms", 0.99),
+                        ("p999_ms", 0.999)):
+            v = self.latency_quantile(q)
+            if v is not None:
+                out[name] = round(v, 3)
+        return out
+
+    # -- shutdown ----------------------------------------------------------
+    def close(self, timeout: float = 10.0):
+        """Graceful shutdown: stop intake, drain every queued request
+        (served, not dropped), join the worker. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        self._worker.join(timeout)
+        if self._worker.is_alive():
+            _log.warning("serve batcher still alive after %.1fs join; "
+                         "leaking the (daemon) thread", timeout)
+        # a dispatch error could strand late submissions; fail them
+        # rather than hang their callers
+        leftovers = [] if self._carry is None else [self._carry]
+        self._carry = None
+        while True:
+            try:
+                leftovers.append(self._q.get_nowait())
+            except _queue.Empty:
+                break
+        for req in leftovers:
+            req.error = MXNetError("BatchScheduler closed before the "
+                                   "request was served")
+            # per-request completion event, not the worker's stop
+            # signal — waking the caller after the join is the point
+            req._done.set()  # graft: lifecycle-ok
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class InferenceServer:
+    """A bound Module served behind a continuous batcher.
+
+    Builds the compiled-once :class:`~mxnet_tpu.fused_step.FusedInfer`
+    from the module's executor (params packed + replicated across the
+    ``dp`` mesh when the module was bound over multiple devices;
+    request batches sharded along ``dp``), starts the metrics/health
+    server per ``MXNET_TPU_SERVE_PORT``, and registers the SLO health
+    probe. ``top_k=0`` returns raw forward outputs, ``top_k=1`` the
+    on-device argmax, ``top_k>1`` top-k (values, indices) — all
+    computed inside the same single dispatch.
+    """
+
+    def __init__(self, module, top_k: int = 0,
+                 max_batch: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 slo_ms: Optional[float] = None,
+                 port: Optional[object] = None):
+        from .fused_step import make_fused_infer
+
+        if not module.binded or not module.params_initialized:
+            raise MXNetError("InferenceServer needs a bound, "
+                             "param-initialized module")
+        group = module._exec_group
+        ex = group.executor
+        mesh = getattr(group, "_mesh", None)
+        dp = int(mesh.size) if mesh is not None else 1
+        self.dp = dp
+        self._fused = make_fused_infer(ex, module._data_names,
+                                       top_k=top_k, mesh=mesh)
+        self._data_shapes = [d.shape for d in group.data_shapes]
+        self.scheduler = BatchScheduler(
+            self._fused, self._data_shapes, max_batch=max_batch,
+            max_wait_ms=max_wait_ms, buckets=buckets, slo_ms=slo_ms,
+            dp=dp, place=self._fused.place_batch)
+        self._metrics = None
+        self._own_metrics = False
+        if port is None:
+            port = _env.get("MXNET_TPU_SERVE_PORT")
+        if port != "" and port is not None:
+            self._metrics = _tracing.MetricsServer(int(port))
+            self._own_metrics = True
+        elif _tracing.metrics_server() is not None:
+            self._metrics = _tracing.metrics_server()
+        self._probe_name = "serve_slo:%d" % id(self)
+        _tracing.register_health_probe(self._probe_name,
+                                       self.scheduler.slo_probe)
+        self._closed = False
+        _log.info("serving: buckets=%s max_wait_ms=%s dp=%d slo_ms=%s%s",
+                  self.scheduler.buckets, self.scheduler.max_wait_ms,
+                  dp, self.scheduler.slo_ms or "off",
+                  " metrics on :%d" % self._metrics.port
+                  if self._metrics else "")
+
+    # -- serving API -------------------------------------------------------
+    @property
+    def port(self) -> Optional[int]:
+        return self._metrics.port if self._metrics is not None else None
+
+    @property
+    def buckets(self) -> Tuple[int, ...]:
+        return self.scheduler.buckets
+
+    @property
+    def compiles(self) -> int:
+        """Executables built so far (bounded by len(buckets))."""
+        return self._fused.compiles
+
+    def submit(self, arrays) -> Request:
+        return self.scheduler.submit(arrays)
+
+    def infer(self, arrays, timeout: Optional[float] = 60.0):
+        return self.scheduler.infer(arrays, timeout)
+
+    def refresh_params(self):
+        """Repack after a weight update (e.g. module.set_params)."""
+        self._fused.refresh_params()
+
+    def stats(self) -> dict:
+        out = self.scheduler.stats()
+        out["compiles"] = self.compiles
+        out["buckets"] = list(self.buckets)
+        out["dp"] = self.dp
+        return out
+
+    # -- shutdown ----------------------------------------------------------
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        _tracing.unregister_health_probe(self._probe_name)
+        self.scheduler.close()
+        if self._own_metrics and self._metrics is not None:
+            self._metrics.stop()
+        self._metrics = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

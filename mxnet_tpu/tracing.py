@@ -54,9 +54,10 @@ from . import telemetry as _tel
 from . import env as _env
 
 __all__ = ["StepTrace", "SlowStepDetector", "RecompileDetector",
-           "InputStallDetector", "AnomalyProfiler", "FlightRecorder",
-           "MetricsServer", "step_trace", "record_step", "maybe_init",
-           "set_worker_rank", "worker_rank", "shutdown"]
+           "InputStallDetector", "SlowRequestDetector", "AnomalyProfiler",
+           "FlightRecorder", "MetricsServer", "step_trace", "record_step",
+           "maybe_init", "set_worker_rank", "worker_rank", "shutdown",
+           "register_health_probe", "unregister_health_probe"]
 
 _log = logging.getLogger(__name__)
 
@@ -176,8 +177,67 @@ class InputStallDetector:
         return None
 
 
+class SlowRequestDetector:
+    """Serving-tier SLO guard: fires when a served request batch
+    reports a worst-case per-request latency (``request_ms``, stamped
+    into the record by ``serving.BatchScheduler``) over the SLO
+    (``slo_ms``, stamped from ``MXNET_TPU_SERVE_SLO_MS``). Training
+    records never carry ``request_ms``, so this is inert there."""
+
+    type = "slow_request"
+
+    def check(self, rec: dict) -> Optional[dict]:
+        req = rec.get("request_ms")
+        slo = rec.get("slo_ms")
+        if req is not None and slo and req > slo:
+            return {"type": self.type, "request_ms": round(req, 3),
+                    "slo_ms": round(float(slo), 3),
+                    "over_frac": round(req / slo - 1.0, 3)}
+        return None
+
+
 def default_detectors() -> list:
-    return [SlowStepDetector(), RecompileDetector(), InputStallDetector()]
+    return [SlowStepDetector(), RecompileDetector(), InputStallDetector(),
+            SlowRequestDetector()]
+
+
+# ---------------------------------------------------------------------------
+# pluggable /healthz probes
+# ---------------------------------------------------------------------------
+
+_probe_lock = threading.Lock()
+_health_probes: Dict[str, object] = {}
+
+
+def register_health_probe(name: str, probe):
+    """Register a liveness probe consulted by ``/healthz``: a callable
+    returning None when healthy or a JSON-able failure detail when not.
+    Any failing probe flips the endpoint to ``{"status": "degraded"}``
+    with HTTP 503 — the serving tier registers its SLO check here so a
+    load balancer drains a replica whose tail latency broke the SLO."""
+    with _probe_lock:
+        _health_probes[name] = probe
+
+
+def unregister_health_probe(name: str):
+    with _probe_lock:
+        _health_probes.pop(name, None)
+
+
+def _run_health_probes() -> Dict[str, object]:
+    """Failing probes by name ({} == healthy). A probe that raises is
+    itself a failure — a broken health check must not read as green."""
+    with _probe_lock:
+        probes = list(_health_probes.items())
+    failing = {}
+    for name, probe in probes:
+        try:
+            detail = probe()
+        except Exception as e:
+            detail = "probe raised: %s" % (e,)
+        if detail is not None:
+            failing[name] = detail
+    return failing
 
 
 # ---------------------------------------------------------------------------
@@ -579,14 +639,28 @@ class _MetricsHandler(http.server.BaseHTTPRequestHandler):
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif self.path.split("?")[0] == "/healthz":
             tr = _recorder
-            body = json.dumps({
-                "status": "ok", "pid": os.getpid(),
+            failing = _run_health_probes()
+            payload = {
+                "status": "degraded" if failing else "ok",
+                "pid": os.getpid(),
                 "rank": worker_rank(),
                 "uptime_s": round(time.time() - self.server.started_at, 3),
                 "steps": tr.step if tr is not None else 0,
                 "anomalies": len(tr.events) if tr is not None else 0,
-            }).encode()
+            }
+            if failing:
+                payload["probes"] = failing
+            body = json.dumps(payload).encode()
             ctype = "application/json"
+            if failing:
+                # 503 so a load balancer health check drains the
+                # replica without parsing the JSON
+                self.send_response(503)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
         else:
             self.send_error(404)
             return

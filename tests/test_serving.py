@@ -1,0 +1,332 @@
+"""Continuous-batching serving tier: batcher parity (bit-identical
+batched-padded vs one-by-one), the bucket-ladder compile pin (at most
+len(buckets) executables ever, exactly one dispatch per served batch),
+dp=8 vs dp=1 parity on the forced mesh, the SLO health probe flipping
+/healthz, and the graceful-shutdown drain (leak-gate clean)."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serving, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.module import Module
+from mxnet_tpu.serving import BatchScheduler, bucket_ladder
+
+DIM = 8
+CLASSES = 4
+HID = 16
+
+
+@pytest.fixture
+def tel():
+    telemetry.reset()
+    telemetry.enable()
+    yield telemetry
+    telemetry.reset()
+    telemetry.disable()
+
+
+def _mlp():
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=HID, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=CLASSES, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _seed_params(net, batch, seed=3):
+    """Exact-arithmetic regime (integer data x half-integer weights,
+    power-of-two sizes): every logit is a dyadic rational, so batching,
+    padding, row offset and dp-sharding cannot perturb bits."""
+    arg_shapes, _, _ = net.infer_shape(data=(batch, DIM),
+                                       softmax_label=(batch,))
+    rng = np.random.RandomState(seed)
+    return {name: mx.nd.array(
+        (rng.randint(-2, 3, shape) * 0.5).astype(np.float32))
+        for name, shape in zip(net.list_arguments(), arg_shapes)
+        if name not in ("data", "softmax_label")}
+
+
+def _rows(n, seed=11):
+    rng = np.random.RandomState(seed)
+    return rng.randint(-3, 4, (n, DIM)).astype(np.float32)
+
+
+def _bound_module(dp=1, batch=8):
+    net = _mlp()
+    ctx = [mx.cpu(i) for i in range(dp)] if dp > 1 else mx.cpu()
+    mod = Module(net, context=ctx)
+    mod.bind(data_shapes=[("data", (batch, DIM))],
+             label_shapes=[("softmax_label", (batch,))],
+             for_training=False)
+    mod.init_params(initializer=None, arg_params=_seed_params(net, batch),
+                    aux_params={})
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# bucket ladder
+# ---------------------------------------------------------------------------
+
+def test_bucket_ladder_powers_of_two():
+    assert bucket_ladder(64) == (1, 2, 4, 8, 16, 32, 64)
+    assert bucket_ladder(6) == (1, 2, 4, 6)
+
+
+def test_bucket_ladder_dp_rounds_every_rung():
+    # every rung a multiple of dp so the batch axis always shards evenly
+    assert bucket_ladder(16, dp=8) == (8, 16)
+    assert bucket_ladder(64, dp=8) == (8, 16, 32, 64)
+    for r in bucket_ladder(24, dp=4):
+        assert r % 4 == 0
+
+
+def test_bucket_ladder_explicit_spec():
+    assert bucket_ladder(64, spec="3,17,64") == (3, 17, 64)
+    # a spec that tops out below max_batch still gets a covering rung
+    assert bucket_ladder(64, dp=4, spec="3,17")[-1] == 64
+
+
+# ---------------------------------------------------------------------------
+# BatchScheduler on a fake infer: padding/slicing parity, FIFO carry,
+# graceful shutdown — no jax in the loop, fully deterministic
+# ---------------------------------------------------------------------------
+
+def _fake_infer(placed):
+    # identity "model": result row i is input row i doubled, so the
+    # per-request slices prove the stager padded and the scheduler
+    # sliced at the right offsets
+    return [placed[0] * 2.0], ()
+
+
+def test_scheduler_pads_and_slices_per_request():
+    sched = BatchScheduler(_fake_infer, [(4, DIM)], max_batch=4,
+                           max_wait_ms=1.0, slo_ms=0.0)
+    try:
+        payloads = [_rows(1, seed=s) for s in (1, 2, 3)]
+        payloads.append(_rows(3, seed=4))    # multi-row request
+        reqs = [sched.submit([p]) for p in payloads]
+        for p, r in zip(payloads, reqs):
+            (out,) = r.get(timeout=30)
+            assert out.shape == p.shape
+            assert np.array_equal(out, p * 2.0)
+    finally:
+        sched.close()
+
+
+def test_scheduler_rejects_bad_requests():
+    sched = BatchScheduler(_fake_infer, [(4, DIM)], max_batch=4,
+                           max_wait_ms=1.0, slo_ms=0.0)
+    try:
+        with pytest.raises(MXNetError, match="row shape"):
+            sched.submit([np.zeros((1, DIM + 1), np.float32)])
+        with pytest.raises(MXNetError, match="max_batch"):
+            sched.submit([np.zeros((5, DIM), np.float32)])
+        with pytest.raises(MXNetError, match="input arrays"):
+            sched.submit([np.zeros((1, DIM), np.float32)] * 2)
+    finally:
+        sched.close()
+
+
+def test_graceful_shutdown_drains_queue(tel):
+    done = threading.Event()
+
+    def slow_infer(placed):
+        time.sleep(0.002)
+        return [placed[0] * 2.0], ()
+
+    sched = BatchScheduler(slow_infer, [(4, DIM)], max_batch=4,
+                           max_wait_ms=0.5, slo_ms=0.0)
+    reqs = [sched.submit([_rows(1, seed=s)]) for s in range(32)]
+    sched.close()
+    # every request submitted before close() was SERVED, not dropped
+    for r in reqs:
+        assert r.done()
+        (out,) = r.get(timeout=0)
+        assert out.shape == (1, DIM)
+    assert not sched._worker.is_alive()
+    sched.close()                      # idempotent
+    with pytest.raises(MXNetError, match="closed"):
+        sched.submit([_rows(1)])
+    assert not done.is_set()           # no stray callbacks
+    assert tel.peek("serve.errors") in (None, 0)
+
+
+# ---------------------------------------------------------------------------
+# real model through InferenceServer
+# ---------------------------------------------------------------------------
+
+def test_batcher_parity_bit_identical(tel):
+    """Coalesced-padded-sliced results == one-by-one results, bit for
+    bit: whatever grouping the continuous batcher picks, padding rows
+    and batch offsets must never leak into a request's answer."""
+    mod = _bound_module(dp=1, batch=8)
+    rows = [_rows(1, seed=100 + i) for i in range(12)]
+    with serving.InferenceServer(mod, top_k=0, max_batch=8,
+                                 max_wait_ms=1.0, buckets=[8],
+                                 slo_ms=0.0, port=None) as srv:
+        one_by_one = [srv.infer([r])[0] for r in rows]
+        # burst: submit everything before collecting, so the batcher
+        # coalesces multiple requests into shared padded dispatches
+        reqs = [srv.submit([r]) for r in rows]
+        batched = [req.get(timeout=30)[0] for req in reqs]
+    for a, b in zip(one_by_one, batched):
+        assert a.dtype == b.dtype
+        assert np.array_equal(a, b), \
+            "batched result diverged (max abs diff %g)" % np.abs(a - b).max()
+
+
+def test_bucket_ladder_compile_pin(tel):
+    """At most len(buckets) compiles EVER; zero once every rung is
+    warm; exactly 1.0 dispatches per served batch (the forward and the
+    on-device argmax ride one executable)."""
+    mod = _bound_module(dp=1, batch=8)
+    with serving.InferenceServer(mod, top_k=1, max_batch=8,
+                                 max_wait_ms=0.5, slo_ms=0.0,
+                                 port=None) as srv:
+        assert srv.buckets == (1, 2, 4, 8)
+        for n in (1, 2, 3, 5, 8, 1, 4, 7):
+            srv.infer([_rows(n)])
+        assert srv.compiles <= len(srv.buckets)
+        warm = srv.compiles
+        d0 = tel.peek("infer.dispatches") or 0
+        b0 = tel.peek("serve.batches") or 0
+        for n in (1, 2, 3, 5, 8, 6, 2, 1):
+            srv.infer([_rows(n)])
+        # steady state: every rung warm -> ZERO further compiles
+        assert srv.compiles == warm
+        assert tel.peek("infer.recompiles") == warm
+        d1 = tel.peek("infer.dispatches") or 0
+        b1 = tel.peek("serve.batches") or 0
+        assert b1 - b0 == 8
+        assert (d1 - d0) / float(b1 - b0) == 1.0
+        stats = srv.stats()
+        assert stats["requests_served"] >= 16
+        assert stats["batches"] == b1
+    assert (tel.peek("serve.pad_rows") or 0) > 0
+
+
+@pytest.mark.multichip
+def test_dp8_parity_with_single_device(tel):
+    """Replicated params + dp-sharded request batches give the same
+    bits as one device: GSPMD partitioning of the serving forward is
+    a layout change, not a numeric one (exact-arithmetic regime)."""
+    rows = [_rows(1, seed=200 + i) for i in range(10)]
+    outs = {}
+    for dp in (1, 8):
+        mod = _bound_module(dp=dp, batch=16)
+        # same bucket for both servers so XLA sees identical shapes
+        with serving.InferenceServer(mod, top_k=0, max_batch=16,
+                                     buckets=[16], max_wait_ms=0.5,
+                                     slo_ms=0.0, port=None) as srv:
+            if dp == 8:
+                assert srv.dp == 8
+            outs[dp] = [srv.infer([r])[0] for r in rows]
+    for a, b in zip(outs[1], outs[8]):
+        assert a.dtype == b.dtype
+        assert np.array_equal(a, b), \
+            "dp=8 serving diverged (max abs diff %g)" % np.abs(a - b).max()
+
+
+# ---------------------------------------------------------------------------
+# SLO -> /healthz
+# ---------------------------------------------------------------------------
+
+def _healthz(port):
+    try:
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/healthz" % port, timeout=10) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_slo_breach_flips_healthz(tel):
+    mod = _bound_module(dp=1, batch=8)
+    # an SLO of 1 microsecond: every real dispatch breaches it
+    with serving.InferenceServer(mod, top_k=1, max_batch=8,
+                                 max_wait_ms=0.5, slo_ms=0.001,
+                                 port=0) as srv:
+        assert srv.port is not None
+        for _ in range(4):
+            srv.infer([_rows(1)])
+        probe = srv.scheduler.slo_probe()
+        assert probe is not None and probe["p99_ms"] > probe["slo_ms"]
+        status, health = _healthz(srv.port)
+        assert status == 503
+        assert health["status"] == "degraded"
+        assert any(k.startswith("serve_slo:") for k in health["probes"])
+
+
+def test_healthz_ok_within_slo(tel):
+    mod = _bound_module(dp=1, batch=8)
+    with serving.InferenceServer(mod, top_k=1, max_batch=8,
+                                 max_wait_ms=0.5, slo_ms=60000.0,
+                                 port=0) as srv:
+        for _ in range(3):
+            srv.infer([_rows(1)])
+        assert srv.scheduler.slo_probe() is None
+        status, health = _healthz(srv.port)
+        assert status == 200
+        assert health["status"] == "ok"
+        assert "probes" not in health
+
+
+# ---------------------------------------------------------------------------
+# base_module pad-and-slice: the final partial batch must reuse the one
+# compiled forward, not trace a one-off shape
+# ---------------------------------------------------------------------------
+
+class _RaggedIter:
+    """Yields a genuinely SMALLER final batch (11 rows at batch 4 ->
+    4, 4, 3), the shape pattern that used to retrace the forward."""
+
+    def __init__(self, X, y, batch_size):
+        self._X, self._y, self._bs = X, y, batch_size
+        self.provide_data = [mx.io.DataDesc("data",
+                                            (batch_size,) + X.shape[1:])]
+        self.provide_label = [mx.io.DataDesc("softmax_label",
+                                             (batch_size,))]
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        for lo in range(0, len(self._X), self._bs):
+            yield mx.io.DataBatch(
+                [mx.nd.array(self._X[lo:lo + self._bs])],
+                [mx.nd.array(self._y[lo:lo + self._bs])], pad=0)
+
+
+def test_module_predict_partial_batch_no_retrace(tel):
+    X = _rows(11, seed=5)
+    y = np.array([i % CLASSES for i in range(11)], np.float32)
+    it = _RaggedIter(X, y, batch_size=4)
+    mod = _bound_module(dp=1, batch=4)
+    out = mod.predict(it)
+    assert out.shape == (11, CLASSES)
+    # the pin: ONE traced forward served both the full and the padded
+    # partial batches
+    assert mod._exec_group.executor._fwd_infer._cache_size() == 1
+    assert tel.peek("module.pad_batches") == 1
+    # pad rows sliced off: the partial tail matches an unpadded forward
+    full = mod.predict(_RaggedIter(X[8:], y[8:], batch_size=4))
+    assert np.array_equal(out.asnumpy()[8:], full.asnumpy()[:3])
+
+
+def test_module_score_partial_batch_exact_metric(tel):
+    X = _rows(11, seed=6)
+    y = np.array([i % CLASSES for i in range(11)], np.float32)
+    it = _RaggedIter(X, y, batch_size=4)
+    mod = _bound_module(dp=1, batch=4)
+    (_, acc), = mod.score(it, "acc")
+    pred = mod.predict(_RaggedIter(X, y, batch_size=4)).asnumpy()
+    expect = float((pred.argmax(axis=1) == y).sum()) / 11.0
+    assert acc == expect
+    assert mod._exec_group.executor._fwd_infer._cache_size() == 1

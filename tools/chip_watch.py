@@ -136,7 +136,7 @@ def _run(cmd, timeout_s, env_overrides=None, outfile=None,
 ARTIFACTS = ["BENCH_watch.json", ".bench_cache.json",
              ".bench_trace_summary.json", "MFU_EXPERIMENTS.jsonl",
              "TPU_CONSISTENCY.txt", "XPROF_DEVICE_TIME.json",
-             "MULTICHIP_scaling.json"]
+             "MULTICHIP_scaling.json", "SERVE_bench.json"]
 
 
 def xprof_device_time(stamp):
@@ -279,6 +279,19 @@ def fire():
                        "chip_watch_stamp": stamp}, f)
             f.write("\n")
     _commit("multichip dp scaling", stamp)
+    # 7. serving tier: continuous-batching goodput sweep against the
+    # tail-latency SLO -> SERVE_bench.json. Same INCOMPLETE contract as
+    # the multichip stage: bench.py stamps its own record when the
+    # child dies; a wedged orchestrator gets one written here.
+    out = _run([py, os.path.join(REPO, "bench.py"), "serve"], 2000)
+    if out is None:
+        with open(os.path.join(REPO, "SERVE_bench.json"), "w") as f:
+            json.dump({"metric": "serve_goodput_rps", "value": 0,
+                       "incomplete": "chip_watch serving stage timed "
+                                     "out or crashed",
+                       "chip_watch_stamp": stamp}, f)
+            f.write("\n")
+    _commit("serving goodput sweep", stamp)
 
 
 def main(argv=None):

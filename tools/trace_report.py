@@ -168,8 +168,20 @@ def render_locks(telemetry):
 def load_bench_records(path):
     """Dict records from a BENCH file (bench.py prints one JSON object
     per line; BENCH_watch.json interleaves stage markers — any dict
-    line is kept, unparseable lines skipped)."""
+    line is kept, unparseable lines skipped). Pretty-printed artifacts
+    holding one object (SERVE_bench.json) load as a single record."""
     recs = []
+    with open(path) as f:
+        body = f.read()
+    try:
+        whole = json.loads(body)
+    except ValueError:
+        pass
+    else:
+        if isinstance(whole, dict):
+            return [whole]
+        if isinstance(whole, list):
+            return [r for r in whole if isinstance(r, dict)]
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -274,6 +286,68 @@ def collective_fraction(rec):
             "byte_fraction": (c.get("bytes", 0) / total_by
                               if total_by else 0.0),
             "ops": c.get("count", 0)}
+
+
+def latest_serve_record(recs):
+    """The newest serving-bench record (SERVE_bench.json lines carry no
+    xprof key, so they need their own selector)."""
+    for r in reversed(recs):
+        if (r.get("metric") == "serve_goodput_rps"
+                or "latency_decomposition_ms" in r):
+            return r
+    return None
+
+
+def render_serve(rec):
+    """Serving view: the goodput/SLO headline, per-request latency
+    decomposition (queue / h2d / dispatch / pad-waste / d2h), and the
+    offered-load sweep table."""
+    out = ["serving: %.1f req/s (goodput at %sms SLO: %.1f), "
+           "p50 %.2fms  p99 %.2fms  p999 %.2fms"
+           % (rec.get("requests_per_sec") or 0,
+              ("%g" % rec["slo_ms"]) if rec.get("slo_ms") else "no",
+              rec.get("goodput_rps_at_slo") or 0,
+              rec.get("p50_ms") or 0, rec.get("p99_ms") or 0,
+              rec.get("p999_ms") or 0),
+           "buckets %s  dp=%s  mean occupancy %.1f%%  compiles %s  "
+           "steady-state retraces %s  dispatches/batch %s"
+           % (rec.get("buckets"), rec.get("dp"),
+              100.0 * (rec.get("mean_batch_occupancy") or 0.0),
+              rec.get("compiles"), rec.get("steady_state_retraces"),
+              rec.get("dispatches_per_request_batch")), ""]
+    dec = rec.get("latency_decomposition_ms") or {}
+    if dec:
+        order = ("queue_ms", "h2d_ms", "dispatch_ms", "pad_waste_ms",
+                 "d2h_ms", "request_ms")
+        rows = [("stage", "mean", "p50", "p99")]
+        for k in order:
+            h = dec.get(k)
+            if not h:
+                continue
+            rows.append((k[:-3], "%.3f" % (h.get("mean") or 0),
+                         "%.3f" % (h.get("p50") or 0),
+                         "%.3f" % (h.get("p99") or 0)))
+        out.append("per-request latency decomposition (ms):")
+        out += _table(rows)
+        out.append("")
+    tiers = rec.get("tiers") or []
+    if tiers:
+        rows = [("offered", "achieved", "goodput", "p50_ms", "p99_ms",
+                 "p999_ms", "slo")]
+        for t in tiers:
+            rows.append(("%g" % t.get("offered_rps", 0),
+                         "%.1f" % t.get("achieved_rps", 0),
+                         "%.1f" % t.get("goodput_rps", 0),
+                         "%.2f" % t.get("p50_ms", 0),
+                         "%.2f" % t.get("p99_ms", 0),
+                         "%.2f" % t.get("p999_ms", 0),
+                         "ok" if t.get("slo_ok") else "BREACH"))
+        out.append("offered-load sweep (req/s):")
+        out += _table(rows)
+        out.append("")
+    if rec.get("incomplete"):
+        out.append("INCOMPLETE: %s" % rec["incomplete"])
+    return "\n".join(out) + "\n"
 
 
 def render_compile(rec):
@@ -490,10 +564,12 @@ def main(argv=None):
     p.add_argument("--top", type=int, default=10,
                    help="slowest steps to show (default 10)")
     p.add_argument("--view", default="steps",
-                   choices=("steps", "compile", "ops", "memory", "bench"),
+                   choices=("steps", "compile", "ops", "memory", "bench",
+                            "serve"),
                    help="steps (default): slowest-step trace table; "
                         "compile/ops/memory/bench: xprof views over a "
-                        "BENCH record file")
+                        "BENCH record file; serve: latency decomposition "
+                        "+ load sweep over a SERVE_bench.json record")
     p.add_argument("--profile-report", action="store_true",
                    help="auto-discover the newest BENCH / chip_watch "
                         "artifacts in the repo root and render the "
@@ -504,6 +580,13 @@ def main(argv=None):
         return 0
     if a.path is None:
         p.error("path is required unless --profile-report is given")
+    if a.view == "serve":
+        rec = latest_serve_record(load_bench_records(a.path))
+        if rec is None:
+            sys.stdout.write("no serving record in %s\n" % a.path)
+            return 1
+        sys.stdout.write(render_serve(rec))
+        return 0
     if a.view != "steps":
         rec = latest_xprof_record(load_bench_records(a.path))
         if rec is None:
