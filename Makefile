@@ -68,7 +68,12 @@ multichip:
 serve-bench:
 	python bench.py serve
 
+# preemption-safety suite: crash-safe writes, torn-file detection,
+# bit-identical kill-at-step-k resume, elastic dp rejoin, SIGTERM grace
+ckpt-test:
+	python -m pytest tests/test_checkpoint.py tests/test_elastic_recovery.py -q
+
 clean:
 	rm -rf mxnet_tpu/_native perl-package/blib
 
-.PHONY: all predict perl test lint profile-report multichip serve-bench clean
+.PHONY: all predict perl test lint profile-report multichip serve-bench ckpt-test clean

@@ -11,15 +11,29 @@ __all__ = ["Speedometer", "do_checkpoint", "log_train_metric",
            "module_checkpoint", "ProgressBar"]
 
 
-def do_checkpoint(prefix: str, period: int = 1):
-    """Save params every ``period`` epochs (reference do_checkpoint)."""
+def do_checkpoint(prefix: str, period: int = 1,
+                  save_optimizer_states: bool = False, mod=None):
+    """Save params every ``period`` epochs (reference do_checkpoint).
+
+    ``save_optimizer_states=True`` additionally writes the updater's
+    ``prefix-NNNN.states`` file so a resumed run keeps its momentum /
+    update counts; it needs the module itself (the epoch-end callback
+    signature only carries (sym, arg, aux)), so pass ``mod=``."""
     from .model import save_checkpoint
 
     period = int(max(1, period))
+    if save_optimizer_states and mod is None:
+        raise ValueError("do_checkpoint(save_optimizer_states=True) "
+                         "needs mod= (the bound module that owns the "
+                         "optimizer states)")
 
     def _callback(iter_no, sym, arg, aux):
         if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+            if save_optimizer_states:
+                mod.save_checkpoint(prefix, iter_no + 1,
+                                    save_optimizer_states=True)
+            else:
+                save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
     return _callback
 
 

@@ -1,0 +1,647 @@
+"""Preemption-safe training: full-state snapshot/restore at step
+granularity, crash-safe on disk.
+
+The reference's recovery story was ps-lite dead-node tracking plus
+epoch-granularity param checkpoints — a preempted run lost up to an
+epoch of work and resumed on a *different* trajectory (fresh optimizer
+counters, fresh RNG, fresh metric sums). The donated fused step
+(:mod:`mxnet_tpu.fused_step`) concentrated all training state into a
+handful of packs, which makes the production version tractable: one
+snapshot captures everything the next step reads, so a resumed run is
+**bit-identical** to an uninterrupted one.
+
+What a snapshot holds (:func:`snapshot`):
+
+* the param / aux / optimizer-state packs, fetched off-device inside an
+  ``intentional_transfer`` window (the transfer sanitizer stays armed
+  across a save);
+* the optimizer's host-side ``_plan`` scalars — update counts and
+  lr-schedule state (``Optimizer.get_checkpoint_state``);
+* the metric accumulators: host ``sum_metric``/``num_inst`` plus the
+  on-device ``(sum, count)`` fold pair;
+* the data-plane cursor as a LOGICAL batch count (epoch + batches
+  consumed) — prefetch wrappers read ahead of the training loop, so a
+  raw cursor would replay or skip batches — plus the (seed, epoch)
+  scalars the ``io_cache`` aug/shuffle RNG is a pure function of;
+* the executor/global RNG state (base key + step counter), so dropout
+  and any later draw replays the same key sequence;
+* the dp mesh shape, for the resume log — :func:`restore` re-places
+  every pack onto the *current* mesh via the executor group's own
+  ``_place``, so a snapshot saved at dp=N restores at dp=M as a
+  re-shard, not a retrace (params/opt-state/accs are replicated; only
+  batches are dp-sharded, and those are not in the snapshot).
+
+On-disk crash safety (:class:`SnapshotStore`): every file lands via
+tmp + fsync + ``os.replace`` (:func:`atomic_writer`), the manifest is
+written LAST and carries a content hash per snapshot, and
+:meth:`SnapshotStore.load_latest` verifies size + sha256 + unpickle
+before trusting a file — a torn write is skipped (``ckpt.torn_skipped``)
+and the previous snapshot loads instead. Never a silent bad resume.
+
+Fit-loop wiring (:class:`CheckpointManager`, armed by
+``MXNET_TPU_CKPT_DIR``): periodic saves every
+``MXNET_TPU_CKPT_EVERY_N_STEPS``, auto-resume at fit() entry
+(``MXNET_TPU_CKPT_RESUME``), and a SIGTERM grace path riding the
+FlightRecorder signal hooks — mid-step the hook defers termination to
+the step boundary (the donated packs are torn *during* a dispatch),
+saves, then re-delivers SIGTERM; between steps it saves immediately.
+``MXNET_TPU_CKPT_GRACE_S`` bounds the grace save: past the deadline the
+write is abandoned (``ckpt.preempt_abandoned``) rather than started —
+the previous snapshot stays valid either way.
+
+Telemetry: ``ckpt.saves`` / ``ckpt.save_ms`` / ``ckpt.bytes`` /
+``ckpt.restores`` / ``ckpt.preempt_saves`` / ``ckpt.preempt_abandoned``
+/ ``ckpt.torn_skipped`` — surfaced by ``tools/trace_report.py`` and the
+per-step ``ckpt_saves``/``ckpt_save_ms`` trace columns. See
+docs/performance.md ("Surviving preemption").
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import signal
+import socket
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from . import env as _env
+from . import random as _random
+from . import telemetry as _tel
+from .analysis import sanitizers as _san
+from .base import MXNetError
+
+__all__ = ["CheckpointError", "atomic_writer", "atomic_write_bytes",
+           "atomic_ndarray_save", "snapshot", "restore", "SnapshotStore",
+           "CheckpointManager", "maybe_manager"]
+
+_log = logging.getLogger(__name__)
+
+FORMAT = 1
+MANIFEST = "MANIFEST.json"
+
+
+class CheckpointError(MXNetError):
+    """A snapshot could not be captured, written, or restored."""
+
+
+# ---------------------------------------------------------------------------
+# crash-safe writes
+# ---------------------------------------------------------------------------
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so a just-replaced entry survives power loss;
+    best-effort (not every filesystem supports directory fds)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+@contextmanager
+def atomic_writer(path: str, mode: str = "wb"):
+    """Crash-safe file replacement: write to a same-directory tmp file
+    (host+pid suffixed, so concurrent writers never collide), flush +
+    fsync, then ``os.replace`` over the target and fsync the directory.
+    A crash at ANY point leaves either the complete old file or the
+    complete new one — never a torn mix. On failure the tmp file is
+    unlinked and the target untouched."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    tmp = os.path.join(d, ".%s.tmp-%s-%d"
+                       % (os.path.basename(path),
+                          socket.gethostname(), os.getpid()))
+    f = open(tmp, mode)
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        os.replace(tmp, path)
+        _fsync_dir(d)
+    except BaseException:
+        try:
+            f.close()
+        except Exception:
+            pass
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    with atomic_writer(path) as f:
+        f.write(data)
+
+
+def atomic_ndarray_save(fname, data) -> None:
+    """Crash-safe :func:`mxnet_tpu.ndarray.save` for plain local paths.
+    URI schemes (``mem://``, registered stores) go through their handler
+    unchanged — the handler owns atomicity there (MemFS already commits
+    whole blobs on close)."""
+    from . import ndarray as nd
+    from .filesystem import scheme_of
+
+    if scheme_of(fname) is not None:
+        nd.save(fname, data)
+        return
+    with atomic_writer(os.fspath(fname)) as f:
+        nd.save_to_stream(f, data)
+
+
+# ---------------------------------------------------------------------------
+# full-state capture / restore
+# ---------------------------------------------------------------------------
+
+def _fetch(x) -> np.ndarray:
+    import jax
+
+    return np.asarray(jax.device_get(x))
+
+
+def _metric_leaves(eval_metric):
+    from . import metric as _metric
+
+    if isinstance(eval_metric, _metric.CompositeEvalMetric):
+        return list(eval_metric.metrics)
+    return [eval_metric]
+
+
+def _place_states(group, obj):
+    """Numpy optimizer-state tree -> NDArrays placed like fresh-created
+    states (replicated on the group's mesh / pinned to its device):
+    identical avals+shardings to ``_zeros_like_state``, so the fused
+    step's next dispatch reuses its compiled executable — restore must
+    never grow the trace cache."""
+    if isinstance(obj, np.ndarray):
+        return group._place(obj, None)
+    if isinstance(obj, tuple):
+        return tuple(_place_states(group, o) for o in obj)
+    if isinstance(obj, list):
+        return [_place_states(group, o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _place_states(group, v) for k, v in obj.items()}
+    return obj
+
+
+def snapshot(module, eval_metric=None, train_data=None, *, step: int = 0,
+             epoch: int = 0, nbatch: int = -1) -> Dict[str, Any]:
+    """Capture the full training state of a bound module as one
+    picklable payload. All device fetches happen inside a single
+    ``intentional_transfer`` window (the step loop's transfer guard
+    stays armed); reads never consume donated buffers — the step's
+    write-back already swapped fresh arrays in."""
+    from .optimizer import _states_to_numpy
+
+    group = module._exec_group
+    if group is None:
+        raise CheckpointError("snapshot: module is not bound")
+    ex = group.executor
+    payload: Dict[str, Any] = {
+        "format": FORMAT, "step": int(step), "epoch": int(epoch),
+        "nbatch": int(nbatch), "dp": len(group.contexts),
+        "time": round(time.time(), 3),
+    }
+    with _san.intentional_transfer():
+        payload["params"] = {
+            n: _fetch(ex.arg_dict[n]._data)
+            for n in module._param_names if n in ex.arg_dict}
+        payload["aux"] = {
+            n: _fetch(a._data)
+            for n, a in zip(group.aux_names, ex.aux_arrays)}
+        updater = getattr(module, "_updater", None)
+        payload["updater_states"] = (
+            _states_to_numpy(updater.states) if updater is not None
+            else None)
+        optimizer = getattr(module, "_optimizer", None)
+        payload["optimizer"] = (optimizer.get_checkpoint_state()
+                                if optimizer is not None else None)
+        metrics = None
+        if eval_metric is not None:
+            metrics = []
+            for leaf in _metric_leaves(eval_metric):
+                acc = leaf._device_acc
+                if acc is not None:
+                    acc = (_fetch(acc[0]), _fetch(acc[1]))
+                metrics.append({"name": leaf.name,
+                                "sum_metric": leaf.sum_metric,
+                                "num_inst": leaf.num_inst,
+                                "device_acc": acc})
+        payload["metrics"] = metrics
+        base_key = ex._base_key
+        payload["rng"] = {
+            "global": _random.get_state(),
+            "executor_step": int(ex._step),
+            "executor_base_key": (None if base_key is None
+                                  else _fetch(base_key)),
+        }
+        data_state = None
+        if train_data is not None:
+            get = getattr(train_data, "get_checkpoint_state", None)
+            if callable(get):
+                data_state = get()
+        payload["data_iter"] = data_state
+    return payload
+
+
+def restore(payload: Dict[str, Any], module, eval_metric=None,
+            train_data=None) -> Dict[str, Any]:
+    """Rebuild a :func:`snapshot` payload onto the module's CURRENT
+    mesh. Every array re-enters the device through the executor group's
+    own ``_place`` with the placement fresh init uses (params/opt-state/
+    metric accs replicated, batch-independent) — so a snapshot saved at
+    a different dp count re-shards without retracing, and a same-dp
+    resume reuses every compiled executable. Assignments go into the
+    executor's existing NDArrays in place, so the fused step's
+    pre-derived packs see the restored values."""
+    import jax.numpy as jnp
+
+    group = module._exec_group
+    if group is None:
+        raise CheckpointError("restore: module is not bound")
+    ex = group.executor
+    if payload.get("format") != FORMAT:
+        raise CheckpointError("unsupported snapshot format %r"
+                              % (payload.get("format"),))
+    saved_dp = int(payload.get("dp") or 0)
+    cur_dp = len(group.contexts)
+    if saved_dp and saved_dp != cur_dp:
+        _log.info("elastic rejoin: snapshot saved at dp=%d restoring "
+                  "onto dp=%d (replicated state re-shards; no retrace)",
+                  saved_dp, cur_dp)
+    aux_by_name = dict(zip(group.aux_names, ex.aux_arrays))
+    with _san.intentional_transfer():
+        for name, val in payload["params"].items():
+            arr = ex.arg_dict.get(name)
+            if arr is None:
+                raise CheckpointError(
+                    "snapshot param '%s' has no slot in the bound "
+                    "executor (model changed since the save?)" % name)
+            if tuple(arr.shape) != tuple(val.shape):
+                raise CheckpointError(
+                    "snapshot param '%s' shape %s does not match bound "
+                    "shape %s" % (name, tuple(val.shape),
+                                  tuple(arr.shape)))
+            arr._data = group._place(val, None)._data
+        for name, val in payload.get("aux", {}).items():
+            arr = aux_by_name.get(name)
+            if arr is None:
+                raise CheckpointError(
+                    "snapshot aux state '%s' has no slot in the bound "
+                    "executor" % name)
+            arr._data = group._place(val, None)._data
+        updater = getattr(module, "_updater", None)
+        if payload.get("updater_states") is not None \
+                and updater is not None:
+            updater.states = _place_states(group,
+                                           payload["updater_states"])
+        optimizer = getattr(module, "_optimizer", None)
+        if payload.get("optimizer") is not None and optimizer is not None:
+            optimizer.set_checkpoint_state(payload["optimizer"])
+        if payload.get("metrics") is not None and eval_metric is not None:
+            leaves = _metric_leaves(eval_metric)
+            saved = payload["metrics"]
+            if len(leaves) != len(saved):
+                raise CheckpointError(
+                    "snapshot has %d metric leaves, fit has %d"
+                    % (len(saved), len(leaves)))
+            for leaf, st in zip(leaves, saved):
+                leaf.sum_metric = st["sum_metric"]
+                leaf.num_inst = st["num_inst"]
+                acc = st["device_acc"]
+                leaf._device_acc = None if acc is None else (
+                    group._place(np.asarray(acc[0], np.float32),
+                                 None)._data,
+                    group._place(np.asarray(acc[1], np.float32),
+                                 None)._data)
+        rng = payload.get("rng")
+        if rng is not None:
+            _random.set_state(tuple(rng["global"]))
+            ex._step = int(rng["executor_step"])
+            bk = rng.get("executor_base_key")
+            ex._base_key = None if bk is None else jnp.asarray(bk)
+        if train_data is not None:
+            seek = getattr(train_data, "set_checkpoint_state", None)
+            if callable(seek):
+                st = {"batches": int(payload.get("nbatch", -1)) + 1}
+                dstate = payload.get("data_iter") or {}
+                if "epoch" in dstate:
+                    st["epoch"] = dstate["epoch"]
+                seek(st)
+    module._params_dirty = True
+    _tel.inc("ckpt.restores")
+    return {"epoch": int(payload["epoch"]), "nbatch": int(payload["nbatch"]),
+            "step": int(payload["step"]), "dp": saved_dp}
+
+
+# ---------------------------------------------------------------------------
+# on-disk snapshot store
+# ---------------------------------------------------------------------------
+
+class SnapshotStore:
+    """A directory of snapshots plus a manifest, every write crash-safe.
+
+    Layout: ``snap-<step>-<seq>.ckpt`` payload files and ``MANIFEST.json``
+    listing them oldest-first with per-file ``sha256``/``bytes``. The
+    data file is written (atomically) BEFORE the manifest: a crash
+    between the two orphans the new file but leaves the previous
+    manifest — and therefore the previous snapshot — fully intact.
+    :meth:`load_latest` walks the manifest newest-first and verifies
+    existence, size, content hash and unpickle before trusting a file;
+    anything torn is counted (``ckpt.torn_skipped``), logged by name,
+    and skipped in favor of the next-older snapshot."""
+
+    def __init__(self, directory: str, keep: Optional[int] = None):
+        self.dir = os.fspath(directory)
+        if keep is None:
+            keep = _env.get("MXNET_TPU_CKPT_KEEP")
+        self.keep = max(1, int(keep))
+        os.makedirs(self.dir, exist_ok=True)
+        self._seq = 0
+
+    # -- manifest ------------------------------------------------------
+    def _manifest_path(self) -> str:
+        return os.path.join(self.dir, MANIFEST)
+
+    def _read_manifest(self) -> dict:
+        empty = {"format": FORMAT, "snapshots": []}
+        path = self._manifest_path()
+        try:
+            with open(path) as f:
+                m = json.load(f)
+        except FileNotFoundError:
+            return empty
+        except (OSError, ValueError) as e:
+            _log.warning("unreadable checkpoint manifest %s (%s); "
+                         "treating the store as empty", path, e)
+            return empty
+        if not isinstance(m, dict) \
+                or not isinstance(m.get("snapshots"), list):
+            _log.warning("malformed checkpoint manifest %s; treating "
+                         "the store as empty", path)
+            return empty
+        return m
+
+    # -- save / load ---------------------------------------------------
+    def save(self, payload: Dict[str, Any], reason: str = "periodic",
+             deadline: Optional[float] = None) -> Optional[str]:
+        """Serialize + write one snapshot, update the manifest, prune
+        beyond ``keep``. ``deadline`` (``time.monotonic()`` scale)
+        abandons the save before the write starts when the serialize
+        phase already blew the budget — a torn write mid-preemption
+        would be worse than no write at all. Returns the snapshot file
+        name, or None when abandoned."""
+        t0 = time.perf_counter()
+        blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+        digest = hashlib.sha256(blob).hexdigest()
+        if deadline is not None and time.monotonic() > deadline:
+            _tel.inc("ckpt.preempt_abandoned")
+            _log.warning("abandoning snapshot (reason=%s): grace "
+                         "deadline passed before the write started; "
+                         "the previous snapshot remains valid", reason)
+            return None
+        self._seq += 1
+        fname = "snap-%08d-%03d.ckpt" % (int(payload.get("step", 0)),
+                                         self._seq)
+        atomic_write_bytes(os.path.join(self.dir, fname), blob)
+        manifest = self._read_manifest()
+        manifest["snapshots"].append({
+            "file": fname, "step": int(payload.get("step", 0)),
+            "epoch": int(payload.get("epoch", 0)),
+            "nbatch": int(payload.get("nbatch", -1)),
+            "dp": int(payload.get("dp", 0)),
+            "sha256": digest, "bytes": len(blob),
+            "time": round(time.time(), 3), "reason": reason,
+        })
+        drop = manifest["snapshots"][:-self.keep]
+        manifest["snapshots"] = manifest["snapshots"][-self.keep:]
+        # manifest LAST, and only ever pointing at fully-written files
+        atomic_write_bytes(self._manifest_path(),
+                           json.dumps(manifest, indent=1).encode())
+        for entry in drop:
+            try:
+                os.unlink(os.path.join(self.dir, entry["file"]))
+            except OSError:
+                pass
+        _tel.inc("ckpt.saves")
+        _tel.inc("ckpt.bytes", len(blob))
+        _tel.observe("ckpt.save_ms", (time.perf_counter() - t0) * 1e3)
+        return fname
+
+    def load_latest(self):
+        """``(payload, manifest_entry)`` of the newest VALID snapshot,
+        or None when the store holds none. Torn/corrupt files are
+        skipped with a warning naming the file."""
+        manifest = self._read_manifest()
+        for entry in reversed(manifest["snapshots"]):
+            path = os.path.join(self.dir, str(entry.get("file", "")))
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+                if len(blob) != int(entry.get("bytes", -1)):
+                    raise CheckpointError(
+                        "size mismatch (manifest says %s bytes, file "
+                        "has %d — torn write?)"
+                        % (entry.get("bytes"), len(blob)))
+                if hashlib.sha256(blob).hexdigest() != entry.get("sha256"):
+                    raise CheckpointError("content hash mismatch")
+                payload = pickle.loads(blob)
+                if not isinstance(payload, dict) \
+                        or payload.get("format") != FORMAT:
+                    raise CheckpointError("unsupported payload format")
+            except (OSError, CheckpointError, pickle.UnpicklingError,
+                    EOFError, ValueError, AttributeError,
+                    ImportError) as e:
+                _tel.inc("ckpt.torn_skipped")
+                _log.warning("skipping torn/corrupt checkpoint %s: %s "
+                             "(falling back to the previous snapshot)",
+                             path, e)
+                continue
+            return payload, entry
+        return None
+
+
+# ---------------------------------------------------------------------------
+# fit-loop manager
+# ---------------------------------------------------------------------------
+
+class CheckpointManager:
+    """Owns the snapshot cadence, auto-resume and the SIGTERM grace path
+    for one fit() run. Created by :func:`maybe_manager` when
+    ``MXNET_TPU_CKPT_DIR`` is set; ``base_module.fit`` calls
+    :meth:`maybe_restore` once before the epoch loop, brackets each
+    batch with :meth:`step_begin`/:meth:`step_end`, and arms/disarms the
+    preemption hook around the whole loop."""
+
+    def __init__(self, module, eval_metric=None, train_data=None,
+                 directory: Optional[str] = None,
+                 every_n: Optional[int] = None,
+                 keep: Optional[int] = None,
+                 grace_s: Optional[float] = None):
+        directory = directory or _env.get("MXNET_TPU_CKPT_DIR")
+        if not directory:
+            raise CheckpointError(
+                "CheckpointManager needs a directory "
+                "(set MXNET_TPU_CKPT_DIR)")
+        self._module = module
+        self._metric = eval_metric
+        self._data = train_data
+        self._every_n = int(every_n if every_n is not None
+                            else _env.get("MXNET_TPU_CKPT_EVERY_N_STEPS"))
+        self._grace_s = float(grace_s if grace_s is not None
+                              else _env.get("MXNET_TPU_CKPT_GRACE_S"))
+        self.store = SnapshotStore(directory, keep=keep)
+        self.global_step = 0
+        self._epoch = 0
+        self._nbatch = -1
+        # signal-handler handshake: the SIGTERM hook runs on the main
+        # thread between bytecodes, so plain attributes are safe — but
+        # _in_step must be flipped around EXACTLY the region where the
+        # packs are torn (the dispatch + write-back)
+        self._in_step = False
+        self._exit_after_step = False
+        self._preempt_at: Optional[float] = None
+        self._armed = False
+
+    # -- resume --------------------------------------------------------
+    def maybe_restore(self) -> Optional[Dict[str, Any]]:
+        """Restore the newest valid snapshot onto the module (gated by
+        ``MXNET_TPU_CKPT_RESUME``); returns the resume position
+        ``{"epoch", "nbatch", "step", "dp"}`` or None."""
+        if not _env.get("MXNET_TPU_CKPT_RESUME"):
+            return None
+        found = self.store.load_latest()
+        if found is None:
+            return None
+        payload, entry = found
+        info = restore(payload, self._module, self._metric, self._data)
+        self.global_step = info["step"]
+        self._epoch, self._nbatch = info["epoch"], info["nbatch"]
+        _log.info("resumed from snapshot %s: step %d (epoch %d, batch "
+                  "%d), saved at dp=%d, restored onto dp=%d",
+                  entry.get("file"), info["step"], info["epoch"],
+                  info["nbatch"], info["dp"],
+                  len(self._module._exec_group.contexts))
+        return info
+
+    # -- fit-loop hooks ------------------------------------------------
+    def step_begin(self) -> None:
+        self._in_step = True
+
+    def step_end(self, epoch: int, nbatch: int) -> None:
+        """Called after each completed batch (write-back done, packs
+        whole). Handles a deferred preemption first — save, then
+        re-deliver SIGTERM so default termination proceeds — else the
+        periodic cadence."""
+        self._in_step = False
+        self.global_step += 1
+        self._epoch, self._nbatch = epoch, nbatch
+        if self._exit_after_step:
+            self._exit_after_step = False
+            deadline = ((self._preempt_at or time.monotonic())
+                        + self._grace_s)
+            self._save("preempt", deadline=deadline)
+            self._reraise_sigterm()
+            return
+        if self._every_n > 0 and self.global_step % self._every_n == 0:
+            self._save("periodic")
+
+    def save_now(self, reason: str = "manual") -> Optional[str]:
+        return self._save(reason)
+
+    def _save(self, reason: str,
+              deadline: Optional[float] = None) -> Optional[str]:
+        try:
+            payload = snapshot(self._module, self._metric, self._data,
+                               step=self.global_step, epoch=self._epoch,
+                               nbatch=self._nbatch)
+            if deadline is not None and time.monotonic() > deadline:
+                _tel.inc("ckpt.preempt_abandoned")
+                _log.warning("abandoning snapshot (reason=%s): grace "
+                             "deadline passed during the device fetch; "
+                             "the previous snapshot remains valid",
+                             reason)
+                return None
+            fname = self.store.save(payload, reason=reason,
+                                    deadline=deadline)
+        except Exception as e:
+            # a failed periodic save must not kill a healthy run (and
+            # the preempt path is about to terminate anyway) — the
+            # previous snapshot is still on disk
+            _log.error("checkpoint save failed (reason=%s): %s",
+                       reason, e)
+            return None
+        if fname is not None and reason == "preempt":
+            _tel.inc("ckpt.preempt_saves")
+        return fname
+
+    # -- SIGTERM grace path --------------------------------------------
+    def arm(self) -> "CheckpointManager":
+        """Route SIGTERM through the checkpoint-then-exit grace path
+        (installs the FlightRecorder signal handlers if the env flag
+        didn't already)."""
+        if self._armed:
+            return self
+        from . import tracing as _tracing
+
+        _tracing.ensure_flight_recorder()
+        _tracing.register_preempt_hook(self._on_preempt)
+        self._armed = True
+        return self
+
+    def disarm(self) -> None:
+        if not self._armed:
+            return
+        from . import tracing as _tracing
+
+        _tracing.unregister_preempt_hook(self._on_preempt)
+        self._armed = False
+
+    def _on_preempt(self) -> Optional[str]:
+        """FlightRecorder SIGTERM hook. Mid-step the donated packs are
+        torn (XLA owns the buffers), so defer to the step boundary —
+        step_end saves and re-delivers the signal. Between steps the
+        state is whole: save right here and let default termination
+        proceed."""
+        self._preempt_at = time.monotonic()
+        if self._in_step:
+            self._exit_after_step = True
+            return "defer"
+        self._save("preempt",
+                   deadline=self._preempt_at + self._grace_s)
+        return None
+
+    @staticmethod
+    def _reraise_sigterm() -> None:
+        try:
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        except (ValueError, OSError):
+            pass
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+def maybe_manager(module, eval_metric=None,
+                  train_data=None) -> Optional[CheckpointManager]:
+    """fit() hook: a :class:`CheckpointManager` when
+    ``MXNET_TPU_CKPT_DIR`` is set and the module is bound, else None
+    (zero overhead: one env read)."""
+    directory = _env.get("MXNET_TPU_CKPT_DIR")
+    if not directory:
+        return None
+    if getattr(module, "_exec_group", None) is None:
+        return None
+    return CheckpointManager(module, eval_metric, train_data,
+                             directory=directory)

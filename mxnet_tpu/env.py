@@ -410,6 +410,40 @@ declare("MXNET_TPU_SERVE_SLO_MS", float, 0.0,
         "through the step-trace detectors. `0` disables SLO "
         "enforcement (latency is still measured).", section=_S)
 
+_C = "Checkpointing"
+declare("MXNET_TPU_CKPT_DIR", str, "",
+        "Directory for step-granularity full-state training snapshots "
+        "(params, optimizer state, metric accumulators, data cursor, "
+        "RNG keys — see `mxnet_tpu/checkpoint.py`). Setting it arms "
+        "the checkpoint manager inside `Module.fit`: periodic saves at "
+        "`MXNET_TPU_CKPT_EVERY_N_STEPS`, a SIGTERM checkpoint-then-exit "
+        "grace path, and automatic resume from the newest valid "
+        "snapshot at the next fit() (`MXNET_TPU_CKPT_RESUME`). Unset "
+        "disables all of it.", section=_C)
+declare("MXNET_TPU_CKPT_EVERY_N_STEPS", int, 0,
+        "Save a full-state snapshot every N training steps (batches). "
+        "`0` disables periodic saves — with `MXNET_TPU_CKPT_DIR` set "
+        "the SIGTERM grace path still writes a final snapshot on "
+        "preemption. See docs/performance.md (\"Surviving "
+        "preemption\") for cadence-vs-step-cost guidance.", section=_C)
+declare("MXNET_TPU_CKPT_KEEP", int, 2,
+        "How many snapshots to retain in `MXNET_TPU_CKPT_DIR`; older "
+        "ones are pruned after each successful save. Keep >= 2 so a "
+        "write torn by the preemption itself always leaves a loadable "
+        "previous snapshot behind.", section=_C)
+declare("MXNET_TPU_CKPT_RESUME", bool, True,
+        "Auto-resume: when `MXNET_TPU_CKPT_DIR` holds a valid snapshot, "
+        "`Module.fit` restores it (onto the *current* device mesh — a "
+        "different dp count re-shards, it does not retrace) and "
+        "continues from the saved step. `0` trains from scratch while "
+        "still saving snapshots.", section=_C)
+declare("MXNET_TPU_CKPT_GRACE_S", float, 25.0,
+        "Deadline budget (seconds) for the SIGTERM grace save: the "
+        "preemption hook abandons a snapshot whose device fetch + "
+        "serialize phases exceed the budget rather than start a write "
+        "it cannot finish (`ckpt.preempt_abandoned`); the previous "
+        "snapshot stays valid either way.", section=_C)
+
 declare("MXNET_TPU_NO_NATIVE", bool, False,
         "Disable the C++ runtime library (pure-Python recordio + engines "
         "only).", section="Native library / Pallas")

@@ -225,6 +225,34 @@ class FusedTrainStep:
         return leaves
 
     # ------------------------------------------------------------------
+    # checkpoint support (checkpoint.py)
+    @property
+    def trace_cache_size(self) -> int:
+        """Distinct trace signatures seen (== jit retraces). A resume
+        that re-places restored state with the same avals/shardings as
+        fresh init must NOT grow this — the elastic-rejoin tests assert
+        the delta across a restore is zero."""
+        return len(self._seen_sigs)
+
+    def state_arrays(self):
+        """The donated training-state NDArrays by role — the exact
+        packs :mod:`mxnet_tpu.checkpoint` snapshots/restores, derived
+        from the same index maps the dispatch uses so the two can never
+        disagree about what "full state" means.
+
+        Returns ``{"params": {name: NDArray}, "aux": {name: NDArray},
+        "updater_slots": {upd_i: param_name}}``.
+        """
+        ex = self._executor
+        params = {ex.arg_names[i]: ex.arg_arrays[i]
+                  for i in self._p_arg_idx}
+        aux = dict(zip(self._group.aux_names, ex.aux_arrays))
+        slots = {upd_i: ex.arg_names[arg_i]
+                 for upd_i, arg_i in zip(self._p_upd_idx,
+                                         self._p_arg_idx)}
+        return {"params": params, "aux": aux, "updater_slots": slots}
+
+    # ------------------------------------------------------------------
     def step(self, data_batch, eval_metric):
         """Run one training batch as one XLA dispatch."""
         import jax.numpy as jnp

@@ -171,6 +171,21 @@ class NDArrayIter(DataIter):
     def reset(self):
         self.cursor = -self.batch_size
 
+    # -- checkpoint support (checkpoint.py) ---------------------------
+    def get_checkpoint_state(self) -> dict:
+        """Identity of this data stream for the snapshot manifest."""
+        return {"kind": type(self).__name__,
+                "batch_size": self.batch_size,
+                "num_data": self.num_data}
+
+    def set_checkpoint_state(self, state: dict) -> None:
+        """Seek to ``state["batches"]`` batches already consumed this
+        epoch (0 == freshly reset). A logical-count seek, not a raw
+        cursor copy: the saved cursor may include prefetch wrapper
+        read-ahead the training loop never saw."""
+        k = int(state.get("batches", 0))
+        self.cursor = (k - 1) * self.batch_size
+
     def iter_next(self):
         self.cursor += self.batch_size
         return self.cursor < self.num_data

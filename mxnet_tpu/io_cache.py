@@ -502,6 +502,29 @@ class CachedImageRecordIter(DataIter):
         self._order = None
         self._batch_cursor = None   # cursor values repeat across epochs
 
+    # -- checkpoint support (checkpoint.py) ---------------------------
+    def get_checkpoint_state(self) -> dict:
+        """Stream identity for the snapshot. The aug RNG needs no
+        explicit keys: crop/mirror draws and the shuffle order are pure
+        functions of (seed, epoch, cursor, replica) — restoring those
+        scalars restores every per-replica ``batch.aug`` stream."""
+        return {"kind": type(self).__name__,
+                "batch_size": self.batch_size,
+                "seed": self._seed,
+                "epoch": self._epoch,
+                "aug_replicas": self.aug_replicas}
+
+    def set_checkpoint_state(self, state: dict) -> None:
+        """Seek to ``state["batches"]`` batches consumed within epoch
+        ``state["epoch"]``; the next batch drawn reproduces the
+        uninterrupted run's order and aug params bit-for-bit."""
+        if "epoch" in state:
+            self._epoch = int(state["epoch"])
+        k = int(state.get("batches", 0))
+        self.cursor = (k - 1) * self.batch_size
+        self._order = None
+        self._batch_cursor = None
+
     def _epoch_order(self):
         if self._order is None:
             if self.shuffle:

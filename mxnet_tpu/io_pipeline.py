@@ -495,6 +495,21 @@ class DeviceStagingIter(DataIter):
         self._staged = None
         self._exhausted = False
 
+    # -- checkpoint support (checkpoint.py): the wrapper has no stream
+    # state of its own beyond the staged read-ahead, which a seek must
+    # discard — the base iterator will re-produce it from the restored
+    # logical position
+    def get_checkpoint_state(self):
+        get = getattr(self.base, "get_checkpoint_state", None)
+        return get() if callable(get) else None
+
+    def set_checkpoint_state(self, state):
+        self._staged = None
+        self._exhausted = False
+        st = getattr(self.base, "set_checkpoint_state", None)
+        if callable(st):
+            st(state)
+
     def _to_device(self, x, batch_axis=0):
         from .ndarray import NDArray, array
 
@@ -720,6 +735,22 @@ class FeedScheduler(DataIter):
         self._exhausted = False
         self._closed = False
         # thread restarts lazily on the first next() of the new epoch
+
+    # -- checkpoint support (checkpoint.py): stop the worker and drop
+    # its in-flight read-ahead before seeking the base — staged batches
+    # belong to the pre-seek position and must not leak into the
+    # resumed stream
+    def get_checkpoint_state(self):
+        get = getattr(self.base, "get_checkpoint_state", None)
+        return get() if callable(get) else None
+
+    def set_checkpoint_state(self, state):
+        self._drain()
+        self._err = None
+        self._exhausted = False
+        st = getattr(self.base, "set_checkpoint_state", None)
+        if callable(st):
+            st(state)
 
     def iter_next(self) -> bool:
         try:

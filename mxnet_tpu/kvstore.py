@@ -266,14 +266,21 @@ class KVStore:
     def save_optimizer_states(self, fname: str):
         if self._optimizer is None or self._updater is None:
             raise MXNetError("no optimizer set")
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states())
+        # crash-safe: tmp + fsync + os.replace, never a torn state file
+        from .checkpoint import atomic_write_bytes
+        atomic_write_bytes(fname, self._updater.get_states())
 
     def load_optimizer_states(self, fname: str):
         if self._updater is None:
             raise MXNetError("no optimizer set")
         with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+            blob = f.read()
+        try:
+            self._updater.set_states(blob)
+        except Exception as e:
+            raise MXNetError(
+                "invalid optimizer-states file %s: %s (partial/torn "
+                "write?)" % (fname, e))
 
 
 class KVStoreDist(KVStore):

@@ -31,19 +31,31 @@ def save_checkpoint(prefix: str, epoch: int, symbol, arg_params: Dict,
                     aux_params: Dict):
     """``prefix-symbol.json`` + ``prefix-NNNN.params`` (reference
     model.py:308)."""
+    from .checkpoint import atomic_ndarray_save
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
+    # crash-safe: the param file is replaced atomically, never appended
+    # to in place — a preemption mid-save leaves the old file whole
+    atomic_ndarray_save(param_name, save_dict)
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
 
 def load_checkpoint(prefix: str, epoch: int):
-    """Returns (symbol, arg_params, aux_params) (reference model.py:342)."""
+    """Returns (symbol, arg_params, aux_params) (reference model.py:342).
+    Corrupt/torn files raise :class:`MXNetError` naming the file rather
+    than resuming from garbage."""
     symbol = sym_mod.load("%s-symbol.json" % prefix)
-    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    try:
+        save_dict = nd.load(param_name)
+    except MXNetError:
+        raise
+    except Exception as e:
+        raise MXNetError("invalid checkpoint %s: %s (partial/torn write?)"
+                         % (param_name, e))
     arg_params, aux_params = {}, {}
     for k, value in save_dict.items():
         arg_type, name = k.split(":", 1)

@@ -116,7 +116,10 @@ class BaseModule:
         arg_params, aux_params = self.get_params()
         save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
         save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
-        nd.save(fname, save_dict)
+        # crash-safe: a preemption mid-save must never leave a torn
+        # param file over a good one (tmp + fsync + os.replace)
+        from ..checkpoint import atomic_ndarray_save
+        atomic_ndarray_save(fname, save_dict)
 
     def load_params(self, fname: str):
         save_dict = nd.load(fname)
@@ -286,15 +289,46 @@ class BaseModule:
         # optimizers, monitors, grad_req="add")
         fused = self._fused_train_step(eval_metric)
 
+        # MXNET_TPU_CKPT_DIR: preemption-safe full-state snapshots —
+        # periodic saves every MXNET_TPU_CKPT_EVERY_N_STEPS, auto-resume
+        # from the newest valid snapshot, and a SIGTERM grace path that
+        # checkpoints at the step boundary before exiting
+        from ..checkpoint import maybe_manager as _ckpt_manager
+        ckpt = _ckpt_manager(self, eval_metric, train_data)
+        resume = ckpt.maybe_restore() if ckpt is not None else None
+        if ckpt is not None:
+            ckpt.arm()
+        try:
+            self._fit_epochs(train_data, eval_data, eval_metric,
+                             validation_metric, epoch_end_callback,
+                             batch_end_callback, eval_batch_end_callback,
+                             monitor, fused, ckpt, resume,
+                             begin_epoch, num_epoch)
+        finally:
+            if ckpt is not None:
+                ckpt.disarm()
+
+    def _fit_epochs(self, train_data, eval_data, eval_metric,
+                    validation_metric, epoch_end_callback,
+                    batch_end_callback, eval_batch_end_callback,
+                    monitor, fused, ckpt, resume, begin_epoch, num_epoch):
         for epoch in range(begin_epoch, num_epoch):
+            if resume is not None and epoch < resume["epoch"]:
+                continue
+            # resuming mid-epoch: metric sums and the data cursor were
+            # restored by the snapshot — reset would discard them
+            resuming = resume is not None and epoch == resume["epoch"]
+            nbatch_base = resume["nbatch"] + 1 if resuming else 0
+            resume = None
             tic = time.time()
-            eval_metric.reset()
-            train_data.reset()
+            if not resuming:
+                eval_metric.reset()
+                train_data.reset()
             # step latency is measured boundary-to-boundary so the data
             # fetch (where input stalls accrue) is attributed to the
             # step that waited on it, not lost between timers
             t_last = time.perf_counter() if _tel.enabled() else 0.0
-            nbatch = -1
+            nbatch = nbatch_base - 1
             # MXNET_TPU_SANITIZE=transfer (fused path only: the classic
             # loop updates metrics host-side by design): any implicit
             # host<->device transfer inside the step loop raises at the
@@ -304,9 +338,15 @@ class BaseModule:
                      else _contextlib.nullcontext())
             try:
                 with guard:
-                    for nbatch, data_batch in enumerate(train_data):
+                    for data_batch in train_data:
+                        nbatch += 1
                         if monitor is not None:
                             monitor.tic()
+                        if ckpt is not None:
+                            # SIGTERM inside this window defers to the
+                            # step boundary (donated packs are torn
+                            # mid-dispatch)
+                            ckpt.step_begin()
                         if fused is not None:
                             fused.step(data_batch, eval_metric)
                         else:
@@ -317,6 +357,10 @@ class BaseModule:
                             self.update()
                             self.update_metric(eval_metric,
                                                data_batch.label)
+                        if ckpt is not None:
+                            # packs whole again: periodic cadence save,
+                            # or the deferred preempt save + exit
+                            ckpt.step_end(epoch, nbatch)
                         if monitor is not None:
                             monitor.toc_print()
                         if _tel.enabled():

@@ -307,14 +307,23 @@ class Module(BaseModule):
         self.save_params(param_name)
         if save_optimizer_states:
             state_name = "%s-%04d.states" % (prefix, epoch)
-            with open(state_name, "wb") as f:
-                f.write(self._updater.get_states() if self._updater else b"")
+            # crash-safe like the param file: tmp + fsync + os.replace
+            from ..checkpoint import atomic_write_bytes
+            atomic_write_bytes(
+                state_name,
+                self._updater.get_states() if self._updater else b"")
 
     def load_optimizer_states(self, fname: str):
         if self._updater is None:
             raise MXNetError("init_optimizer before load_optimizer_states")
         with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+            blob = f.read()
+        try:
+            self._updater.set_states(blob)
+        except Exception as e:
+            raise MXNetError(
+                "invalid optimizer-states file %s: %s (partial/torn "
+                "write?)" % (fname, e))
 
     @staticmethod
     def load(prefix: str, epoch: int, load_optimizer_states: bool = False,

@@ -701,9 +701,23 @@ def save_to_stream(f, data) -> None:
         f.write(b)
 
 
+def _read_exact(f, n: int, what: str) -> bytes:
+    """Read exactly ``n`` bytes or raise a named MXNetError. Central
+    torn-file detection: a checkpoint truncated mid-write (preemption,
+    full disk) surfaces as "truncated ... file X", never as a raw
+    struct.error half-way through a resume."""
+    raw = f.read(n)
+    if len(raw) != n:
+        raise MXNetError("invalid NDArray file %s: truncated (wanted %d "
+                         "bytes, got %d — partial/torn write?)"
+                         % (what, n, len(raw)))
+    return raw
+
+
 def load_from_stream(f, what: str = "<stream>"):
     """Read a container from an open binary file object; returns list or
-    dict like :func:`load`."""
+    dict like :func:`load`. Short reads anywhere in the container raise
+    :class:`MXNetError` naming ``what``."""
     header = f.read(24)
     if len(header) < 24:
         raise MXNetError("invalid NDArray file %s: truncated header" % what)
@@ -712,11 +726,15 @@ def load_from_stream(f, what: str = "<stream>"):
         raise MXNetError("invalid NDArray file %s" % what)
     arrays = []
     for _ in range(n):
-        ndim, = struct.unpack("<I", f.read(4))
-        shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim)) if ndim else ()
-        dtype_id, = struct.unpack("<I", f.read(4))
-        nbytes, = struct.unpack("<Q", f.read(8))
-        raw = f.read(nbytes)
+        ndim, = struct.unpack("<I", _read_exact(f, 4, what))
+        shape = struct.unpack("<%dq" % ndim,
+                              _read_exact(f, 8 * ndim, what)) if ndim else ()
+        dtype_id, = struct.unpack("<I", _read_exact(f, 4, what))
+        nbytes, = struct.unpack("<Q", _read_exact(f, 8, what))
+        raw = _read_exact(f, nbytes, what)
+        if dtype_id not in DTYPE_ID_TO_NP:
+            raise MXNetError("invalid NDArray file %s: unknown dtype id %d"
+                             % (what, dtype_id))
         arr = np.frombuffer(raw, dtype=DTYPE_ID_TO_NP[dtype_id]).reshape(shape)
         dt = arr.dtype
         if dt.itemsize == 8 and dt.kind in "iuf":
@@ -733,11 +751,11 @@ def load_from_stream(f, what: str = "<stream>"):
                     % (what, dt, narrowed), stacklevel=2)
                 arr = arr.astype(narrowed)
         arrays.append(array(arr, dtype=arr.dtype))
-    n_names, = struct.unpack("<Q", f.read(8))
+    n_names, = struct.unpack("<Q", _read_exact(f, 8, what))
     names = []
     for _ in range(n_names):
-        ln, = struct.unpack("<Q", f.read(8))
-        names.append(f.read(ln).decode("utf-8"))
+        ln, = struct.unpack("<Q", _read_exact(f, 8, what))
+        names.append(_read_exact(f, ln, what).decode("utf-8"))
     if names:
         if len(names) != len(arrays):
             raise MXNetError("corrupt NDArray file: name/array count mismatch")

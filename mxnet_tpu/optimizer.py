@@ -337,6 +337,32 @@ class Optimizer:
             get_engine().push(_do, const_vars=[m[1]._var for m in members],
                               mutable_vars=muts)
 
+    # -- checkpoint support (checkpoint.py) ---------------------------
+    def get_checkpoint_state(self) -> dict:
+        """The host-side scalars the per-step ``_plan`` reads — update
+        counts and lr-schedule state. These never live on device, so a
+        full-state snapshot must carry them explicitly: resuming
+        without them replays the lr warm-up/decay from step 0 and the
+        loss stream diverges."""
+        st = {"num_update": self.num_update,
+              "begin_num_update": self.begin_num_update,
+              "index_update_count": dict(self._index_update_count)}
+        if self.lr_scheduler is not None:
+            st["lr_scheduler"] = {
+                k: v for k, v in vars(self.lr_scheduler).items()
+                if isinstance(v, (int, float, bool))}
+        return st
+
+    def set_checkpoint_state(self, st: dict) -> None:
+        """Restore a state captured by :meth:`get_checkpoint_state`."""
+        self.num_update = int(st["num_update"])
+        self.begin_num_update = int(st["begin_num_update"])
+        self._index_update_count = {int(k): int(v) for k, v in
+                                    st["index_update_count"].items()}
+        for k, v in st.get("lr_scheduler", {}).items():
+            if self.lr_scheduler is not None:
+                setattr(self.lr_scheduler, k, v)
+
     def set_lr_mult(self, args_lr_mult: Dict[str, float]):
         self.lr_mult.update(args_lr_mult)
 

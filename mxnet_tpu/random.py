@@ -9,9 +9,8 @@ staying functional underneath.
 """
 from __future__ import annotations
 
-import itertools
 import threading
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -19,11 +18,12 @@ from .base import mx_real_t
 from .context import Context
 from .ndarray import NDArray
 
-__all__ = ["seed", "uniform", "normal", "randint", "next_key"]
+__all__ = ["seed", "uniform", "normal", "randint", "next_key",
+           "get_state", "set_state"]
 
 _lock = threading.Lock()
 _seed = 0
-_counter = itertools.count()
+_counter = 0
 
 
 def seed(seed_state: int) -> None:
@@ -31,7 +31,23 @@ def seed(seed_state: int) -> None:
     global _seed, _counter
     with _lock:
         _seed = int(seed_state)
-        _counter = itertools.count()
+        _counter = 0
+
+
+def get_state() -> Tuple[int, int]:
+    """The global PRNG state as ``(seed, draws)``: restoring it with
+    :func:`set_state` replays the exact key sequence from that point."""
+    with _lock:
+        return (_seed, _counter)
+
+
+def set_state(state: Tuple[int, int]) -> None:
+    """Restore a state captured by :func:`get_state` (checkpoint resume)."""
+    global _seed, _counter
+    s, n = state
+    with _lock:
+        _seed = int(s)
+        _counter = int(n)
 
 
 def next_key():
@@ -39,8 +55,10 @@ def next_key():
     Dropout/initializers/executors)."""
     import jax
 
+    global _counter
     with _lock:
-        n = next(_counter)
+        n = _counter
+        _counter += 1
         s = _seed
     return jax.random.fold_in(jax.random.PRNGKey(s), n)
 

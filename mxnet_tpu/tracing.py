@@ -57,7 +57,9 @@ __all__ = ["StepTrace", "SlowStepDetector", "RecompileDetector",
            "InputStallDetector", "SlowRequestDetector", "AnomalyProfiler",
            "FlightRecorder", "MetricsServer", "step_trace", "record_step",
            "maybe_init", "set_worker_rank", "worker_rank", "shutdown",
-           "register_health_probe", "unregister_health_probe"]
+           "register_health_probe", "unregister_health_probe",
+           "register_preempt_hook", "unregister_preempt_hook",
+           "ensure_flight_recorder"]
 
 _log = logging.getLogger(__name__)
 
@@ -83,6 +85,10 @@ DELTA_SOURCES = (
     # this step spent compiling)
     ("compiles", "compile.count", "counter"),
     ("compile_ms", "compile.time_ms", "hist_sum"),
+    # checkpoint manager: snapshots written this step and the wall time
+    # they took (checkpoint.py)
+    ("ckpt_saves", "ckpt.saves", "counter"),
+    ("ckpt_save_ms", "ckpt.save_ms", "hist_sum"),
 )
 
 _STALL_FIELDS = ("io_stall_ms", "prefetch_stall_ms", "feed_stall_ms")
@@ -476,14 +482,61 @@ def _format_all_stacks() -> str:
     return "\n".join(out)
 
 
+# Preemption hooks: callables run from the SIGTERM handler before the
+# signal is re-raised (signal-handler context: keep them short and
+# non-blocking). A hook may return the string "defer" to suppress the
+# immediate re-raise — the deferring component owns termination from
+# that point and must re-deliver SIGTERM itself once it is safe (the
+# checkpoint manager does this at the next step boundary, where the
+# donated packs are whole). Hook exceptions are swallowed: a broken
+# hook must not mask the preemption.
+_preempt_hooks: List[Callable[[], Optional[str]]] = []
+_preempt_lock = threading.Lock()
+
+
+def register_preempt_hook(fn: Callable[[], Optional[str]]):
+    """Run ``fn()`` on SIGTERM before default termination proceeds."""
+    with _preempt_lock:
+        if fn not in _preempt_hooks:
+            _preempt_hooks.append(fn)
+    return fn
+
+
+def unregister_preempt_hook(fn: Callable[[], Optional[str]]):
+    with _preempt_lock:
+        try:
+            _preempt_hooks.remove(fn)
+        except ValueError:
+            pass
+
+
+def _run_preempt_hooks() -> bool:
+    """Returns True when any hook asked to defer termination."""
+    with _preempt_lock:
+        hooks = list(_preempt_hooks)
+    defer = False
+    for fn in hooks:
+        try:
+            if fn() == "defer":
+                defer = True
+        except Exception as e:
+            try:
+                _log.error("preempt hook %r failed: %s", fn, e)
+            except Exception:
+                pass
+    return defer
+
+
 class FlightRecorder:
     """Dumps the step ring + all-thread stacks + telemetry snapshot
     into a crash directory on unhandled exception, SIGTERM (preemption)
     or SIGUSR1 (operator-requested, run continues).
 
     ``install()`` chains the previous ``sys.excepthook`` and signal
-    handlers; SIGTERM re-raises after dumping so the process still
-    terminates with default semantics."""
+    handlers; SIGTERM runs the registered preemption hooks and then
+    re-raises so the process still terminates with default semantics —
+    unless a hook deferred, in which case that hook's owner re-delivers
+    the signal itself at the next safe point."""
 
     def __init__(self, crash_dir: Optional[str] = None, trace=None):
         self.crash_dir = crash_dir or _env.get(
@@ -578,6 +631,11 @@ class FlightRecorder:
             name = str(signum)
         self.dump("signal:%s" % name)
         if signum == signal.SIGTERM:
+            if _run_preempt_hooks():
+                # a hook deferred termination (e.g. the checkpoint
+                # manager is mid-step and will save at the next step
+                # boundary, then re-deliver SIGTERM itself)
+                return
             # restore the prior disposition and re-raise so termination
             # proceeds exactly as it would have without us
             prev = self._prev_handlers.get(signum)
@@ -799,6 +857,24 @@ def metrics_server() -> Optional[MetricsServer]:
 
 def flight_recorder() -> Optional[FlightRecorder]:
     return _flight_recorder
+
+
+def ensure_flight_recorder() -> FlightRecorder:
+    """Install the global flight recorder even when the
+    ``MXNET_TPU_FLIGHT_RECORDER`` env flag is off. The checkpoint
+    manager's SIGTERM grace path needs its signal routing (preempt
+    hooks run from ``_on_signal``) regardless of whether the operator
+    asked for crash dumps. Registers :func:`shutdown` with atexit so
+    the handlers are uninstalled on interpreter exit."""
+    global _flight_recorder, _atexit_registered
+    with _init_lock:
+        if _flight_recorder is None:
+            _flight_recorder = FlightRecorder().install()
+        if not _atexit_registered:
+            import atexit
+            atexit.register(shutdown)
+            _atexit_registered = True
+        return _flight_recorder
 
 
 def shutdown():

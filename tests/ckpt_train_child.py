@@ -1,0 +1,67 @@
+"""Child process for the checkpoint/preemption subprocess tests (not a
+test module). Trains the exact-arithmetic linear model with the
+checkpoint manager armed via MXNET_TPU_CKPT_* env, appends each step's
+(epoch, nbatch, mse-as-hexfloat) to ``$T_DIR/stream.txt``, and — when
+``DIE_AT_STEP`` is set — delivers ``DIE_SIG`` (SIGTERM default, or
+SIGKILL for the hard-crash tests) to itself after that global step's
+batch_end callback. A run that reaches fit() completion writes
+``$T_DIR/completed``."""
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import symbol as sym  # noqa: E402
+from mxnet_tpu.module import Module  # noqa: E402
+
+TMP = os.environ["T_DIR"]
+DIE_AT_STEP = int(os.environ.get("DIE_AT_STEP", "-1"))
+DIE_SIG = getattr(signal, os.environ.get("DIE_SIG", "SIGTERM"))
+BATCH, DIM, NBATCHES, NUM_EPOCH = 8, 4, 6, 2
+
+net = sym.Variable("data")
+net = sym.FullyConnected(net, num_hidden=1, name="fc1")
+net = mx.sym.LinearRegressionOutput(net, name="lro")
+
+rng = np.random.RandomState(5)
+X = rng.randint(0, 2, (BATCH * NBATCHES, DIM)).astype(np.float32)
+y = rng.randint(0, 4, (BATCH * NBATCHES, 1)).astype(np.float32)
+data = mx.io.NDArrayIter(X, y, batch_size=BATCH, label_name="lro_label")
+
+arg_shapes, _, _ = net.infer_shape(data=(BATCH, DIM),
+                                   lro_label=(BATCH, 1))
+prng = np.random.RandomState(9)
+arg_params = {name: mx.nd.array(
+    (prng.randint(-2, 3, shape) * 0.5).astype(np.float32))
+    for name, shape in zip(net.list_arguments(), arg_shapes)
+    if name not in ("data", "lro_label")}
+
+mod = Module(net, label_names=("lro_label",))
+step = [0]
+
+
+def cb(param):
+    step[0] += 1
+    mse = float(dict(param.eval_metric.get_name_value())["mse"])
+    with open(os.path.join(TMP, "stream.txt"), "a") as f:
+        f.write("%d %d %s\n" % (param.epoch, param.nbatch, mse.hex()))
+    if DIE_AT_STEP >= 0 and step[0] == DIE_AT_STEP:
+        os.kill(os.getpid(), DIE_SIG)
+
+
+mod.fit(data, num_epoch=NUM_EPOCH, eval_metric="mse", optimizer="sgd",
+        arg_params=arg_params, initializer=None,
+        optimizer_params={"learning_rate": 0.5, "momentum": 0.5},
+        batch_end_callback=cb)
+
+args_out, _ = mod.get_params()
+np.save(os.path.join(TMP, "final_w.npy"),
+        args_out["fc1_weight"].asnumpy())
+with open(os.path.join(TMP, "completed"), "w") as f:
+    f.write("ok")

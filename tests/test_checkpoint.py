@@ -1,0 +1,384 @@
+"""Preemption-safe training (mxnet_tpu/checkpoint.py): crash-safe
+writes, torn-file detection, bit-identical full-state snapshot/resume,
+elastic dp rejoin, and the SIGTERM checkpoint-then-exit grace path.
+
+Runs with the transfer sanitizer armed (conftest) — every device fetch
+a save performs must sit inside a sanctioned intentional_transfer
+window, or these tests fail at the batch that leaked.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import checkpoint as ckpt
+from mxnet_tpu import symbol as sym
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.module import Module
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# exact-arithmetic regime (see test_sharded_fused.py): a linear head
+# over integer data with quarter-integer weights and power-of-two
+# batch/lr keeps every loss, gradient, momentum buffer and update an
+# exactly-representable dyadic rational in float32 — so "bit-identical
+# resume" is a == on the metric stream, not an allclose
+BATCH = 8
+DIM = 4
+
+
+def _lin_sym():
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=1, name="fc1")
+    return mx.sym.LinearRegressionOutput(net, name="lro")
+
+
+def _synthetic_lin(n, seed=5):
+    rng = np.random.RandomState(seed)
+    X = rng.randint(0, 2, (n, DIM)).astype(np.float32)
+    y = rng.randint(0, 4, (n, 1)).astype(np.float32)
+    return X, y
+
+
+def _seed_params(net, seed=9, batch=BATCH):
+    arg_shapes, _, _ = net.infer_shape(data=(batch, DIM),
+                                       lro_label=(batch, 1))
+    rng = np.random.RandomState(seed)
+    return {name: mx.nd.array(
+        (rng.randint(-2, 3, shape) * 0.5).astype(np.float32))
+        for name, shape in zip(net.list_arguments(), arg_shapes)
+        if name not in ("data", "lro_label")}
+
+
+def _fit(dp=1, nbatches=4, num_epoch=2, stream=None, momentum=0.5):
+    """One fused training run; ``stream`` collects the per-step
+    (epoch, nbatch, mse) sequence — the bit-identity evidence."""
+    net = _lin_sym()
+    X, y = _synthetic_lin(BATCH * nbatches)
+    data = mx.io.NDArrayIter(X, y, batch_size=BATCH,
+                             label_name="lro_label")
+    mod = Module(net, context=[mx.cpu(i) for i in range(dp)],
+                 label_names=("lro_label",))
+
+    def cb(param):
+        if stream is not None:
+            stream.append(
+                (param.epoch, param.nbatch,
+                 dict(param.eval_metric.get_name_value())["mse"]))
+
+    mod.fit(data, num_epoch=num_epoch, kvstore="device_sync",
+            eval_metric="mse", optimizer="sgd",
+            arg_params=_seed_params(net), initializer=None,
+            optimizer_params={"learning_rate": 0.5,
+                              "momentum": momentum},
+            batch_end_callback=cb)
+    return mod
+
+
+def _keep_only_step(d, step):
+    """Trim the manifest to the snapshot taken at ``step`` — simulates
+    resuming from a mid-run save rather than the final one."""
+    mp = os.path.join(d, ckpt.MANIFEST)
+    with open(mp) as f:
+        man = json.load(f)
+    man["snapshots"] = [e for e in man["snapshots"] if e["step"] == step]
+    assert man["snapshots"], "no snapshot at step %d" % step
+    with open(mp, "w") as f:
+        json.dump(man, f)
+
+
+@pytest.fixture
+def tel():
+    telemetry.reset()
+    telemetry.enable()
+    yield telemetry
+    telemetry.reset()
+    telemetry.disable()
+
+
+# ---------------------------------------------------------------------------
+# crash-safe writes + torn-file detection
+# ---------------------------------------------------------------------------
+
+def test_atomic_writer_crash_leaves_old_file_whole(tmp_path):
+    p = str(tmp_path / "f.bin")
+    ckpt.atomic_write_bytes(p, b"old-complete-content")
+    with pytest.raises(RuntimeError):
+        with ckpt.atomic_writer(p) as f:
+            f.write(b"new-half")
+            raise RuntimeError("simulated crash mid-write")
+    assert open(p, "rb").read() == b"old-complete-content"
+    assert not [x for x in os.listdir(tmp_path) if ".tmp-" in x], \
+        "tmp file leaked after failed atomic write"
+
+
+def test_snapshot_store_prunes_to_keep(tmp_path):
+    st = ckpt.SnapshotStore(str(tmp_path), keep=2)
+    for step in (1, 2, 3):
+        st.save({"format": ckpt.FORMAT, "step": step, "epoch": 0,
+                 "nbatch": step - 1, "dp": 1})
+    with open(tmp_path / ckpt.MANIFEST) as f:
+        man = json.load(f)
+    assert [e["step"] for e in man["snapshots"]] == [2, 3]
+    assert len([x for x in os.listdir(tmp_path)
+                if x.endswith(".ckpt")]) == 2
+    payload, entry = st.load_latest()
+    assert payload["step"] == 3 and entry["step"] == 3
+
+
+def test_torn_snapshot_skipped_never_silently_loaded(tmp_path, tel):
+    """Truncating the newest checkpoint mid-file must leave the store
+    loadable from the previous snapshot — counted, named in the log,
+    never a silent bad resume."""
+    st = ckpt.SnapshotStore(str(tmp_path), keep=2)
+    st.save({"format": ckpt.FORMAT, "step": 1, "epoch": 0,
+             "nbatch": 0, "dp": 1})
+    st.save({"format": ckpt.FORMAT, "step": 2, "epoch": 0,
+             "nbatch": 1, "dp": 1})
+    _, newest = st.load_latest()
+    path = tmp_path / newest["file"]
+    blob = path.read_bytes()
+    path.write_bytes(blob[:len(blob) // 2])          # torn write
+    payload, entry = st.load_latest()
+    assert payload["step"] == 1, "torn snapshot was not skipped"
+    assert telemetry.peek("ckpt.torn_skipped") == 1
+    # corrupt content (right size, flipped byte) is caught by the hash
+    path.write_bytes(bytes([blob[0] ^ 0xFF]) + blob[1:])
+    payload, _ = st.load_latest()
+    assert payload["step"] == 1
+    assert telemetry.peek("ckpt.torn_skipped") == 2
+
+
+def test_unreadable_manifest_treated_as_empty(tmp_path):
+    (tmp_path / ckpt.MANIFEST).write_text("{torn json")
+    st = ckpt.SnapshotStore(str(tmp_path), keep=2)
+    assert st.load_latest() is None
+    st.save({"format": ckpt.FORMAT, "step": 1, "epoch": 0,
+             "nbatch": 0, "dp": 1})
+    payload, _ = st.load_latest()
+    assert payload["step"] == 1
+
+
+# ---------------------------------------------------------------------------
+# crash-safe satellite paths: model / module / callback checkpoints
+# ---------------------------------------------------------------------------
+
+def test_model_checkpoint_atomic_and_corrupt_named_error(tmp_path):
+    net = _lin_sym()
+    prefix = str(tmp_path / "ck")
+    arg_params = _seed_params(net)
+    mx.model.save_checkpoint(prefix, 1, net, arg_params, {})
+    _, loaded, _ = mx.model.load_checkpoint(prefix, 1)
+    assert set(loaded) == set(arg_params)
+    assert not [x for x in os.listdir(tmp_path) if ".tmp-" in x]
+    pf = "%s-0001.params" % prefix
+    blob = open(pf, "rb").read()
+    open(pf, "wb").write(blob[:len(blob) // 2])      # torn write
+    with pytest.raises(MXNetError) as ei:
+        mx.model.load_checkpoint(prefix, 1)
+    assert "ck-0001.params" in str(ei.value)
+
+
+def test_optimizer_states_atomic_and_corrupt_named_error(tmp_path):
+    mod = _fit(nbatches=2, num_epoch=1)
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+    sf = prefix + "-0001.states"
+    assert os.path.exists(sf)
+    mod.load_optimizer_states(sf)                     # roundtrip
+    assert not [x for x in os.listdir(tmp_path) if ".tmp-" in x]
+    open(sf, "wb").write(b"\x80\x04garbage-not-a-pickle")
+    with pytest.raises(MXNetError) as ei:
+        mod.load_optimizer_states(sf)
+    assert "m-0001.states" in str(ei.value)
+
+
+def test_do_checkpoint_save_optimizer_states(tmp_path):
+    with pytest.raises(ValueError):
+        mx.callback.do_checkpoint(str(tmp_path / "x"),
+                                  save_optimizer_states=True)
+    net = _lin_sym()
+    X, y = _synthetic_lin(BATCH * 2)
+    data = mx.io.NDArrayIter(X, y, batch_size=BATCH,
+                             label_name="lro_label")
+    mod = Module(net, label_names=("lro_label",))
+    prefix = str(tmp_path / "cb")
+    mod.fit(data, num_epoch=2, eval_metric="mse", optimizer="sgd",
+            arg_params=_seed_params(net), initializer=None,
+            optimizer_params={"learning_rate": 0.5},
+            epoch_end_callback=mx.callback.do_checkpoint(
+                prefix, save_optimizer_states=True, mod=mod))
+    for ep in (1, 2):
+        assert os.path.exists("%s-%04d.params" % (prefix, ep))
+        assert os.path.exists("%s-%04d.states" % (prefix, ep))
+
+
+# ---------------------------------------------------------------------------
+# full-state snapshot / resume
+# ---------------------------------------------------------------------------
+
+def test_resume_bit_identical_stream(tmp_path, tel, monkeypatch):
+    """Kill-at-step-k contract, in process: a fresh module resuming
+    from the step-3 snapshot replays the remaining (epoch, nbatch, mse)
+    stream bit-for-bit against the uninterrupted run — params, momentum
+    buffers, optimizer counters, metric sums, RNG and the data cursor
+    all restored — without growing the fused trace cache."""
+    monkeypatch.setenv("MXNET_TPU_FUSED_STEP", "1")
+    ref = []
+    _fit(stream=ref)                                  # uninterrupted
+    assert len(ref) == 8
+
+    d = str(tmp_path / "snaps")
+    monkeypatch.setenv("MXNET_TPU_CKPT_DIR", d)
+    monkeypatch.setenv("MXNET_TPU_CKPT_EVERY_N_STEPS", "3")
+    monkeypatch.setenv("MXNET_TPU_CKPT_RESUME", "0")
+    s1 = []
+    _fit(stream=s1)
+    assert s1 == ref, "checkpointing perturbed the training stream"
+    _keep_only_step(d, 3)                 # pretend we died after step 3
+
+    monkeypatch.setenv("MXNET_TPU_CKPT_RESUME", "1")
+    monkeypatch.setenv("MXNET_TPU_CKPT_EVERY_N_STEPS", "0")
+    rec_before = telemetry.peek("step.fused_recompiles") or 0
+    s2 = []
+    mod2 = _fit(stream=s2)
+    rec_delta = (telemetry.peek("step.fused_recompiles") or 0) \
+        - rec_before
+    assert telemetry.peek("ckpt.restores") == 1
+    # snapshot was (epoch 0, nbatch 2): the resumed stream is exactly
+    # the uninterrupted stream after that point
+    assert s2 == [r for r in ref if (r[0], r[1]) > (0, 2)]
+    assert rec_delta == 1, \
+        "resume retraced the fused step (recompiles=%d)" % rec_delta
+    # and the final params equal the uninterrupted run's, bit for bit
+    ref_mod = _fit_no_ckpt_ref(monkeypatch)
+    a, _ = mod2.get_params()
+    b, _ = ref_mod.get_params()
+    for name in sorted(b):
+        assert np.array_equal(a[name].asnumpy(), b[name].asnumpy()), name
+
+
+def _fit_no_ckpt_ref(monkeypatch):
+    monkeypatch.delenv("MXNET_TPU_CKPT_DIR", raising=False)
+    mod = _fit()
+    return mod
+
+
+@pytest.mark.multichip
+def test_elastic_resume_different_dp(tmp_path, tel, monkeypatch):
+    """Elastic rejoin: a snapshot saved at dp=1 restores onto a dp=8
+    mesh as a re-shard (params/opt-state/accs are replicated), and the
+    post-resume stream matches the uninterrupted dp=8 run exactly —
+    the exact-arithmetic regime makes even the mean-psum reduction
+    order bit-transparent."""
+    monkeypatch.setenv("MXNET_TPU_FUSED_STEP", "1")
+    ref8 = []
+    _fit(dp=8, stream=ref8)
+
+    d = str(tmp_path / "snaps")
+    monkeypatch.setenv("MXNET_TPU_CKPT_DIR", d)
+    monkeypatch.setenv("MXNET_TPU_CKPT_EVERY_N_STEPS", "3")
+    monkeypatch.setenv("MXNET_TPU_CKPT_RESUME", "0")
+    _fit(dp=1)                                        # saved at dp=1
+    _keep_only_step(d, 3)
+    with open(os.path.join(d, ckpt.MANIFEST)) as f:
+        assert json.load(f)["snapshots"][0]["dp"] == 1
+
+    monkeypatch.setenv("MXNET_TPU_CKPT_RESUME", "1")
+    monkeypatch.setenv("MXNET_TPU_CKPT_EVERY_N_STEPS", "0")
+    s = []
+    _fit(dp=8, stream=s)                              # rejoin at dp=8
+    assert telemetry.peek("ckpt.restores") == 1
+    assert s == [r for r in ref8 if (r[0], r[1]) > (0, 2)]
+
+
+def test_restore_names_model_mismatch(tmp_path):
+    mod = _fit(nbatches=2, num_epoch=1)
+    payload = ckpt.snapshot(mod, step=1, epoch=0, nbatch=0)
+    payload["params"]["not_a_param"] = np.zeros((2, 2), np.float32)
+    with pytest.raises(ckpt.CheckpointError) as ei:
+        ckpt.restore(payload, mod)
+    assert "not_a_param" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM grace path
+# ---------------------------------------------------------------------------
+
+def test_preempt_mid_step_defers_to_boundary(tmp_path, tel, monkeypatch):
+    """A SIGTERM landing mid-step (donated packs torn) must defer:
+    the hook suppresses termination, step_end saves a 'preempt'
+    snapshot and only then re-delivers the signal."""
+    from mxnet_tpu import tracing
+
+    mod = _fit(nbatches=2, num_epoch=1)
+    monkeypatch.setenv("MXNET_TPU_CKPT_DIR", str(tmp_path / "snaps"))
+    monkeypatch.setenv("MXNET_TPU_CRASH_DIR", str(tmp_path / "crash"))
+    redelivered = []
+    monkeypatch.setattr(ckpt.CheckpointManager, "_reraise_sigterm",
+                        staticmethod(lambda: redelivered.append(True)))
+    man = ckpt.CheckpointManager(mod)
+    man.arm()
+    try:
+        man.step_begin()
+        os.kill(os.getpid(), signal.SIGTERM)   # synchronous delivery
+        assert man._exit_after_step, "mid-step SIGTERM did not defer"
+        man.step_end(0, 0)
+    finally:
+        man.disarm()
+        tracing.shutdown()
+    assert redelivered == [True], "SIGTERM was not re-delivered"
+    payload, entry = man.store.load_latest()
+    assert entry["reason"] == "preempt"
+    assert telemetry.peek("ckpt.preempt_saves") == 1
+    assert "fc1_weight" in payload["params"]
+
+
+@pytest.mark.slow
+def test_sigterm_grace_checkpoint_then_exit_subprocess(tmp_path):
+    """End to end in a real process: SIGTERM between steps triggers an
+    immediate preempt save and default termination; the relaunched job
+    auto-resumes from that snapshot and runs to completion."""
+    snaps = tmp_path / "snaps"
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "MXNET_TPU_FUSED_STEP": "1",
+        "MXNET_TPU_CKPT_DIR": str(snaps),
+        "MXNET_TPU_CKPT_EVERY_N_STEPS": "4",
+        "MXNET_TPU_CRASH_DIR": str(tmp_path / "crash"),
+        "T_DIR": str(tmp_path),
+    })
+    env.pop("MXNET_TPU_SANITIZE", None)
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "ckpt_train_child.py")
+
+    env["DIE_AT_STEP"] = "7"                          # epoch 1, batch 0
+    r = subprocess.run([sys.executable, script], env=env, timeout=240,
+                       capture_output=True, text=True)
+    assert r.returncode != 0, "child survived its own SIGTERM"
+    assert not (tmp_path / "completed").exists()
+    with open(snaps / ckpt.MANIFEST) as f:
+        last = json.load(f)["snapshots"][-1]
+    assert last["reason"] == "preempt", last
+    assert last["step"] == 7
+
+    env.pop("DIE_AT_STEP")
+    r = subprocess.run([sys.executable, script], env=env, timeout=240,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert (tmp_path / "completed").read_text() == "ok"
+    with open(snaps / ckpt.MANIFEST) as f:
+        last = json.load(f)["snapshots"][-1]
+    assert last["step"] == 12                         # ran to the end
+    # the resumed stream picks up exactly after the preempt point
+    lines = [l.split() for l in
+             (tmp_path / "stream.txt").read_text().splitlines()]
+    assert [tuple(map(int, l[:2])) for l in lines[7:9]] \
+        == [(1, 1), (1, 2)]

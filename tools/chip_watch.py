@@ -135,8 +135,42 @@ def _run(cmd, timeout_s, env_overrides=None, outfile=None,
 
 ARTIFACTS = ["BENCH_watch.json", ".bench_cache.json",
              ".bench_trace_summary.json", "MFU_EXPERIMENTS.jsonl",
-             "TPU_CONSISTENCY.txt", "XPROF_DEVICE_TIME.json",
+             "TPU_CONSISTENCY.txt", "TPU_CONSISTENCY_verdict.json",
+             "XPROF_DEVICE_TIME.json",
              "MULTICHIP_scaling.json", "SERVE_bench.json"]
+
+
+def tpu_consistency_verdict(out, stamp):
+    """Distill the sweep's final ``TPU_CONSISTENCY ok=N fail=M`` line
+    into a machine-checkable verdict row (TPU_CONSISTENCY_verdict.json)
+    so the hardware-truth gate is one jq away instead of a 400-line
+    scrape. INCOMPLETE-safe: a sweep that died before the summary (or
+    never saw a chip) still writes a row saying exactly that — a stale
+    verdict can't pass as this window's."""
+    row = {"stamp": stamp}
+    summary = None
+    for line in (out or "").splitlines():
+        if line.startswith("TPU_CONSISTENCY ok="):
+            summary = line.strip()
+    if summary is not None:
+        try:
+            parts = dict(p.split("=", 1) for p in summary.split()[1:])
+            row["ok"] = int(parts["ok"])
+            row["fail"] = int(parts["fail"])
+            row["verdict"] = "PASS" if row["fail"] == 0 else "FAIL"
+        except (ValueError, KeyError):
+            row["incomplete"] = "unparseable summary line: %s" % summary
+    elif out and "skipped: no accelerator" in out:
+        row["incomplete"] = "skipped: no accelerator in this window"
+    else:
+        row["incomplete"] = ("sweep died before the summary line "
+                             "(timeout/crash); any per-case lines are "
+                             "in TPU_CONSISTENCY.txt")
+    with open(os.path.join(REPO, "TPU_CONSISTENCY_verdict.json"),
+              "a") as f:
+        f.write(json.dumps(row, sort_keys=True) + "\n")
+    log("tpu_consistency verdict: %s"
+        % (row.get("verdict") or "INCOMPLETE (%s)" % row["incomplete"]))
 
 
 def xprof_device_time(stamp):
@@ -233,6 +267,23 @@ def fire():
          env_overrides={"MXNET_TPU_BENCH_INPUT": "1"},
          outfile="BENCH_watch.json")
     _commit("e2e input-fed bench", stamp)
+    # 2b. cache-fed e2e tier (hardware-truth gate, ROADMAP 5b): decode
+    # once into the on-disk uint8 cache, then feed the chip from it —
+    # the steady-state input path a resumed production run restarts on.
+    # INCOMPLETE contract as below: a wedged/crashed stage writes its
+    # own marker record so a stale number can't pass as this window's.
+    out = _run([py, os.path.join(REPO, "bench.py")], 3000,
+               env_overrides={"MXNET_TPU_BENCH_INPUT": "1",
+                              "MXNET_TPU_BENCH_CACHE": "1"},
+               outfile="BENCH_watch.json")
+    if out is None:
+        with open(os.path.join(REPO, "BENCH_watch.json"), "a") as f:
+            f.write(json.dumps(
+                {"metric": "e2e_cached_imgs_per_sec", "value": 0,
+                 "incomplete": "chip_watch e2e_cached stage timed out "
+                               "or crashed",
+                 "chip_watch_stamp": stamp}, sort_keys=True) + "\n")
+    _commit("e2e cache-fed bench", stamp)
     # 3. MFU experiments: all variants, then the latency-hiding flag
     mfu = os.path.join(REPO, "tools", "mfu_experiments.py")
     _run([py, mfu], 4000, outfile="MFU_EXPERIMENTS.jsonl")
@@ -253,9 +304,16 @@ def fire():
     # evidence is exactly what the artifact is for
     out = _run([py, os.path.join(REPO, "tools", "tpu_consistency.py")],
                3000, keep_output=True)
-    if out is not None:
-        with open(os.path.join(REPO, "TPU_CONSISTENCY.txt"), "a") as f:
+    with open(os.path.join(REPO, "TPU_CONSISTENCY.txt"), "a") as f:
+        if out is not None:
             f.write("== chip_watch %s ==\n%s" % (stamp, out))
+        else:
+            # crash before any case printed: the artifact still records
+            # that THIS window attempted the sweep and got nothing
+            f.write("== chip_watch %s ==\n[chip_watch] INCOMPLETE: "
+                    "sweep produced no output (crashed before any "
+                    "case)\n" % stamp)
+    tpu_consistency_verdict(out, stamp)
     _commit("op consistency sweep", stamp)
     # 5. op-category device-time table: profiler trace window merged
     # with the analytic xprof breakdown (INCOMPLETE-safe on its own)

@@ -161,6 +161,36 @@ def render_locks(telemetry):
     return "\n".join(out) + "\n" if out else ""
 
 
+def render_ckpt(telemetry):
+    """Preemption-safety counters (``ckpt.*``, fed by
+    mxnet_tpu/checkpoint.py) from a telemetry snapshot: snapshot
+    saves/bytes/latency, restores, SIGTERM grace saves, and torn files
+    skipped at load."""
+    ck = telemetry.get("ckpt")
+    if not isinstance(ck, dict):
+        return ""
+
+    def _n(key):
+        v = ck.get(key, 0)
+        if isinstance(v, dict):
+            v = v.get("_value", 0)
+        return v
+
+    counters = ("saves", "bytes", "restores", "preempt_saves",
+                "preempt_abandoned", "torn_skipped")
+    vals = {k: _n(k) for k in counters}
+    if not any(vals.values()):
+        return ""
+    out = ["checkpoint (ckpt.*):",
+           "  " + "  ".join("%s=%s" % (k, vals[k]) for k in counters)]
+    rows = [(n, s) for n, s in _hist_rows(ck.get("save_ms"))
+            if s.get("count", 0) > 0]
+    for _, s in rows:
+        out.append("  save_ms: mean=%.1f  p50=%.1f  p90=%.1f  max=%.1f"
+                   % (s["mean"], s["p50"], s["p90"], s["max"]))
+    return "\n".join(out) + "\n"
+
+
 # ---------------------------------------------------------------------------
 # xprof views (compile / ops / memory) over BENCH records
 # ---------------------------------------------------------------------------
@@ -549,9 +579,13 @@ def report_crash_dump(dump_dir, top=10):
     tel_path = os.path.join(dump_dir, "telemetry.json")
     if os.path.exists(tel_path):
         with open(tel_path) as f:
-            locks = render_locks(json.load(f))
+            tel = json.load(f)
+        locks = render_locks(tel)
         if locks:
             out.append(locks)
+        ckpt = render_ckpt(tel)
+        if ckpt:
+            out.append(ckpt)
     out.append(render_events(events))
     return "\n".join(out)
 
