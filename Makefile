@@ -68,6 +68,13 @@ multichip:
 serve-bench:
 	python bench.py serve
 
+# closed-loop kernel/config search: candidates compiled through the
+# xprof registry, pruned or timed, fenced rows into
+# MFU_EXPERIMENTS.jsonl, winners into .autotune_cache.json
+# -> AUTOTUNE_search.json (read it with trace_report --view tune)
+autotune:
+	python bench.py autotune
+
 # preemption-safety suite: crash-safe writes, torn-file detection,
 # bit-identical kill-at-step-k resume, elastic dp rejoin, SIGTERM grace
 ckpt-test:

@@ -205,6 +205,14 @@ def main():
         return _bench_serve()
     if "serve" in sys.argv[1:]:
         return _serve_main()
+    # the autotune tier: the closed-loop kernel/config search on the
+    # forced cpu mesh ("autotune" before the generic --smoke check so
+    # `bench.py autotune --smoke` routes here)
+    # graft: env-ok
+    if os.environ.get("MXNET_TPU_BENCH_AUTOTUNE"):
+        return _bench_autotune()
+    if "autotune" in sys.argv[1:]:
+        return _autotune_main()
     if "--smoke" in sys.argv[1:]:
         import argparse
 
@@ -716,6 +724,81 @@ def _serve_main():
             f.write("\n")
     except OSError:
         pass
+    print(json.dumps(result))
+    return result
+
+
+def _autotune_main():
+    """Orchestrator for ``bench.py autotune [--smoke]``: run the
+    closed-loop kernel/config search (mxnet_tpu/autotune.py) in a child
+    interpreter on the forced cpu backend, write the search summary to
+    AUTOTUNE_search.json, print the one JSON line. Like :func:`main` it
+    never imports jax itself."""
+    # graft: env-ok
+    timeout_s = int(os.environ.get("MXNET_TPU_BENCH_TIMEOUT", 1200))
+    env = {"JAX_PLATFORMS": "cpu", "MXNET_TPU_BENCH_AUTOTUNE": "1"}
+    # orchestrator side of the budget knob (never imports mxnet_tpu, so
+    # the read stays on os.environ): shrink the search for --smoke
+    # unless the operator pinned a budget
+    # graft: env-ok
+    pinned = os.environ.get("MXNET_TPU_AUTOTUNE_BUDGET_S")
+    if "--smoke" in sys.argv[1:] and not pinned:
+        env["MXNET_TPU_AUTOTUNE_BUDGET_S"] = "30"
+    result = _run_child(env, timeout_s)
+    if result is None:
+        result = {"metric": "autotune_speedup_vs_default", "value": 0,
+                  "unit": "x",
+                  "incomplete": "autotune bench child failed/timed out"}
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "AUTOTUNE_search.json")
+    try:
+        with open(out, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass
+    print(json.dumps(result))
+    return result
+
+
+def _bench_autotune():
+    """The measured autotune tier (inner child, forced-cpu mesh): the
+    bounded two-site search — the ``norm_act`` row-tile knob and the
+    ``conv_backward`` kernel choice — every candidate compiled through
+    the registry, pruned or timed, every row fenced through
+    mfu_experiments.validate() into MFU_EXPERIMENTS.jsonl, winners
+    persisted to the autotune cache. The summary is the proof the loop
+    closes: on the cpu interpreter the non-default norm_act row tile
+    wins, so ``non_default_winner`` must be true."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # graft: env-ok (same pre-import reapply as _bench)
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from mxnet_tpu import autotune, xprof
+
+    xprof.enable()
+    xprof.reset()
+    summary = autotune.run_smoke()
+    speedups = [r.get("speedup_vs_default") or 0.0
+                for r in summary["sites"].values()]
+    result = {"metric": "autotune_speedup_vs_default",
+              "value": max(speedups) if speedups else 0.0, "unit": "x",
+              "chip": summary["chip"],
+              "budget_s": summary["budget_s"],
+              "candidates": sum(r["candidates"]
+                                for r in summary["sites"].values()),
+              "pruned_preflight": sum(r["pruned_preflight"]
+                                      for r in summary["sites"].values()),
+              "pruned_inapplicable": sum(
+                  r["pruned_inapplicable"]
+                  for r in summary["sites"].values()),
+              "non_default_winner": summary["non_default_winner"],
+              "rows_written": summary["rows_written"],
+              "rows_refused": summary["rows_refused"],
+              "sites": summary["sites"],
+              "platform": jax.default_backend()}
     print(json.dumps(result))
     return result
 

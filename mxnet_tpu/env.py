@@ -453,6 +453,25 @@ declare("MXNET_TPU_NO_PALLAS", bool, False,
         "at every size, see docs/pallas.md; the kernels remain available "
         "explicitly via `ops.pallas_kernels`, `rtc`, ring/Ulysses "
         "attention.)", section="Native library / Pallas")
+declare("MXNET_TPU_PALLAS_CONV", bool, False,
+        "Force the Pallas conv-backward kernels (dgrad/wgrad as tiled "
+        "MXU matmuls, `ops.pallas_kernels.conv2d`) for every applicable "
+        "Convolution, bypassing the autotune cache — the pin/override "
+        "for a chip window (docs/performance.md). Misaligned shapes "
+        "still fall back to XLA per-layer.",
+        section="Native library / Pallas")
+
+_AT = "Autotuning"
+declare("MXNET_TPU_AUTOTUNE", bool, False,
+        "Consult the autotuner's best-config cache "
+        "(`.autotune_cache.json`, written by `bench.py autotune`) at "
+        "trace time: tuned kernel/tile choices apply to `ops/nn.py` and "
+        "the fused step with zero extra dispatches. Off: every site "
+        "keeps its measured default.", section=_AT)
+declare("MXNET_TPU_AUTOTUNE_BUDGET_S", float, 60.0,
+        "Wall-clock budget (seconds) for one `mxnet_tpu.autotune` "
+        "search; candidates past the budget are recorded as pruned "
+        "(`budget exhausted`), never silently skipped.", section=_AT)
 
 
 # ---------------------------------------------------------------------------

@@ -23,7 +23,9 @@ import numpy as np
 
 from .. import env as _env
 
-__all__ = ["fused_linear", "flash_attention", "pallas_available"]
+__all__ = ["fused_linear", "flash_attention", "pallas_available",
+           "conv2d", "conv_dgrad", "conv_wgrad", "conv_backward_applicable",
+           "fused_norm_act", "norm_act_applicable"]
 
 # float32 MXU-friendly tiles (sublane 8, lane 128)
 TILE_M = 128
@@ -282,3 +284,421 @@ def flash_attention(q, k, v, causal: bool = False,
 
     f.defvjp(f_fwd, f_bwd)
     return f(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# conv backward: dgrad + wgrad as MXU-shaped matmuls over im2col tiles
+# ---------------------------------------------------------------------------
+#
+# xprof's op-category breakdown pins the fused ResNet step on the conv
+# backward (ROADMAP item 1), which XLA lowers as transposed convs. Here
+# both halves become plain tiled matmuls — the shape the MXU actually
+# is — over im2col patches:
+#
+#   wgrad:  gw = patches(x)^T @ g      (K*K*C, N*HO*WO) x (N*HO*WO, O)
+#   dgrad:  dx = patches(g~) @ w~      (N*H*W, K*K*O)   x (K*K*O, C)
+#
+# where g~ is g stride-dilated + edge-padded and w~ the spatially
+# flipped, O<->C-swapped kernel (the standard transposed-conv algebra).
+# Patch extraction is a handful of strided slices XLA fuses into the
+# operand feed; the MXU work runs in the Pallas kernels below with
+# bf16-or-f32 inputs and f32 accumulation. Tile sizes are parameters —
+# the autotuner (mxnet_tpu/autotune.py) measures candidates per chip.
+
+_DEF_TILES = (128, 128, 128)
+
+
+def _tiles_ok(tiles) -> bool:
+    # both matmul kernels place every tile dimension on either the MXU
+    # lane axis (128) or a sublane axis fed from one; 128-multiples
+    # everywhere keep one rule valid for f32 and bf16 operand tiles
+    return (len(tiles) == 3
+            and all(t > 0 and t % 128 == 0 for t in tiles))
+
+
+def _matmul(a, b, tiles, transpose_a=False):
+    """Tiled matmul with f32 accumulation: ``a @ b`` or ``a.T @ b``.
+
+    ``transpose_a`` contracts on ``a``'s FIRST axis without ever
+    materializing the transpose — the wgrad shape (patches^T @ g) — so
+    the only data movement is the tile feed itself. Inputs may be bf16
+    (MXU-native) or f32; the accumulator and output are f32.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    tm, tn, tk = tiles
+    if transpose_a:
+        k, m = a.shape
+    else:
+        m, k = a.shape
+    _, n = b.shape
+    grid = (m // tm, n // tn, k // tk)
+    nk = grid[2]
+
+    def kernel(a_ref, b_ref, o_ref):
+        kk = pl.program_id(2)
+
+        @pl.when(kk == 0)
+        def _():
+            o_ref[:] = jnp.zeros_like(o_ref)
+        if transpose_a:
+            o_ref[:] += jax.lax.dot_general(
+                a_ref[:], b_ref[:], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        else:
+            o_ref[:] += jnp.dot(a_ref[:], b_ref[:],
+                                preferred_element_type=jnp.float32)
+
+    a_spec = (pl.BlockSpec((tk, tm), lambda i, j, kk: (kk, i))
+              if transpose_a
+              else pl.BlockSpec((tm, tk), lambda i, j, kk: (i, kk)))
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[a_spec,
+                  pl.BlockSpec((tk, tn), lambda i, j, kk: (kk, j))],
+        out_specs=pl.BlockSpec((tm, tn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
+        interpret=_interpret_mode(),
+    )(a, b)
+
+
+def _patches(x, kh, kw, stride):
+    """im2col over an already-padded NHWC tensor: (N, Hp, Wp, C) ->
+    (N*HO*WO, KH*KW*C), minor order (kh, kw, c) — the flattening of
+    ``w.transpose(2, 3, 1, 0)`` so the matmul contracts correctly."""
+    import jax
+    import jax.numpy as jnp
+
+    n, hp, wp, c = x.shape
+    sh, sw = stride
+    ho = (hp - kh) // sh + 1
+    wo = (wp - kw) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            cols.append(jax.lax.slice(
+                x, (0, i, j, 0),
+                (n, i + (ho - 1) * sh + 1, j + (wo - 1) * sw + 1, c),
+                (1, sh, sw, 1)))
+    p = jnp.stack(cols, axis=3)          # (N, HO, WO, KH*KW, C)
+    return p.reshape(n * ho * wo, kh * kw * c)
+
+
+def _cast_in(x, compute_dtype):
+    import jax.numpy as jnp
+
+    return x.astype(compute_dtype) if compute_dtype is not None \
+        and x.dtype != compute_dtype else x
+
+
+def conv_backward_applicable(x_shape, w_shape, stride, pad, dilate,
+                             num_group, tiles=_DEF_TILES) -> bool:
+    """Static (trace-time) applicability of the Pallas conv-backward
+    pair for a 2D conv. Every condition is a shape/param fact, so the
+    decision costs nothing per dispatch. ``x_shape`` is NHWC."""
+    if not pallas_available() or not _tiles_ok(tiles):
+        return False
+    if len(x_shape) != 4 or len(w_shape) != 4 or num_group != 1:
+        return False
+    if tuple(dilate) != (1, 1):
+        return False
+    n, h, w, c = x_shape
+    o, ci, kh, kw = w_shape
+    sh, sw = stride
+    ph, pw = pad
+    if ci != c or ph > kh - 1 or pw > kw - 1:
+        return False
+    if (h + 2 * ph - kh) % sh or (w + 2 * pw - kw) % sw:
+        return False   # dgrad's dilate+pad inversion is only exact here
+    ho = (h + 2 * ph - kh) // sh + 1
+    wo = (w + 2 * pw - kw) // sw + 1
+    tm, tn, tk = tiles
+    return not (n * h * w % tm or c % tn or kh * kw * o % tk      # dgrad
+                or kh * kw * c % tm or o % tn or n * ho * wo % tk  # wgrad
+                )
+
+
+def conv_dgrad(w, g, x_shape, stride, pad, tiles=_DEF_TILES,
+               compute_dtype=None):
+    """Input gradient of a 2D conv as one tiled matmul.
+
+    ``w`` OIHW, ``g`` NHWC output cotangent, ``x_shape`` the NHWC primal
+    shape. Returns dx (NHWC, primal dtype) or None when the shapes don't
+    tile. ``compute_dtype`` (e.g. bf16) casts the matmul operands; the
+    accumulator stays f32 either way.
+    """
+    if not pallas_available():
+        return None
+    import jax.numpy as jnp
+
+    n, h, wd, c = x_shape
+    o, _, kh, kw = w.shape
+    sh, sw = stride
+    ph, pw = pad
+    if not conv_backward_applicable(x_shape, w.shape, stride, pad,
+                                    (1, 1), 1, tiles):
+        return None
+    ho = (h + 2 * ph - kh) // sh + 1
+    wo = (wd + 2 * pw - kw) // sw + 1
+    if (sh, sw) != (1, 1):
+        gd = jnp.zeros((n, (ho - 1) * sh + 1, (wo - 1) * sw + 1, o),
+                       g.dtype)
+        gd = gd.at[:, ::sh, ::sw, :].set(g)
+    else:
+        gd = g
+    gp = jnp.pad(gd, ((0, 0), (kh - 1 - ph,) * 2, (kw - 1 - pw,) * 2,
+                      (0, 0)))
+    pat = _patches(gp, kh, kw, (1, 1))            # (N*H*W, KH*KW*O)
+    wt = w[:, :, ::-1, ::-1].transpose(2, 3, 0, 1).reshape(kh * kw * o, c)
+    dx = _matmul(_cast_in(pat, compute_dtype),
+                 _cast_in(wt, compute_dtype), tiles)
+    return dx.reshape(n, h, wd, c).astype(g.dtype)
+
+
+def conv_wgrad(x, g, w_shape, stride, pad, tiles=_DEF_TILES,
+               compute_dtype=None):
+    """Weight gradient of a 2D conv as one tiled ``patches^T @ g``
+    matmul (the transpose is folded into the kernel's tile feed, never
+    materialized). ``x``/``g`` NHWC, returns gw in OIHW, or None."""
+    if not pallas_available():
+        return None
+    import jax.numpy as jnp
+
+    o, c, kh, kw = w_shape
+    ph, pw = pad
+    if not conv_backward_applicable(x.shape, w_shape, stride, pad,
+                                    (1, 1), 1, tiles):
+        return None
+    xp = jnp.pad(x, ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    pat = _patches(xp, kh, kw, stride)            # (N*HO*WO, KH*KW*C)
+    gm = g.reshape(-1, o)
+    gw = _matmul(_cast_in(pat, compute_dtype),
+                 _cast_in(gm, compute_dtype), tiles, transpose_a=True)
+    return gw.reshape(kh, kw, c, o).transpose(3, 2, 0, 1).astype(g.dtype)
+
+
+def conv2d(x, w, bias=None, stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+           num_group=1, nhwc=False, tiles=_DEF_TILES,
+           compute_dtype=None):
+    """2D convolution whose *backward* runs the Pallas dgrad/wgrad
+    kernels. The forward stays ``lax.conv_general_dilated`` — XLA's
+    forward conv already saturates the MXU (docs/pallas.md policy); it
+    is the backward, which XLA lowers as transposed convs, that the
+    profile blames. Returns None when the kernels do not apply (shape
+    misalignment, groups, dilation) — callers keep the XLA path.
+    """
+    if not pallas_available():
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    x_nhwc_shape = x.shape if nhwc \
+        else (x.shape[0], x.shape[2], x.shape[3], x.shape[1])
+    if not conv_backward_applicable(x_nhwc_shape, w.shape, stride, pad,
+                                    dilate, num_group, tiles):
+        return None
+
+    dn = ("NHWC", "OIHW", "NHWC") if nhwc else ("NCHW", "OIHW", "NCHW")
+    pads = [(p, p) for p in pad]
+
+    def _fwd_conv(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=pads,
+            dimension_numbers=dn,
+            preferred_element_type=x.dtype
+            if x.dtype == jnp.float32 else None)
+
+    @jax.custom_vjp
+    def f(x, w):
+        return _fwd_conv(x, w)
+
+    def f_fwd(x, w):
+        return f(x, w), (x, w)
+
+    def f_bwd(res, g):
+        x, w = res
+        xh = x if nhwc else x.transpose(0, 2, 3, 1)
+        gh = g if nhwc else g.transpose(0, 2, 3, 1)
+        dx = conv_dgrad(w, gh, xh.shape, stride, pad, tiles,
+                        compute_dtype)
+        gw = conv_wgrad(xh, gh, w.shape, stride, pad, tiles,
+                        compute_dtype)
+        if dx is None or gw is None:  # pragma: no cover - pre-checked
+            _, vjp = jax.vjp(_fwd_conv, x, w)
+            return vjp(g)
+        if not nhwc:
+            dx = dx.transpose(0, 3, 1, 2)
+        return dx.astype(x.dtype), gw.astype(w.dtype)
+
+    f.defvjp(f_fwd, f_bwd)
+    out = f(x, w)
+    if bias is not None:
+        bshape = (1, 1, 1, -1) if nhwc else (1, -1, 1, 1)
+        out = out + bias.reshape(bshape)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fused norm + activation (BN scale/shift + ReLU, forward and backward)
+# ---------------------------------------------------------------------------
+#
+# BatchNorm's apply step is a per-channel scale/shift (the statistics
+# are folded beforehand, ops/nn.py); its backward in XLA re-reads the
+# activations twice (dx, then the per-channel reductions). Both
+# directions here are one VMEM pass each: forward computes
+# act(x*scale+shift) in f32; backward recomputes the pre-activation
+# (cheaper than storing the mask), masks the cotangent, and emits dx
+# plus the per-channel dscale/dshift partial sums in the same pass.
+
+NORM_BLOCK_ROWS = 128
+_NORM_BLOCK_C = 128
+
+
+def norm_act_applicable(shape, dtype, block_rows=NORM_BLOCK_ROWS) -> bool:
+    """Static applicability: channels-last tensor whose row count tiles
+    ``block_rows`` and whose channel count tiles the 128 lane axis."""
+    if not pallas_available():
+        return False
+    import jax.numpy as jnp
+
+    if len(shape) < 2 or block_rows <= 0 or block_rows % 8:
+        return False
+    if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),
+                                jnp.dtype(jnp.bfloat16)):
+        return False
+    c = shape[-1]
+    rows = 1
+    for d in shape[:-1]:
+        rows *= d
+    return not (rows % block_rows or c % _NORM_BLOCK_C)
+
+
+def _norm_act_fwd_call(x2, scale, shift, act, block_rows):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    r, c = x2.shape
+    grid = (r // block_rows, c // _NORM_BLOCK_C)
+
+    def kernel(x_ref, sc_ref, sh_ref, o_ref):
+        y = (x_ref[:].astype(jnp.float32) * sc_ref[:]
+             + sh_ref[:])
+        if act == "relu":
+            y = jnp.maximum(y, 0.0)
+        o_ref[:] = y.astype(o_ref.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, _NORM_BLOCK_C),
+                         lambda i, j: (i, j)),
+            pl.BlockSpec((1, _NORM_BLOCK_C), lambda i, j: (0, j)),
+            pl.BlockSpec((1, _NORM_BLOCK_C), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, _NORM_BLOCK_C),
+                               lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((r, c), x2.dtype),
+        interpret=_interpret_mode(),
+    )(x2, scale, shift)
+
+
+def _norm_act_bwd_call(x2, scale, shift, g2, act, block_rows):
+    """One pass: dx + per-channel dscale/dshift partials. The row-tile
+    axis is the LAST grid dimension so the (1, C) reduction outputs
+    accumulate sequentially across row tiles (same revisit rule as the
+    matmul K axis)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    r, c = x2.shape
+    grid = (c // _NORM_BLOCK_C, r // block_rows)
+
+    def kernel(x_ref, sc_ref, sh_ref, g_ref, dx_ref, dsc_ref, dsh_ref):
+        i = pl.program_id(1)
+
+        @pl.when(i == 0)
+        def _():
+            dsc_ref[:] = jnp.zeros_like(dsc_ref)
+            dsh_ref[:] = jnp.zeros_like(dsh_ref)
+        x = x_ref[:].astype(jnp.float32)
+        ge = g_ref[:].astype(jnp.float32)
+        if act == "relu":
+            pre = x * sc_ref[:] + sh_ref[:]
+            ge = jnp.where(pre > 0.0, ge, 0.0)
+        dx_ref[:] = (ge * sc_ref[:]).astype(dx_ref.dtype)
+        dsc_ref[:] += jnp.sum(ge * x, axis=0, keepdims=True)
+        dsh_ref[:] += jnp.sum(ge, axis=0, keepdims=True)
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_rows, _NORM_BLOCK_C),
+                         lambda j, i: (i, j)),
+            pl.BlockSpec((1, _NORM_BLOCK_C), lambda j, i: (0, j)),
+            pl.BlockSpec((1, _NORM_BLOCK_C), lambda j, i: (0, j)),
+            pl.BlockSpec((block_rows, _NORM_BLOCK_C),
+                         lambda j, i: (i, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_rows, _NORM_BLOCK_C),
+                         lambda j, i: (i, j)),
+            pl.BlockSpec((1, _NORM_BLOCK_C), lambda j, i: (0, j)),
+            pl.BlockSpec((1, _NORM_BLOCK_C), lambda j, i: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((r, c), g2.dtype),
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
+        ],
+        interpret=_interpret_mode(),
+    )(x2, scale, shift, g2)
+
+
+def fused_norm_act(x, scale, shift, act: str = "none",
+                   block_rows: int = NORM_BLOCK_ROWS):
+    """``act(x * scale + shift)`` with per-channel scale/shift over the
+    last (channels) axis, forward and backward each one fused kernel.
+
+    ``x`` is any-rank channels-last (bf16 or f32); ``scale``/``shift``
+    are per-channel vectors. Math runs in f32 regardless of input dtype
+    (bf16 compute, f32 accumulate); the output is cast back to
+    ``x.dtype``. Returns None when the kernel does not apply — callers
+    fall back to the XLA elementwise path. ``block_rows`` is the tuned
+    row-tile knob (site ``norm_act`` in mxnet_tpu/autotune.py).
+    """
+    if act not in ("none", "relu"):
+        return None
+    if not norm_act_applicable(x.shape, x.dtype, block_rows):
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    c = x.shape[-1]
+    sc = scale.astype(jnp.float32).reshape(1, c)
+    sh = shift.astype(jnp.float32).reshape(1, c)
+
+    @jax.custom_vjp
+    def f(x, sc, sh):
+        return _norm_act_fwd_call(x.reshape(-1, c), sc, sh, act,
+                                  block_rows).reshape(x.shape)
+
+    def f_fwd(x, sc, sh):
+        return f(x, sc, sh), (x, sc, sh)
+
+    def f_bwd(res, g):
+        x, sc, sh = res
+        dx, dsc, dsh = _norm_act_bwd_call(
+            x.reshape(-1, c), sc, sh, g.reshape(-1, c), act, block_rows)
+        return (dx.reshape(x.shape).astype(x.dtype),
+                dsc.reshape(sc.shape), dsh.reshape(sh.shape))
+
+    f.defvjp(f_fwd, f_bwd)
+    out = f(x, sc, sh)
+    return out

@@ -1,0 +1,225 @@
+"""Interpreter-mode parity for the Pallas conv-backward pair and the
+fused norm+activation kernel vs the lax reference: forward AND vjp, f32
+and bf16, stride-1 and stride-2 geometries, with the misaligned-shape
+fallback and the BatchNorm wiring (gradient chain through the traced
+batch statistics) pinned too."""
+import numpy as np
+import pytest
+
+from mxnet_tpu.ops import pallas_kernels as pk
+
+pytestmark = pytest.mark.skipif(not pk.pallas_available(),
+                                reason="pallas unavailable")
+
+
+def _jx():
+    import jax
+    return jax
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _ref_conv(x, w, stride, pad):
+    jax, jnp = _jx(), _jnp()
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=[(p, p) for p in pad],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32
+        if x.dtype == jnp.float32 else None)
+
+
+# 128-aligned geometries: N*H*W, C, O, KH*KW*O, KH*KW*C, N*HO*WO all
+# tile (the conv_backward_applicable conditions)
+GEOMS = [
+    ((2, 128, 8, 8), (128, 128, 3, 3), (1, 1), (1, 1)),
+    ((2, 128, 16, 16), (128, 128, 2, 2), (2, 2), (0, 0)),
+]
+
+
+@pytest.mark.parametrize("shape,wshape,stride,pad", GEOMS)
+@pytest.mark.parametrize("dt", ["float32", "bfloat16"])
+def test_conv2d_forward_and_vjp_parity(shape, wshape, stride, pad, dt):
+    jax, jnp = _jx(), _jnp()
+    dt = jnp.dtype(dt)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(*shape), dt)
+    w = jnp.asarray(rng.randn(*wshape) * 0.1, dt)
+    out = pk.conv2d(x, w, stride=stride, pad=pad)
+    assert out is not None, "kernel must apply to this geometry"
+    ref = _ref_conv(x, w, stride, pad)
+    f_rtol, f_atol = (2e-2, 1e-2) if dt == jnp.bfloat16 else (1e-5, 1e-4)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=f_rtol, atol=f_atol)
+
+    g = jnp.asarray(rng.randn(*ref.shape), dt)
+
+    def loss_p(x, w):
+        return (pk.conv2d(x, w, stride=stride, pad=pad) * g).sum()
+
+    def loss_r(x, w):
+        return (_ref_conv(x, w, stride, pad) * g).sum()
+
+    dxp, dwp = jax.grad(loss_p, (0, 1))(x, w)
+    dxr, dwr = jax.grad(loss_r, (0, 1))(x, w)
+    rtol, atol = (3e-2, 3e-1) if dt == jnp.bfloat16 else (1e-4, 1e-3)
+    np.testing.assert_allclose(np.asarray(dxp, np.float32),
+                               np.asarray(dxr, np.float32),
+                               rtol=rtol, atol=atol)
+    np.testing.assert_allclose(np.asarray(dwp, np.float32),
+                               np.asarray(dwr, np.float32),
+                               rtol=rtol, atol=atol)
+
+
+def test_conv2d_bf16_compute_dtype_backward():
+    """The bf16-operand / f32-accumulate path: casting the backward
+    matmul operands must stay within bf16 tolerance of the f32 vjp."""
+    jax, jnp = _jx(), _jnp()
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 128, 8, 8), jnp.float32)
+    w = jnp.asarray(rng.randn(128, 128, 3, 3) * 0.1, jnp.float32)
+    g = jnp.asarray(rng.randn(2, 128, 8, 8), jnp.float32)
+
+    def loss(x, w):
+        out = pk.conv2d(x, w, stride=(1, 1), pad=(1, 1),
+                        compute_dtype=jnp.bfloat16)
+        return (out * g).sum()
+
+    def loss_r(x, w):
+        return (_ref_conv(x, w, (1, 1), (1, 1)) * g).sum()
+
+    dxp, dwp = jax.grad(loss, (0, 1))(x, w)
+    dxr, dwr = jax.grad(loss_r, (0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(dxp), np.asarray(dxr),
+                               rtol=3e-2, atol=3e-1)
+    np.testing.assert_allclose(np.asarray(dwp), np.asarray(dwr),
+                               rtol=3e-2, atol=3e-1)
+
+
+def test_conv2d_fallback_on_misaligned_and_grouped():
+    jnp = _jnp()
+    # channel count 7: no tile covers it
+    assert pk.conv2d(jnp.zeros((2, 7, 8, 8)), jnp.zeros((7, 7, 3, 3)),
+                     stride=(1, 1), pad=(1, 1)) is None
+    # grouped conv is out of scope by design
+    assert pk.conv2d(jnp.zeros((2, 128, 8, 8)),
+                     jnp.zeros((128, 64, 3, 3)),
+                     stride=(1, 1), pad=(1, 1), num_group=2) is None
+    # pad > k-1 breaks the dgrad pad inversion
+    assert not pk.conv_backward_applicable(
+        (2, 8, 8, 128), (128, 128, 3, 3), (1, 1), (3, 3), (1, 1), 1)
+    # inexact stride: (8 + 0 - 3) % 2 != 0
+    assert not pk.conv_backward_applicable(
+        (2, 8, 8, 128), (128, 128, 3, 3), (2, 2), (0, 0), (1, 1), 1)
+
+
+@pytest.mark.parametrize("dt", ["float32", "bfloat16"])
+@pytest.mark.parametrize("act", ["none", "relu"])
+def test_fused_norm_act_parity(dt, act):
+    jax, jnp = _jx(), _jnp()
+    dt = jnp.dtype(dt)
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(256, 128), dt)
+    sc = jnp.asarray(rng.randn(128) * 0.5 + 1.0, jnp.float32)
+    sh = jnp.asarray(rng.randn(128) * 0.1, jnp.float32)
+
+    def ref(x, sc, sh):
+        y = x.astype(jnp.float32) * sc + sh
+        if act == "relu":
+            y = jnp.maximum(y, 0.0)
+        return y.astype(x.dtype)
+
+    out = pk.fused_norm_act(x, sc, sh, act=act)
+    assert out is not None
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref(x, sc, sh), np.float32),
+                               rtol=2e-2, atol=1e-2)
+
+    g = jnp.asarray(rng.randn(256, 128), dt)
+
+    def lp(x, sc, sh):
+        return (pk.fused_norm_act(x, sc, sh, act=act) * g).sum()
+
+    def lr(x, sc, sh):
+        return (ref(x, sc, sh) * g).sum()
+
+    gp = jax.grad(lp, (0, 1, 2))(x, sc, sh)
+    gr = jax.grad(lr, (0, 1, 2))(x, sc, sh)
+    atol = 3e-1 if dt == jnp.bfloat16 else 1e-3
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=3e-2, atol=atol)
+
+
+def test_fused_norm_act_block_rows_is_semantics_free():
+    """block_rows is the autotune knob: every legal value must produce
+    bit-identical output, or the tuner would be changing numerics."""
+    jnp = _jnp()
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(512, 128), jnp.float32)
+    sc = jnp.asarray(rng.rand(128) + 0.5, jnp.float32)
+    sh = jnp.asarray(rng.randn(128), jnp.float32)
+    o1 = pk.fused_norm_act(x, sc, sh, act="relu", block_rows=128)
+    o2 = pk.fused_norm_act(x, sc, sh, act="relu", block_rows=256)
+    o3 = pk.fused_norm_act(x, sc, sh, act="relu", block_rows=512)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o3))
+
+
+def test_fused_norm_act_fallback():
+    jnp = _jnp()
+    # 100 rows don't tile 128
+    assert pk.fused_norm_act(jnp.zeros((100, 128)), jnp.ones((128,)),
+                             jnp.zeros((128,))) is None
+    # unsupported activation
+    assert pk.fused_norm_act(jnp.zeros((256, 128)), jnp.ones((128,)),
+                             jnp.zeros((128,)), act="tanh") is None
+
+
+def test_batchnorm_fused_path_parity(monkeypatch, tmp_path):
+    """The ops/nn.py wiring: a channels-last BatchNorm with an autotune
+    cache hit must produce the same forward and the same data/gamma/beta
+    gradients (the scale/shift cotangents chain through the traced batch
+    statistics) as the XLA elementwise path."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autotune
+    from mxnet_tpu import symbol as sym
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 8, 8, 128).astype(np.float32)
+    gamma = (rng.rand(128) + 0.5).astype(np.float32)
+    beta = rng.randn(128).astype(np.float32)
+
+    def run():
+        s = sym.BatchNorm(sym.Variable("data"), axis=-1,
+                          fix_gamma=False, name="bn")
+        args = {"data": mx.nd.array(x), "bn_gamma": mx.nd.array(gamma),
+                "bn_beta": mx.nd.array(beta)}
+        grads = {k: mx.nd.zeros(v.shape) for k, v in args.items()}
+        aux = {"bn_moving_mean": mx.nd.zeros((128,)),
+               "bn_moving_var": mx.nd.ones((128,))}
+        ex = s.bind(mx.cpu(), args, args_grad=grads, grad_req="write",
+                    aux_states=aux)
+        ex.forward(is_train=True)
+        ex.backward([mx.nd.ones(x.shape)])
+        return (ex.outputs[0].asnumpy(),
+                {k: g.asnumpy() for k, g in grads.items()})
+
+    out_ref, g_ref = run()
+
+    cachep = str(tmp_path / "cache.json")
+    autotune.save_best("norm_act", {"block_rows": 128},
+                       chip=autotune._chip_kind(), path=cachep)
+    monkeypatch.setenv("MXNET_TPU_AUTOTUNE", "1")
+    monkeypatch.setattr(autotune, "CACHE_FILE", cachep)
+    monkeypatch.setattr(autotune, "_cache_memo", None)
+    assert autotune.norm_block_rows() == 128
+    out_f, g_f = run()
+    np.testing.assert_allclose(out_f, out_ref, rtol=1e-5, atol=1e-5)
+    for k in g_ref:
+        np.testing.assert_allclose(g_f[k], g_ref[k],
+                                   rtol=1e-4, atol=1e-4, err_msg=k)

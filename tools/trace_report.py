@@ -258,6 +258,82 @@ def _table(rows):
     return out
 
 
+def _strike(s):
+    """Strike-through via the unicode combining long stroke: invalid
+    rows stay visible in the table (the fence's whole point is that
+    bad measurements are shown refuted, not silently dropped)."""
+    return "".join(ch + "̶" for ch in s)
+
+
+def load_tune_rows(path):
+    """Autotuner rows from MFU_EXPERIMENTS.jsonl: the lines written by
+    mxnet_tpu/autotune.py (``experiment: autotune:<site>:<cand>``).
+    Unparseable lines are skipped, same contract as load_records."""
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and rec.get("site") \
+                        and str(rec.get("experiment",
+                                        "")).startswith("autotune:"):
+                    rows.append(rec)
+    except OSError:
+        pass
+    return rows
+
+
+def render_tune(rows):
+    """Winners/losers table per autotune site: candidate, config,
+    measured step time, analytic MFU, and the status column (BEST /
+    prune reason). Rows the validate() gate rejects render
+    struck-through with the reason — never dropped."""
+    if not rows:
+        return ("no autotune rows (run `python bench.py autotune "
+                "[--smoke]` to populate MFU_EXPERIMENTS.jsonl)\n")
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from mfu_experiments import validate
+    except Exception:   # numpy-less box: trust the stored tags
+        def validate(row):
+            return None
+    out = []
+    for site in sorted({r["site"] for r in rows}):
+        srows = [r for r in rows if r["site"] == site]
+        out.append("site %s (%d candidates)" % (site, len(srows)))
+        table = [("candidate", "config", "step_ms", "mfu_pct", "status")]
+        for r in srows:
+            step = ("%.3f" % r["step_time_ms"]
+                    if r.get("step_time_ms") is not None else "-")
+            mfu = ("%.2f" % r["analytic_mfu_pct"]
+                   if r.get("analytic_mfu_pct") is not None else "-")
+            if r.get("pruned"):
+                status = "pruned: %s" % r["pruned"]
+            elif r.get("best"):
+                status = "BEST"
+            else:
+                status = ""
+            cells = (str(r.get("candidate", "?")),
+                     json.dumps(r.get("config", {}), sort_keys=True),
+                     step, mfu, status)
+            reason = validate(r)
+            if reason is None and r.get("valid") is False:
+                reason = r.get("invalid_reason") or "tagged invalid"
+            if reason:
+                cells = tuple(_strike(c) for c in cells[:4]) \
+                    + ("INVALID: %s" % reason,)
+            table.append(cells)
+        out.extend(_table(table))
+        out.append("")
+    return "\n".join(out) + "\n"
+
+
 def render_bench_summary(rec):
     """The one-line "analytic vs measured MFU, gap attributed to
     <category>" headline for the top of the bench report."""
@@ -599,11 +675,13 @@ def main(argv=None):
                    help="slowest steps to show (default 10)")
     p.add_argument("--view", default="steps",
                    choices=("steps", "compile", "ops", "memory", "bench",
-                            "serve"),
+                            "serve", "tune"),
                    help="steps (default): slowest-step trace table; "
                         "compile/ops/memory/bench: xprof views over a "
                         "BENCH record file; serve: latency decomposition "
-                        "+ load sweep over a SERVE_bench.json record")
+                        "+ load sweep over a SERVE_bench.json record; "
+                        "tune: autotuner winners/losers per site from "
+                        "MFU_EXPERIMENTS.jsonl")
     p.add_argument("--profile-report", action="store_true",
                    help="auto-discover the newest BENCH / chip_watch "
                         "artifacts in the repo root and render the "
@@ -614,6 +692,10 @@ def main(argv=None):
         return 0
     if a.path is None:
         p.error("path is required unless --profile-report is given")
+    if a.view == "tune":
+        rows = load_tune_rows(a.path)
+        sys.stdout.write(render_tune(rows))
+        return 0 if rows else 1
     if a.view == "serve":
         rec = latest_serve_record(load_bench_records(a.path))
         if rec is None:
