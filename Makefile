@@ -75,6 +75,13 @@ serve-bench:
 autotune:
 	python bench.py autotune
 
+# fault-tolerant serving fleet: goodput vs replica count, a replica
+# killed mid-load (zero client-visible errors, measured recovery
+# window), rolling param-swap purity with torn_swap armed
+# -> FLEET_bench.json (read it with trace_report --view fleet)
+fleet-bench:
+	python bench.py fleet
+
 # preemption-safety suite: crash-safe writes, torn-file detection,
 # bit-identical kill-at-step-k resume, elastic dp rejoin, SIGTERM grace
 ckpt-test:
@@ -83,4 +90,4 @@ ckpt-test:
 clean:
 	rm -rf mxnet_tpu/_native perl-package/blib
 
-.PHONY: all predict perl test lint profile-report multichip serve-bench ckpt-test clean
+.PHONY: all predict perl test lint profile-report multichip serve-bench fleet-bench ckpt-test clean

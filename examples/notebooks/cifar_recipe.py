@@ -92,9 +92,9 @@ def main():
     assert glob.glob(prefix + "-symbol.json"), "no symbol checkpoint"
     assert glob.glob(prefix + "-000*.params"), "no param checkpoints"
 
-    # resume from epoch 3 and continue to 8 (the notebook's resume cell)
+    # resume from epoch 3 and continue to 10 (the notebook's resume cell)
     resumed = mx.model.FeedForward.load(prefix, 3, ctx=mx.cpu(),
-                                        num_epoch=8, optimizer="adam",
+                                        num_epoch=10, optimizer="adam",
                                         learning_rate=0.005)
     train, val = iters()
     resumed.fit(X=train, eval_data=val)   # resumes at begin_epoch=3 from load()

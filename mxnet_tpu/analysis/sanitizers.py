@@ -420,7 +420,11 @@ class DeadlockWatchdog:
                 continue
             stalled = now - last_change
             if stalled >= self._threshold and not tripped:
+                # dump BEFORE publishing the trip: observers poll
+                # `trips` and react (releasing the very threads the
+                # dump is meant to capture), so the count must imply
+                # the dump is already on disk
                 tripped = True
-                self.trips += 1
-                record_trip("deadlock")
                 self.last_dump = self._dump(stalled, cur)
+                record_trip("deadlock")
+                self.trips += 1

@@ -410,6 +410,67 @@ declare("MXNET_TPU_SERVE_SLO_MS", float, 0.0,
         "through the step-trace detectors. `0` disables SLO "
         "enforcement (latency is still measured).", section=_S)
 
+_F = "Fleet / fault injection"
+declare("MXNET_TPU_FLEET_REPLICAS", int, 2,
+        "Default replica count for a `fleet.FleetRouter` when the "
+        "caller does not pass `n_replicas`. Autoscaling (when enabled) "
+        "moves the live count between `MXNET_TPU_FLEET_MIN_REPLICAS` "
+        "and `MXNET_TPU_FLEET_MAX_REPLICAS`.", section=_F)
+declare("MXNET_TPU_FLEET_MIN_REPLICAS", int, 1,
+        "Lower bound the fleet autoscaler will drain down to when every "
+        "replica has been healthy for the scale-down patience window.",
+        section=_F)
+declare("MXNET_TPU_FLEET_MAX_REPLICAS", int, 4,
+        "Upper bound the fleet autoscaler will grow to while replicas "
+        "report a degraded `/healthz` (SLO probe failing).", section=_F)
+declare("MXNET_TPU_FLEET_DEADLINE_MS", float, 2000.0,
+        "Total per-request deadline budget across every retry and "
+        "hedge the router makes. Attempt timeouts, backoff sleeps and "
+        "hedge waits are all clamped to the remaining budget, so the "
+        "caller never waits longer than this.", section=_F)
+declare("MXNET_TPU_FLEET_ATTEMPT_TIMEOUT_MS", float, 500.0,
+        "Per-attempt timeout: how long the router waits on one replica "
+        "before counting the attempt failed and retrying elsewhere "
+        "(clamped to the remaining deadline budget).", section=_F)
+declare("MXNET_TPU_FLEET_RETRIES", int, 4,
+        "Maximum attempts per request (first try + retries). Each "
+        "failed attempt records a breaker failure on its replica and "
+        "backs off exponentially with jitter before the next.",
+        section=_F)
+declare("MXNET_TPU_FLEET_BACKOFF_MS", float, 5.0,
+        "Base of the exponential retry backoff: attempt `k` sleeps "
+        "uniformly in `[base*2^k/2, base*2^k)` ms (full jitter halves "
+        "synchronized retry storms), clamped to the remaining deadline "
+        "budget.", section=_F)
+declare("MXNET_TPU_FLEET_HEDGE", bool, False,
+        "Tail-latency hedging: when an attempt is still pending at the "
+        "router's observed p95, send a duplicate (same request-id, so "
+        "the replica tier dedupes) to a second replica and take "
+        "whichever answers first; the loser is abandoned and counted "
+        "(`fleet.hedges`, `fleet.hedge_wins`).", section=_F)
+declare("MXNET_TPU_FLEET_BREAKER_FAILS", int, 3,
+        "Consecutive failures that trip a replica's circuit breaker "
+        "from closed to open (load sheds to healthy peers).", section=_F)
+declare("MXNET_TPU_FLEET_BREAKER_COOLDOWN_MS", float, 500.0,
+        "How long an open breaker sheds load before letting one "
+        "half-open probe request through; the probe's success closes "
+        "the breaker, its failure re-opens it for another cooldown.",
+        section=_F)
+declare("MXNET_TPU_FAULTS", str, "",
+        "Arm the typed fault-injection registry (`mxnet_tpu/faults.py`) "
+        "with a comma list of `name` or `name:rate` entries, rate in "
+        "[0,1] (default 1). Names: `replica_crash`, `slow_replica`, "
+        "`drop_response`, `torn_swap`; anything else fails fast at "
+        "parse. Unset: injection code is a single None-check in the "
+        "hot path.", section=_F)
+declare("MXNET_TPU_FAULTS_SEED", int, 0,
+        "Seed for the fault plan's RNG: every injection decision draws "
+        "from one seeded stream, so a chaos run replays bit-identically.",
+        section=_F)
+declare("MXNET_TPU_FAULT_SLOW_MS", float, 50.0,
+        "Injected latency (ms) each time a `slow_replica` fault fires "
+        "in the batcher's dispatch path.", section=_F)
+
 _C = "Checkpointing"
 declare("MXNET_TPU_CKPT_DIR", str, "",
         "Directory for step-granularity full-state training snapshots "

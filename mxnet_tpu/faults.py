@@ -1,0 +1,152 @@
+"""Typed fault-injection registry for the serving fleet.
+
+Robustness claims in this repo are proven by injected faults, not
+asserted (PRs 6-11 set the pattern: sanitizer trips, torn checkpoints,
+preemption drills). This module is the serving tier's fault plane: a
+*typed* registry parsed from ``MXNET_TPU_FAULTS`` — unknown fault names
+fail fast at parse time instead of silently injecting nothing — threaded
+through the serving/fleet hot paths at effectively zero cost when
+disabled (one module-global ``None`` check, the same idiom as
+``telemetry._ENABLED``).
+
+Fault kinds (comma list, each ``name`` or ``name:rate`` with rate in
+[0, 1], default 1.0):
+
+* ``replica_crash`` — a replica dies on request intake: subprocess
+  replicas hard-exit (``os._exit``), in-process replicas drop dead and
+  refuse the request. Exercises the router's crash detection, retry,
+  and respawn paths.
+* ``slow_replica`` — the batcher sleeps ``MXNET_TPU_FAULT_SLOW_MS``
+  before dispatch. Exercises hedging and the SLO/degraded signal.
+* ``drop_response`` — a gathered batch is abandoned before dispatch:
+  the work is never completed and callers see a timeout, exactly like
+  a response lost on the wire. Exercises deadline-budgeted retries.
+* ``torn_swap`` — ``refresh_params`` becomes non-atomic: the param
+  pack is swapped in two halves with a sleep in between, so a request
+  dispatched inside the window would see mixed-version weights.
+  Exercises the fleet's drain-then-swap rolling update, which must
+  mask the window entirely.
+
+Injection decisions come from one seeded ``random.Random``
+(``MXNET_TPU_FAULTS_SEED``) behind a lock, so a chaos run is
+reproducible; every fired fault counts ``faults.injected.<name>``.
+
+>>> plan = FaultPlan("slow_replica:0.5,replica_crash")
+>>> sorted(plan.rates)
+['replica_crash', 'slow_replica']
+>>> plan.rates["replica_crash"]
+1.0
+"""
+from __future__ import annotations
+
+import logging
+import random
+import threading
+from typing import Dict, Optional
+
+from . import env as _env
+from . import telemetry as _tel
+from .base import MXNetError
+
+__all__ = ["FAULTS", "FaultPlan", "configure", "reload", "active",
+           "fires", "slow_ms"]
+
+_log = logging.getLogger(__name__)
+
+#: The typed registry: the only fault names MXNET_TPU_FAULTS accepts.
+FAULTS = ("replica_crash", "slow_replica", "drop_response", "torn_swap")
+
+
+class FaultPlan:
+    """A parsed ``MXNET_TPU_FAULTS`` spec: per-fault Bernoulli rates
+    drawn from one seeded RNG, with per-fault fired counts."""
+
+    def __init__(self, spec: str, seed: int = 0, slow_ms: float = 50.0):
+        self.rates: Dict[str, float] = {}
+        for part in str(spec).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, rate_s = part.partition(":")
+            name = name.strip()
+            if name not in FAULTS:
+                raise MXNetError(
+                    "unknown fault %r in MXNET_TPU_FAULTS=%r; the typed "
+                    "registry accepts %s" % (name, spec, ", ".join(FAULTS)))
+            try:
+                rate = float(rate_s) if rate_s else 1.0
+            except ValueError:
+                raise MXNetError("fault rate %r for %r is not a float"
+                                 % (rate_s, name))
+            if not 0.0 <= rate <= 1.0:
+                raise MXNetError("fault rate %r for %r is outside [0, 1]"
+                                 % (rate, name))
+            self.rates[name] = rate
+        self.seed = int(seed)
+        self.slow_ms = float(slow_ms)
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+        self.injected: Dict[str, int] = {}
+
+    def fires(self, name: str) -> bool:
+        rate = self.rates.get(name)
+        if not rate:
+            return False
+        with self._lock:
+            hit = rate >= 1.0 or self._rng.random() < rate
+            if hit:
+                self.injected[name] = self.injected.get(name, 0) + 1
+        if hit:
+            _tel.inc("faults.injected.%s" % name)
+            _log.debug("fault injected: %s", name)
+        return hit
+
+
+# The live plan. None == faults disabled == the hot-path check is one
+# global load + None test (zero-cost idiom, see telemetry._ENABLED).
+_PLAN: Optional[FaultPlan] = None
+
+
+def configure(spec: Optional[str], seed: Optional[int] = None,
+              slow_ms: Optional[float] = None) -> Optional[FaultPlan]:
+    """Install a fault plan programmatically (tests); ``None``/empty
+    spec disarms. Returns the installed plan (or None)."""
+    global _PLAN
+    if not spec:
+        _PLAN = None
+        return None
+    _PLAN = FaultPlan(
+        spec,
+        seed=_env.get("MXNET_TPU_FAULTS_SEED") if seed is None else seed,
+        slow_ms=(_env.get("MXNET_TPU_FAULT_SLOW_MS")
+                 if slow_ms is None else slow_ms))
+    if _PLAN.rates:
+        _log.warning("fault injection ARMED: %s (seed=%d)",
+                     ",".join(sorted(_PLAN.rates)), _PLAN.seed)
+    return _PLAN
+
+
+def reload() -> Optional[FaultPlan]:
+    """(Re)parse MXNET_TPU_FAULTS from the environment. Called once at
+    import; tests that monkeypatch the env call it again."""
+    return configure(_env.get("MXNET_TPU_FAULTS"))
+
+
+def active() -> bool:
+    return _PLAN is not None
+
+
+def fires(name: str) -> bool:
+    """True when fault ``name`` should inject right now. The disabled
+    path is one global read + None check."""
+    plan = _PLAN
+    return plan is not None and plan.fires(name)
+
+
+def slow_ms() -> float:
+    """Injected latency for a fired ``slow_replica``, in ms."""
+    plan = _PLAN
+    return plan.slow_ms if plan is not None else 0.0
+
+
+reload()

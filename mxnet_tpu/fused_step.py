@@ -603,6 +603,11 @@ class FusedInfer:
     ``fused_infer`` site proves it at the compile registry).
     """
 
+    #: Retry-safety contract: a dispatch donates nothing and mutates no
+    #: state, so serving a duplicate (hedged/retried) request twice is
+    #: harmless — the scheduler's request-id dedup keys off this tag.
+    idempotent = True
+
     def __init__(self, executor, data_names, top_k=0, mesh=None):
         from .base import MXNetError
 
@@ -651,9 +656,17 @@ class FusedInfer:
         return NamedSharding(
             self._mesh, PartitionSpec(*(("dp",) + (None,) * (ndim - 1))))
 
-    def refresh_params(self):
+    def refresh_params(self, torn_ms: float = 0.0):
         """(Re)pack the non-data args + aux states, replicated across
-        the mesh when sharded serving is on. Call after set_params."""
+        the mesh when sharded serving is on. Call after set_params.
+
+        ``torn_ms > 0`` (the ``torn_swap`` injected fault) makes the
+        swap deliberately non-atomic: half the new pack lands, then a
+        sleep of ``torn_ms``, then the rest — a dispatch inside that
+        window reads mixed param versions. Serving callers must drain
+        the replica first; the fleet's rolling swap does."""
+        import time as _time
+
         import jax
 
         ex = self._ex
@@ -662,9 +675,19 @@ class FusedInfer:
             def place(v):
                 return jax.device_put(v, rep) if rep is not None else v
 
-            self._param_vals = [place(ex.arg_arrays[i]._data)
-                                for i in self._p_idx]
-            self._aux_vals = [place(a._data) for a in ex.aux_arrays]
+            new_params = [place(ex.arg_arrays[i]._data)
+                          for i in self._p_idx]
+            new_aux = [place(a._data) for a in ex.aux_arrays]
+        if torn_ms > 0 and self._param_vals is not None and new_params:
+            half = max(1, len(new_params) // 2)
+            self._param_vals = (new_params[:half]
+                                + self._param_vals[half:])
+            _time.sleep(torn_ms / 1e3)
+            self._param_vals = new_params
+            self._aux_vals = new_aux
+            return
+        self._param_vals = new_params
+        self._aux_vals = new_aux
 
     def place_batch(self, arrays):
         """Device-place one request batch (numpy or jax arrays), batch

@@ -828,6 +828,21 @@ class RequestStager:
 
     def __init__(self, place=None):
         self._place = place
+        # pad rows are always zeros of a ladder shape: cache one
+        # template per (rows, tail-shape, dtype) instead of allocating
+        # a fresh zero block on every under-full dispatch — under a
+        # fleet every replica batcher pays this on the hot path
+        self._pad_cache: dict = {}
+
+    def _pad_rows(self, pad: int, shape: tuple, dtype) -> np.ndarray:
+        key = (pad, shape, np.dtype(dtype).str)
+        block = self._pad_cache.get(key)
+        if block is None:
+            block = np.zeros((pad,) + shape, dtype)
+            if len(self._pad_cache) >= 64:   # ladder shapes are few;
+                self._pad_cache.clear()      # runaway keys mean abuse
+            self._pad_cache[key] = block
+        return block
 
     def stage(self, rows: Sequence[Sequence[np.ndarray]], bucket: int):
         """``rows`` is one payload tuple per queued request (arrays of
@@ -846,7 +861,7 @@ class RequestStager:
         pad = bucket - n
         if pad:
             batch = [np.concatenate(
-                [b, np.zeros((pad,) + b.shape[1:], b.dtype)], axis=0)
+                [b, self._pad_rows(pad, b.shape[1:], b.dtype)], axis=0)
                 for b in batch]
         placed = self._place(batch) if self._place is not None else batch
         _tel.observe("serve.h2d_ms", (time.perf_counter() - t0) * 1e3)

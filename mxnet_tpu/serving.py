@@ -38,15 +38,18 @@ latency, mean batch occupancy).
 """
 from __future__ import annotations
 
+import collections
 import logging
 import queue as _queue
 import threading
 import time
+import uuid
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from . import env as _env
+from . import faults as _faults
 from . import telemetry as _tel
 from . import tracing as _tracing
 from .base import MXNetError
@@ -89,12 +92,19 @@ def bucket_ladder(max_batch: int, dp: int = 1,
 class Request:
     """One in-flight inference request: the payload arrays (one per
     data name, leading axis = rows, normally 1) plus the completion
-    event the scheduler signals once results (or an error) land."""
+    event the scheduler signals once results (or an error) land.
+
+    Every request carries a stable ``request_id`` (caller-provided or
+    a fresh uuid): a hedged or retried duplicate re-submitted with the
+    same id is deduped at the scheduler instead of dispatched twice —
+    safe because the ``FusedInfer`` dispatch is idempotent (nothing
+    donated, no state mutated)."""
 
     __slots__ = ("arrays", "rows", "t_enq", "_done", "result", "error",
-                 "queue_ms", "latency_ms")
+                 "queue_ms", "latency_ms", "request_id")
 
-    def __init__(self, arrays: Sequence[np.ndarray]):
+    def __init__(self, arrays: Sequence[np.ndarray],
+                 request_id: Optional[str] = None):
         self.arrays = [np.asarray(a) for a in arrays]
         self.rows = int(self.arrays[0].shape[0])
         self.t_enq = time.perf_counter()
@@ -103,6 +113,7 @@ class Request:
         self.error: Optional[BaseException] = None
         self.queue_ms = 0.0
         self.latency_ms = 0.0
+        self.request_id = request_id or uuid.uuid4().hex
 
     def get(self, timeout: Optional[float] = None) -> List[np.ndarray]:
         """Block until the scheduler served this request; returns the
@@ -159,23 +170,52 @@ class BatchScheduler:
         self._carry: Optional[Request] = None
         self._stop = threading.Event()
         self._closed = False
+        self._started = False
         self._lock = threading.Lock()
         self._lat: List[float] = []
         self._lat_cap = int(slo_window)
         self._served = 0
         self._batches = 0
         self._occ_sum = 0.0
+        self._in_flight = 0
+        # retry-safety: request-id -> Request. In-flight dedup is always
+        # safe (same object); completed-result reuse additionally needs
+        # the infer fn tagged idempotent (FusedInfer is: nothing
+        # donated, no state mutated).
+        self._idempotent = bool(getattr(infer_fn, "idempotent", False))
+        self._inflight_ids: dict = {}
+        self._done_ids: collections.OrderedDict = collections.OrderedDict()
+        self._done_cap = 1024
+        self._worker: Optional[threading.Thread] = None
+        self.start()
+
+    def start(self):
+        """Start the worker loop (called by ``__init__``). A second
+        call is a programming error — the double-start guard keeps two
+        batcher threads from racing on one queue."""
+        with self._lock:
+            if self._closed:
+                raise MXNetError("BatchScheduler is closed; build a "
+                                 "new one instead of restarting it")
+            if self._started:
+                raise MXNetError("BatchScheduler already started "
+                                 "(double start)")
+            self._started = True
         self._worker = threading.Thread(target=self._run,
                                         name="mxtpu-serve-batcher",
                                         daemon=True)
         self._worker.start()
 
     # -- intake ------------------------------------------------------------
-    def submit(self, arrays: Sequence[np.ndarray]) -> Request:
+    def submit(self, arrays: Sequence[np.ndarray],
+               request_id: Optional[str] = None) -> Request:
         """Enqueue one request (arrays follow the server's data names;
         leading axis = rows). Returns immediately; block on
-        ``Request.get()``."""
-        req = Request(arrays)
+        ``Request.get()``. Re-submitting a ``request_id`` that is
+        already in flight (or recently served, when the infer fn is
+        idempotent) returns the original request instead of dispatching
+        the work twice and counts ``serve.duplicate_requests``."""
+        req = Request(arrays, request_id)
         if len(req.arrays) != len(self._data_shapes):
             raise MXNetError("expected %d input arrays, got %d"
                              % (len(self._data_shapes), len(req.arrays)))
@@ -191,9 +231,37 @@ class BatchScheduler:
                              % (req.rows, self.max_batch))
         if self._closed:
             raise MXNetError("BatchScheduler is closed")
+        with self._lock:
+            dup = self._inflight_ids.get(req.request_id)
+            if dup is None and self._idempotent:
+                dup = self._done_ids.get(req.request_id)
+            if dup is not None:
+                _tel.inc("serve.duplicate_requests")
+                return dup
+            self._inflight_ids[req.request_id] = req
+            self._in_flight += 1
         _tel.inc("serve.requests")
+        _tel.set_gauge("serve.in_flight", self.in_flight())
         self._q.put(req)
         return req
+
+    def in_flight(self) -> int:
+        """Requests accepted but not yet completed (the /healthz
+        identity payload reads this)."""
+        with self._lock:
+            return self._in_flight
+
+    def _finish(self, req: Request, served: bool):
+        """Completion bookkeeping: retire the request id (into the
+        dedup cache when served and the infer fn is idempotent) and
+        drop it from the in-flight count."""
+        with self._lock:
+            if self._inflight_ids.pop(req.request_id, None) is not None:
+                self._in_flight -= 1
+            if served and self._idempotent:
+                self._done_ids[req.request_id] = req
+                while len(self._done_ids) > self._done_cap:
+                    self._done_ids.popitem(last=False)
 
     def infer(self, arrays: Sequence[np.ndarray],
               timeout: Optional[float] = 60.0) -> List[np.ndarray]:
@@ -242,12 +310,24 @@ class BatchScheduler:
                 _tel.inc("serve.errors")  # not the serving loop)
                 for req in batch:
                     req.error = e
+                    self._finish(req, served=False)
                     req._done.set()
                 _log.exception("serve batch failed (%d requests)",
                                len(batch))
 
     def _dispatch(self, batch: List[Request]):
         import jax
+
+        if _faults.fires("drop_response"):
+            # the response is lost on the wire: the work is abandoned,
+            # callers see a timeout, and the router's deadline-budgeted
+            # retry path has to recover the request elsewhere
+            _tel.inc("serve.dropped_responses")
+            for req in batch:
+                self._finish(req, served=False)
+            return
+        if _faults.fires("slow_replica"):
+            time.sleep(_faults.slow_ms() / 1e3)
 
         t0 = time.perf_counter()
         rows = sum(r.rows for r in batch)
@@ -280,7 +360,9 @@ class BatchScheduler:
             req.latency_ms = (t3 - req.t_enq) * 1e3
             worst = max(worst, req.latency_ms)
             _tel.observe("serve.request_ms", req.latency_ms)
+            self._finish(req, served=True)
             req._done.set()
+        _tel.set_gauge("serve.in_flight", self.in_flight())
         with self._lock:
             self._served += rows
             self._batches += 1
@@ -330,15 +412,20 @@ class BatchScheduler:
     # -- shutdown ----------------------------------------------------------
     def close(self, timeout: float = 10.0):
         """Graceful shutdown: stop intake, drain every queued request
-        (served, not dropped), join the worker. Idempotent."""
-        if self._closed:
-            return
-        self._closed = True
+        (served, not dropped), join the worker. Idempotent and safe to
+        race from several threads (the fleet's monitor, a drain, and a
+        context-manager exit may all call it)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
         self._stop.set()
-        self._worker.join(timeout)
-        if self._worker.is_alive():
-            _log.warning("serve batcher still alive after %.1fs join; "
-                         "leaking the (daemon) thread", timeout)
+        if self._worker is not None:
+            self._worker.join(timeout)
+            if self._worker.is_alive():
+                _log.warning("serve batcher still alive after %.1fs "
+                             "join; leaking the (daemon) thread",
+                             timeout)
         # a dispatch error could strand late submissions; fail them
         # rather than hang their callers
         leftovers = [] if self._carry is None else [self._carry]
@@ -351,6 +438,7 @@ class BatchScheduler:
         for req in leftovers:
             req.error = MXNetError("BatchScheduler closed before the "
                                    "request was served")
+            self._finish(req, served=False)
             # per-request completion event, not the worker's stop
             # signal — waking the caller after the join is the point
             req._done.set()  # graft: lifecycle-ok
@@ -410,7 +498,13 @@ class InferenceServer:
         self._probe_name = "serve_slo:%d" % id(self)
         _tracing.register_health_probe(self._probe_name,
                                        self.scheduler.slo_probe)
+        # replica identity on /healthz: the router and a human curl
+        # read the same in-flight/served signal (rank, pid, uptime are
+        # already in the base payload)
+        self._info_name = "serve:%d" % id(self)
+        _tracing.register_health_info(self._info_name, self.health_info)
         self._closed = False
+        self._close_lock = threading.Lock()
         _log.info("serving: buckets=%s max_wait_ms=%s dp=%d slo_ms=%s%s",
                   self.scheduler.buckets, self.scheduler.max_wait_ms,
                   dp, self.scheduler.slo_ms or "off",
@@ -431,29 +525,60 @@ class InferenceServer:
         """Executables built so far (bounded by len(buckets))."""
         return self._fused.compiles
 
-    def submit(self, arrays) -> Request:
-        return self.scheduler.submit(arrays)
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def start(self):
+        """The server starts serving at construction; an explicit
+        second start is the double-start bug this guard exists for."""
+        self.scheduler.start()
+
+    def submit(self, arrays, request_id: Optional[str] = None) -> Request:
+        return self.scheduler.submit(arrays, request_id=request_id)
 
     def infer(self, arrays, timeout: Optional[float] = 60.0):
         return self.scheduler.infer(arrays, timeout)
 
     def refresh_params(self):
-        """Repack after a weight update (e.g. module.set_params)."""
-        self._fused.refresh_params()
+        """Repack after a weight update (e.g. module.set_params).
+
+        Under an injected ``torn_swap`` fault the repack becomes
+        non-atomic (half the pack, a sleep, the rest), so a dispatch
+        inside the window would mix param versions — the fleet's
+        drain-then-swap rolling update must mask that window, and the
+        chaos tests prove it does."""
+        if _faults.fires("torn_swap"):
+            self._fused.refresh_params(
+                torn_ms=max(_faults.slow_ms(), 1.0))
+        else:
+            self._fused.refresh_params()
+
+    def health_info(self) -> dict:
+        """Identity payload merged into /healthz by the tracing tier."""
+        return {"in_flight": self.scheduler.in_flight(),
+                "requests_served": self.scheduler.stats()
+                                       .get("requests_served", 0)}
 
     def stats(self) -> dict:
         out = self.scheduler.stats()
         out["compiles"] = self.compiles
         out["buckets"] = list(self.buckets)
         out["dp"] = self.dp
+        out["in_flight"] = self.scheduler.in_flight()
         return out
 
     # -- shutdown ----------------------------------------------------------
     def close(self):
-        if self._closed:
-            return
-        self._closed = True
+        """Idempotent and race-safe: the first caller wins, everyone
+        else returns immediately (the fleet may close a replica from
+        its monitor thread while a drain path does the same)."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
         _tracing.unregister_health_probe(self._probe_name)
+        _tracing.unregister_health_info(self._info_name)
         self.scheduler.close()
         if self._own_metrics and self._metrics is not None:
             self._metrics.stop()

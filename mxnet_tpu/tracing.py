@@ -54,10 +54,12 @@ from . import telemetry as _tel
 from . import env as _env
 
 __all__ = ["StepTrace", "SlowStepDetector", "RecompileDetector",
-           "InputStallDetector", "SlowRequestDetector", "AnomalyProfiler",
+           "InputStallDetector", "SlowRequestDetector",
+           "FleetHealthDetector", "AnomalyProfiler",
            "FlightRecorder", "MetricsServer", "step_trace", "record_step",
            "maybe_init", "set_worker_rank", "worker_rank", "shutdown",
            "register_health_probe", "unregister_health_probe",
+           "register_health_info", "unregister_health_info",
            "register_preempt_hook", "unregister_preempt_hook",
            "ensure_flight_recorder"]
 
@@ -202,9 +204,35 @@ class SlowRequestDetector:
         return None
 
 
+class FleetHealthDetector:
+    """Fleet-tier guard: the :class:`~mxnet_tpu.fleet.FleetRouter`'s
+    monitor stamps ``fleet_down`` (dead replicas awaiting respawn) and
+    ``breaker_open`` (replicas currently shedding load) into a step
+    record whenever either is nonzero; this turns that into an anomaly
+    so /healthz and the flight recorder see a shrinking fleet the same
+    way they see a slow request. Inert for training and single-replica
+    serving records."""
+
+    type = "fleet_degraded"
+
+    def check(self, rec: dict) -> Optional[dict]:
+        down = rec.get("fleet_down", 0)
+        tripped = rec.get("breaker_open", 0)
+        if down or tripped:
+            ev = {"type": self.type}
+            if down:
+                ev["replicas_down"] = int(down)
+            if tripped:
+                ev["breakers_open"] = int(tripped)
+            if rec.get("fleet_size") is not None:
+                ev["fleet_size"] = int(rec["fleet_size"])
+            return ev
+        return None
+
+
 def default_detectors() -> list:
     return [SlowStepDetector(), RecompileDetector(), InputStallDetector(),
-            SlowRequestDetector()]
+            SlowRequestDetector(), FleetHealthDetector()]
 
 
 # ---------------------------------------------------------------------------
@@ -228,6 +256,42 @@ def register_health_probe(name: str, probe):
 def unregister_health_probe(name: str):
     with _probe_lock:
         _health_probes.pop(name, None)
+
+
+# identity/info providers: merged into the /healthz JSON regardless of
+# health (probes above only surface when they FAIL; info is always on)
+_health_info: Dict[str, object] = {}
+
+
+def register_health_info(name: str, info):
+    """Register an identity/info provider for ``/healthz``: a callable
+    returning a JSON-able dict merged into the payload on every scrape
+    (existing payload keys win). The serving tier registers its
+    in-flight/served counts here so the fleet router and a human curl
+    read one replica-identity signal."""
+    with _probe_lock:
+        _health_info[name] = info
+
+
+def unregister_health_info(name: str):
+    with _probe_lock:
+        _health_info.pop(name, None)
+
+
+def _run_health_info() -> Dict[str, object]:
+    """Merged info payload ({} when none registered). A provider that
+    raises contributes an error string instead of crashing the scrape."""
+    with _probe_lock:
+        infos = list(_health_info.items())
+    merged: Dict[str, object] = {}
+    for name, info in infos:
+        try:
+            detail = info()
+            if detail:
+                merged.update(dict(detail))
+        except Exception as e:
+            merged[name] = "info provider raised: %s" % (e,)
+    return merged
 
 
 def _run_health_probes() -> Dict[str, object]:
@@ -706,6 +770,8 @@ class _MetricsHandler(http.server.BaseHTTPRequestHandler):
                 "steps": tr.step if tr is not None else 0,
                 "anomalies": len(tr.events) if tr is not None else 0,
             }
+            for k, v in _run_health_info().items():
+                payload.setdefault(k, v)
             if failing:
                 payload["probes"] = failing
             body = json.dumps(payload).encode()

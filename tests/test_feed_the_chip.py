@@ -23,7 +23,11 @@ def _accelerator_up():
     sys.path.insert(0, REPO)
     from bench import _accelerator_reachable
 
-    return _accelerator_reachable(timeout_s=120)
+    # the probe runs a trivial jit: even a cold live tunnel answers in
+    # well under a minute, while a dead one burns the whole budget —
+    # keep it tight, and bench._accelerator_reachable memoizes the
+    # verdict so later accelerator-gated tests in this run pay nothing
+    return _accelerator_reachable(timeout_s=60)
 
 
 @pytest.mark.nightly

@@ -1,0 +1,718 @@
+"""Fault-tolerant serving fleet: retry/backoff/deadline math under a
+fake clock (the budget is never exceeded), the circuit-breaker FSM,
+consistent-hash session affinity, hedging, the typed fault registry,
+and the chaos proofs — kill a replica mid-load with zero client-visible
+errors, recover lost responses under an injected drop_response fault,
+and a rolling refresh_params swap under load that serves zero
+mixed-version responses even with a torn_swap fault armed."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, fleet, serving, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.fleet import (AttemptTimeout, CircuitBreaker,
+                             DeadlineExceeded, FleetError, FleetRouter,
+                             ReplicaCrash, backoff_delay_s)
+from mxnet_tpu.module import Module
+
+DIM = 8
+CLASSES = 4
+HID = 16
+
+
+@pytest.fixture
+def tel():
+    telemetry.reset()
+    telemetry.enable()
+    yield telemetry
+    telemetry.reset()
+    telemetry.disable()
+
+
+@pytest.fixture
+def no_faults():
+    yield
+    faults.configure(None)
+
+
+def _rows(n, seed=11):
+    rng = np.random.RandomState(seed)
+    return rng.randint(-3, 4, (n, DIM)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# fakes: router logic with no jax, no sleeping
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    """Monotonic fake time; sleep() just advances it."""
+
+    def __init__(self):
+        self.t = 0.0
+        self._lock = threading.Lock()
+
+    def __call__(self):
+        with self._lock:
+            return self.t
+
+    def sleep(self, s):
+        assert s >= 0.0
+        with self._lock:
+            self.t += s
+
+
+class _OkWaiter:
+    def __init__(self, arrays):
+        self._arrays = arrays
+
+    def wait(self, timeout_s):
+        return [np.asarray(a) * 2.0 for a in self._arrays]
+
+    def cancel(self):
+        pass
+
+
+class _HangWaiter:
+    """Never answers: consumes the full wait (fake or real time)."""
+
+    def __init__(self, clock_sleep):
+        self._sleep = clock_sleep
+
+    def wait(self, timeout_s):
+        self._sleep(timeout_s)
+        raise AttemptTimeout("fake replica never answered")
+
+    def cancel(self):
+        pass
+
+
+class _SlowWaiter:
+    """Answers after delay_s of real time."""
+
+    def __init__(self, arrays, delay_s):
+        self._arrays = arrays
+        self._t_due = time.monotonic() + delay_s
+
+    def wait(self, timeout_s):
+        rem = self._t_due - time.monotonic()
+        if rem > 0:
+            if timeout_s < rem:
+                time.sleep(timeout_s)
+                raise AttemptTimeout("still slow")
+            time.sleep(rem)
+        return [np.asarray(a) * 2.0 for a in self._arrays]
+
+    def cancel(self):
+        pass
+
+
+class FakeReplica(fleet.Replica):
+    """behavior: ok | hang | crash | slow; health_status is mutable so
+    autoscale tests can flip a replica degraded."""
+
+    def __init__(self, rid, behavior="ok", clock_sleep=time.sleep,
+                 slow_s=0.2):
+        self.rid = rid
+        self.behavior = behavior
+        self.health_status = "ok"
+        self.submits = 0
+        self._alive = True
+        self._clock_sleep = clock_sleep
+        self._slow_s = slow_s
+
+    def submit(self, arrays, request_id=None):
+        self.submits += 1
+        if not self._alive:
+            raise ReplicaCrash("replica %s is down" % self.rid)
+        if self.behavior == "crash":
+            self._alive = False
+            raise ReplicaCrash("replica %s crashed" % self.rid)
+        if self.behavior == "hang":
+            return _HangWaiter(self._clock_sleep)
+        if self.behavior == "slow":
+            return _SlowWaiter(arrays, self._slow_s)
+        return _OkWaiter(arrays)
+
+    def alive(self):
+        return self._alive
+
+    def health(self):
+        if not self._alive:
+            raise ReplicaCrash("down")
+        return {"status": self.health_status, "in_flight": 0}
+
+    def in_flight(self):
+        return 0
+
+    def refresh_params(self, apply_fn=None):
+        pass
+
+    def restart(self):
+        self._alive = True
+        self.behavior = "ok"
+
+    def kill(self):
+        self._alive = False
+
+    def close(self):
+        self._alive = False
+
+
+def _fake_router(behaviors, clock=None, **kw):
+    """Router over FakeReplicas; behaviors assigned per slot in order."""
+    made = {}
+    queue = list(behaviors)
+    sleep = clock.sleep if clock is not None else time.sleep
+
+    def factory(rid):
+        behavior = queue.pop(0) if queue else "ok"
+        made[rid] = FakeReplica(rid, behavior, clock_sleep=sleep)
+        return made[rid]
+
+    kw.setdefault("health_interval_s", 60.0)   # monitor stays out of
+    kw.setdefault("auto_respawn", False)       # the fake-clock math
+    if clock is not None:
+        kw.setdefault("clock", clock)
+        kw.setdefault("sleep", clock.sleep)
+    r = FleetRouter(factory, len(behaviors), **kw)
+    return r, made
+
+
+# ---------------------------------------------------------------------------
+# retry math: jitter bounds, deadline budget, attempt cap
+# ---------------------------------------------------------------------------
+
+def test_backoff_jitter_bounds():
+    rng = __import__("random").Random(7)
+    base = 0.01
+    for attempt in range(8):
+        e = min(1.0, base * 2 ** attempt)
+        for _ in range(50):
+            d = backoff_delay_s(attempt, base, rng, cap_s=1.0)
+            assert e / 2 <= d < e, (attempt, d, e)
+
+
+def test_deadline_budget_never_exceeded_across_retries():
+    """Every attempt timeout and backoff sleep is clamped to the
+    remaining budget: with replicas that never answer, the caller's
+    total (fake) wait is <= the deadline, bit-for-bit."""
+    clock = FakeClock()
+    router, _ = _fake_router(["hang", "hang"], clock=clock,
+                             deadline_ms=1000.0, attempt_timeout_ms=300.0,
+                             retries=1000, backoff_ms=10.0, hedge=False)
+    try:
+        t0 = clock()
+        with pytest.raises(DeadlineExceeded) as ei:
+            router._serve([_rows(1)], None, "req-dl", 1.0)
+        elapsed = clock() - t0
+        assert elapsed <= 1.0 + 1e-9, elapsed
+        # the budget was genuinely used (several attempts ran)
+        assert elapsed >= 0.9
+        assert "deadline" in str(ei.value)
+    finally:
+        router.close()
+
+
+def test_retry_cap_raises_before_deadline():
+    clock = FakeClock()
+    router, _ = _fake_router(["hang"], clock=clock, deadline_ms=60000.0,
+                             attempt_timeout_ms=10.0, retries=3,
+                             backoff_ms=1.0, hedge=False)
+    try:
+        with pytest.raises(FleetError, match="after 3 attempts"):
+            router._serve([_rows(1)], None, "req-cap", 60.0)
+        assert clock() < 60.0
+    finally:
+        router.close()
+
+
+def test_failover_retry_succeeds_on_peer(tel):
+    router, made = _fake_router(["crash", "ok"], deadline_ms=5000.0,
+                                attempt_timeout_ms=500.0, retries=4,
+                                backoff_ms=1.0)
+    try:
+        x = _rows(1, seed=5)
+        (out,) = router.infer([x], request_id="req-fo")
+        assert np.array_equal(out, x * 2.0)
+        st = router.stats()
+        assert st["counters"]["retries"] >= 1
+        assert st["counters"]["served"] == 1
+        assert st["counters"]["recovered_requests"] == 1
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker FSM under a fake clock
+# ---------------------------------------------------------------------------
+
+def test_breaker_state_machine():
+    t = [0.0]
+    b = CircuitBreaker(fail_threshold=2, cooldown_s=1.0,
+                       clock=lambda: t[0])
+    assert b.state == CircuitBreaker.CLOSED
+    assert b.allow()
+    assert b.record_failure() is False        # 1 of 2
+    assert b.state == CircuitBreaker.CLOSED
+    assert b.record_failure() is True         # trip
+    assert b.state == CircuitBreaker.OPEN
+    assert b.trips == 1
+    assert not b.allow()                      # shedding
+    t[0] = 0.99
+    assert not b.allow()                      # still cooling down
+    t[0] = 1.01
+    assert b.state == CircuitBreaker.HALF_OPEN
+    assert b.allow()                          # the one probe
+    assert not b.allow()                      # only one probe at a time
+    assert b.record_failure() is True         # probe failed: re-open
+    assert b.state == CircuitBreaker.OPEN
+    assert b.trips == 2
+    t[0] = 2.5
+    assert b.allow()                          # second probe
+    b.record_success()
+    assert b.state == CircuitBreaker.CLOSED
+    assert b.allow() and b.allow()            # fully closed again
+    # success resets the consecutive-failure count
+    assert b.record_failure() is False
+    b.record_success()
+    assert b.record_failure() is False
+
+
+def test_breaker_sheds_load_to_healthy_peer():
+    """After the breaker trips, the broken replica stops being picked
+    at all until its cooldown expires."""
+    clock = FakeClock()
+    router, made = _fake_router(["crash", "ok"], clock=clock,
+                                deadline_ms=10000.0,
+                                attempt_timeout_ms=100.0, retries=8,
+                                backoff_ms=1.0, breaker_fails=1,
+                                breaker_cooldown_ms=1e7)
+    try:
+        crashed = next(r for r in made.values() if not r.behavior == "ok")
+        (out,) = router.infer([_rows(1)], request_id="r1")
+        assert out is not None
+        n = crashed.submits
+        for i in range(5):
+            router.infer([_rows(1)], request_id="r-%d" % i)
+        assert crashed.submits == n          # breaker open: never picked
+        st = router.stats()
+        assert st["counters"]["breaker_trips"] == 1
+        assert st["replicas"][crashed.rid]["breaker"]["state"] == "open"
+        assert any(e["type"] == "breaker_open" for e in st["events"])
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash session affinity
+# ---------------------------------------------------------------------------
+
+def test_session_affinity_stable_and_fails_over():
+    router, made = _fake_router(["ok", "ok", "ok"], deadline_ms=5000.0)
+    try:
+        home = {s: router._pick("sess-%d" % s)[0] for s in range(64)}
+        # stable: the same session maps to the same replica every time
+        for s, rid in home.items():
+            for _ in range(3):
+                assert router._pick("sess-%d" % s)[0] == rid
+        # all three replicas own some sessions (md5 spreads)
+        assert len(set(home.values())) == 3
+        # kill one: only ITS sessions move; everyone else stays home
+        dead_rid = home[0]
+        made[dead_rid].kill()
+        for s, rid in home.items():
+            got = router._pick("sess-%d" % s)[0]
+            if rid == dead_rid:
+                assert got != dead_rid
+            else:
+                assert got == rid
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# hedging
+# ---------------------------------------------------------------------------
+
+def test_hedge_second_send_wins(tel):
+    router, made = _fake_router(["slow", "ok"], deadline_ms=10000.0,
+                                attempt_timeout_ms=2000.0, retries=3,
+                                backoff_ms=1.0, hedge=True)
+    try:
+        # prime the latency window so p95 ~ 5ms (hedge trigger)
+        with router._rlock:
+            router._lat.extend([0.005] * 30)
+        # least-inflight tie breaks by rid: r1 (slow) is primary
+        slow = made["r1"]
+        assert slow.behavior == "slow"
+        x = _rows(1, seed=9)
+        (out,) = router.infer([x], request_id="req-hedge")
+        assert np.array_equal(out, x * 2.0)
+        st = router.stats()
+        assert st["counters"]["hedges"] >= 1
+        assert st["counters"]["hedge_wins"] >= 1
+        assert made["r2"].submits >= 1
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: crash detection + respawn, drain-then-stop, autoscale
+# ---------------------------------------------------------------------------
+
+def test_monitor_detects_crash_and_respawns():
+    router, made = _fake_router(["ok", "ok"], health_interval_s=0.01,
+                                auto_respawn=True, deadline_ms=5000.0)
+    try:
+        rid = router.replica_ids()[0]
+        router.kill_replica(rid)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            st = router.stats()
+            if st["counters"].get("respawns", 0) >= 1:
+                break
+            time.sleep(0.01)
+        st = router.stats()
+        assert st["counters"]["replica_crashes"] >= 1
+        assert st["counters"]["respawns"] >= 1
+        types = [e["type"] for e in st["events"]]
+        assert "replica_killed" in types
+        assert "replica_dead" in types
+        assert "replica_respawned" in types
+        assert st["replicas"][rid]["state"] == "up"
+        # and it serves again
+        (out,) = router.infer([_rows(1)], session=None)
+        assert out is not None
+    finally:
+        router.close()
+
+
+def test_remove_replica_drains_then_stops():
+    router, made = _fake_router(["ok", "ok"], deadline_ms=5000.0)
+    try:
+        rid = router.replica_ids()[0]
+        router.remove_replica(rid, drain_timeout_s=5.0)
+        assert rid not in router.replica_ids()
+        assert not made[rid].alive()
+        (out,) = router.infer([_rows(1)])   # the peer still serves
+        assert out is not None
+    finally:
+        router.close()
+
+
+def test_autoscale_up_on_degraded_down_when_healthy():
+    armed = {"degraded": True}
+    made = {}
+
+    def factory(rid):
+        r = FakeReplica(rid, "ok")
+        r.health_status = "degraded" if armed["degraded"] else "ok"
+        made[rid] = r
+        return r
+
+    router = FleetRouter(factory, 1, autoscale=True, min_replicas=1,
+                         max_replicas=3, scale_down_ticks=3,
+                         health_interval_s=0.01, auto_respawn=True,
+                         deadline_ms=5000.0)
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if len(router.replica_ids()) >= 3:
+                break
+            time.sleep(0.01)
+        assert len(router.replica_ids()) == 3
+        assert router.stats()["counters"]["scale_ups"] >= 2
+        # flip everyone healthy: the fleet drains back down to min
+        armed["degraded"] = False
+        for r in made.values():
+            r.health_status = "ok"
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if len(router.replica_ids()) == 1:
+                break
+            time.sleep(0.02)
+        assert len(router.replica_ids()) == 1
+        assert router.stats()["counters"]["scale_downs"] >= 1
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# typed fault registry
+# ---------------------------------------------------------------------------
+
+def test_fault_registry_is_typed(no_faults):
+    with pytest.raises(MXNetError, match="unknown fault"):
+        faults.FaultPlan("replica_crash,not_a_fault")
+    with pytest.raises(MXNetError, match="outside"):
+        faults.FaultPlan("slow_replica:1.5")
+    with pytest.raises(MXNetError, match="not a float"):
+        faults.FaultPlan("slow_replica:often")
+    plan = faults.FaultPlan("replica_crash:0.25,torn_swap")
+    assert plan.rates == {"replica_crash": 0.25, "torn_swap": 1.0}
+
+
+def test_fault_plan_seeded_and_counted(no_faults):
+    a = faults.FaultPlan("drop_response:0.5", seed=42)
+    b = faults.FaultPlan("drop_response:0.5", seed=42)
+    seq_a = [a.fires("drop_response") for _ in range(64)]
+    seq_b = [b.fires("drop_response") for _ in range(64)]
+    assert seq_a == seq_b                      # reproducible chaos
+    assert 0 < sum(seq_a) < 64
+    assert a.injected["drop_response"] == sum(seq_a)
+    # unarmed faults never fire, even on an armed plan
+    assert not a.fires("torn_swap")
+
+
+def test_faults_disabled_is_inert(no_faults):
+    faults.configure(None)
+    assert not faults.active()
+    assert not faults.fires("replica_crash")
+    assert faults.slow_ms() == 0.0
+    faults.configure("slow_replica", slow_ms=7.5)
+    assert faults.active()
+    assert faults.fires("slow_replica")
+    assert faults.slow_ms() == 7.5
+
+
+def test_drop_response_fault_times_out_caller(tel, no_faults):
+    def fake(placed):
+        return [placed[0] * 2.0], ()
+
+    faults.configure("drop_response")
+    sched = serving.BatchScheduler(fake, [(4, DIM)], max_batch=4,
+                                   max_wait_ms=0.5, slo_ms=0.0)
+    try:
+        r = sched.submit([_rows(1)])
+        with pytest.raises(MXNetError, match="timed out"):
+            r.get(0.3)
+        # dropped requests do not leak the in-flight gauge
+        assert sched.in_flight() == 0
+        assert tel.peek("serve.dropped_responses") >= 1
+    finally:
+        faults.configure(None)
+        sched.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos proofs on real InferenceServer replicas (in-process)
+# ---------------------------------------------------------------------------
+
+def _mlp():
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=HID, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=CLASSES, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _seed_params(net, batch, seed=3):
+    arg_shapes, _, _ = net.infer_shape(data=(batch, DIM),
+                                       softmax_label=(batch,))
+    rng = np.random.RandomState(seed)
+    return {name: mx.nd.array(
+        (rng.randint(-2, 3, shape) * 0.5).astype(np.float32))
+        for name, shape in zip(net.list_arguments(), arg_shapes)
+        if name not in ("data", "softmax_label")}
+
+
+def _server_factory():
+    net = _mlp()
+    batch = 8
+    mod = Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (batch, DIM))],
+             label_shapes=[("softmax_label", (batch,))],
+             for_training=False)
+    mod.init_params(initializer=None,
+                    arg_params=_seed_params(net, batch), aux_params={})
+    return serving.InferenceServer(mod, top_k=0, max_batch=batch,
+                                   max_wait_ms=0.5, buckets=[batch],
+                                   slo_ms=0.0, port=None)
+
+
+def test_chaos_kill_replica_mid_load_zero_failures(tel):
+    """THE chaos acceptance: kill a replica mid-load; every request
+    still gets a correct answer (zero client-visible errors) and p99
+    stays bounded — inflated by retries, but nowhere near the deadline."""
+    router = FleetRouter(fleet.in_process(_server_factory), 2,
+                         deadline_ms=30000.0, attempt_timeout_ms=5000.0,
+                         retries=10, backoff_ms=2.0,
+                         health_interval_s=0.02)
+    lat_lock = threading.Lock()
+    baseline, chaos = [], []
+    try:
+        x = _rows(1, seed=77)
+        (expect,) = router.infer([x])
+
+        def run_phase(n, sink, kill_at=None):
+            futs = []
+            for i in range(n):
+                t0 = time.perf_counter()
+
+                def cb(f, t0=t0):
+                    with lat_lock:
+                        sink.append(time.perf_counter() - t0)
+
+                f = router.submit([x], request_id=None)
+                f.add_done_callback(cb)
+                futs.append(f)
+                if kill_at is not None and i == kill_at:
+                    router.kill_replica(router.replica_ids()[0])
+                time.sleep(0.002)
+            return futs
+
+        futs = run_phase(40, baseline)
+        for f in futs:
+            (out,) = f.result(60)            # raises on any failure
+            assert np.array_equal(out, expect)
+        futs = run_phase(60, chaos, kill_at=20)
+        for f in futs:
+            (out,) = f.result(60)            # zero client-visible errors
+            assert np.array_equal(out, expect)
+        st = router.stats()
+        assert st["counters"]["replica_crashes"] >= 1
+        assert st["counters"]["respawns"] >= 1
+        assert st["counters"].get("client_errors", 0) == 0
+        p99_base = sorted(baseline)[int(0.99 * (len(baseline) - 1))]
+        p99_chaos = sorted(chaos)[int(0.99 * (len(chaos) - 1))]
+        # bounded inflation: retries cost something, but the recovery
+        # is orders of magnitude inside the 30s deadline
+        assert p99_chaos < max(20 * p99_base, 5.0), (p99_base, p99_chaos)
+    finally:
+        router.close()
+
+
+def test_router_recovers_injected_drop_response(tel, no_faults):
+    """Lost responses (served but never delivered) are recovered by
+    deadline-budgeted retries: every caller still gets its answer."""
+    faults.configure("drop_response:0.4", seed=1234)
+    router = FleetRouter(fleet.in_process(_server_factory), 2,
+                         deadline_ms=30000.0, attempt_timeout_ms=400.0,
+                         retries=20, backoff_ms=2.0,
+                         health_interval_s=60.0)
+    try:
+        x = _rows(1, seed=31)
+        futs = [router.submit([x], request_id="drop-%d" % i)
+                for i in range(24)]
+        outs = [f.result(60)[0] for f in futs]  # all succeed
+        ref = outs[0]
+        for out in outs:
+            assert np.array_equal(out, ref)
+        st = router.stats()
+        assert st["counters"]["retries"] >= 1   # drops really happened
+        plan = faults._PLAN
+        assert plan is not None
+        assert plan.injected.get("drop_response", 0) >= 1
+    finally:
+        router.close()
+        faults.configure(None)
+
+
+def _double_params(srv):
+    """apply_fn for the rolling swap: double every packed param of the
+    served executor (the new 'trained' weights)."""
+    fused = srv._fused
+    ex = fused._ex
+    for i in fused._p_idx:
+        arr = ex.arg_arrays[i]
+        arr._data = arr._data * 2.0
+
+
+def test_rolling_swap_under_load_zero_mixed_versions(tel, no_faults):
+    """Glitch-free serve-while-training swap, with the torn_swap fault
+    ARMED: every response served during the rolling refresh is exactly
+    pure-old or pure-new — the drain masks the torn window entirely —
+    and zero requests fail."""
+    faults.configure("torn_swap", slow_ms=30.0)
+    router = FleetRouter(fleet.in_process(_server_factory), 2,
+                         deadline_ms=30000.0, attempt_timeout_ms=5000.0,
+                         retries=10, backoff_ms=2.0,
+                         health_interval_s=60.0)
+    try:
+        x = _rows(1, seed=55)
+        (old,) = router.infer([x])
+
+        # reference NEW output: a third, private server swapped while
+        # idle tells us what pure-new bits look like
+        ref = fleet.InProcReplica("ref", _server_factory)
+        try:
+            _double_params(ref._srv)
+            ref._srv.refresh_params()
+            (new,) = ref.submit([x]).wait(30)
+        finally:
+            ref.close()
+        assert not np.array_equal(old, new)
+
+        stop = threading.Event()
+        outs, errs = [], []
+
+        def load():
+            i = 0
+            while not stop.is_set():
+                try:
+                    (out,) = router.infer([x], request_id="swap-%d" % i)
+                    outs.append(out)
+                except Exception as e:   # noqa: BLE001 (collected+pinned)
+                    errs.append(e)
+                i += 1
+
+        threads = [threading.Thread(target=load, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        router.refresh_params(apply_fn=_double_params,
+                              drain_timeout_s=30.0)
+        time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join(30)
+
+        assert not errs, errs[:3]                 # zero failed responses
+        n_old = sum(np.array_equal(o, old) for o in outs)
+        n_new = sum(np.array_equal(o, new) for o in outs)
+        assert n_old + n_new == len(outs), \
+            "mixed-version responses served: %d of %d" \
+            % (len(outs) - n_old - n_new, len(outs))
+        assert n_old > 0 and n_new > 0            # load straddled the swap
+        plan = faults._PLAN
+        assert plan is not None
+        assert plan.injected.get("torn_swap", 0) >= 2   # window existed
+        st = router.stats()
+        assert st["counters"]["param_swaps"] == 2
+    finally:
+        router.close()
+        faults.configure(None)
+
+
+# ---------------------------------------------------------------------------
+# subprocess replicas: real processes, real SIGKILL
+# ---------------------------------------------------------------------------
+
+def test_subprocess_replica_serves_and_survives_sigkill(tel):
+    router = FleetRouter(
+        fleet.in_subprocess("mxnet_tpu.fleet:demo_server_factory"), 1,
+        deadline_ms=120000.0, attempt_timeout_ms=60000.0, retries=20,
+        backoff_ms=50.0, health_interval_s=0.05)
+    try:
+        x = _rows(1, seed=3)
+        (out,) = router.infer([x], timeout=120.0)
+        assert out.shape == (1, CLASSES)
+        h = router._entries[router.replica_ids()[0]].replica.health()
+        assert h["status"] == "ok"
+        assert h["pid"] != __import__("os").getpid()   # really remote
+        assert "in_flight" in h and "uptime_s" in h
+        # SIGKILL the child mid-fleet; the monitor respawns it and the
+        # next request succeeds with zero client-visible errors
+        router.kill_replica(router.replica_ids()[0])
+        (out2,) = router.infer([x], timeout=120.0)
+        assert np.array_equal(out2, out)
+        st = router.stats()
+        assert st["counters"]["replica_crashes"] >= 1
+        assert st["counters"]["respawns"] >= 1
+    finally:
+        router.close()

@@ -330,3 +330,92 @@ def test_module_score_partial_batch_exact_metric(tel):
     expect = float((pred.argmax(axis=1) == y).sum()) / 11.0
     assert acc == expect
     assert mod._exec_group.executor._fwd_infer._cache_size() == 1
+
+
+# ---------------------------------------------------------------------------
+# retry safety: request-id dedup, double-start guard, close idempotence,
+# /healthz replica identity
+# ---------------------------------------------------------------------------
+
+def _idempotent_fake(placed):
+    return [placed[0] * 2.0], ()
+
+
+_idempotent_fake.idempotent = True
+
+
+def test_scheduler_dedups_request_ids(tel):
+    sched = BatchScheduler(_idempotent_fake, [(4, DIM)], max_batch=4,
+                           max_wait_ms=200.0, slo_ms=0.0)
+    try:
+        x = _rows(1, seed=21)
+        r1 = sched.submit([x], request_id="req-A")
+        # a retry of an in-flight id joins the SAME request object:
+        # one dispatch, one answer, both handles resolve together
+        r2 = sched.submit([x], request_id="req-A")
+        assert r2 is r1
+        (out,) = r1.get(timeout=30)
+        assert np.array_equal(out, x * 2.0)
+        # a retry AFTER completion reuses the served result (the infer
+        # fn is tagged idempotent, so replay is safe and free)
+        r3 = sched.submit([x], request_id="req-A")
+        assert r3 is r1
+        assert np.array_equal(r3.get(timeout=1)[0], x * 2.0)
+        assert tel.peek("serve.duplicate_requests") == 2
+        assert tel.peek("serve.requests") == 1
+    finally:
+        sched.close()
+
+
+def test_scheduler_no_completed_dedup_without_idempotent_tag(tel):
+    # _fake_infer carries no .idempotent tag: completed results must
+    # NOT be replayed (only the always-safe in-flight join applies)
+    sched = BatchScheduler(_fake_infer, [(4, DIM)], max_batch=4,
+                           max_wait_ms=1.0, slo_ms=0.0)
+    try:
+        x = _rows(1, seed=22)
+        r1 = sched.submit([x], request_id="req-B")
+        r1.get(timeout=30)
+        r2 = sched.submit([x], request_id="req-B")
+        assert r2 is not r1
+        r2.get(timeout=30)
+        assert (tel.peek("serve.duplicate_requests") or 0) == 0
+    finally:
+        sched.close()
+
+
+def test_scheduler_double_start_and_close_idempotence():
+    sched = BatchScheduler(_fake_infer, [(4, DIM)], max_batch=4,
+                           max_wait_ms=1.0, slo_ms=0.0)
+    with pytest.raises(MXNetError, match="double start"):
+        sched.start()
+    sched.close()
+    sched.close()                       # idempotent: second is a no-op
+    assert not sched._worker.is_alive()
+    with pytest.raises(MXNetError, match="closed"):
+        sched.start()                   # closed schedulers stay closed
+    with pytest.raises(MXNetError, match="closed"):
+        sched.submit([_rows(1)])
+
+
+def test_server_close_idempotent_and_healthz_identity(tel):
+    mod = _bound_module(dp=1, batch=8)
+    srv = serving.InferenceServer(mod, top_k=0, max_batch=8,
+                                  max_wait_ms=0.5, buckets=[8],
+                                  slo_ms=0.0, port=0)
+    try:
+        srv.infer([_rows(2)])
+        status, health = _healthz(srv.port)
+        assert status == 200
+        # replica identity for the fleet router: who am I, how busy
+        assert health["pid"] == __import__("os").getpid()
+        assert "rank" in health and "uptime_s" in health
+        assert health["in_flight"] == 0
+        assert health["requests_served"] >= 1
+        assert srv.stats()["in_flight"] == 0
+    finally:
+        srv.close()
+        srv.close()                     # idempotent
+    assert srv.closed
+    with pytest.raises(MXNetError, match="closed"):
+        srv.submit([_rows(1)])

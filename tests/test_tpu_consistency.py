@@ -15,18 +15,15 @@ REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
 def _accelerator_alive(env, timeout_s=60):
-    """Probe: EXECUTE a computation (device enumeration alone can succeed
-    on a wedged tunnel)."""
-    probe = ("import jax, jax.numpy as jnp; "
-             "v=float(jax.jit(lambda x:(x*2).sum())(jnp.ones(8))); "
-             "print('PLATFORM', jax.devices()[0].platform)")
-    try:
-        r = subprocess.run([sys.executable, "-c", probe],
-                           capture_output=True, text=True,
-                           timeout=timeout_s, env=env)
-    except subprocess.TimeoutExpired:
-        return False
-    return r.returncode == 0 and "PLATFORM cpu" not in r.stdout
+    """Probe via bench._accelerator_reachable: it EXECUTEs a computation
+    (device enumeration alone can succeed on a wedged tunnel) and
+    memoizes the verdict, so when an earlier accelerator-gated test in
+    this pytest run already paid the dead-tunnel timeout we skip
+    instantly instead of burning it again."""
+    sys.path.insert(0, REPO)
+    from bench import _accelerator_reachable
+
+    return _accelerator_reachable(timeout_s=timeout_s)
 
 
 def test_tpu_vs_cpu_operator_consistency():

@@ -135,6 +135,10 @@ def test_train_imagenet_cache_path(tmp_path):
          "--batch-size", "16",
          "--num-epochs", "3",
          "--lr", "0.05",
+         # decay like the e2e variant: 6 batches/epoch at a constant
+         # lr 0.05 with momentum 0.9 diverges after the second epoch
+         "--lr-factor", "0.7",
+         "--lr-factor-epoch", "1",
          "--save-model-prefix", prefix],
         capture_output=True, text=True, timeout=900, env=env)
     assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-2500:])

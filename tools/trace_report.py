@@ -456,6 +456,64 @@ def render_serve(rec):
     return "\n".join(out) + "\n"
 
 
+def latest_fleet_record(recs):
+    """The newest fleet-bench record (FLEET_bench.json)."""
+    for r in reversed(recs):
+        if r.get("metric") == "fleet_goodput_rps" or "chaos" in r:
+            return r
+    return None
+
+
+def render_fleet(rec):
+    """Fleet view: goodput vs replica count, the killed-replica
+    recovery window, and the rolling-swap purity proof."""
+    out = ["fleet: %.1f req/s best (%s replicas)  chaos %s  swap %s"
+           % (rec.get("value") or 0, rec.get("replicas_best"),
+              "OK" if rec.get("chaos_ok") else "FAILED",
+              "OK" if rec.get("swap_ok") else "FAILED"), ""]
+    scaling = rec.get("scaling") or []
+    if scaling:
+        rows = [("replicas", "offered", "achieved", "p50_ms", "p99_ms",
+                 "errors")]
+        for t in scaling:
+            rows.append((str(t.get("replicas")),
+                         "%g" % t.get("offered_rps", 0),
+                         "%.1f" % t.get("achieved_rps", 0),
+                         "%.2f" % (t.get("p50_ms") or 0),
+                         "%.2f" % (t.get("p99_ms") or 0),
+                         str(t.get("errors", 0))))
+        out.append("goodput vs replica count:")
+        out += _table(rows)
+        out.append("")
+    c = rec.get("chaos") or {}
+    if c:
+        out.append("killed-replica window:")
+        out.append("  pre-kill %.1f req/s -> min %.1f req/s in window, "
+                   "recovered to 90%% in %sms"
+                   % (c.get("pre_kill_goodput_rps") or 0,
+                      c.get("kill_window_min_goodput_rps") or 0,
+                      c.get("recovery_ms")))
+        out.append("  client errors %s  crashes %s  respawns %s  "
+                   "retries %s  recovered requests %s"
+                   % (c.get("client_errors"), c.get("replica_crashes"),
+                      c.get("respawns"), c.get("retries"),
+                      c.get("recovered_requests")))
+        out.append("")
+    s = rec.get("swap") or {}
+    if s:
+        out.append("rolling param swap under load (torn_swap armed):")
+        out.append("  %s responses: %s old / %s new / %s MIXED, "
+                   "%s failed; %s swaps, torn window injected %sx"
+                   % (s.get("responses"), s.get("old_version"),
+                      s.get("new_version"), s.get("mixed_version"),
+                      s.get("failed"), s.get("swaps"),
+                      s.get("torn_injected")))
+        out.append("")
+    if rec.get("incomplete"):
+        out.append("INCOMPLETE: %s" % rec["incomplete"])
+    return "\n".join(out) + "\n"
+
+
 def render_compile(rec):
     """Per-site compile registry table."""
     xp = rec.get("xprof") or {}
@@ -675,12 +733,14 @@ def main(argv=None):
                    help="slowest steps to show (default 10)")
     p.add_argument("--view", default="steps",
                    choices=("steps", "compile", "ops", "memory", "bench",
-                            "serve", "tune"),
+                            "serve", "fleet", "tune"),
                    help="steps (default): slowest-step trace table; "
                         "compile/ops/memory/bench: xprof views over a "
                         "BENCH record file; serve: latency decomposition "
                         "+ load sweep over a SERVE_bench.json record; "
-                        "tune: autotuner winners/losers per site from "
+                        "fleet: recovery window + swap purity over a "
+                        "FLEET_bench.json record; tune: autotuner "
+                        "winners/losers per site from "
                         "MFU_EXPERIMENTS.jsonl")
     p.add_argument("--profile-report", action="store_true",
                    help="auto-discover the newest BENCH / chip_watch "
@@ -702,6 +762,13 @@ def main(argv=None):
             sys.stdout.write("no serving record in %s\n" % a.path)
             return 1
         sys.stdout.write(render_serve(rec))
+        return 0
+    if a.view == "fleet":
+        rec = latest_fleet_record(load_bench_records(a.path))
+        if rec is None:
+            sys.stdout.write("no fleet record in %s\n" % a.path)
+            return 1
+        sys.stdout.write(render_fleet(rec))
         return 0
     if a.view != "steps":
         rec = latest_xprof_record(load_bench_records(a.path))
