@@ -735,6 +735,8 @@ def _serve_main():
     }
     if "--smoke" in sys.argv[1:]:
         env["MXNET_TPU_BENCH_SERVE_SMOKE"] = "1"
+    if "--lanes" in sys.argv[1:]:
+        env["MXNET_TPU_BENCH_SERVE_LANES"] = "1"
     result = _run_child(env, timeout_s)
     if result is None:
         result = {"metric": "serve_goodput_rps", "value": 0,
@@ -831,7 +833,12 @@ def _serve_tier(srv, rate, duration, slo_ms, rng):
     """One open-loop load tier: Poisson arrivals at ``rate`` req/s for
     ``duration`` seconds, submissions never waiting on completions
     (overload shows up as queue growth -> tail latency, exactly like a
-    real load balancer feeding a replica). Returns the tier record."""
+    real load balancer feeding a replica). Returns the tier record,
+    including the tier's own occupancy delta, queue-depth percentiles
+    and where the adaptive-wait controller ended up."""
+    sched = srv.scheduler
+    occ0 = sched.occupancy_snapshot()
+    sched.drain_depth_samples()
     dim = srv._data_shapes[0][1:]
     row = rng.rand(1, *dim).astype(np.float32)
     reqs = []
@@ -864,7 +871,76 @@ def _serve_tier(srv, rate, duration, slo_ms, rng):
             "p50_ms": q(0.50), "p99_ms": q(0.99), "p999_ms": q(0.999)}
     tier["slo_ok"] = bool(lat) and tier["p99_ms"] <= slo_ms \
         and not failures
+    occ1 = sched.occupancy_snapshot()
+    db = occ1["batches"] - occ0["batches"]
+    tier["mean_occupancy"] = round(
+        (occ1["occ_sum"] - occ0["occ_sum"]) / db, 4) if db else 0.0
+    depth = sched.drain_depth_samples()
+    if depth:
+        depth.sort()
+        tier["queue_depth"] = {
+            "p50": depth[len(depth) // 2],
+            "p99": depth[min(len(depth) - 1, int(0.99 * len(depth)))],
+            "max": depth[-1]}
+    tier["adaptive_wait_ms"] = \
+        sched.controller_state()["adaptive_wait_ms"]
     return tier
+
+
+def _serve_lanes_tier(srv, rate, duration, slo_ms, rng):
+    """Mixed-workload tier for ``--lanes``: an interactive Poisson
+    stream (70% of the offered rate, deadline = SLO) interleaved with
+    a batch-lane stream (30%, 4x looser deadline). Per-lane goodput
+    counts a request only against its OWN deadline, so the record
+    shows the batch lane riding along without starving and the
+    interactive lane holding its deadline."""
+    from mxnet_tpu import serving
+
+    dim = srv._data_shapes[0][1:]
+    row = rng.rand(1, *dim).astype(np.float32)
+    lanes = {"interactive": {"rate": rate * 0.7, "deadline_ms": slo_ms},
+             "batch": {"rate": rate * 0.3, "deadline_ms": 4 * slo_ms}}
+    reqs = {lane: [] for lane in lanes}
+    t0 = time.perf_counter()
+    t_end = t0 + duration
+    nxt = {lane: t0 + rng.exponential(1.0 / cfg["rate"])
+           for lane, cfg in lanes.items()}
+    while True:
+        lane = min(nxt, key=nxt.get)
+        if nxt[lane] >= t_end:
+            break
+        now = time.perf_counter()
+        if nxt[lane] > now:
+            time.sleep(nxt[lane] - now)
+        cfg = lanes[lane]
+        reqs[lane].append(srv.submit([row], priority=lane,
+                                     deadline_ms=cfg["deadline_ms"]))
+        nxt[lane] += rng.exponential(1.0 / cfg["rate"])
+    out = {}
+    for lane, cfg in lanes.items():
+        lat, shed, failures = [], 0, 0
+        for r in reqs[lane]:
+            try:
+                r.get(120)
+                lat.append(r.latency_ms)
+            except serving.RequestShed:
+                shed += 1
+            except Exception:
+                failures += 1
+        lat.sort()
+
+        def q(p):
+            return round(lat[min(len(lat) - 1, int(p * len(lat)))], 2) \
+                if lat else None
+
+        good = sum(1 for v in lat if v <= cfg["deadline_ms"])
+        out[lane] = {"offered_rps": round(cfg["rate"], 1),
+                     "deadline_ms": cfg["deadline_ms"],
+                     "served": len(lat), "shed": shed,
+                     "failures": failures,
+                     "goodput_rps": round(good / duration, 1),
+                     "p50_ms": q(0.50), "p99_ms": q(0.99)}
+    return out
 
 
 def _bench_serve():
@@ -891,6 +967,8 @@ def _bench_serve():
     xprof.reset()
     # graft: env-ok
     smoke = bool(os.environ.get("MXNET_TPU_BENCH_SERVE_SMOKE"))
+    # graft: env-ok
+    lanes_sweep = bool(os.environ.get("MXNET_TPU_BENCH_SERVE_LANES"))
 
     n_dev = len(jax.devices())
     dp = min(8, n_dev)
@@ -923,7 +1001,7 @@ def _bench_serve():
         di0 = telemetry.peek("infer.dispatches") or 0
         ba0 = telemetry.peek("serve.batches") or 0
 
-        rates = [50, 150] if smoke else [25, 50, 100, 200, 400, 800]
+        rates = [50, 150, 300] if smoke else [25, 50, 100, 200, 400, 800]
         duration = 1.5 if smoke else 4.0
         tiers = []
         for rate in rates:
@@ -932,12 +1010,19 @@ def _bench_serve():
             if not tier["slo_ok"]:
                 break
 
+        lanes = None
+        if lanes_sweep:
+            lanes = _serve_lanes_tier(srv, 150 if smoke else 200,
+                                      duration, slo_ms, rng)
+
         xp1 = (xprof.summary()["sites"].get("fused_infer")
                or {}).get("compiles", 0)
         rc1 = telemetry.peek("infer.recompiles") or 0
         di1 = telemetry.peek("infer.dispatches") or 0
         ba1 = telemetry.peek("serve.batches") or 0
         stats = srv.stats()
+        traj = srv.scheduler.wait_trajectory()
+        lane_counts = srv.scheduler.lane_stats()
         buckets = list(srv.buckets)
         compiles = srv.compiles
     finally:
@@ -946,13 +1031,16 @@ def _bench_serve():
     good = [t for t in tiers if t["slo_ok"]]
     best = good[-1] if good else tiers[-1]
     decomp = {}
-    for k in ("queue_ms", "h2d_ms", "dispatch_ms", "d2h_ms",
-              "pad_waste_ms", "request_ms"):
+    for k in ("queue_ms", "sched_idle_ms", "h2d_ms", "dispatch_ms",
+              "d2h_ms", "pad_waste_ms", "request_ms"):
         exp = telemetry.histogram("serve." + k).export()
         if exp.get("count"):
             decomp[k] = {"mean": round(exp["mean"], 3),
                          "p50": round(exp["p50"], 3),
                          "p99": round(exp["p99"], 3)}
+    if len(traj) > 64:   # downsample evenly; the full ring lives in
+        step = len(traj) / 64.0          # the scheduler, not the JSON
+        traj = [traj[int(i * step)] for i in range(64)]
     batches = ba1 - ba0
     result = {
         "metric": "serve_goodput_rps",
@@ -961,19 +1049,30 @@ def _bench_serve():
         "n_devices": n_dev, "dp": dp,
         "buckets": buckets, "max_batch": max_batch,
         "max_wait_ms": max_wait_ms, "slo_ms": slo_ms,
+        "adaptive": stats.get("adaptive", False),
+        "adaptive_wait_ms": stats.get("adaptive_wait_ms"),
         "requests_per_sec": best["achieved_rps"],
         "goodput_rps_at_slo": best["goodput_rps"],
         "p50_ms": best["p50_ms"], "p99_ms": best["p99_ms"],
         "p999_ms": best["p999_ms"],
         "mean_batch_occupancy": stats.get("mean_occupancy", 0.0),
+        "queue_depth": {k: stats[sk] for k, sk in
+                        (("p50", "queue_depth_p50"),
+                         ("p99", "queue_depth_p99"),
+                         ("max", "queue_depth_max"))
+                        if stats.get(sk) is not None},
         "compiles": compiles,
         "steady_state_retraces": (rc1 - rc0) + (xp1 - xp0),
         "zero_steady_state_retraces": rc1 == rc0 and xp1 == xp0,
         "dispatches_per_request_batch":
             round((di1 - di0) / batches, 3) if batches else 0.0,
         "latency_decomposition_ms": decomp,
+        "adaptive_wait_trajectory": traj,
+        "lane_counts": lane_counts,
         "tiers": tiers, "smoke": smoke,
     }
+    if lanes is not None:
+        result["lanes"] = lanes
     print(json.dumps(result))
     return result
 

@@ -409,6 +409,28 @@ declare("MXNET_TPU_SERVE_SLO_MS", float, 0.0,
         "to `degraded` (HTTP 503) and a `slow_request` anomaly fires "
         "through the step-trace detectors. `0` disables SLO "
         "enforcement (latency is still measured).", section=_S)
+declare("MXNET_TPU_SERVE_ADAPTIVE", bool, True,
+        "Adaptive deadline-aware scheduling: a closed-loop controller "
+        "replaces the fixed `MXNET_TPU_SERVE_MAX_WAIT_MS` coalescing "
+        "window, widening it while the sliding-window p99 has headroom "
+        "against `MXNET_TPU_SERVE_SLO_MS` (filling bigger buckets) and "
+        "collapsing it near breach; dispatch is earliest-deadline-"
+        "first with overload shedding. Needs a nonzero SLO to close "
+        "the loop on — without one the static window applies "
+        "regardless. Set to 0 to pin the wait manually.", section=_S)
+declare("MXNET_TPU_SERVE_DEADLINE_MS", float, 0.0,
+        "Default per-request deadline for the interactive lane when "
+        "the caller does not pass `deadline_ms`. `0`: use the SLO "
+        "(`MXNET_TPU_SERVE_SLO_MS`) when the adaptive scheduler is "
+        "active, else no implicit deadline. Deadlines drive EDF "
+        "dispatch order, the slack-triggered early dispatch, and "
+        "which requests overload shedding may drop.", section=_S)
+declare("MXNET_TPU_SERVE_BATCH_DEADLINE_MS", float, 0.0,
+        "Default per-request deadline for the `batch` priority lane. "
+        "`0`: 4x the interactive default. Batch-lane requests ride "
+        "along in whatever bucket capacity the interactive lane "
+        "leaves free and are the first shed under overload.",
+        section=_S)
 
 _F = "Fleet / fault injection"
 declare("MXNET_TPU_FLEET_REPLICAS", int, 2,

@@ -210,7 +210,15 @@ class Replica:
 
     rid: str = "?"
 
-    def submit(self, arrays, request_id: Optional[str] = None):
+    def submit(self, arrays, request_id: Optional[str] = None,
+               deadline_ms: Optional[float] = None,
+               priority: Optional[str] = None):
+        """``deadline_ms`` is the request's REMAINING budget as the
+        router sees it at this attempt — a retry or hedge arrives at
+        the replica with its true remaining slack, not a fresh
+        deadline, so the replica's scheduler cannot double-spend time
+        the router has already burned. ``priority`` picks the
+        scheduler lane."""
         raise NotImplementedError
 
     def health(self) -> dict:
@@ -309,13 +317,17 @@ class InProcReplica(Replica):
         return (not self._dead and srv is not None
                 and not getattr(srv, "closed", False))
 
-    def submit(self, arrays, request_id: Optional[str] = None):
+    def submit(self, arrays, request_id: Optional[str] = None,
+               deadline_ms: Optional[float] = None,
+               priority: Optional[str] = None):
         if _faults.fires("replica_crash"):
             self.kill()
         srv = self._srv
         if not self.alive() or srv is None:
             raise ReplicaCrash("replica %s is down" % self.rid)
-        return _RequestWaiter(srv.submit(arrays, request_id=request_id))
+        return _RequestWaiter(srv.submit(arrays, request_id=request_id,
+                                         deadline_ms=deadline_ms,
+                                         priority=priority))
 
     def health(self) -> dict:
         srv = self._srv
@@ -397,7 +409,15 @@ def _subprocess_replica_main(conn, factory_ref: str):
                 if _faults.fires("replica_crash"):
                     os._exit(23)
                 try:
-                    out = srv.infer(msg[3], timeout=60.0)
+                    # envelope: (op, mid, request_id, arrays,
+                    # deadline_ms, priority) — the deadline is the
+                    # router's REMAINING budget for this attempt; old
+                    # parents that omit the tail fields still work
+                    out = srv.submit(
+                        msg[3], request_id=msg[2],
+                        deadline_ms=msg[4] if len(msg) > 4 else None,
+                        priority=msg[5] if len(msg) > 5 else None,
+                    ).get(60.0)
                     conn.send(("ok", mid,
                                [np.asarray(o) for o in out]))
                 except BaseException as e:   # noqa: BLE001 (report,
@@ -521,9 +541,12 @@ class SubprocessReplica(Replica):
         return (not self._dead and not self._closed
                 and self._proc.is_alive())
 
-    def submit(self, arrays, request_id: Optional[str] = None):
+    def submit(self, arrays, request_id: Optional[str] = None,
+               deadline_ms: Optional[float] = None,
+               priority: Optional[str] = None):
         arrays = [np.asarray(a) for a in arrays]
-        return self._send("infer", (request_id, arrays))
+        return self._send("infer", (request_id, arrays, deadline_ms,
+                                    priority))
 
     def health(self, timeout_s: float = 5.0) -> dict:
         return self._send("health").wait(timeout_s)
@@ -870,10 +893,15 @@ class FleetRouter:
     # -- request path ------------------------------------------------------
     def submit(self, arrays, session: Optional[str] = None,
                request_id: Optional[str] = None,
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               priority: Optional[str] = None) -> Future:
         """Route one request; returns a Future resolving to the result
         arrays (or raising a :class:`FleetError` once the deadline
-        budget is spent)."""
+        budget is spent). The deadline is ONE total budget: every
+        attempt (retry or hedge) ships the remaining slack to the
+        replica in the request envelope, so the replica's scheduler
+        never re-starts the clock. ``priority`` picks the replica
+        scheduler's lane (interactive/batch)."""
         if self._closed:
             raise MXNetError("FleetRouter is closed")
         rid = request_id or uuid.uuid4().hex
@@ -881,21 +909,24 @@ class FleetRouter:
                       else float(deadline_ms) / 1e3)
         self._count("requests")
         return self._pool.submit(self._serve, arrays, session, rid,
-                                 deadline_s)
+                                 deadline_s, priority)
 
     def infer(self, arrays, session: Optional[str] = None,
               request_id: Optional[str] = None,
               deadline_ms: Optional[float] = None,
+              priority: Optional[str] = None,
               timeout: Optional[float] = None):
         """Synchronous convenience: submit + wait."""
         deadline_s = (self._deadline_s if deadline_ms is None
                       else float(deadline_ms) / 1e3)
         return self.submit(arrays, session=session, request_id=request_id,
-                           deadline_ms=deadline_ms).result(
+                           deadline_ms=deadline_ms,
+                           priority=priority).result(
                                deadline_s + 5.0 if timeout is None
                                else timeout)
 
-    def _serve(self, arrays, session, request_id, deadline_s):
+    def _serve(self, arrays, session, request_id, deadline_s,
+               priority=None):
         t_start = self._clock()
         attempt = 0
         exclude: set = set()
@@ -926,7 +957,8 @@ class FleetRouter:
             t_a = self._clock()
             try:
                 result = self._attempt(rid, entry, arrays, request_id,
-                                       min(self._attempt_s, remaining))
+                                       min(self._attempt_s, remaining),
+                                       priority)
             except (FleetError, MXNetError) as e:
                 last_err = e
                 with self._rlock:
@@ -958,11 +990,17 @@ class FleetRouter:
         if remaining > 0:
             self._sleep(min(delay, remaining))
 
-    def _attempt(self, rid, entry, arrays, request_id, timeout_s):
+    def _attempt(self, rid, entry, arrays, request_id, timeout_s,
+                 priority=None):
         with self._rlock:
             entry.inflight += 1
         try:
-            w = entry.replica.submit(arrays, request_id=request_id)
+            # the envelope deadline is exactly this attempt's timeout:
+            # the remaining total budget, already net of earlier
+            # attempts — a retried request cannot double-spend slack
+            w = entry.replica.submit(arrays, request_id=request_id,
+                                     deadline_ms=timeout_s * 1e3,
+                                     priority=priority)
             hedge_after = self._hedge_after_s() if self._hedge else None
             if hedge_after is None or hedge_after >= timeout_s:
                 return w.wait(timeout_s)
@@ -971,15 +1009,17 @@ class FleetRouter:
             except AttemptTimeout:
                 pass
             return self._hedged_wait(rid, w, arrays, request_id,
-                                     timeout_s - hedge_after)
+                                     timeout_s - hedge_after, priority)
         finally:
             with self._rlock:
                 entry.inflight -= 1
 
-    def _hedged_wait(self, rid, w1, arrays, request_id, remaining_s):
+    def _hedged_wait(self, rid, w1, arrays, request_id, remaining_s,
+                     priority=None):
         """The attempt is past p95: duplicate it elsewhere (same
-        request-id — the replica dedupes), first response wins, the
-        loser is abandoned."""
+        request-id — the replica dedupes; same REMAINING deadline — the
+        hedge doesn't get fresh slack), first response wins, the loser
+        is abandoned."""
         self._count("hedges")
         try:
             rid2, e2 = self._pick(None, exclude={rid})
@@ -989,7 +1029,9 @@ class FleetRouter:
             e2.inflight += 1
         try:
             try:
-                w2 = e2.replica.submit(arrays, request_id=request_id)
+                w2 = e2.replica.submit(arrays, request_id=request_id,
+                                       deadline_ms=remaining_s * 1e3,
+                                       priority=priority)
             except FleetError:
                 return w1.wait(remaining_s)
             waiters = {rid: w1, rid2: w2}

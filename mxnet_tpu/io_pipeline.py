@@ -821,9 +821,14 @@ class RequestStager:
     what keeps every dispatch one of at most ``len(buckets)`` stable
     shapes — mixed request rates never retrace.
 
-    Telemetry: ``serve.h2d_ms`` (histogram, pack+place wall time),
-    ``serve.h2d_bytes``, and ``serve.pad_rows`` so the mean-occupancy
-    number in ``SERVE_bench.json`` stays honest about pad waste.
+    A single payload that already fills its bucket (the interactive
+    lane's common case once the adaptive scheduler ships full rungs)
+    skips the concat+pad entirely (``serve.stage_fastpath``).
+
+    Telemetry: ``serve.h2d_bytes`` and ``serve.pad_rows`` so the
+    mean-occupancy number in ``SERVE_bench.json`` stays honest about
+    pad waste (the wall-time split lives in the scheduler's
+    per-request ``serve.h2d_ms``).
     """
 
     def __init__(self, place=None):
@@ -849,22 +854,27 @@ class RequestStager:
         shape ``(k, ...)``, normally k=1), all with the same arity.
         Returns ``(placed_arrays, pad)`` where ``pad`` is the number of
         zero rows added to reach ``bucket``."""
-        t0 = time.perf_counter()
         n = sum(int(r[0].shape[0]) for r in rows)
         if n > bucket:
             raise MXNetError("request batch of %d rows scheduled into a "
                              "bucket of %d" % (n, bucket))
-        cols = list(zip(*rows))
-        batch = [np.concatenate([np.asarray(a) for a in c],  # graft: host-sync
-                                axis=0)
-                 for c in cols]
         pad = bucket - n
-        if pad:
-            batch = [np.concatenate(
-                [b, self._pad_rows(pad, b.shape[1:], b.dtype)], axis=0)
-                for b in batch]
+        if len(rows) == 1 and pad == 0:
+            # interactive fast path: one payload already filling its
+            # bucket — no concat, no pad, straight to placement
+            batch = [np.asarray(a) for a in rows[0]]  # graft: host-sync
+            _tel.inc("serve.stage_fastpath")
+        else:
+            cols = list(zip(*rows))
+            batch = [np.concatenate([np.asarray(a) for a in c],  # graft: host-sync
+                                    axis=0)
+                     for c in cols]
+            if pad:
+                batch = [np.concatenate(
+                    [b, self._pad_rows(pad, b.shape[1:], b.dtype)],
+                    axis=0)
+                    for b in batch]
         placed = self._place(batch) if self._place is not None else batch
-        _tel.observe("serve.h2d_ms", (time.perf_counter() - t0) * 1e3)
         _tel.inc("serve.h2d_bytes", sum(int(b.nbytes) for b in batch))
         if pad:
             _tel.inc("serve.pad_rows", pad)

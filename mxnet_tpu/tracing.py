@@ -190,7 +190,12 @@ class SlowRequestDetector:
     reports a worst-case per-request latency (``request_ms``, stamped
     into the record by ``serving.BatchScheduler``) over the SLO
     (``slo_ms``, stamped from ``MXNET_TPU_SERVE_SLO_MS``). Training
-    records never carry ``request_ms``, so this is inert there."""
+    records never carry ``request_ms``, so this is inert there.
+
+    When the record carries the adaptive scheduler's controller state
+    (``adaptive_wait_ms``, ``queue_depth``) the event copies it, so a
+    breached SLO is attributable at a glance: a wide wait means the
+    controller was still coalescing, a deep queue means overload."""
 
     type = "slow_request"
 
@@ -198,9 +203,13 @@ class SlowRequestDetector:
         req = rec.get("request_ms")
         slo = rec.get("slo_ms")
         if req is not None and slo and req > slo:
-            return {"type": self.type, "request_ms": round(req, 3),
-                    "slo_ms": round(float(slo), 3),
-                    "over_frac": round(req / slo - 1.0, 3)}
+            ev = {"type": self.type, "request_ms": round(req, 3),
+                  "slo_ms": round(float(slo), 3),
+                  "over_frac": round(req / slo - 1.0, 3)}
+            for k in ("adaptive_wait_ms", "queue_depth"):
+                if rec.get(k) is not None:
+                    ev[k] = rec[k]
+            return ev
         return None
 
 

@@ -120,12 +120,15 @@ class FakeReplica(fleet.Replica):
         self.behavior = behavior
         self.health_status = "ok"
         self.submits = 0
+        self.envelopes = []   # (request_id, deadline_ms, priority)
         self._alive = True
         self._clock_sleep = clock_sleep
         self._slow_s = slow_s
 
-    def submit(self, arrays, request_id=None):
+    def submit(self, arrays, request_id=None, deadline_ms=None,
+               priority=None):
         self.submits += 1
+        self.envelopes.append((request_id, deadline_ms, priority))
         if not self._alive:
             raise ReplicaCrash("replica %s is down" % self.rid)
         if self.behavior == "crash":
@@ -716,3 +719,102 @@ def test_subprocess_replica_serves_and_survives_sigkill(tel):
         assert st["counters"]["respawns"] >= 1
     finally:
         router.close()
+
+
+# ---------------------------------------------------------------------------
+# deadline-budget envelope: the scheduling envelope every attempt ships
+# ---------------------------------------------------------------------------
+
+def test_retry_envelope_carries_remaining_budget_not_fresh():
+    """A retried attempt submits with the REMAINING deadline budget in
+    its envelope, not the original one — a request can't double-spend
+    its slack across replicas."""
+    clock = FakeClock()
+    router, made = _fake_router(["hang", "hang"], clock=clock,
+                                deadline_ms=500.0,
+                                attempt_timeout_ms=300.0, retries=10,
+                                backoff_ms=10.0, hedge=False)
+    try:
+        with pytest.raises(DeadlineExceeded):
+            router._serve([_rows(1)], None, "req-env", 0.5,
+                          priority="batch")
+        envs = [e for r in made.values() for e in r.envelopes]
+        assert len(envs) >= 2
+        for rid, dl, prio in envs:
+            assert rid == "req-env"
+            assert prio == "batch"
+            assert dl <= 500.0 + 1e-9
+        deadlines = sorted((dl for _, dl, _ in envs), reverse=True)
+        # first attempt gets the full attempt timeout; the retry only
+        # what the first one left behind (300ms attempt + backoff gone)
+        assert deadlines[0] == pytest.approx(300.0)
+        assert deadlines[1] < 200.0
+    finally:
+        router.close()
+
+
+class _RecordingServer:
+    """Duck-typed InferenceServer: records each submit envelope."""
+
+    def __init__(self):
+        self.calls = []
+        self.closed = False
+
+    def submit(self, arrays, request_id=None, deadline_ms=None,
+               priority=None):
+        self.calls.append((request_id, deadline_ms, priority))
+        outs = [np.asarray(a) * 2.0 for a in arrays]
+
+        class _Done:
+            def get(self, timeout=None):
+                return outs
+
+            def done(self):
+                return True
+
+        return _Done()
+
+    def close(self):
+        self.closed = True
+
+
+def test_inproc_replica_passes_envelope_through():
+    srv = _RecordingServer()
+    rep = fleet.InProcReplica("r0", lambda: srv)
+    x = _rows(1, seed=9)
+    w = rep.submit([x], request_id="rid-1", deadline_ms=42.0,
+                   priority="batch")
+    (out,) = w.wait(1.0)
+    assert np.array_equal(out, x * 2.0)
+    assert srv.calls == [("rid-1", 42.0, "batch")]
+    rep.close()
+
+
+def test_subprocess_wire_envelope_layout():
+    """The parent-side wire message carries (op, mid, request_id,
+    arrays, deadline_ms, priority) — the layout the child handler (and
+    any older child that ignores the tail fields) decodes."""
+    sent = []
+
+    class _FakeConn:
+        def send(self, msg):
+            sent.append(msg)
+
+    rep = fleet.SubprocessReplica.__new__(fleet.SubprocessReplica)
+    rep.rid = "r0"
+    rep._lock = threading.Lock()
+    rep._dead = False
+    rep._closed = False
+    rep._pending = {}
+    rep._conn = _FakeConn()
+    rep._proc = type("P", (), {"is_alive": staticmethod(lambda: True)})()
+    x = _rows(1, seed=4)
+    rep.submit([x], request_id="rid-2", deadline_ms=77.0,
+               priority="interactive")
+    assert len(sent) == 1
+    op, mid, request_id, arrays, deadline_ms, priority = sent[0]
+    assert op == "infer"
+    assert request_id == "rid-2"
+    assert np.array_equal(arrays[0], x)
+    assert deadline_ms == 77.0
+    assert priority == "interactive"

@@ -340,10 +340,14 @@ def fire():
             f.write("\n")
     _commit("multichip dp scaling", stamp)
     # 7. serving tier: continuous-batching goodput sweep against the
-    # tail-latency SLO -> SERVE_bench.json. Same INCOMPLETE contract as
-    # the multichip stage: bench.py stamps its own record when the
-    # child dies; a wedged orchestrator gets one written here.
-    out = _run([py, os.path.join(REPO, "bench.py"), "serve"], 2000)
+    # tail-latency SLO, with the adaptive deadline-aware scheduler and
+    # the mixed interactive/batch lane workload -> SERVE_bench.json
+    # (occupancy, adaptive-wait trajectory, per-lane goodput). Same
+    # INCOMPLETE contract as the multichip stage: bench.py stamps its
+    # own record when the child dies; a wedged orchestrator gets one
+    # written here.
+    out = _run([py, os.path.join(REPO, "bench.py"), "serve",
+                "--lanes"], 2000)
     if out is None:
         with open(os.path.join(REPO, "SERVE_bench.json"), "w") as f:
             json.dump({"metric": "serve_goodput_rps", "value": 0,

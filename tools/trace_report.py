@@ -406,7 +406,8 @@ def latest_serve_record(recs):
 
 def render_serve(rec):
     """Serving view: the goodput/SLO headline, per-request latency
-    decomposition (queue / h2d / dispatch / pad-waste / d2h), and the
+    decomposition (queue / sched-idle / h2d / dispatch / pad-waste /
+    d2h), the adaptive-wait trajectory, the per-lane table, and the
     offered-load sweep table."""
     out = ["serving: %.1f req/s (goodput at %sms SLO: %.1f), "
            "p50 %.2fms  p99 %.2fms  p999 %.2fms"
@@ -420,11 +421,20 @@ def render_serve(rec):
            % (rec.get("buckets"), rec.get("dp"),
               100.0 * (rec.get("mean_batch_occupancy") or 0.0),
               rec.get("compiles"), rec.get("steady_state_retraces"),
-              rec.get("dispatches_per_request_batch")), ""]
+              rec.get("dispatches_per_request_batch"))]
+    if rec.get("adaptive") is not None:
+        qd = rec.get("queue_depth") or {}
+        out.append("adaptive %s  wait %.2fms  queue depth p50 %s  "
+                   "p99 %s  max %s"
+                   % ("on" if rec.get("adaptive") else "off",
+                      rec.get("adaptive_wait_ms") or 0.0,
+                      qd.get("p50", "-"), qd.get("p99", "-"),
+                      qd.get("max", "-")))
+    out.append("")
     dec = rec.get("latency_decomposition_ms") or {}
     if dec:
-        order = ("queue_ms", "h2d_ms", "dispatch_ms", "pad_waste_ms",
-                 "d2h_ms", "request_ms")
+        order = ("queue_ms", "sched_idle_ms", "h2d_ms", "dispatch_ms",
+                 "pad_waste_ms", "d2h_ms", "request_ms")
         rows = [("stage", "mean", "p50", "p99")]
         for k in order:
             h = dec.get(k)
@@ -449,6 +459,39 @@ def render_serve(rec):
                          "%.2f" % t.get("p999_ms", 0),
                          "ok" if t.get("slo_ok") else "BREACH"))
         out.append("offered-load sweep (req/s):")
+        out += _table(rows)
+        out.append("")
+    lanes = rec.get("lanes") or {}
+    if lanes:
+        rows = [("lane", "offered", "goodput", "deadline_ms", "served",
+                 "shed", "p50_ms", "p99_ms")]
+        for name, ln in sorted(lanes.items()):
+            rows.append((name, "%.1f" % (ln.get("offered_rps") or 0),
+                         "%.1f" % (ln.get("goodput_rps") or 0),
+                         "%g" % (ln.get("deadline_ms") or 0),
+                         str(ln.get("served", "-")),
+                         str(ln.get("shed", "-")),
+                         "%.2f" % (ln.get("p50_ms") or 0),
+                         "%.2f" % (ln.get("p99_ms") or 0)))
+        out.append("per-lane goodput (mixed workload):")
+        out += _table(rows)
+        out.append("")
+    traj = rec.get("adaptive_wait_trajectory") or []
+    if traj:
+        # downsample to ~16 rows: enough to see the controller ramp,
+        # collapse and recovery without drowning the report
+        step = max(1, len(traj) // 16)
+        rows = [("t_s", "wait_ms", "depth", "rows", "bucket", "occ",
+                 "reason")]
+        for p in traj[::step]:
+            rows.append(("%.2f" % (p.get("t_s") or 0),
+                         "%.2f" % (p.get("wait_ms") or 0),
+                         str(p.get("queue_depth", "-")),
+                         str(p.get("rows", "-")),
+                         str(p.get("bucket", "-")),
+                         "%.2f" % (p.get("occupancy") or 0),
+                         str(p.get("reason", "-"))))
+        out.append("adaptive-wait trajectory (sampled):")
         out += _table(rows)
         out.append("")
     if rec.get("incomplete"):
