@@ -82,6 +82,17 @@ autotune:
 fleet-bench:
 	python bench.py fleet
 
+# distributed-tracing smoke: the fleet bench (smoke profile) with the
+# tracer armed must produce a loadable merged chrome trace holding at
+# least one kept span tree -> FLEET_trace.json (read it with
+# trace_report --view waterfall, or load it in Perfetto)
+trace-smoke:
+	MXNET_TPU_DTRACE=1 python bench.py fleet --smoke
+	python -c "import json; d=json.load(open('FLEET_trace.json')); \
+	evs=[e for e in d['traceEvents'] if e.get('cat')=='dtrace']; \
+	assert evs, 'no dtrace events in FLEET_trace.json'; \
+	print('FLEET_trace.json ok: %d dtrace events' % len(evs))"
+
 # preemption-safety suite: crash-safe writes, torn-file detection,
 # bit-identical kill-at-step-k resume, elastic dp rejoin, SIGTERM grace
 ckpt-test:
@@ -90,4 +101,4 @@ ckpt-test:
 clean:
 	rm -rf mxnet_tpu/_native perl-package/blib
 
-.PHONY: all predict perl test lint profile-report multichip serve-bench fleet-bench ckpt-test clean
+.PHONY: all predict perl test lint profile-report multichip serve-bench fleet-bench trace-smoke ckpt-test clean

@@ -1200,13 +1200,16 @@ def _bench_fleet():
     """The measured fleet tier (inner child, forced cpu): a FleetRouter
     over in-process ``demo_server_factory`` replicas.
 
-    Three phases: (1) goodput vs replica count under fixed open-loop
+    Four phases: (1) goodput vs replica count under fixed open-loop
     Poisson load; (2) the chaos acceptance — kill a replica mid-load,
     bin completions into 100ms windows, and measure the recovery time
     until goodput is back to >=90% of the pre-kill rate with ZERO
     client-visible errors; (3) the rolling ``refresh_params`` swap
     under load with the ``torn_swap`` fault armed — every response must
-    be pure-old or pure-new bits, none failed."""
+    be pure-old or pure-new bits, none failed; (4) the distributed-
+    trace acceptance — subprocess replicas with one armed slow, hedged
+    requests traced end to end, the merged clock-aligned tree written
+    to FLEET_trace.json."""
     import jax
 
     if os.environ.get("JAX_PLATFORMS"):
@@ -1353,6 +1356,82 @@ def _bench_fleet():
     finally:
         faults.configure(None)
 
+    # phase 4: distributed trace of a hedged request — SUBPROCESS
+    # replicas this time (real OS processes beside the router). The
+    # first replica spawns with ``slow_replica`` armed through the
+    # inherited env, so first attempts stall past the primed p95 and
+    # hedge to the cleanly-spawned second replica; the tail sampler
+    # must keep the hedged trees, and each must hold the winning AND
+    # the abandoned attempt with replica-side spans from two child
+    # pids, clock-aligned onto the router's wall clock.
+    from mxnet_tpu import dtrace
+
+    os.environ["MXNET_TPU_FAULTS"] = "slow_replica"
+    os.environ["MXNET_TPU_FAULT_SLOW_MS"] = "60"
+    try:
+        r = fleet.FleetRouter(
+            fleet.in_subprocess("mxnet_tpu.fleet:demo_server_factory"),
+            1, deadline_ms=30000.0, attempt_timeout_ms=5000.0,
+            retries=10, backoff_ms=2.0, hedge=True,
+            health_interval_s=60.0)
+    finally:
+        del os.environ["MXNET_TPU_FAULTS"]
+        del os.environ["MXNET_TPU_FAULT_SLOW_MS"]
+    trace = {"hedged_trace": None, "pids": 0, "nested": False}
+    try:
+        r.add_replica()          # clean env: the fast hedge target
+        # warm both children's one-time compile UNTRACED (session ids
+        # walk the hash ring, so a handful covers both replicas)
+        for i in range(16):
+            r.infer([row], session="warm%d" % i)
+        dtrace.enable()
+        for _ in range(12):
+            with r._rlock:       # pin the hedge trigger at ~p95=4ms
+                r._lat.clear()
+                r._lat.extend([0.004] * 30)
+            r.infer([row])
+        time.sleep(0.5)          # let hedge losers' late replies land
+        trace.update(dtrace.stats())
+        for ent in dtrace.kept_traces():
+            if ent["kept"] != "hedge":
+                continue
+            spans = ent["spans"]
+            atts = [s for s in spans if s["name"] == "fleet.attempt"]
+            won = [a for a in atts if a["tags"].get("won")]
+            lost = [a for a in atts if a["tags"].get("abandoned")]
+            if not (won and lost):
+                continue
+
+            def _child_pids(att):
+                return {s["pid"] for s in spans
+                        if s["parent"] == att["span"]
+                        and s["pid"] != att["pid"]}
+
+            pids_w, pids_l = _child_pids(won[0]), _child_pids(lost[0])
+            if not (pids_w and pids_l):
+                continue
+            root = next(s for s in spans if s["parent"] == "")
+            lo, hi = root["ts"], root["ts"] + root["dur"]
+            eps = 0.025
+            nested = all(lo - eps <= s["ts"]
+                         and s["ts"] + s["dur"] <= hi + eps
+                         for s in spans
+                         if s["parent"] == won[0]["span"])
+            trace.update({
+                "hedged_trace": ent["trace_id"],
+                "pids": len({root["pid"]} | pids_w | pids_l),
+                "nested": nested,
+                "spans_in_tree": len(spans)})
+            if nested:
+                break
+        trace_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "FLEET_trace.json")
+        trace["events"] = dtrace.write_chrome_trace(trace_path)
+    finally:
+        r.close()
+        dtrace.disable()
+
     best = max(scaling, key=lambda t: t["achieved_rps"])
     result = {
         "metric": "fleet_goodput_rps",
@@ -1364,6 +1443,9 @@ def _bench_fleet():
                      and chaos["recovered_to_90pct"]),
         "swap_ok": (swap["failed"] == 0 and swap["mixed_version"] == 0
                     and swap["torn_injected"] >= 2),
+        "trace": trace,
+        "trace_ok": (trace["hedged_trace"] is not None
+                     and trace["pids"] >= 3 and trace["nested"]),
         "smoke": smoke,
     }
     print(json.dumps(result))

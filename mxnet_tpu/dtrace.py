@@ -1,0 +1,481 @@
+"""Distributed request tracing for the serving fleet.
+
+The fleet retries, hedges, and sheds (see ``fleet.py``), but aggregate
+histograms cannot answer "which attempt won the hedge, and where did
+its 57 ms go". This module is the Dapper-style answer (Sigelman et
+al., 2010): every request gets a 128-bit trace id; the router's
+``submit`` opens the root span; each retry/hedge attempt is a child
+span tagged with the attempt number, replica id, breaker state and
+won/abandoned; the context rides the subprocess wire envelope (old
+children ignore the extra tail field); and inside the replica the
+``BatchScheduler`` emits queue / sched_idle / h2d / dispatch / d2h
+child spans off its exact latency decomposition. Child processes
+return their completed spans over the wire at reply time together
+with their ``perf_counter``-to-wall offset (captured once at process
+start — the "handshake" epoch), so the router can clock-align spans
+from different interpreters onto one shared wall-clock axis and merge
+them into a single tree.
+
+Sampling is tail-based (the Canopy model, Kaldor et al., 2017): a
+bounded in-flight buffer holds every live tree, but at root-finish
+only trees that *earned* keeping survive — the request errored, was
+shed, breached its SLO, or hedged — plus a head-sampled 1-in-N floor
+(``MXNET_TPU_DTRACE_SAMPLE``). Everything else is dropped on the
+floor with counters (``dtrace.kept`` / ``dtrace.dropped`` /
+``dtrace.spans``), so steady state costs a bounded buffer and no I/O.
+
+Export is Perfetto chrome-trace (one lane per OS process, flow events
+stitching a router attempt to the replica dispatch it landed on) via
+``telemetry.write_chrome_trace(path, extra_events=...)``, plus the
+``tools/trace_report.py --view waterfall <trace_id>`` text rendering.
+
+Disabled cost follows the ``faults.py`` idiom exactly: the live
+tracer is one module global; every hot-path call site does one global
+load plus a ``None`` check and nothing else.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple, Union
+
+from . import env as _env
+from . import telemetry as _tel
+
+__all__ = ["Span", "Tracer", "enable", "disable", "reload", "tracer",
+           "enabled", "ensure_enabled", "finish_root", "harvest",
+           "absorb", "stats", "kept_traces", "to_chrome_events",
+           "write_chrome_trace"]
+
+#: perf_counter -> wall offset for THIS process, captured once at
+#: import (the per-process "handshake" measurement): spans record the
+#: monotonic clock, and ``wall = t + _EPOCH`` places them on the one
+#: clock domain every process on the host shares. Child replicas ship
+#: their own epoch with every span payload so the router aligns spans
+#: it did not record itself.
+_EPOCH = time.time() - time.perf_counter()
+
+#: keep reasons, in decision order (first match wins)
+KEEP_REASONS = ("error", "shed", "slo", "hedge", "head")
+
+
+def _span_id() -> str:
+    """A fresh 64-bit span id (16 hex chars)."""
+    return uuid.uuid4().hex[:16]
+
+
+def _parse_parent(parent) -> Tuple[str, str]:
+    """(trace_id, span_id) from a Span, a wire ctx dict, or a tuple."""
+    if isinstance(parent, Span):
+        return parent.trace_id, parent.span_id
+    if isinstance(parent, dict):
+        return parent["t"], parent["s"]
+    trace_id, span_id = parent
+    return trace_id, span_id
+
+
+class Span:
+    """One open interval in a trace tree. ``finish()`` is idempotent
+    (the hedge path may race the normal completion path to it); tags
+    passed to ``finish`` win over earlier ones."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "tags",
+                 "t0", "_tracer", "_finished")
+
+    def __init__(self, tracer: "Tracer", trace_id: str, span_id: str,
+                 parent_id: str, name: str, tags: dict, t0: float):
+        self._tracer = tracer
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.tags = tags
+        self.t0 = t0
+        self._finished = False
+
+    def ctx(self) -> dict:
+        """The propagation context: what rides the wire envelope."""
+        return {"t": self.trace_id, "s": self.span_id}
+
+    def tag(self, **kv):
+        self.tags.update(kv)
+
+    def finished(self) -> bool:
+        return self._finished
+
+    def finish(self, **tags) -> bool:
+        """Close the span and record it; returns False when a racing
+        path already finished it (the late call's tags are dropped —
+        the first outcome is the true one)."""
+        if self._finished:
+            return False
+        self._finished = True
+        if tags:
+            self.tags.update(tags)
+        self._tracer._record(self._to_record(), self.trace_id)
+        return True
+
+    def _to_record(self) -> dict:
+        return {"trace": self.trace_id, "span": self.span_id,
+                "parent": self.parent_id, "name": self.name,
+                "pid": os.getpid(), "tid": threading.get_ident(),
+                "t0": self.t0,
+                "dur": max(0.0, self._tracer._clock() - self.t0),
+                "tags": dict(self.tags)}
+
+
+class Tracer:
+    """The span-tree store for one process.
+
+    In the router process it owns root spans and the tail-sampling
+    decision; in a replica child it is just a buffer the wire
+    ``harvest`` drains at reply time. ``clock``/``epoch`` are
+    injectable so the tail sampler and the waterfall math are pinned
+    by fake-clock tests with zero real waiting.
+    """
+
+    def __init__(self, sample: Optional[int] = None,
+                 buffer: Optional[int] = None,
+                 keep: Optional[int] = None,
+                 clock=time.perf_counter,
+                 epoch: Optional[float] = None):
+        self._sample = int(_env.get("MXNET_TPU_DTRACE_SAMPLE")
+                           if sample is None else sample)
+        self._buffer = max(1, int(_env.get("MXNET_TPU_DTRACE_BUFFER")
+                                  if buffer is None else buffer))
+        self._keep_cap = max(1, int(_env.get("MXNET_TPU_DTRACE_KEEP")
+                                    if keep is None else keep))
+        self._clock = clock
+        self._epoch = _EPOCH if epoch is None else float(epoch)
+        self._lock = threading.Lock()
+        #: in-flight trace id -> completed span records (raw clock)
+        self._bufs: "OrderedDict[str, List[dict]]" = OrderedDict()
+        #: locally-started trace id -> head-sample decision
+        self._head: Dict[str, bool] = {}
+        #: finished kept traces, oldest evicted first
+        self._kept: "OrderedDict[str, dict]" = OrderedDict()
+        self._n = 0
+        self.kept = 0
+        self.dropped = 0
+        self.spans = 0
+        self.overflow = 0
+        self.late = 0
+
+    # -- span creation -----------------------------------------------------
+    def start_trace(self, name: str, request_id: Optional[str] = None,
+                    tags: Optional[dict] = None) -> Optional[Span]:
+        """Open a root span (a fresh 128-bit trace id). Returns None
+        when the in-flight buffer is full — the request simply goes
+        untraced rather than growing the buffer unboundedly."""
+        with self._lock:
+            if len(self._bufs) >= self._buffer:
+                self.overflow += 1
+                _tel.inc("dtrace.overflow")
+                return None
+            self._n += 1
+            head = bool(self._sample) and self._n % self._sample == 0
+            trace_id = uuid.uuid4().hex   # 128 bits
+            self._bufs[trace_id] = []
+            self._head[trace_id] = head
+        t = dict(tags or ())
+        if request_id:
+            t["request_id"] = request_id
+        return Span(self, trace_id, _span_id(), "", name, t,
+                    self._clock())
+
+    def start_span(self, name: str, parent,
+                   tags: Optional[dict] = None) -> Span:
+        """Open a child span under ``parent`` (a Span, a wire ctx
+        dict, or a ``(trace_id, span_id)`` tuple)."""
+        trace_id, parent_id = _parse_parent(parent)
+        return Span(self, trace_id, _span_id(), parent_id, name,
+                    dict(tags or ()), self._clock())
+
+    def emit(self, name: str, parent, t0: float, t1: float,
+             tags: Optional[dict] = None) -> str:
+        """Record an already-measured interval as a completed span
+        (the scheduler's decomposition timestamps arrive this way);
+        returns the new span id so callers can parent further spans
+        or cross-link (``batch=<id>``) without holding the Span."""
+        trace_id, parent_id = _parse_parent(parent)
+        span_id = _span_id()
+        self._record({"trace": trace_id, "span": span_id,
+                      "parent": parent_id, "name": name,
+                      "pid": os.getpid(),
+                      "tid": threading.get_ident(),
+                      "t0": float(t0),
+                      "dur": max(0.0, float(t1) - float(t0)),
+                      "tags": dict(tags or ())}, trace_id)
+        return span_id
+
+    def _record(self, rec: dict, trace_id: str):
+        """Append one completed span. An unknown trace id creates its
+        buffer lazily — that is how a replica child (which never saw
+        ``start_trace``) accumulates spans for a remote trace."""
+        with self._lock:
+            buf = self._bufs.get(trace_id)
+            if buf is None:
+                ent = self._kept.get(trace_id)
+                if ent is not None:
+                    # late arrival into an already-kept tree (a hedge
+                    # loser's reply lands after the root finished)
+                    _normalize(rec, self._epoch)
+                    ent["spans"].append(rec)
+                    self.spans += 1
+                    _tel.inc("dtrace.spans")
+                    return
+                if len(self._bufs) >= self._buffer:
+                    self.overflow += 1
+                    _tel.inc("dtrace.overflow")
+                    return
+                buf = self._bufs[trace_id] = []
+            buf.append(rec)
+            self.spans += 1
+        _tel.inc("dtrace.spans")
+
+    # -- root finish / tail sampling ---------------------------------------
+    def finish_root(self, root: Span, error=None):
+        """Close the root span and make the tail-sampling decision:
+        keep the full tree for errored / shed / SLO-breaching / hedged
+        requests (plus the head-sample floor), drop everything else."""
+        if error is not None:
+            root.tags.setdefault(
+                "error", "%s: %s" % (type(error).__name__, error))
+        if root._finished:
+            return
+        root._finished = True
+        rec = root._to_record()
+        with self._lock:
+            buf = self._bufs.pop(root.trace_id, [])
+            head = self._head.pop(root.trace_id, False)
+            buf.append(rec)
+            self.spans += 1
+            reason = self._keep_reason(rec, buf, head)
+            if reason is not None:
+                for r in buf:
+                    _normalize(r, self._epoch)
+                self.kept += 1
+                self._kept[root.trace_id] = {
+                    "trace_id": root.trace_id, "kept": reason,
+                    "root_ms": rec.get("dur", 0.0) * 1e3,
+                    "request_id": root.tags.get("request_id"),
+                    "spans": buf}
+                while len(self._kept) > self._keep_cap:
+                    self._kept.popitem(last=False)
+            else:
+                self.dropped += 1
+        _tel.inc("dtrace.spans")
+        _tel.inc("dtrace.kept" if reason is not None else
+                 "dtrace.dropped")
+
+    @staticmethod
+    def _keep_reason(root_rec: dict, buf: List[dict],
+                     head: bool) -> Optional[str]:
+        tags = root_rec.get("tags") or {}
+        err = tags.get("error")
+        if err:
+            return "shed" if "RequestShed" in str(err) else "error"
+        for r in buf:
+            t = r.get("tags") or {}
+            if t.get("shed"):
+                return "shed"
+            if t.get("slo_breach"):
+                return "slo"
+        if tags.get("hedged"):
+            return "hedge"
+        if head:
+            return "head"
+        return None
+
+    # -- the wire ----------------------------------------------------------
+    def harvest(self, ctx) -> Optional[dict]:
+        """Child side of the wire: drain the completed spans for one
+        remote trace and return the reply payload — the spans still on
+        the child's monotonic clock, plus this process's epoch so the
+        router can place them on the shared wall clock."""
+        trace_id, _ = _parse_parent(ctx)
+        with self._lock:
+            spans = self._bufs.pop(trace_id, None)
+            self._head.pop(trace_id, None)
+        if not spans:
+            return None
+        return {"epoch": self._epoch, "spans": spans}
+
+    def absorb(self, payload) -> int:
+        """Router side of the wire: clock-align a child's harvested
+        spans with the child's shipped epoch and merge them into the
+        in-flight tree (or an already-kept one, for hedge losers)."""
+        if not payload:
+            return 0
+        epoch = float(payload.get("epoch", self._epoch))
+        n = 0
+        for rec in payload.get("spans") or ():
+            _normalize(rec, epoch)
+            self._record(rec, rec.get("trace", ""))
+            n += 1
+        return n
+
+    def discard(self, ctx):
+        """Drop an in-flight remote trace without counting it (child
+        cleanup when a traced request dies without a reply path)."""
+        trace_id, _ = _parse_parent(ctx)
+        with self._lock:
+            self._bufs.pop(trace_id, None)
+            self._head.pop(trace_id, None)
+
+    # -- export ------------------------------------------------------------
+    def kept_traces(self) -> List[dict]:
+        """Finished kept trees, oldest first (each a dict with
+        ``trace_id``, ``kept`` reason, ``root_ms`` and ``spans``)."""
+        with self._lock:
+            return [dict(e, spans=list(e["spans"]))
+                    for e in self._kept.values()]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"kept": self.kept, "dropped": self.dropped,
+                    "spans": self.spans, "overflow": self.overflow,
+                    "in_flight": len(self._bufs),
+                    "kept_buffered": len(self._kept)}
+
+    def to_chrome_events(self) -> List[dict]:
+        """Kept trees as Perfetto chrome-trace events: one ``X`` event
+        per span in its OS process's lane, ``M`` metadata naming the
+        lanes, and ``s``/``f`` flow events stitching every
+        cross-process parent->child edge (router attempt -> replica
+        request)."""
+        events: List[dict] = []
+        roles: Dict[int, str] = {}
+        for ent in self.kept_traces():
+            by_id = {r["span"]: r for r in ent["spans"]}
+            for r in ent["spans"]:
+                args = {"trace": r["trace"], "span": r["span"],
+                        "parent": r["parent"], "kept": ent["kept"]}
+                args.update(r.get("tags") or {})
+                events.append({"name": r["name"], "ph": "X",
+                               "cat": "dtrace", "pid": r["pid"],
+                               "tid": r["tid"],
+                               "ts": r["ts"] * 1e6,
+                               "dur": r["dur"] * 1e6, "args": args})
+                role = ("router" if r["name"].startswith("fleet.")
+                        else "replica")
+                roles.setdefault(r["pid"], role)
+                par = by_id.get(r["parent"])
+                if par is not None and par["pid"] != r["pid"]:
+                    # the wire hop: flow from the router-side parent
+                    # to the replica-side child, bound at a timestamp
+                    # clamped inside the parent's interval
+                    fid = int(r["span"][:15], 16) or 1
+                    ts_s = min(max(r["ts"], par["ts"]),
+                               par["ts"] + par["dur"])
+                    events.append({"name": "wire", "ph": "s",
+                                   "cat": "dtrace", "id": fid,
+                                   "pid": par["pid"],
+                                   "tid": par["tid"],
+                                   "ts": ts_s * 1e6})
+                    events.append({"name": "wire", "ph": "f",
+                                   "bp": "e", "cat": "dtrace",
+                                   "id": fid, "pid": r["pid"],
+                                   "tid": r["tid"],
+                                   "ts": r["ts"] * 1e6})
+        for pid, role in sorted(roles.items()):
+            events.append({"name": "process_name", "ph": "M",
+                           "pid": pid,
+                           "args": {"name": "%s (pid %d)"
+                                    % (role, pid)}})
+        return events
+
+
+def _normalize(rec: dict, epoch: float):
+    """raw monotonic ``t0`` -> shared wall-clock ``ts`` (idempotent)."""
+    if "ts" not in rec:
+        rec["ts"] = rec.pop("t0", 0.0) + epoch
+
+
+# The live tracer. None == tracing disabled == every hot-path check is
+# one module-global load + None test (the faults._PLAN idiom).
+_TRACER: Optional[Tracer] = None
+
+
+def enable(sample: Optional[int] = None, buffer: Optional[int] = None,
+           keep: Optional[int] = None) -> Tracer:
+    """Install (or replace) the process tracer. Env-declared knobs
+    fill any argument left None."""
+    global _TRACER
+    _TRACER = Tracer(sample=sample, buffer=buffer, keep=keep)
+    return _TRACER
+
+
+def disable():
+    global _TRACER
+    _TRACER = None
+
+
+def reload() -> Optional[Tracer]:
+    """(Re)arm from ``MXNET_TPU_DTRACE``. Called once at import; tests
+    that monkeypatch the env call it again."""
+    if _env.get("MXNET_TPU_DTRACE"):
+        return enable()
+    disable()
+    return None
+
+
+def tracer() -> Optional[Tracer]:
+    return _TRACER
+
+
+def enabled() -> bool:
+    return _TRACER is not None
+
+
+def ensure_enabled() -> Tracer:
+    """Idempotent arm: a replica child that receives a traced envelope
+    arms itself lazily — the parent's programmatic ``enable()`` does
+    not cross the spawn boundary, but a ``trace_ctx`` on the wire is
+    an explicit signal that the router upstream is tracing."""
+    return _TRACER if _TRACER is not None else enable()
+
+
+def finish_root(root: Optional[Span], error=None):
+    """Convenience for call sites holding a possibly-None root."""
+    if root is not None:
+        root._tracer.finish_root(root, error=error)
+
+
+def harvest(ctx) -> Optional[dict]:
+    trc = _TRACER
+    return trc.harvest(ctx) if trc is not None else None
+
+
+def absorb(payload) -> int:
+    trc = _TRACER
+    return trc.absorb(payload) if trc is not None else 0
+
+
+def stats() -> dict:
+    trc = _TRACER
+    return trc.stats() if trc is not None else {}
+
+
+def kept_traces() -> List[dict]:
+    trc = _TRACER
+    return trc.kept_traces() if trc is not None else []
+
+
+def to_chrome_events() -> List[dict]:
+    trc = _TRACER
+    return trc.to_chrome_events() if trc is not None else []
+
+
+def write_chrome_trace(path: str) -> int:
+    """Merge the kept trees with the process's flat telemetry spans
+    into one Perfetto chrome-trace file (the telemetry writer owns the
+    file format and the local process/thread metadata)."""
+    return _tel.write_chrome_trace(path,
+                                   extra_events=to_chrome_events())
+
+
+reload()

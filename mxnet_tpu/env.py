@@ -493,6 +493,31 @@ declare("MXNET_TPU_FAULT_SLOW_MS", float, 50.0,
         "Injected latency (ms) each time a `slow_replica` fault fires "
         "in the batcher's dispatch path.", section=_F)
 
+_D = "Distributed request tracing (dtrace)"
+declare("MXNET_TPU_DTRACE", bool, False,
+        "Arm the distributed request tracer (`mxnet_tpu/dtrace.py`): "
+        "the fleet router opens a 128-bit root span per request, the "
+        "trace context rides the subprocess wire envelope, and replica "
+        "schedulers emit the queue/sched_idle/h2d/dispatch/d2h "
+        "decomposition as child spans returned (clock-aligned) at "
+        "reply time. Unset: the hot path is a single module-global "
+        "None check (the `MXNET_TPU_FAULTS` idiom).", section=_D)
+declare("MXNET_TPU_DTRACE_SAMPLE", int, 0,
+        "Head-sampled keep floor for the tail-based sampler: keep "
+        "every Nth trace even when nothing went wrong (errored, shed, "
+        "SLO-breaching and hedged requests are always kept). `0` "
+        "disables the floor — only tail-worthy trees survive "
+        "root-finish.", section=_D)
+declare("MXNET_TPU_DTRACE_BUFFER", int, 256,
+        "Bound on concurrently in-flight trace trees per process. A "
+        "request arriving with the buffer full goes untraced "
+        "(`dtrace.overflow`) instead of growing the buffer.",
+        section=_D)
+declare("MXNET_TPU_DTRACE_KEEP", int, 64,
+        "Finished kept traces retained for export (oldest evicted "
+        "first); `dtrace.write_chrome_trace` and the trace_report "
+        "waterfall read these.", section=_D)
+
 _C = "Checkpointing"
 declare("MXNET_TPU_CKPT_DIR", str, "",
         "Directory for step-granularity full-state training snapshots "

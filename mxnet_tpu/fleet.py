@@ -66,6 +66,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import dtrace as _dtrace
 from . import env as _env
 from . import faults as _faults
 from . import telemetry as _tel
@@ -319,12 +320,19 @@ class InProcReplica(Replica):
 
     def submit(self, arrays, request_id: Optional[str] = None,
                deadline_ms: Optional[float] = None,
-               priority: Optional[str] = None):
+               priority: Optional[str] = None,
+               trace_ctx: Optional[dict] = None):
         if _faults.fires("replica_crash"):
             self.kill()
         srv = self._srv
         if not self.alive() or srv is None:
             raise ReplicaCrash("replica %s is down" % self.rid)
+        if trace_ctx is not None:
+            # kwarg only when traced: duck-typed test servers keep
+            # their pre-trace submit signature
+            return _RequestWaiter(srv.submit(
+                arrays, request_id=request_id, deadline_ms=deadline_ms,
+                priority=priority, trace_ctx=trace_ctx))
         return _RequestWaiter(srv.submit(arrays, request_id=request_id,
                                          deadline_ms=deadline_ms,
                                          priority=priority))
@@ -408,21 +416,36 @@ def _subprocess_replica_main(conn, factory_ref: str):
             if op == "infer":
                 if _faults.fires("replica_crash"):
                     os._exit(23)
+                # envelope: (op, mid, request_id, arrays, deadline_ms,
+                # priority, trace_ctx) — the deadline is the router's
+                # REMAINING budget for this attempt; old parents that
+                # omit tail fields still work. A trace_ctx arms the
+                # child's tracer lazily (programmatic enable() in the
+                # parent does not cross the spawn boundary); traced
+                # replies grow a 4th element with the harvested spans
+                # + this process's clock epoch — old routers never
+                # send a trace_ctx, so they never see a 4-tuple.
+                tctx = msg[6] if len(msg) > 6 else None
+                kw = {}
+                if tctx is not None:
+                    _dtrace.ensure_enabled()
+                    kw["trace_ctx"] = tctx
                 try:
-                    # envelope: (op, mid, request_id, arrays,
-                    # deadline_ms, priority) — the deadline is the
-                    # router's REMAINING budget for this attempt; old
-                    # parents that omit the tail fields still work
                     out = srv.submit(
                         msg[3], request_id=msg[2],
                         deadline_ms=msg[4] if len(msg) > 4 else None,
                         priority=msg[5] if len(msg) > 5 else None,
-                    ).get(60.0)
-                    conn.send(("ok", mid,
-                               [np.asarray(o) for o in out]))
+                        **kw).get(60.0)
+                    reply = ("ok", mid, [np.asarray(o) for o in out])
+                    if tctx is not None:
+                        reply += (_dtrace.harvest(tctx),)
+                    conn.send(reply)
                 except BaseException as e:   # noqa: BLE001 (report,
-                    conn.send(("err", mid,   # don't die)
-                               "%s: %s" % (type(e).__name__, e)))
+                    reply = ("err", mid,     # don't die)
+                             "%s: %s" % (type(e).__name__, e))
+                    if tctx is not None:
+                        reply += (_dtrace.harvest(tctx),)
+                    conn.send(reply)
             elif op == "health":
                 try:
                     probe = srv.scheduler.slo_probe()
@@ -497,7 +520,14 @@ class SubprocessReplica(Replica):
                 msg = conn.recv()
             except (EOFError, OSError):
                 break
-            kind, mid, payload = msg
+            # replies are (kind, mid, payload) — traced ones append a
+            # span payload the tracer clock-aligns and merges BEFORE
+            # the waiter resolves (the root may finish right after)
+            kind, mid, payload = msg[0], msg[1], msg[2]
+            if len(msg) > 3 and msg[3]:
+                trc = _dtrace._TRACER
+                if trc is not None:
+                    trc.absorb(msg[3])
             with self._lock:
                 w = self._pending.pop(mid, None)
             if w is None:
@@ -543,10 +573,15 @@ class SubprocessReplica(Replica):
 
     def submit(self, arrays, request_id: Optional[str] = None,
                deadline_ms: Optional[float] = None,
-               priority: Optional[str] = None):
+               priority: Optional[str] = None,
+               trace_ctx: Optional[dict] = None):
         arrays = [np.asarray(a) for a in arrays]
-        return self._send("infer", (request_id, arrays, deadline_ms,
-                                    priority))
+        payload = (request_id, arrays, deadline_ms, priority)
+        if trace_ctx is not None:
+            # appended, never inserted: old children index the tail
+            # conditionally and ignore anything past what they know
+            payload += (trace_ctx,)
+        return self._send("infer", payload)
 
     def health(self, timeout_s: float = 5.0) -> dict:
         return self._send("health").wait(timeout_s)
@@ -908,8 +943,15 @@ class FleetRouter:
         deadline_s = (self._deadline_s if deadline_ms is None
                       else float(deadline_ms) / 1e3)
         self._count("requests")
+        root = None
+        trc = _dtrace._TRACER   # disabled cost: this one None check
+        if trc is not None:
+            root = trc.start_trace(
+                "fleet.request", request_id=rid,
+                tags={"deadline_ms": round(deadline_s * 1e3, 1),
+                      "priority": priority or "interactive"})
         return self._pool.submit(self._serve, arrays, session, rid,
-                                 deadline_s, priority)
+                                 deadline_s, priority, root)
 
     def infer(self, arrays, session: Optional[str] = None,
               request_id: Optional[str] = None,
@@ -926,7 +968,21 @@ class FleetRouter:
                                else timeout)
 
     def _serve(self, arrays, session, request_id, deadline_s,
-               priority=None):
+               priority=None, root=None):
+        if root is None:
+            return self._serve_loop(arrays, session, request_id,
+                                    deadline_s, priority, None)
+        try:
+            result = self._serve_loop(arrays, session, request_id,
+                                      deadline_s, priority, root)
+        except BaseException as e:
+            _dtrace.finish_root(root, error=e)
+            raise
+        _dtrace.finish_root(root)
+        return result
+
+    def _serve_loop(self, arrays, session, request_id, deadline_s,
+                    priority, root):
         t_start = self._clock()
         attempt = 0
         exclude: set = set()
@@ -955,11 +1011,20 @@ class FleetRouter:
                 attempt += 1
                 continue
             t_a = self._clock()
+            aspan = None
+            if root is not None:
+                aspan = root._tracer.start_span(
+                    "fleet.attempt", root,
+                    tags={"attempt": attempt, "replica": rid,
+                          "breaker": entry.breaker.state})
             try:
                 result = self._attempt(rid, entry, arrays, request_id,
                                        min(self._attempt_s, remaining),
-                                       priority)
+                                       priority, root, aspan)
             except (FleetError, MXNetError) as e:
+                if aspan is not None:
+                    aspan.finish(won=False, error="%s: %s"
+                                 % (type(e).__name__, e))
                 last_err = e
                 with self._rlock:
                     entry.failures += 1
@@ -973,6 +1038,10 @@ class FleetRouter:
                 self._backoff_sleep(attempt, t_start, deadline_s)
                 attempt += 1
                 continue
+            if aspan is not None:
+                # a hedge that won elsewhere already finished this
+                # span as abandoned; finish() is first-writer-wins
+                aspan.finish(won=True)
             lat_s = self._clock() - t_a
             with self._rlock:
                 entry.served += 1
@@ -991,16 +1060,18 @@ class FleetRouter:
             self._sleep(min(delay, remaining))
 
     def _attempt(self, rid, entry, arrays, request_id, timeout_s,
-                 priority=None):
+                 priority=None, root=None, aspan=None):
         with self._rlock:
             entry.inflight += 1
         try:
             # the envelope deadline is exactly this attempt's timeout:
             # the remaining total budget, already net of earlier
             # attempts — a retried request cannot double-spend slack
-            w = entry.replica.submit(arrays, request_id=request_id,
-                                     deadline_ms=timeout_s * 1e3,
-                                     priority=priority)
+            w = entry.replica.submit(
+                arrays, request_id=request_id,
+                deadline_ms=timeout_s * 1e3, priority=priority,
+                **({"trace_ctx": aspan.ctx()} if aspan is not None
+                   else {}))
             hedge_after = self._hedge_after_s() if self._hedge else None
             if hedge_after is None or hedge_after >= timeout_s:
                 return w.wait(timeout_s)
@@ -1009,13 +1080,14 @@ class FleetRouter:
             except AttemptTimeout:
                 pass
             return self._hedged_wait(rid, w, arrays, request_id,
-                                     timeout_s - hedge_after, priority)
+                                     timeout_s - hedge_after, priority,
+                                     root, aspan)
         finally:
             with self._rlock:
                 entry.inflight -= 1
 
     def _hedged_wait(self, rid, w1, arrays, request_id, remaining_s,
-                     priority=None):
+                     priority=None, root=None, aspan=None):
         """The attempt is past p95: duplicate it elsewhere (same
         request-id — the replica dedupes; same REMAINING deadline — the
         hedge doesn't get fresh slack), first response wins, the loser
@@ -1025,14 +1097,28 @@ class FleetRouter:
             rid2, e2 = self._pick(None, exclude={rid})
         except NoReplicaAvailable:
             return w1.wait(remaining_s)   # nowhere to hedge to
+        hspan = None
+        if root is not None:
+            root.tag(hedged=True)
+            hspan = root._tracer.start_span(
+                "fleet.attempt", root,
+                tags={"attempt": (aspan.tags.get("attempt", 0)
+                                  if aspan is not None else 0),
+                      "replica": rid2, "hedge": True,
+                      "breaker": e2.breaker.state})
         with self._rlock:
             e2.inflight += 1
         try:
             try:
-                w2 = e2.replica.submit(arrays, request_id=request_id,
-                                       deadline_ms=remaining_s * 1e3,
-                                       priority=priority)
-            except FleetError:
+                w2 = e2.replica.submit(
+                    arrays, request_id=request_id,
+                    deadline_ms=remaining_s * 1e3, priority=priority,
+                    **({"trace_ctx": hspan.ctx()} if hspan is not None
+                       else {}))
+            except FleetError as e:
+                if hspan is not None:
+                    hspan.finish(won=False, error="%s: %s"
+                                 % (type(e).__name__, e))
                 return w1.wait(remaining_s)
             waiters = {rid: w1, rid2: w2}
             t_end = self._clock() + remaining_s
@@ -1053,10 +1139,19 @@ class FleetRouter:
                         with self._rlock:
                             e2.served += 1
                         e2.breaker.record_success()
+                        if hspan is not None:
+                            hspan.finish(won=True)
+                        if aspan is not None:
+                            aspan.finish(won=False, abandoned=True)
                         w1.cancel()
                     else:
+                        if hspan is not None:
+                            hspan.finish(won=False, abandoned=True)
                         w2.cancel()
                     return res
+            if hspan is not None:
+                hspan.finish(won=False,
+                             error="AttemptTimeout: %s" % last)
             raise last
         finally:
             with self._rlock:

@@ -833,6 +833,11 @@ class RequestStager:
 
     def __init__(self, place=None):
         self._place = place
+        # facts about the most recent stage() call, read by the
+        # scheduler's span emitter to tag the traced h2d interval
+        # (fastpath taken? bytes shipped?) without re-deriving them
+        self.last_fastpath = False
+        self.last_bytes = 0
         # pad rows are always zeros of a ladder shape: cache one
         # template per (rows, tail-shape, dtype) instead of allocating
         # a fresh zero block on every under-full dispatch — under a
@@ -859,7 +864,8 @@ class RequestStager:
             raise MXNetError("request batch of %d rows scheduled into a "
                              "bucket of %d" % (n, bucket))
         pad = bucket - n
-        if len(rows) == 1 and pad == 0:
+        self.last_fastpath = len(rows) == 1 and pad == 0
+        if self.last_fastpath:
             # interactive fast path: one payload already filling its
             # bucket — no concat, no pad, straight to placement
             batch = [np.asarray(a) for a in rows[0]]  # graft: host-sync
@@ -875,7 +881,8 @@ class RequestStager:
                     axis=0)
                     for b in batch]
         placed = self._place(batch) if self._place is not None else batch
-        _tel.inc("serve.h2d_bytes", sum(int(b.nbytes) for b in batch))
+        self.last_bytes = sum(int(b.nbytes) for b in batch)
+        _tel.inc("serve.h2d_bytes", self.last_bytes)
         if pad:
             _tel.inc("serve.pad_rows", pad)
         return placed, pad

@@ -65,6 +65,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import dtrace as _dtrace
 from . import env as _env
 from . import faults as _faults
 from . import telemetry as _tel
@@ -77,6 +78,20 @@ __all__ = ["bucket_ladder", "LANES", "Request", "RequestShed",
            "AdaptiveWaitController", "BatchScheduler", "InferenceServer"]
 
 _log = logging.getLogger(__name__)
+
+
+def _corr_ids(reqs, cap: int = 8) -> str:
+    """Correlation ids for a server-side log line: request ids, each
+    with its trace id when the request rode in sampled — a
+    client-reported failure greps straight to the server event."""
+    parts = []
+    for r in list(reqs)[:cap]:
+        ctx = getattr(r, "trace_ctx", None)
+        parts.append("%s(trace=%s)" % (r.request_id, ctx["t"])
+                     if ctx else r.request_id)
+    if len(reqs) > cap:
+        parts.append("... +%d more" % (len(reqs) - cap))
+    return ", ".join(parts)
 
 #: The two priority lanes. ``interactive`` requests default to the SLO
 #: deadline; ``batch`` requests default to a 4x looser one and are the
@@ -246,12 +261,13 @@ class Request:
     __slots__ = ("arrays", "rows", "t_enq", "_done", "result", "error",
                  "queue_ms", "latency_ms", "request_id", "deadline_ms",
                  "priority", "t_deadline", "t_adm", "sched_idle_ms",
-                 "components")
+                 "components", "trace_ctx")
 
     def __init__(self, arrays: Sequence[np.ndarray],
                  request_id: Optional[str] = None,
                  deadline_ms: Optional[float] = None,
-                 priority: Optional[str] = None):
+                 priority: Optional[str] = None,
+                 trace_ctx: Optional[dict] = None):
         self.arrays = [np.asarray(a) for a in arrays]
         self.rows = int(self.arrays[0].shape[0])
         self.t_enq = time.perf_counter()
@@ -268,6 +284,10 @@ class Request:
         self.t_deadline: Optional[float] = None   # stamped at submit
         self.t_adm = self.t_enq
         self.components: Optional[dict] = None
+        # the distributed-trace context this request rode in with
+        # (None = untraced); the scheduler parents its decomposition
+        # spans under it
+        self.trace_ctx = trace_ctx
 
     def get(self, timeout: Optional[float] = None) -> List[np.ndarray]:
         """Block until the scheduler served this request; returns the
@@ -406,6 +426,9 @@ class BatchScheduler:
         self._inflight_ids: dict = {}
         self._done_ids: collections.OrderedDict = collections.OrderedDict()
         self._done_cap = 1024
+        # the last SLO-breaching traced request: the slo_probe attaches
+        # it so a degraded /healthz names a concrete reproducible trace
+        self._last_breach_trace: Optional[str] = None
         self._worker: Optional[threading.Thread] = None
         if autostart:
             self.start()
@@ -432,16 +455,20 @@ class BatchScheduler:
     def submit(self, arrays: Sequence[np.ndarray],
                request_id: Optional[str] = None,
                deadline_ms: Optional[float] = None,
-               priority: Optional[str] = None) -> Request:
+               priority: Optional[str] = None,
+               trace_ctx: Optional[dict] = None) -> Request:
         """Enqueue one request (arrays follow the server's data names;
         leading axis = rows). Returns immediately; block on
         ``Request.get()``. ``deadline_ms`` is the remaining latency
         budget (defaults to the lane's configured deadline, then the
-        SLO); ``priority`` picks the lane (``interactive`` default).
-        Re-submitting a ``request_id`` that is already in flight (or
-        recently served, when the infer fn is idempotent) returns the
-        original request instead of dispatching the work twice and
-        counts ``serve.duplicate_requests``."""
+        SLO); ``priority`` picks the lane (``interactive`` default);
+        ``trace_ctx`` is the distributed-trace context propagated from
+        the fleet router (the dispatch decomposition lands under it as
+        child spans). Re-submitting a ``request_id`` that is already
+        in flight (or recently served, when the infer fn is
+        idempotent) returns the original request instead of
+        dispatching the work twice and counts
+        ``serve.duplicate_requests``."""
         priority = priority or "interactive"
         if priority not in LANES:
             raise MXNetError("unknown priority lane %r (expected one "
@@ -449,7 +476,7 @@ class BatchScheduler:
         if deadline_ms is None:
             deadline_ms = self._deadline_default_ms[priority] or None
         req = Request(arrays, request_id, deadline_ms=deadline_ms,
-                      priority=priority)
+                      priority=priority, trace_ctx=trace_ctx)
         req.t_enq = self._clock()
         req.t_adm = req.t_enq
         if req.deadline_ms:
@@ -562,11 +589,16 @@ class BatchScheduler:
         self._pending = [r for r in self._pending
                          if id(r) not in shed_ids]
         self._pending_rows = rows
+        trc = _dtrace._TRACER   # disabled cost: this one None check
         for r in shed:
             _tel.inc("serve.shed_requests")
             _tel.inc("serve.shed.%s" % r.priority)
             with self._lock:
                 self._lane[r.priority]["shed"] += 1
+            if trc is not None and r.trace_ctx is not None:
+                trc.emit("serve.shed", r.trace_ctx, r.t_enq, now,
+                         tags={"shed": True, "priority": r.priority,
+                               "request_id": r.request_id})
             r.error = RequestShed(
                 "request %s (%s lane) shed under overload: deadline "
                 "%.1fms expired %.1fms ago with %d rows queued"
@@ -574,6 +606,8 @@ class BatchScheduler:
                    (now - r.t_deadline) * 1e3, self._pending_rows))
             self._finish(r, served=False)
             r._done.set()
+        _log.warning("shed %d request(s) under overload: %s",
+                     len(shed), _corr_ids(shed))
 
     def _decide(self, now: float) -> Optional[float]:
         """The dispatch decision over the pending set: ``None`` means
@@ -712,8 +746,8 @@ class BatchScheduler:
                     req.error = e
                     self._finish(req, served=False)
                     req._done.set()
-                _log.exception("serve batch failed (%d requests)",
-                               len(batch))
+                _log.exception("serve batch failed (%d requests: %s)",
+                               len(batch), _corr_ids(batch))
 
     def _dispatch(self, batch: List[Request]):
         import jax
@@ -723,6 +757,9 @@ class BatchScheduler:
             # callers see a timeout, and the router's deadline-budgeted
             # retry path has to recover the request elsewhere
             _tel.inc("serve.dropped_responses")
+            _log.warning("response dropped (injected fault) for %d "
+                         "request(s): %s", len(batch),
+                         _corr_ids(batch))
             for req in batch:
                 self._finish(req, served=False)
             return
@@ -751,6 +788,12 @@ class BatchScheduler:
         self._svc.observe(bucket, (t3 - t0) * 1e3)
         _tel.observe("serve.batch_occupancy", occupancy)
         _tel.inc("serve.batches")
+
+        worst_trace = None
+        trc = _dtrace._TRACER   # disabled cost: this one None check
+        if trc is not None:
+            worst_trace = self._emit_spans(trc, batch, t0, t1, t2, t3,
+                                           rows, bucket, occupancy)
 
         off, worst = 0, 0.0
         for req in batch:
@@ -813,12 +856,71 @@ class BatchScheduler:
             "arrival_rps": round(self._arrival.rate(), 2)})
         # the serving step record: the SlowRequestDetector keys off
         # request_ms/slo_ms, and the /healthz anomaly count moves
-        _tracing.record_step((t3 - t0) * 1e3, extra={
+        extra = {
             "request_ms": round(worst, 3),
             "slo_ms": self.slo_ms,
             "serve_rows": rows, "serve_bucket": bucket,
             "adaptive_wait_ms": round(self._ctl.wait_ms, 3),
-            "queue_depth": depth})
+            "queue_depth": depth}
+        if worst_trace is not None:
+            extra["worst_trace_id"] = worst_trace
+        _tracing.record_step((t3 - t0) * 1e3, extra=extra)
+
+    def _emit_spans(self, trc, batch, t0, t1, t2, t3, rows, bucket,
+                    occupancy):
+        """Traced requests' decomposition spans: under each request's
+        propagated context, a ``serve.request`` span covering enqueue
+        to completion with the five exact components as children
+        (their durations sum to request_ms by construction), every
+        dispatch span cross-linked (``batch=<id>``) to one shared
+        ``serve.batch_dispatch`` span tagged with the bucket,
+        occupancy and whether this dispatch carried the bucket's
+        one-time compile (the xprof registry's count moves in step).
+        Returns the worst traced request's trace id (or None)."""
+        batch_sid = None
+        worst_ms, worst_trace = -1.0, None
+        # _warmed gains the bucket only after this dispatch; compiles
+        # is the FusedInfer/xprof-registry counter when present
+        compiled = bucket not in self._warmed
+        for req in batch:
+            ctx = req.trace_ctx
+            if ctx is None:
+                continue
+            req_ms = (t3 - req.t_enq) * 1e3
+            breach = bool(self.slo_ms) and req_ms > self.slo_ms
+            sid = trc.emit(
+                "serve.request", ctx, req.t_enq, t3,
+                tags={"request_id": req.request_id,
+                      "priority": req.priority, "rows": req.rows,
+                      "slo_breach": breach})
+            if batch_sid is None:
+                # one shared batch-dispatch span (first traced
+                # request's tree hosts it; the rest cross-link)
+                batch_sid = trc.emit(
+                    "serve.batch_dispatch", (ctx["t"], sid), t1, t2,
+                    tags={"bucket": bucket, "rows": rows,
+                          "occupancy": round(occupancy, 4),
+                          "compile": compiled,
+                          "compiles": getattr(self._infer, "compiles",
+                                              None),
+                          "requests": len(batch)})
+            parent = (ctx["t"], sid)
+            trc.emit("serve.queue", parent, req.t_enq, req.t_adm)
+            trc.emit("serve.sched_idle", parent, req.t_adm, t0)
+            trc.emit("serve.h2d", parent, t0, t1,
+                     tags={"pad_rows": bucket - rows,
+                           "fastpath": self._stager.last_fastpath,
+                           "h2d_bytes": self._stager.last_bytes})
+            trc.emit("serve.dispatch", parent, t1, t2,
+                     tags={"batch": batch_sid, "bucket": bucket,
+                           "occupancy": round(occupancy, 4),
+                           "compile": compiled})
+            trc.emit("serve.d2h", parent, t2, t3)
+            if breach:
+                self._last_breach_trace = ctx["t"]
+            if req_ms > worst_ms:
+                worst_ms, worst_trace = req_ms, ctx["t"]
+        return worst_trace
 
     # -- SLO / stats -------------------------------------------------------
     def latency_quantile(self, q: float) -> Optional[float]:
@@ -855,6 +957,10 @@ class BatchScheduler:
         if p99 is not None and p99 > self.slo_ms:
             detail = {"p99_ms": round(p99, 3), "slo_ms": self.slo_ms}
             detail.update(self.controller_state())
+            if self._last_breach_trace is not None:
+                # a concrete reproducible trace for the degradation:
+                # `trace_report --view waterfall <id>` renders it
+                detail["worst_trace_id"] = self._last_breach_trace
             return detail
         return None
 
@@ -946,6 +1052,9 @@ class BatchScheduler:
                 leftovers.append(self._q.get_nowait())
             except _queue.Empty:
                 break
+        if leftovers:
+            _log.warning("failing %d queued request(s) at close: %s",
+                         len(leftovers), _corr_ids(leftovers))
         for req in leftovers:
             req.error = MXNetError("BatchScheduler closed before the "
                                    "request was served")
@@ -1054,10 +1163,12 @@ class InferenceServer:
 
     def submit(self, arrays, request_id: Optional[str] = None,
                deadline_ms: Optional[float] = None,
-               priority: Optional[str] = None) -> Request:
+               priority: Optional[str] = None,
+               trace_ctx: Optional[dict] = None) -> Request:
         return self.scheduler.submit(arrays, request_id=request_id,
                                      deadline_ms=deadline_ms,
-                                     priority=priority)
+                                     priority=priority,
+                                     trace_ctx=trace_ctx)
 
     def infer(self, arrays, timeout: Optional[float] = 60.0,
               deadline_ms: Optional[float] = None,

@@ -288,14 +288,38 @@ def spans():
     return list(_spans)
 
 
-def write_chrome_trace(path: str):
+def write_chrome_trace(path: str, extra_events: Optional[list] = None):
     """Write buffered host spans in the chrome trace event format.
     Timestamps are wall-clock microseconds, the same clock domain the
-    XLA trace stamps, so both load side by side in Perfetto."""
-    events = [{"name": name, "ph": "X", "cat": "host",
-               "pid": os.getpid(), "tid": tid,
-               "ts": (t0 + _EPOCH) * 1e6, "dur": dur * 1e6}
-              for name, tid, t0, dur in list(_spans)]
+    XLA trace stamps, so both load side by side in Perfetto.
+
+    ``process_name``/``thread_name`` metadata events (ph="M") name
+    this process's lanes, so a multi-process merged trace reads as
+    named lanes instead of bare pids/tids. ``extra_events`` appends
+    pre-built chrome events verbatim — the distributed tracer
+    (:mod:`mxnet_tpu.dtrace`) reuses this writer for its merged
+    cross-process span trees."""
+    import sys
+
+    spans = list(_spans)
+    pid = os.getpid()
+    thread_names = {t.ident: t.name for t in threading.enumerate()}
+    meta = [{"name": "process_name", "ph": "M", "pid": pid,
+             "args": {"name": "%s (pid %d)"
+                      % (os.path.basename(sys.argv[0] or "python"),
+                         pid)}}]
+    for tid in sorted({tid for _, tid, _, _ in spans}):
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": tid,
+                     "args": {"name": thread_names.get(
+                         tid, "tid-%d" % tid)}})
+    events = meta + [
+        {"name": name, "ph": "X", "cat": "host",
+         "pid": pid, "tid": tid,
+         "ts": (t0 + _EPOCH) * 1e6, "dur": dur * 1e6}
+        for name, tid, t0, dur in spans]
+    if extra_events:
+        events.extend(extra_events)
     with open(path, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
     return len(events)

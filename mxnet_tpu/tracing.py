@@ -195,7 +195,11 @@ class SlowRequestDetector:
     When the record carries the adaptive scheduler's controller state
     (``adaptive_wait_ms``, ``queue_depth``) the event copies it, so a
     breached SLO is attributable at a glance: a wide wait means the
-    controller was still coalescing, a deep queue means overload."""
+    controller was still coalescing, a deep queue means overload. When
+    the distributed tracer sampled the offending request the record
+    also carries ``worst_trace_id``; copying it into the event links
+    the anomaly straight to a kept span tree
+    (``tools/trace_report.py --view waterfall <id>``)."""
 
     type = "slow_request"
 
@@ -206,7 +210,8 @@ class SlowRequestDetector:
             ev = {"type": self.type, "request_ms": round(req, 3),
                   "slo_ms": round(float(slo), 3),
                   "over_frac": round(req / slo - 1.0, 3)}
-            for k in ("adaptive_wait_ms", "queue_depth"):
+            for k in ("adaptive_wait_ms", "queue_depth",
+                      "worst_trace_id"):
                 if rec.get(k) is not None:
                     ev[k] = rec[k]
             return ev

@@ -1,0 +1,829 @@
+"""Distributed request tracing: the tail sampler's keep/drop decisions
+under a fake clock, span-tree construction and the exact five-way
+decomposition, cross-process clock alignment via shipped epochs, wire
+compatibility in BOTH rolling-upgrade directions, hedged traces with
+winning and abandoned attempts, the Perfetto export (lanes, metadata,
+flow events), the waterfall rendering, and the disabled-cost contract
+(one module-global None check, no spans, no counters)."""
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import dtrace, fleet, serving, telemetry
+from mxnet_tpu.fleet import FleetRouter
+from mxnet_tpu.serving import BatchScheduler
+from mxnet_tpu.tracing import SlowRequestDetector
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+import trace_report  # noqa: E402
+
+DIM = 8
+
+
+@pytest.fixture
+def trc():
+    """An armed tracer, disarmed (and telemetry reset) afterwards."""
+    telemetry.reset()
+    telemetry.enable()
+    t = dtrace.enable(sample=0)
+    yield t
+    dtrace.disable()
+    telemetry.reset()
+    telemetry.disable()
+
+
+@pytest.fixture
+def no_dtrace():
+    dtrace.disable()
+    yield
+    dtrace.disable()
+
+
+def _rows(n, seed=11):
+    rng = np.random.RandomState(seed)
+    return rng.randint(-3, 4, (n, DIM)).astype(np.float32)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# tail sampling: keep/drop pinned by a fake clock, no real waiting
+# ---------------------------------------------------------------------------
+
+def test_tail_sampler_keeps_interesting_drops_the_rest():
+    clk = _Clock()
+    t = dtrace.Tracer(sample=0, buffer=64, keep=64, clock=clk,
+                      epoch=0.0)
+
+    def run_trace(error=None, child_tags=None, hedged=False):
+        root = t.start_trace("fleet.request", request_id="r")
+        clk.t += 0.010
+        if child_tags is not None:
+            t.emit("serve.request", root, clk.t - 0.005, clk.t,
+                   tags=child_tags)
+        if hedged:
+            root.tag(hedged=True)
+        t.finish_root(root, error=error)
+        return root.trace_id
+
+    # boring success: dropped at root-finish
+    run_trace()
+    assert t.kept == 0 and t.dropped == 1
+    # errored: kept, reason "error"
+    tid = run_trace(error=RuntimeError("boom"))
+    assert t._kept[tid]["kept"] == "error"
+    # shed: the typed RequestShed error maps to its own reason
+    tid = run_trace(error=serving.RequestShed("req r shed"))
+    assert t._kept[tid]["kept"] == "shed"
+    # a shed child span also keeps (child-side shed, ok root path)
+    tid = run_trace(child_tags={"shed": True})
+    assert t._kept[tid]["kept"] == "shed"
+    # SLO breach tagged by the scheduler's decomposition spans
+    tid = run_trace(child_tags={"slo_breach": True})
+    assert t._kept[tid]["kept"] == "slo"
+    # hedged: kept even when it succeeded fast
+    tid = run_trace(hedged=True)
+    assert t._kept[tid]["kept"] == "hedge"
+    assert t.kept == 5 and t.dropped == 1
+    # in-flight buffer is drained either way
+    assert t.stats()["in_flight"] == 0
+
+
+def test_head_sample_floor_and_boring_drop_rate():
+    """With 1-in-N head sampling armed, EVERY interesting trace is
+    still kept and boring traces are kept at exactly the head rate."""
+    clk = _Clock()
+    t = dtrace.Tracer(sample=4, buffer=64, keep=64, clock=clk,
+                      epoch=0.0)
+    kept_boring = 0
+    for i in range(20):
+        root = t.start_trace("fleet.request")
+        clk.t += 0.001
+        t.finish_root(root)
+        if root.trace_id in t._kept:
+            kept_boring += 1
+            assert t._kept[root.trace_id]["kept"] == "head"
+    assert kept_boring == 5           # 20 / 4
+    # interesting traces are NEVER subject to the head rate
+    for _ in range(8):
+        root = t.start_trace("fleet.request")
+        root.tag(hedged=True)
+        t.finish_root(root)
+        assert t._kept[root.trace_id]["kept"] in ("hedge", "head")
+    assert t.kept == 5 + 8
+
+
+def test_inflight_buffer_bounded_and_keep_cap_evicts():
+    clk = _Clock()
+    t = dtrace.Tracer(sample=0, buffer=2, keep=2, clock=clk, epoch=0.0)
+    r1 = t.start_trace("a")
+    r2 = t.start_trace("b")
+    # buffer full: the third request simply goes untraced
+    assert t.start_trace("c") is None
+    assert t.overflow == 1
+    kept_ids = []
+    for root in (r1, r2):
+        root.tag(hedged=True)
+        t.finish_root(root)
+        kept_ids.append(root.trace_id)
+    r3 = t.start_trace("d")
+    r3.tag(hedged=True)
+    t.finish_root(r3)
+    kept_ids.append(r3.trace_id)
+    # keep cap: oldest kept tree evicted first
+    assert len(t._kept) == 2
+    assert kept_ids[0] not in t._kept
+    assert kept_ids[1] in t._kept and kept_ids[2] in t._kept
+
+
+# ---------------------------------------------------------------------------
+# span trees, ids, clock alignment across processes
+# ---------------------------------------------------------------------------
+
+def test_trace_and_span_id_widths(trc):
+    root = trc.start_trace("fleet.request")
+    child = trc.start_span("fleet.attempt", root)
+    assert len(root.trace_id) == 32      # 128-bit trace id
+    assert len(root.span_id) == 16       # 64-bit span id
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    assert root.parent_id == ""
+    # the wire context is the minimal {trace, span} pair
+    assert child.ctx() == {"t": child.trace_id, "s": child.span_id}
+    child.finish()
+    trc.finish_root(root, error=RuntimeError("keep me"))
+
+
+def test_finish_is_idempotent_first_writer_wins(trc):
+    root = trc.start_trace("fleet.request")
+    a = trc.start_span("fleet.attempt", root)
+    assert a.finish(won=True) is True
+    assert a.finish(won=False, abandoned=True) is False
+    root.tag(hedged=True)
+    trc.finish_root(root)
+    (rec,) = [s for s in trc._kept[root.trace_id]["spans"]
+              if s["span"] == a.span_id]
+    assert rec["tags"] == {"won": True}
+
+
+def test_absorb_aligns_child_clock_via_shipped_epoch():
+    """The child records on ITS monotonic clock; the router absorbs
+    with the child's shipped epoch, landing the spans on the shared
+    wall axis next to its own."""
+    router_clk, child_clk = _Clock(), _Clock()
+    child_clk.t = 5.0                      # wildly skewed perf_counter
+    router = dtrace.Tracer(sample=0, buffer=8, keep=8,
+                           clock=router_clk, epoch=1000.0)
+    child = dtrace.Tracer(sample=0, buffer=8, keep=8,
+                          clock=child_clk, epoch=1095.0)
+    root = router.start_trace("fleet.request")
+    ctx = {"t": root.trace_id, "s": root.span_id}
+    # child-side span: wall time 1095 + 5 = 1100
+    child.emit("serve.request", ctx, child_clk.t, child_clk.t + 0.010)
+    payload = child.harvest(ctx)
+    assert payload["epoch"] == 1095.0
+    assert router.absorb(payload) == 1
+    router_clk.t += 0.020                  # root: wall 1100 .. 1100.02
+    root.tag(hedged=True)
+    router.finish_root(root)
+    spans = {s["name"]: s for s in router._kept[root.trace_id]["spans"]}
+    assert spans["serve.request"]["ts"] == pytest.approx(1100.0)
+    assert spans["fleet.request"]["ts"] == pytest.approx(1100.0)
+    # the child interval nests inside the root interval on the shared
+    # axis even though the two monotonic clocks never agreed
+    r, c = spans["fleet.request"], spans["serve.request"]
+    assert r["ts"] <= c["ts"]
+    assert c["ts"] + c["dur"] <= r["ts"] + r["dur"] + 1e-9
+    # harvest drained the child buffer; a second harvest ships nothing
+    assert child.harvest(ctx) is None
+
+
+def test_late_arrival_lands_in_already_kept_tree():
+    """A hedge loser's reply arrives after the root finished: the
+    spans are absorbed into the kept tree, not dropped."""
+    clk = _Clock()
+    t = dtrace.Tracer(sample=0, buffer=8, keep=8, clock=clk, epoch=0.0)
+    root = t.start_trace("fleet.request")
+    root.tag(hedged=True)
+    t.finish_root(root)
+    assert root.trace_id in t._kept
+    before = len(t._kept[root.trace_id]["spans"])
+    t.absorb({"epoch": 50.0, "spans": [
+        {"trace": root.trace_id, "span": "feedfeedfeedfeed",
+         "parent": root.span_id, "name": "serve.request", "pid": 4242,
+         "tid": 1, "t0": 1.0, "dur": 0.002, "tags": {}}]})
+    spans = t._kept[root.trace_id]["spans"]
+    assert len(spans) == before + 1
+    late = spans[-1]
+    assert late["ts"] == pytest.approx(51.0)   # child epoch applied
+
+
+# ---------------------------------------------------------------------------
+# the wire: rolling-upgrade compatibility in BOTH directions
+# ---------------------------------------------------------------------------
+
+def _fake_parent_replica():
+    sent = []
+
+    class _FakeConn:
+        def send(self, msg):
+            sent.append(msg)
+
+    rep = fleet.SubprocessReplica.__new__(fleet.SubprocessReplica)
+    rep.rid = "r0"
+    rep._lock = threading.Lock()
+    rep._dead = False
+    rep._closed = False
+    rep._pending = {}
+    rep._conn = _FakeConn()
+    rep._proc = type("P", (), {"is_alive": staticmethod(lambda: True)})()
+    return rep, sent
+
+
+def test_untraced_envelope_stays_six_tuple():
+    """No trace_ctx -> the wire message is EXACTLY the pre-trace
+    layout; an old child's strict unpack keeps working."""
+    rep, sent = _fake_parent_replica()
+    rep.submit([_rows(1)], request_id="rid", deadline_ms=5.0,
+               priority="batch")
+    assert len(sent[0]) == 6
+
+
+def test_traced_envelope_appends_ctx_old_child_ignores_tail():
+    rep, sent = _fake_parent_replica()
+    ctx = {"t": "ab" * 16, "s": "cd" * 8}
+    rep.submit([_rows(1)], request_id="rid", deadline_ms=5.0,
+               priority="batch", trace_ctx=ctx)
+    msg = sent[0]
+    assert len(msg) == 7 and msg[6] == ctx
+    # an old child decodes the head conditionally and never looks past
+    # what it knows — the appended ctx is invisible to it
+    op, mid, request_id, arrays = msg[0], msg[1], msg[2], msg[3]
+    deadline = msg[4] if len(msg) > 4 else None
+    priority = msg[5] if len(msg) > 5 else None
+    assert (op, request_id, deadline, priority) == \
+        ("infer", "rid", 5.0, "batch")
+
+
+class _PipeEnd:
+    """One end of an in-memory duplex pipe driving the child main loop
+    in a thread (no spawn, no jax)."""
+
+    def __init__(self):
+        import queue
+
+        self._in = queue.Queue()
+        self.sent = []
+
+    def recv(self):
+        msg = self._in.get()
+        if msg is None:
+            raise EOFError
+        return msg
+
+    def send(self, msg):
+        self.sent.append(msg)
+
+    def feed(self, msg):
+        self._in.put(msg)
+
+    def close(self):
+        pass
+
+
+class _TracingFakeServer:
+    """Duck-typed InferenceServer for the child main loop: doubles the
+    input; when the envelope carried a trace ctx it emits one span the
+    harvest must ship back."""
+
+    def __init__(self):
+        self.closed = False
+
+    def submit(self, arrays, request_id=None, deadline_ms=None,
+               priority=None, trace_ctx=None):
+        if trace_ctx is not None:
+            t = dtrace.tracer()
+            t.emit("serve.request", trace_ctx, 1.0, 1.002,
+                   tags={"request_id": request_id})
+        outs = [np.asarray(a) * 2.0 for a in arrays]
+
+        class _Done:
+            def get(self, timeout=None):
+                return outs
+
+        return _Done()
+
+    def close(self):
+        self.closed = True
+
+
+def test_child_main_loop_reply_shapes_both_directions(
+        monkeypatch, no_dtrace):
+    """Old router (no trace_ctx) -> strict 3-tuple reply, tracer never
+    armed. New router (trace_ctx) -> 4-tuple reply carrying the span
+    payload with the child's epoch."""
+    monkeypatch.setattr(fleet, "_resolve_factory",
+                        lambda ref: _TracingFakeServer)
+    conn = _PipeEnd()
+    worker = threading.Thread(
+        target=fleet._subprocess_replica_main, args=(conn, "x:y"),
+        daemon=True)
+    worker.start()
+    x = _rows(1, seed=3)
+    # old-style envelope: untraced, reply must stay a strict 3-tuple
+    conn.feed(("infer", "m1", "rid-1", [x], 50.0, None))
+    # traced envelope: reply grows the harvested-span payload
+    ctx = {"t": "ee" * 16, "s": "ff" * 8}
+    conn.feed(("infer", "m2", "rid-2", [x], 50.0, None, ctx))
+    conn.feed(("stop", "m3"))
+    worker.join(10.0)
+    assert not worker.is_alive()
+    replies = {m[1]: m for m in conn.sent}
+    assert len(replies["m1"]) == 3
+    kind, _, payload, spans_payload = replies["m2"]
+    assert kind == "ok"
+    assert np.array_equal(payload[0], x * 2.0)
+    assert isinstance(spans_payload, dict)
+    assert "epoch" in spans_payload
+    (rec,) = spans_payload["spans"]
+    assert rec["trace"] == ctx["t"] and rec["parent"] == ctx["s"]
+    # a traced envelope armed the child's tracer lazily
+    assert dtrace.enabled()
+
+
+def test_old_router_missing_ctx_means_untraced(monkeypatch, no_dtrace):
+    """An old router never sends trace_ctx: the new child must not arm
+    its tracer and must not grow the reply."""
+    monkeypatch.setattr(fleet, "_resolve_factory",
+                        lambda ref: _TracingFakeServer)
+    conn = _PipeEnd()
+    worker = threading.Thread(
+        target=fleet._subprocess_replica_main, args=(conn, "x:y"),
+        daemon=True)
+    worker.start()
+    conn.feed(("infer", "m1", "rid-1", [_rows(1)], 50.0, None))
+    conn.feed(("stop", "m2"))
+    worker.join(10.0)
+    assert len([m for m in conn.sent if m[1] == "m1"][0]) == 3
+    assert not dtrace.enabled()
+
+
+# ---------------------------------------------------------------------------
+# router spans: root, attempts, hedging (fake replicas, no jax)
+# ---------------------------------------------------------------------------
+
+class _TraceFakeReplica(fleet.Replica):
+    """ok | slow fake accepting the traced submit signature."""
+
+    def __init__(self, rid, behavior="ok", slow_s=0.1):
+        self.rid = rid
+        self.behavior = behavior
+        self.ctxs = []
+        self._slow_s = slow_s
+
+    def submit(self, arrays, request_id=None, deadline_ms=None,
+               priority=None, trace_ctx=None):
+        self.ctxs.append(trace_ctx)
+        outs = [np.asarray(a) * 2.0 for a in arrays]
+        if self.behavior == "slow":
+            t_due = time.monotonic() + self._slow_s
+
+            class _Slow:
+                def wait(self, timeout_s):
+                    rem = t_due - time.monotonic()
+                    if rem > 0:
+                        time.sleep(min(timeout_s, rem))
+                        if timeout_s < rem:
+                            raise fleet.AttemptTimeout("still slow")
+                    return outs
+
+                def cancel(self):
+                    pass
+
+            return _Slow()
+        if self.behavior == "crash":
+            raise fleet.ReplicaCrash("replica %s crashed" % self.rid)
+
+        class _Ok:
+            def wait(self, timeout_s):
+                return outs
+
+            def cancel(self):
+                pass
+
+        return _Ok()
+
+    def alive(self):
+        return True
+
+    def health(self):
+        return {"status": "ok", "in_flight": 0}
+
+    def in_flight(self):
+        return 0
+
+    def refresh_params(self, apply_fn=None):
+        pass
+
+    def restart(self):
+        pass
+
+    def kill(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def _trace_router(behaviors, **kw):
+    made = {}
+    queue = list(behaviors)
+
+    def factory(rid):
+        made[rid] = _TraceFakeReplica(rid, queue.pop(0) if queue
+                                      else "ok")
+        return made[rid]
+
+    kw.setdefault("health_interval_s", 60.0)
+    kw.setdefault("auto_respawn", False)
+    kw.setdefault("deadline_ms", 5000.0)
+    kw.setdefault("attempt_timeout_ms", 2000.0)
+    kw.setdefault("retries", 4)
+    kw.setdefault("backoff_ms", 1.0)
+    return FleetRouter(factory, len(behaviors), **kw), made
+
+
+def test_boring_request_traced_then_dropped(trc):
+    router, made = _trace_router(["ok"], hedge=False)
+    try:
+        (out,) = router.infer([_rows(1)], timeout=10.0)
+    finally:
+        router.close()
+    assert trc.kept == 0 and trc.dropped == 1
+    # the attempt DID ride the wire with a ctx while in flight
+    (ctx,) = made["r1"].ctxs
+    assert set(ctx) == {"t", "s"}
+
+
+def test_failed_request_keeps_trace_with_attempt_errors(trc):
+    router, made = _trace_router(["crash", "crash"], hedge=False,
+                                 retries=2, deadline_ms=500.0)
+    try:
+        with pytest.raises(fleet.FleetError):
+            router.infer([_rows(1)], request_id="doomed", timeout=10.0)
+    finally:
+        router.close()
+    (ent,) = trc.kept_traces()
+    assert ent["kept"] == "error"
+    assert ent["request_id"] == "doomed"
+    by_name = {}
+    for s in ent["spans"]:
+        by_name.setdefault(s["name"], []).append(s)
+    (root,) = by_name["fleet.request"]
+    assert "FleetError" in root["tags"]["error"]
+    attempts = by_name["fleet.attempt"]
+    assert len(attempts) == 2
+    for a in attempts:
+        assert a["parent"] == root["span"]
+        assert a["tags"]["won"] is False
+        assert "ReplicaCrash" in a["tags"]["error"]
+        assert a["tags"]["breaker"] == "closed"
+    assert {a["tags"]["attempt"] for a in attempts} == {0, 1}
+    assert {a["tags"]["replica"] for a in attempts} == {"r1", "r2"}
+
+
+def test_hedged_trace_has_winning_and_abandoned_attempts(trc):
+    router, made = _trace_router(["slow", "ok"], hedge=True)
+    try:
+        with router._rlock:
+            router._lat.extend([0.004] * 30)   # pin hedge_after ~4ms
+        (out,) = router.infer([_rows(1, seed=5)], timeout=10.0)
+        assert np.array_equal(out, _rows(1, seed=5) * 2.0)
+    finally:
+        router.close()
+    assert router.stats()["counters"].get("hedge_wins", 0) == 1
+    (ent,) = trc.kept_traces()
+    assert ent["kept"] == "hedge"
+    attempts = [s for s in ent["spans"] if s["name"] == "fleet.attempt"]
+    assert len(attempts) == 2
+    by_replica = {a["tags"]["replica"]: a for a in attempts}
+    assert by_replica["r2"]["tags"]["won"] is True
+    assert by_replica["r2"]["tags"]["hedge"] is True
+    assert by_replica["r1"]["tags"]["won"] is False
+    assert by_replica["r1"]["tags"]["abandoned"] is True
+    # both attempts carried their own ctx on the wire
+    assert made["r1"].ctxs[0]["s"] == by_replica["r1"]["span"]
+    assert made["r2"].ctxs[0]["s"] == by_replica["r2"]["span"]
+
+
+# ---------------------------------------------------------------------------
+# scheduler decomposition spans (real BatchScheduler, fake infer)
+# ---------------------------------------------------------------------------
+
+def _fake_infer(placed):
+    return [placed[0] * 2.0], ()
+
+
+def test_scheduler_emits_five_components_summing_to_request(trc):
+    sched = BatchScheduler(_fake_infer, [(4, DIM)], max_batch=4,
+                           max_wait_ms=1.0, slo_ms=0.0)
+    try:
+        root = trc.start_trace("fleet.request")
+        ctx = root.ctx()
+        req = sched.submit([_rows(1)], request_id="q1", trace_ctx=ctx)
+        req.get(timeout=30)
+        root.tag(hedged=True)          # force the keep
+        trc.finish_root(root)
+    finally:
+        sched.close()
+    (ent,) = trc.kept_traces()
+    spans = {s["name"]: s for s in ent["spans"]}
+    request = spans["serve.request"]
+    assert request["parent"] == root.span_id
+    assert request["tags"]["request_id"] == "q1"
+    comp_names = ("serve.queue", "serve.sched_idle", "serve.h2d",
+                  "serve.dispatch", "serve.d2h")
+    total = 0.0
+    for name in comp_names:
+        s = spans[name]
+        assert s["parent"] == request["span"]
+        total += s["dur"]
+    # the EXACT decomposition: five children partition the parent
+    assert total == pytest.approx(request["dur"], rel=1e-6, abs=1e-9)
+    assert total * 1e3 == pytest.approx(req.latency_ms, rel=1e-6)
+    batch = spans["serve.batch_dispatch"]
+    assert batch["tags"]["bucket"] >= 1
+    assert batch["tags"]["compile"] is True     # first dispatch
+    assert spans["serve.dispatch"]["tags"]["batch"] == batch["span"]
+    assert spans["serve.h2d"]["tags"]["fastpath"] in (True, False)
+    assert spans["serve.h2d"]["tags"]["h2d_bytes"] > 0
+
+
+def test_slo_breach_keeps_trace_and_probe_names_it(trc):
+    def slow_infer(placed):
+        time.sleep(0.01)
+        return [placed[0] * 2.0], ()
+
+    sched = BatchScheduler(slow_infer, [(4, DIM)], max_batch=4,
+                           max_wait_ms=0.5, slo_ms=0.001)
+    try:
+        root = trc.start_trace("fleet.request")
+        sched.submit([_rows(1)], trace_ctx=root.ctx()).get(timeout=30)
+        trc.finish_root(root)          # NOT hedged: slo tag must keep
+        probe = sched.slo_probe()
+    finally:
+        sched.close()
+    (ent,) = trc.kept_traces()
+    assert ent["kept"] == "slo"
+    req = [s for s in ent["spans"] if s["name"] == "serve.request"]
+    assert req and req[0]["tags"]["slo_breach"] is True
+    assert probe is not None
+    assert probe["worst_trace_id"] == root.trace_id
+
+
+def test_slow_request_detector_event_carries_worst_trace_id():
+    det = SlowRequestDetector()
+    ev = det.check({"request_ms": 9.0, "slo_ms": 1.0,
+                    "worst_trace_id": "aa" * 16, "queue_depth": 3})
+    assert ev["type"] == "slow_request"
+    assert ev["worst_trace_id"] == "aa" * 16
+    assert ev["queue_depth"] == 3
+    # records without a sampled trace simply omit the key
+    ev2 = det.check({"request_ms": 9.0, "slo_ms": 1.0})
+    assert "worst_trace_id" not in ev2
+
+
+def test_shed_request_keeps_trace_with_shed_span(trc):
+    sched = BatchScheduler(_fake_infer, [(4, DIM)], max_batch=4,
+                           max_wait_ms=1.0, slo_ms=0.0,
+                           autostart=False, clock=time.perf_counter)
+    try:
+        root = trc.start_trace("fleet.request")
+        req = sched.submit([_rows(1)], request_id="victim",
+                           deadline_ms=0.001, trace_ctx=root.ctx())
+        # enough backlog that the shed threshold trips
+        for i in range(12):
+            sched.submit([_rows(1, seed=i)])
+        time.sleep(0.002)
+        sched._admit_intake()
+        sched._maybe_shed(sched._clock())
+        assert req.done()
+        with pytest.raises(serving.RequestShed):
+            req.get(timeout=0)
+        trc.finish_root(root, error=req.error)
+    finally:
+        sched.close()
+    (ent,) = trc.kept_traces()
+    assert ent["kept"] == "shed"
+    shed = [s for s in ent["spans"] if s["name"] == "serve.shed"]
+    assert shed and shed[0]["tags"]["shed"] is True
+    assert shed[0]["tags"]["request_id"] == "victim"
+
+
+# ---------------------------------------------------------------------------
+# disabled cost: no tracer, no spans, no counters, untouched wire
+# ---------------------------------------------------------------------------
+
+def test_disabled_is_inert_everywhere(no_dtrace):
+    assert dtrace.tracer() is None
+    assert dtrace.stats() == {}
+    assert dtrace.kept_traces() == []
+    assert dtrace.to_chrome_events() == []
+    assert dtrace.harvest({"t": "x", "s": "y"}) is None
+    assert dtrace.absorb({"epoch": 0, "spans": []}) == 0
+    dtrace.finish_root(None)           # no-op, no error
+    router, made = _trace_router(["ok"], hedge=False)
+    try:
+        router.infer([_rows(1)], timeout=10.0)
+    finally:
+        router.close()
+    assert made["r1"].ctxs == [None]   # nothing rode the wire
+    sched = BatchScheduler(_fake_infer, [(4, DIM)], max_batch=4,
+                           max_wait_ms=1.0, slo_ms=0.0)
+    try:
+        sched.submit([_rows(1)]).get(timeout=30)
+    finally:
+        sched.close()
+    assert dtrace.stats() == {}        # never lazily armed
+
+
+def test_env_reload_arms_and_disarms(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_DTRACE", "1")
+    monkeypatch.setenv("MXNET_TPU_DTRACE_SAMPLE", "7")
+    assert dtrace.reload() is not None
+    assert dtrace.tracer()._sample == 7
+    monkeypatch.delenv("MXNET_TPU_DTRACE")
+    assert dtrace.reload() is None
+    assert not dtrace.enabled()
+
+
+# ---------------------------------------------------------------------------
+# export: chrome events, lanes, flow stitching, waterfall text
+# ---------------------------------------------------------------------------
+
+def _kept_cross_pid_tracer():
+    clk = _Clock()
+    t = dtrace.Tracer(sample=0, buffer=8, keep=8, clock=clk, epoch=0.0)
+    root = t.start_trace("fleet.request", request_id="rq")
+    att = t.start_span("fleet.attempt", root,
+                       tags={"attempt": 0, "replica": "r1"})
+    ctx = att.ctx()
+    clk.t += 0.002
+    att.finish(won=True)
+    # replica-side spans arrive via the wire from another pid
+    base = 7.0
+    spans = [{"trace": root.trace_id, "span": "a" * 16,
+              "parent": ctx["s"], "name": "serve.request", "pid": 4242,
+              "tid": 9, "t0": base, "dur": 0.0015, "tags": {}}]
+    for i, name in enumerate(("serve.queue", "serve.sched_idle",
+                              "serve.h2d", "serve.dispatch",
+                              "serve.d2h")):
+        spans.append({"trace": root.trace_id, "span": "b%015x" % i,
+                      "parent": "a" * 16, "name": name, "pid": 4242,
+                      "tid": 9, "t0": base + 0.0003 * i, "dur": 0.0003,
+                      "tags": {}})
+    assert t.absorb({"epoch": 100.0 - base + 0.0002,
+                     "spans": spans}) == 6
+    root.tag(hedged=True)
+    t.finish_root(root)
+    return t, root
+
+
+def test_chrome_events_lanes_and_flow(trc):
+    t, root = _kept_cross_pid_tracer()
+    events = t.to_chrome_events()
+    xs = [e for e in events if e["ph"] == "X"]
+    assert {e["pid"] for e in xs} == {os.getpid(), 4242}
+    # one lane-name metadata event per pid, role-labelled
+    metas = {e["pid"]: e["args"]["name"] for e in events
+             if e["ph"] == "M"}
+    assert "router" in metas[os.getpid()]
+    assert "replica" in metas[4242]
+    # the cross-pid parent edge is stitched with a flow pair
+    starts = [e for e in events if e["ph"] == "s"]
+    finishes = [e for e in events if e["ph"] == "f"]
+    assert len(starts) == 1 and len(finishes) == 1
+    assert starts[0]["id"] == finishes[0]["id"]
+    assert starts[0]["pid"] == os.getpid()
+    assert finishes[0]["pid"] == 4242
+    assert finishes[0]["bp"] == "e"
+    # flow binds inside the parent attempt's interval
+    att = next(e for e in xs if e["name"] == "fleet.attempt")
+    assert att["ts"] <= starts[0]["ts"] <= att["ts"] + att["dur"]
+
+
+def test_write_chrome_trace_merges_and_loads(trc, tmp_path, monkeypatch):
+    t, root = _kept_cross_pid_tracer()
+    monkeypatch.setattr(dtrace, "_TRACER", t)
+    with telemetry.span("host_work"):
+        pass
+    path = str(tmp_path / "FLEET_trace.json")
+    n = dtrace.write_chrome_trace(path)
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    assert n == len(evs)
+    cats = {e.get("cat") for e in evs}
+    assert "dtrace" in cats and "host" in cats   # merged, one file
+    trees = trace_report.dtrace_trees(evs)
+    assert list(trees) == [root.trace_id]
+    assert len(trees[root.trace_id]) == 8        # root+attempt+request+5
+
+
+def test_waterfall_renders_tree_and_decomposition(tmp_path):
+    t, root = _kept_cross_pid_tracer()
+    events = t.to_chrome_events()
+    trees = trace_report.dtrace_trees(events)
+    out = trace_report.render_waterfall(root.trace_id,
+                                        trees[root.trace_id])
+    assert root.trace_id in out
+    assert "kept=hedge" in out
+    for name in ("fleet.request", "fleet.attempt", "serve.request",
+                 "serve.queue", "serve.sched_idle", "serve.h2d",
+                 "serve.dispatch", "serve.d2h"):
+        assert name in out
+    assert "2 processes" in out
+    # the five-way decomposition line, parts summing to the request
+    assert "decomposition of serve.request" in out
+    assert "= 1.50ms (request span 1.50ms)" in out
+    # summary view ranks kept traces and names the dominant span
+    summary = trace_report.render_trace_summary(trees)
+    assert root.trace_id[:16] in summary
+    assert "dominant" in summary and "waterfall" in summary
+
+
+def test_waterfall_cli_resolves_id_prefix(tmp_path, monkeypatch, trc):
+    t, root = _kept_cross_pid_tracer()
+    monkeypatch.setattr(dtrace, "_TRACER", t)
+    path = str(tmp_path / "FLEET_trace.json")
+    dtrace.write_chrome_trace(path)
+    monkeypatch.setattr(trace_report, "_repo_root", lambda: str(tmp_path))
+    import io
+    import contextlib
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = trace_report.main(["--view", "waterfall",
+                                root.trace_id[:8]])
+    assert rc == 0
+    assert "serve.dispatch" in buf.getvalue()
+    buf2 = io.StringIO()
+    with contextlib.redirect_stdout(buf2):
+        rc2 = trace_report.main(["--view", "waterfall", path])
+    assert rc2 == 0 and root.trace_id in buf2.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# the real wire: a spawned replica's spans, clock-aligned and nested
+# ---------------------------------------------------------------------------
+
+def test_subprocess_end_to_end_traced_and_clock_aligned():
+    dtrace.enable(sample=1)            # head-keep every trace
+    router = FleetRouter(
+        fleet.in_subprocess("mxnet_tpu.fleet:demo_server_factory"), 1,
+        deadline_ms=120000.0, attempt_timeout_ms=60000.0, retries=5,
+        backoff_ms=50.0, health_interval_s=60.0, hedge=False)
+    try:
+        x = _rows(1, seed=3)
+        (out,) = router.infer([x], request_id="e2e", timeout=120.0)
+        assert out.shape[0] == 1
+    finally:
+        router.close()
+        kept = dtrace.kept_traces()
+        dtrace.disable()
+    ent = next(e for e in kept if e["request_id"] == "e2e")
+    spans = {s["name"]: s for s in ent["spans"]}
+    root = spans["fleet.request"]
+    att = spans["fleet.attempt"]
+    request = spans["serve.request"]
+    assert root["pid"] == os.getpid()
+    assert request["pid"] != os.getpid()          # really remote
+    assert request["parent"] == att["span"]       # stitched across
+    assert att["parent"] == root["span"]          # the wire
+    assert att["tags"]["won"] is True
+    # clock alignment: the remote spans land INSIDE the root's wall
+    # interval (same host, per-process epochs measured independently)
+    eps = 0.025
+    for name in ("serve.request", "serve.queue", "serve.sched_idle",
+                 "serve.h2d", "serve.dispatch", "serve.d2h",
+                 "serve.batch_dispatch"):
+        s = spans[name]
+        assert s["ts"] >= root["ts"] - eps
+        assert s["ts"] + s["dur"] <= root["ts"] + root["dur"] + eps
+    total = sum(spans[n]["dur"] for n in
+                ("serve.queue", "serve.sched_idle", "serve.h2d",
+                 "serve.dispatch", "serve.d2h"))
+    assert total == pytest.approx(spans["serve.request"]["dur"],
+                                  rel=1e-6, abs=1e-9)

@@ -99,14 +99,34 @@ def test_write_chrome_trace(tmp_path):
         pass
     path = str(tmp_path / "trace.json")
     n = telemetry.write_chrome_trace(path)
-    assert n == 2
     with open(path) as f:
         doc = json.load(f)
     evs = doc["traceEvents"]
-    assert len(evs) == 2
-    for ev in evs:
-        assert ev["name"] == "step" and ev["ph"] == "X"
+    assert n == len(evs)
+    xs = [ev for ev in evs if ev["ph"] == "X"]
+    assert len(xs) == 2
+    for ev in xs:
+        assert ev["name"] == "step"
         assert ev["ts"] > 0 and ev["dur"] >= 0
+    # ph="M" metadata names this process's lanes for merged traces
+    metas = [ev for ev in evs if ev["ph"] == "M"]
+    names = {ev["name"] for ev in metas}
+    assert "process_name" in names and "thread_name" in names
+    import os
+    assert all(ev["pid"] == os.getpid() for ev in metas)
+
+
+def test_write_chrome_trace_extra_events(tmp_path):
+    with telemetry.span("host"):
+        pass
+    extra = [{"name": "remote", "ph": "X", "pid": 999, "tid": 1,
+              "ts": 1.0, "dur": 2.0}]
+    path = str(tmp_path / "trace.json")
+    telemetry.write_chrome_trace(path, extra_events=extra)
+    with open(path) as f:
+        doc = json.load(f)
+    assert any(ev.get("name") == "remote" and ev.get("pid") == 999
+               for ev in doc["traceEvents"])
 
 
 # -- concurrency ---------------------------------------------------------

@@ -139,7 +139,7 @@ ARTIFACTS = ["BENCH_watch.json", ".bench_cache.json",
              "XPROF_DEVICE_TIME.json",
              "MULTICHIP_scaling.json", "SERVE_bench.json",
              "AUTOTUNE_search.json", ".autotune_cache.json",
-             "FLEET_bench.json"]
+             "FLEET_bench.json", "FLEET_trace.json"]
 
 
 def tpu_consistency_verdict(out, stamp):
@@ -374,15 +374,22 @@ def fire():
     _commit("autotune search", stamp)
     # 9. fleet tier: fault-tolerant routing over replicas — goodput vs
     # replica count, the killed-replica recovery window, the rolling
-    # swap purity proof -> FLEET_bench.json. Same INCOMPLETE contract:
-    # bench.py stamps its own record when the child dies; a wedged
-    # orchestrator gets one written here.
+    # swap purity proof -> FLEET_bench.json, plus the distributed-trace
+    # phase's merged span trees -> FLEET_trace.json. Same INCOMPLETE
+    # contract: bench.py stamps its own record when the child dies; a
+    # wedged orchestrator gets one written here.
     out = _run([py, os.path.join(REPO, "bench.py"), "fleet"], 2000)
     if out is None:
         with open(os.path.join(REPO, "FLEET_bench.json"), "w") as f:
             json.dump({"metric": "fleet_goodput_rps", "value": 0,
                        "incomplete": "chip_watch fleet stage timed "
                                      "out or crashed",
+                       "chip_watch_stamp": stamp}, f)
+            f.write("\n")
+    if not os.path.exists(os.path.join(REPO, "FLEET_trace.json")):
+        with open(os.path.join(REPO, "FLEET_trace.json"), "w") as f:
+            json.dump({"traceEvents": [],
+                       "incomplete": "fleet trace phase did not run",
                        "chip_watch_stamp": stamp}, f)
             f.write("\n")
     _commit("fleet fault tolerance", stamp)

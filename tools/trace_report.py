@@ -8,6 +8,7 @@ Usage::
 
     python tools/trace_report.py RUN.jsonl [--top K]
     python tools/trace_report.py /tmp/mxnet_tpu_crash/flight-...-pid123-1
+    python tools/trace_report.py --view waterfall <trace_id>
 
 Stdlib only — runs on any box the crash dump was copied to.
 """
@@ -557,6 +558,146 @@ def render_fleet(rec):
     return "\n".join(out) + "\n"
 
 
+# ---------------------------------------------------------------------------
+# distributed-trace views (dtrace span trees in a merged chrome trace)
+# ---------------------------------------------------------------------------
+
+#: the serving tier's exact latency decomposition, in wall order —
+#: these five child spans partition their serve.request parent
+FIVE_COMPONENTS = ("serve.queue", "serve.sched_idle", "serve.h2d",
+                   "serve.dispatch", "serve.d2h")
+
+
+def load_chrome_trace(path):
+    """Event list from a chrome-trace json ({"traceEvents": [...]} or
+    a bare list)."""
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc.get("traceEvents") if isinstance(doc, dict) else doc
+    return [e for e in (evs or []) if isinstance(e, dict)]
+
+
+def dtrace_trees(events):
+    """``{trace_id: [span, ...]}`` from the dtrace ``X`` events of a
+    merged chrome trace (mxnet_tpu.dtrace.write_chrome_trace output);
+    ts/dur stay in the file's microseconds."""
+    trees = {}
+    for e in events:
+        if e.get("ph") != "X" or e.get("cat") != "dtrace":
+            continue
+        args = e.get("args") or {}
+        tid = args.get("trace")
+        if not tid:
+            continue
+        trees.setdefault(tid, []).append({
+            "span": args.get("span"),
+            "parent": args.get("parent") or "",
+            "name": e.get("name"), "pid": e.get("pid"),
+            "ts": float(e.get("ts", 0.0)),
+            "dur": float(e.get("dur", 0.0)),
+            "kept": args.get("kept"),
+            "tags": {k: v for k, v in args.items()
+                     if k not in ("trace", "span", "parent", "kept")}})
+    return trees
+
+
+def _span_label(s):
+    tags = s["tags"]
+    bits = []
+    for k in ("request_id", "attempt", "replica", "hedge", "won",
+              "abandoned", "breaker", "bucket", "occupancy", "compile",
+              "slo_breach", "shed", "pad_rows", "error"):
+        if k in tags and tags[k] is not None:
+            v = tags[k]
+            bits.append(k if v is True else "%s=%s" % (k, v))
+    return "  [%s]" % ", ".join(bits) if bits else ""
+
+
+def render_waterfall(trace_id, spans):
+    """One kept trace as an indented tree: per-span wall offset from
+    the root (ms), duration, owning pid, and the load-bearing tags;
+    under each traced serve.request, the five-component decomposition
+    line whose parts sum to the request span by construction."""
+    by_id = {s["span"]: s for s in spans}
+    by_parent = {}
+    for s in spans:
+        by_parent.setdefault(s["parent"], []).append(s)
+    roots = sorted((s for s in spans if s["parent"] not in by_id),
+                   key=lambda s: s["ts"])
+    if not roots:
+        return "trace %s: no spans\n" % trace_id
+    t0 = roots[0]["ts"]
+    pids = sorted({s["pid"] for s in spans})
+    out = ["trace %s  kept=%s  %d spans across %d processes %s"
+           % (trace_id, roots[0].get("kept"), len(spans), len(pids),
+              pids)]
+
+    def walk(s, depth):
+        out.append("  %+9.2fms %s%-22s %9.2fms  pid %-8s%s"
+                   % ((s["ts"] - t0) / 1e3, "  " * depth,
+                      s["name"], s["dur"] / 1e3, s["pid"],
+                      _span_label(s)))
+        for c in sorted(by_parent.get(s["span"], ()),
+                        key=lambda c: (c["ts"], c["name"])):
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+    for s in spans:
+        if s["name"] != "serve.request":
+            continue
+        comp = {c["name"]: c["dur"] for c in by_parent.get(s["span"], ())
+                if c["name"] in FIVE_COMPONENTS}
+        if len(comp) == len(FIVE_COMPONENTS):
+            total = sum(comp.values())
+            out.append("")
+            out.append("  decomposition of serve.request %s (pid %s):"
+                       % (s["tags"].get("request_id", "?"), s["pid"]))
+            out.append("    " + " + ".join(
+                "%s %.2fms" % (n.split(".", 1)[1], comp[n] / 1e3)
+                for n in FIVE_COMPONENTS)
+                + " = %.2fms (request span %.2fms)"
+                % (total / 1e3, s["dur"] / 1e3))
+    return "\n".join(out) + "\n"
+
+
+def _dominant_span(spans):
+    """The longest non-root span of a tree — where the time actually
+    went (leaf spans preferred: a parent always outlasts its pieces)."""
+    parents = {s["parent"] for s in spans}
+    leaves = [s for s in spans
+              if s["parent"] and s["span"] not in parents]
+    pool = leaves or [s for s in spans if s["parent"]] or spans
+    return max(pool, key=lambda s: s["dur"])
+
+
+def render_trace_summary(trees, top=3):
+    """Top-``top`` slowest kept traces with their dominant span — the
+    profile-report teaser pointing at the full waterfall view."""
+    ranked = []
+    for tid, spans in trees.items():
+        by_id = {s["span"]: s for s in spans}
+        roots = [s for s in spans if s["parent"] not in by_id]
+        if not roots:
+            continue
+        root = max(roots, key=lambda s: s["dur"])
+        ranked.append((root["dur"], tid, root, spans))
+    ranked.sort(key=lambda t: -t[0])
+    out = ["%d kept trace(s); top %d slowest:"
+           % (len(ranked), min(top, len(ranked)))]
+    rows = [("trace", "root_ms", "kept", "spans", "dominant")]
+    for dur, tid, root, spans in ranked[:top]:
+        dom = _dominant_span(spans)
+        rows.append((tid[:16], "%.2f" % (dur / 1e3),
+                     str(root.get("kept")), str(len(spans)),
+                     "%s (%.2fms, pid %s)"
+                     % (dom["name"], dom["dur"] / 1e3, dom["pid"])))
+    out += _table(rows)
+    out.append("(full tree: python tools/trace_report.py --view "
+               "waterfall <trace>)")
+    return "\n".join(out) + "\n"
+
+
 def render_compile(rec):
     """Per-site compile registry table."""
     xp = rec.get("xprof") or {}
@@ -712,6 +853,15 @@ def profile_report(top=10):
                    "(run bench.py, or bench.py --smoke)\n")
     else:
         out.append(render_bench_report(rec, top=top))
+    tr_path = os.path.join(root, "FLEET_trace.json")
+    if os.path.exists(tr_path):
+        try:
+            trees = dtrace_trees(load_chrome_trace(tr_path))
+        except (OSError, ValueError):
+            trees = {}
+        if trees:
+            out.append("distributed traces (FLEET_trace.json):\n")
+            out.append(render_trace_summary(trees, top=3))
     dev = os.path.join(root, "XPROF_DEVICE_TIME.json")
     if os.path.exists(dev):
         rows = load_bench_records(dev)
@@ -770,13 +920,15 @@ def report_crash_dump(dump_dir, top=10):
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("path", nargs="?",
-                   help="step-trace .jsonl, BENCH .json, or crash-dump "
-                        "dir (optional with --profile-report)")
+                   help="step-trace .jsonl, BENCH .json, crash-dump "
+                        "dir, or (--view waterfall) a trace id or "
+                        "chrome-trace path (optional with "
+                        "--profile-report)")
     p.add_argument("--top", type=int, default=10,
                    help="slowest steps to show (default 10)")
     p.add_argument("--view", default="steps",
                    choices=("steps", "compile", "ops", "memory", "bench",
-                            "serve", "fleet", "tune"),
+                            "serve", "fleet", "tune", "waterfall"),
                    help="steps (default): slowest-step trace table; "
                         "compile/ops/memory/bench: xprof views over a "
                         "BENCH record file; serve: latency decomposition "
@@ -784,7 +936,11 @@ def main(argv=None):
                         "fleet: recovery window + swap purity over a "
                         "FLEET_bench.json record; tune: autotuner "
                         "winners/losers per site from "
-                        "MFU_EXPERIMENTS.jsonl")
+                        "MFU_EXPERIMENTS.jsonl; waterfall: one kept "
+                        "distributed trace as an indented span tree "
+                        "(path = trace id, resolved against "
+                        "FLEET_trace.json in the repo root, or a "
+                        "chrome-trace file)")
     p.add_argument("--profile-report", action="store_true",
                    help="auto-discover the newest BENCH / chip_watch "
                         "artifacts in the repo root and render the "
@@ -792,6 +948,36 @@ def main(argv=None):
     a = p.parse_args(argv)
     if a.profile_report:
         sys.stdout.write(profile_report(top=a.top))
+        return 0
+    if a.view == "waterfall":
+        # positional: a trace id (or unique prefix) resolved against
+        # FLEET_trace.json in the repo root, or a chrome-trace path
+        # (then the slowest kept tree renders)
+        tid, path = a.path, None
+        if tid and os.path.exists(tid):
+            path, tid = tid, None
+        if path is None:
+            path = os.path.join(_repo_root(), "FLEET_trace.json")
+        if not os.path.exists(path):
+            sys.stdout.write("no chrome trace at %s (run `make "
+                             "trace-smoke`)\n" % path)
+            return 1
+        trees = dtrace_trees(load_chrome_trace(path))
+        if not trees:
+            sys.stdout.write("no dtrace span trees in %s\n" % path)
+            return 1
+        if tid is not None:
+            hits = [t for t in trees if t.startswith(tid)]
+            if len(hits) != 1:
+                sys.stdout.write(
+                    "trace id %r matches %d of %d kept traces in %s\n"
+                    % (tid, len(hits), len(trees), path))
+                return 1
+            tid = hits[0]
+        else:
+            tid = max(trees, key=lambda t: max(
+                s["dur"] for s in trees[t]))
+        sys.stdout.write(render_waterfall(tid, trees[tid]))
         return 0
     if a.path is None:
         p.error("path is required unless --profile-report is given")
