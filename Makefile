@@ -98,7 +98,22 @@ trace-smoke:
 ckpt-test:
 	python -m pytest tests/test_checkpoint.py tests/test_elastic_recovery.py -q
 
+# perf-regression gate: current bench artifacts (SERVE / FLEET / OBS /
+# MULTICHIP, plus the BENCH_r* trajectory) vs tools/bench_baselines.json.
+# Exit 1 names the regressed metric, artifact, and measured delta;
+# missing artifacts are INCOMPLETE (exit 0) -> BENCH_GATE.json
+bench-gate:
+	python tools/bench_gate.py
+
+# observability gate: lint the new surface, run the obswatch + gate
+# test files, then the regression gate itself, recording the verdict
+# into PROGRESS.jsonl so the growth log carries pass/fail history
+obs-gate: lint
+	python -m pytest tests/test_obswatch.py tests/test_bench_gate.py \
+	    tests/test_telemetry.py -q
+	python tools/bench_gate.py --progress PROGRESS.jsonl
+
 clean:
 	rm -rf mxnet_tpu/_native perl-package/blib
 
-.PHONY: all predict perl test lint profile-report multichip serve-bench fleet-bench trace-smoke ckpt-test clean
+.PHONY: all predict perl test lint profile-report multichip serve-bench fleet-bench trace-smoke ckpt-test bench-gate obs-gate clean

@@ -1432,6 +1432,148 @@ def _bench_fleet():
         r.close()
         dtrace.disable()
 
+    # phase 5: the watchtower — rerun the steady load under obswatch
+    # federation and prove the fleet rollup agrees with the client's
+    # own measurements, then seed an SLO burn (slow_replica fault) and
+    # prove the multi-window burn-rate alert fires before the error
+    # budget is spent. Rollups land in the durable .obswatch store and
+    # the whole time-series artifact goes to OBS_fleet.json.
+    import shutil
+
+    from mxnet_tpu import obswatch
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    obs_dir = os.path.join(here, ".obswatch")
+    shutil.rmtree(obs_dir, ignore_errors=True)   # one run, one series
+    store = obswatch.TimeSeriesStore(obs_dir, seg_records=2048,
+                                     seg_keep=4)
+
+    # (a) federation agreement: manual ticks bracket the load so the
+    # counter deltas cover exactly the measured window
+    with router(2) as r:
+        # warm both compiles, timing each call: the router histogram is
+        # cumulative, so the client reference must cover the same
+        # request population (warmup + load) for a fair p99 comparison
+        warm_lats = []
+        for i in range(16):
+            t_w = time.perf_counter()
+            r.infer([row], session="ow%d" % i)
+            warm_lats.append(time.perf_counter() - t_w)
+        watch = obswatch.ObsWatch(
+            r, store=store,
+            monitor=obswatch.BurnRateMonitor(
+                slo_target=0.99, fast_s=5.0, slow_s=60.0,
+                threshold=14.4),
+            interval_ms=3600e3)                  # manual ticks only
+        try:
+            r0 = watch.tick()
+            done, _ = _fleet_load(r, rate, duration, rng, row)
+            r1 = watch.tick()
+        finally:
+            watch.close()
+    obs_client = _fleet_phase_stats(done, duration)
+    fed_goodput = obswatch.goodput(r0, r1)
+    fed_fleet = r1.get("fleet") or {}
+    fed_p99 = fed_fleet.get("p99_ms")
+    ref = sorted(warm_lats + [l for _, ok, l in done if ok])
+    client_p99 = round(
+        1e3 * ref[min(len(ref) - 1, int(0.99 * len(ref)))], 3) \
+        if ref else None
+
+    def _rel_err(measured, reference):
+        if measured is None or not reference:
+            return None
+        return abs(measured - reference) / reference
+
+    goodput_err = _rel_err(fed_goodput, obs_client["achieved_rps"])
+    p99_err = _rel_err(fed_p99, client_p99)
+    obs = {"fed_goodput_rps": (None if fed_goodput is None
+                               else round(fed_goodput, 1)),
+           "client_goodput_rps": obs_client["achieved_rps"],
+           "goodput_rel_err": (None if goodput_err is None
+                               else round(goodput_err, 4)),
+           "fed_p50_ms": fed_fleet.get("p50_ms"),
+           "fed_p99_ms": fed_p99,
+           "fed_p999_ms": fed_fleet.get("p999_ms"),
+           "client_p99_ms": client_p99,
+           "client_load_p99_ms": obs_client["p99_ms"],
+           "p99_rel_err": (None if p99_err is None
+                           else round(p99_err, 4)),
+           "replicas_up": fed_fleet.get("up"),
+           "store_dir": os.path.relpath(obs_dir, here)}
+
+    # (b) seeded SLO burn: one-in-two batches stalls past the SLO, so
+    # the fleet burns budget at ~2x sustainable (slo_target=0.75 budget
+    # with ~50% bad) — the fast+slow windows must both trip the alert
+    # while budget_spent < 1
+    faults.configure("slow_replica:0.5", slow_ms=15.0)
+    burn = {"alert_fired": False, "alert_at_s": None,
+            "budget_spent_at_alert": None, "fast_burn": None,
+            "slow_burn": None}
+    try:
+        def _slo_factory():
+            srv = fleet.demo_server_factory()
+            srv.scheduler.slo_ms = 10.0          # breached by the fault
+            return srv
+
+        burn_rate = 60 if smoke else 120
+        fast_s, slow_s = (0.8, 3.2) if smoke else (1.0, 6.0)
+        r = fleet.FleetRouter(
+            fleet.in_process(_slo_factory), 2, deadline_ms=20000.0,
+            attempt_timeout_ms=2000.0, retries=10, backoff_ms=2.0,
+            health_interval_s=60.0)
+        try:
+            for i in range(16):
+                r.infer([row], session="bw%d" % i)
+            watch = obswatch.ObsWatch(
+                r, store=store,
+                monitor=obswatch.BurnRateMonitor(
+                    slo_target=0.75, fast_s=fast_s, slow_s=slow_s,
+                    threshold=1.5, min_events=20),
+                interval_ms=100.0)
+            try:
+                t_burn0 = watch.tick()["ts"]
+                watch.start()
+                _fleet_load(r, burn_rate, duration, rng, row)
+            finally:
+                watch.close()
+        finally:
+            r.close()
+        for rec in store.records():
+            v = rec.get("burn") or {}
+            if v.get("alert") and rec.get("ts", 0.0) >= t_burn0:
+                burn.update({
+                    "alert_fired": True,
+                    "alert_at_s": round(rec["ts"] - t_burn0, 3),
+                    "budget_spent_at_alert": v.get("budget_spent"),
+                    "fast_burn": v.get("fast_burn"),
+                    "slow_burn": v.get("slow_burn")})
+                break
+    finally:
+        faults.configure(None)
+
+    obs_ok = bool(goodput_err is not None and goodput_err <= 0.05
+                  and p99_err is not None and p99_err <= 0.05)
+    burn_ok = bool(burn["alert_fired"]
+                   and burn["budget_spent_at_alert"] is not None
+                   and burn["budget_spent_at_alert"] < 1.0)
+    obs_art = {
+        "metric": "obswatch_fleet_goodput_rps",
+        "value": obs["fed_goodput_rps"] or 0, "unit": "req/s",
+        "federation": obs, "final_rollup": r1, "burn": burn,
+        "series": {name: store.query(name) for name in
+                   ("fleet.p99_ms", "fleet.served",
+                    "fleet.slo_breaches", "burn.fast_burn",
+                    "burn.slow_burn", "burn.budget_spent")},
+        "obs_ok": obs_ok, "burn_ok": burn_ok, "smoke": smoke,
+    }
+    try:
+        with open(os.path.join(here, "OBS_fleet.json"), "w") as f:
+            json.dump(obs_art, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass
+
     best = max(scaling, key=lambda t: t["achieved_rps"])
     result = {
         "metric": "fleet_goodput_rps",
@@ -1446,6 +1588,8 @@ def _bench_fleet():
         "trace": trace,
         "trace_ok": (trace["hedged_trace"] is not None
                      and trace["pids"] >= 3 and trace["nested"]),
+        "obs": obs, "burn": burn,
+        "obs_ok": obs_ok, "burn_ok": burn_ok,
         "smoke": smoke,
     }
     print(json.dumps(result))

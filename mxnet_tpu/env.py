@@ -581,6 +581,43 @@ declare("MXNET_TPU_AUTOTUNE_BUDGET_S", float, 60.0,
         "search; candidates past the budget are recorded as pruned "
         "(`budget exhausted`), never silently skipped.", section=_AT)
 
+_OW = "Obswatch / fleet federation"
+declare("MXNET_TPU_OBSWATCH_INTERVAL_MS", float, 1000.0,
+        "Scrape interval for the obswatch background poller "
+        "(`mxnet_tpu.obswatch.ObsWatch.start()`): every tick scrapes "
+        "each replica's metrics+health, federates, and appends one "
+        "rollup record to the time-series store. Manual `tick()` "
+        "callers (the bench) ignore it.", section=_OW)
+declare("MXNET_TPU_OBSWATCH_DIR", str, "",
+        "Directory for the obswatch durable time-series store "
+        "(JSONL ring segments + manifest). Empty: `.obswatch/` under "
+        "the working directory.", section=_OW)
+declare("MXNET_TPU_OBSWATCH_SEG_RECORDS", int, 1024,
+        "Records per time-series segment before the store rolls over "
+        "to a new `segment-N.jsonl`.", section=_OW)
+declare("MXNET_TPU_OBSWATCH_SEG_KEEP", int, 8,
+        "Ring retention: segments kept after rollover; older segments "
+        "are deleted, bounding the store at roughly "
+        "SEG_KEEP x SEG_RECORDS records.", section=_OW)
+declare("MXNET_TPU_OBSWATCH_SLO_TARGET", float, 0.99,
+        "Fraction of requests that must meet the latency SLO "
+        "(`slo_ms`); 1 - target is the error budget the burn-rate "
+        "monitor spends against.", section=_OW)
+declare("MXNET_TPU_OBSWATCH_FAST_S", float, 300.0,
+        "Fast burn-rate window (seconds). The classic multi-window "
+        "pair is 5 m fast / 1 h slow: the fast window catches a new "
+        "burn quickly, the slow window keeps the alert from flapping.",
+        section=_OW)
+declare("MXNET_TPU_OBSWATCH_SLOW_S", float, 3600.0,
+        "Slow burn-rate window (seconds); see "
+        "MXNET_TPU_OBSWATCH_FAST_S.", section=_OW)
+declare("MXNET_TPU_OBSWATCH_BURN", float, 14.4,
+        "Burn-rate alert threshold: fire when BOTH windows burn error "
+        "budget faster than this multiple of the sustainable rate "
+        "(14.4x spends a 30-day budget in ~2 days). The alert stamps "
+        "`slo_burn_alert` into the step record (FleetHealthDetector "
+        "anomaly) and flips a registered /healthz probe.", section=_OW)
+
 
 # ---------------------------------------------------------------------------
 # docs generation

@@ -225,6 +225,13 @@ class Replica:
     def health(self) -> dict:
         raise NotImplementedError
 
+    def metrics(self) -> Optional[dict]:
+        """Flat ``name -> export`` metric payload for fleet federation
+        (:mod:`mxnet_tpu.obswatch`), or None when the replica has no
+        direct metrics path (e.g. a subprocess without a MetricsServer
+        — those are scraped over HTTP instead)."""
+        return None
+
     def alive(self) -> bool:
         raise NotImplementedError
 
@@ -350,6 +357,12 @@ class InProcReplica(Replica):
         if probe:
             payload["probes"] = {"serve_slo": probe}
         return payload
+
+    def metrics(self) -> Optional[dict]:
+        srv = self._srv
+        if not self.alive() or srv is None:
+            return None
+        return srv.metrics_payload()
 
     def in_flight(self) -> int:
         srv = self._srv
@@ -771,6 +784,11 @@ class FleetRouter:
         self._ring: List[Tuple[int, str]] = []
         self._rid_seq = 0
         self._lat: deque = deque(maxlen=512)
+        # router-view latency histogram for fleet federation: what the
+        # CLIENT experiences (queueing + dispatch + wire), as opposed
+        # to each scheduler's enqueue-to-done view — obswatch headlines
+        # fleet percentiles from this series
+        self._lat_hist = _tel.Histogram("router.request_ms")
         self._events: deque = deque(maxlen=1024)
         self._counters: Dict[str, int] = {}
         self._t0 = self._clock()
@@ -883,6 +901,22 @@ class FleetRouter:
         with self._rlock:
             return list(self._entries)
 
+    def replicas(self) -> List[Tuple[str, Replica]]:
+        """(rid, replica) pairs — the obswatch scraper's target list."""
+        with self._rlock:
+            return [(rid, e.replica) for rid, e in self._entries.items()]
+
+    def metrics_payload(self) -> dict:
+        """Router-tier metric series for fleet federation: the
+        client-view latency histogram plus the request counters."""
+        with self._rlock:
+            counters = dict(self._counters)
+        out = {"router.request_ms":
+               self._lat_hist.export(include_sample=True)}
+        for k in ("served", "retries", "hedges", "recovered_requests"):
+            out["router." + k] = int(counters.get(k, 0))
+        return out
+
     # -- routing -----------------------------------------------------------
     def _routable(self, rid: str, e: _Entry, exclude) -> bool:
         return (e.state == "up" and rid not in exclude
@@ -950,8 +984,20 @@ class FleetRouter:
                 "fleet.request", request_id=rid,
                 tags={"deadline_ms": round(deadline_s * 1e3, 1),
                       "priority": priority or "interactive"})
-        return self._pool.submit(self._serve, arrays, session, rid,
-                                 deadline_s, priority, root)
+        t_sub = self._clock()
+        fut = self._pool.submit(self._serve, arrays, session, rid,
+                                deadline_s, priority, root)
+
+        def _observe_latency(f):
+            # router-view latency = submit to completion, pool queueing
+            # included — the same interval the client experiences, so
+            # obswatch's federated fleet p99 matches what callers see
+            if f.cancelled() or f.exception() is not None:
+                return
+            self._lat_hist.observe((self._clock() - t_sub) * 1e3)
+
+        fut.add_done_callback(_observe_latency)
+        return fut
 
     def infer(self, arrays, session: Optional[str] = None,
               request_id: Optional[str] = None,

@@ -413,6 +413,11 @@ class BatchScheduler:
         self._batches = 0
         self._occ_sum = 0.0
         self._in_flight = 0
+        self._slo_breaches = 0
+        # per-scheduler latency histogram: a standalone (non-registry)
+        # instance so two in-process replicas never share one series —
+        # this is the payload an obswatch InProc scrape federates
+        self._lat_hist = _tel.Histogram("serve.request_ms")
         self._lane = {lane: {"served": 0, "shed": 0} for lane in LANES}
         self._depth_samples: collections.deque = collections.deque(
             maxlen=4096)
@@ -823,6 +828,11 @@ class BatchScheduler:
             self._served += rows
             self._batches += 1
             self._occ_sum += occupancy
+            if self.slo_ms:
+                self._slo_breaches += sum(
+                    1 for r in batch if r.latency_ms > self.slo_ms)
+            for r in batch:
+                self._lat_hist.observe(r.latency_ms)
             self._lat.extend(r.latency_ms for r in batch)
             if len(self._lat) > self._lat_cap:
                 del self._lat[:len(self._lat) - self._lat_cap]
@@ -981,6 +991,30 @@ class BatchScheduler:
         with self._lock:
             return {"batches": self._batches, "occ_sum": self._occ_sum,
                     "served": self._served}
+
+    def metrics_payload(self) -> dict:
+        """This scheduler's metrics as a flat ``name -> export`` dict —
+        the /metrics-equivalent payload an InProc fleet scrape reads
+        directly (no socket). Counters export ints, gauges floats, the
+        latency histogram a bucketed summary dict carrying its exact
+        sample ring so the federator's fleet percentiles stay exact at
+        smoke scale. Names match the process-global telemetry series so
+        a subprocess replica's real /metrics merges with these."""
+        with self._lock:
+            served = self._served
+            batches = self._batches
+            occ_sum = self._occ_sum
+            breaches = self._slo_breaches
+        return {
+            "serve.requests_served": served,
+            "serve.batches": batches,
+            "serve.slo_breaches": breaches,
+            "serve.occupancy_sum": float(occ_sum),
+            "serve.in_flight": float(self.in_flight()),
+            "serve.queue_depth": float(self._pending_rows +
+                                       self._q.qsize()),
+            "serve.request_ms": self._lat_hist.export(include_sample=True),
+        }
 
     def drain_depth_samples(self) -> List[int]:
         """Pop and return the queue-depth samples recorded since the
@@ -1200,6 +1234,13 @@ class InferenceServer:
                                        .get("served", 0)}
         info.update(self.scheduler.controller_state())
         return info
+
+    def metrics_payload(self) -> dict:
+        """Scrape payload for fleet federation (obswatch): the
+        scheduler's per-replica metric series plus compile count."""
+        out = self.scheduler.metrics_payload()
+        out["serve.compiles"] = self.compiles
+        return out
 
     def stats(self) -> dict:
         out = self.scheduler.stats()

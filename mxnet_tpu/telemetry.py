@@ -37,13 +37,15 @@ JSONL schema.
 """
 from __future__ import annotations
 
+import bisect
 import contextlib
+import copy
 import json
 import os
 import threading
 import time
 from collections import deque
-from typing import Dict, Optional
+from typing import Dict, Optional, Sequence
 
 from . import env as _env
 from .base import MXNetError
@@ -51,7 +53,8 @@ from .base import MXNetError
 __all__ = ["enabled", "enable", "disable", "counter", "gauge", "histogram",
            "inc", "set_gauge", "observe", "span", "snapshot", "reset",
            "dump_jsonl", "write_chrome_trace", "Counter", "Gauge",
-           "Histogram", "peek", "metrics_items"]
+           "Histogram", "peek", "metrics_items", "merge_snapshots",
+           "bucket_quantile", "sample_quantile", "DEFAULT_BUCKET_BOUNDS"]
 
 _ENABLED = _env.get("MXNET_TPU_TELEMETRY")
 
@@ -126,16 +129,28 @@ class Gauge:
         return self._value
 
 
+# Default latency-oriented bucket ladder (milliseconds). Finite upper
+# bounds only; the implicit +Inf bucket count is the histogram's total
+# count, so JSON exports never need an "Infinity" literal.
+DEFAULT_BUCKET_BOUNDS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+                         250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+
 class Histogram:
-    """Bounded histogram: exact count/sum/min/max plus a ring of the
-    most recent ``capacity`` samples for percentile estimates."""
+    """Bounded histogram: exact count/sum/min/max, fixed cumulative
+    buckets (Prometheus ``le`` semantics, exact forever), plus a ring of
+    the most recent ``capacity`` samples for percentile estimates."""
 
-    __slots__ = ("name", "capacity", "_lock", "_count", "_sum", "_min",
-                 "_max", "_ring", "_idx")
+    __slots__ = ("name", "capacity", "bounds", "_lock", "_count", "_sum",
+                 "_min", "_max", "_ring", "_idx", "_bucket_counts")
 
-    def __init__(self, name: str, capacity: int = 512):
+    def __init__(self, name: str, capacity: int = 512,
+                 bounds: Optional[Sequence[float]] = None):
         self.name = name
         self.capacity = int(capacity)
+        self.bounds = tuple(sorted(float(b) for b in
+                                   (DEFAULT_BUCKET_BOUNDS if bounds is None
+                                    else bounds)))
         self._lock = threading.Lock()
         self._count = 0
         self._sum = 0.0
@@ -143,6 +158,8 @@ class Histogram:
         self._max = None
         self._ring = []
         self._idx = 0
+        # per-bucket (non-cumulative) counts; index len(bounds) = overflow
+        self._bucket_counts = [0] * (len(self.bounds) + 1)
 
     def observe(self, v: float):
         v = float(v)
@@ -153,6 +170,7 @@ class Histogram:
                 self._min = v
             if self._max is None or v > self._max:
                 self._max = v
+            self._bucket_counts[bisect.bisect_left(self.bounds, v)] += 1
             if len(self._ring) < self.capacity:
                 self._ring.append(v)
             else:
@@ -163,15 +181,26 @@ class Histogram:
     def count(self) -> int:
         return self._count
 
-    def export(self) -> dict:
+    def export(self, include_sample: bool = False) -> dict:
+        """Summary dict. ``buckets`` carries cumulative counts per finite
+        ``le`` bound (the +Inf count is ``count``); with
+        ``include_sample`` the sorted sample ring rides along so a
+        federator can merge exact percentiles instead of interpolating
+        from buckets."""
         with self._lock:
             n, s = self._count, self._sum
             lo, hi = self._min, self._max
             sample = sorted(self._ring)
+            per_bucket = list(self._bucket_counts)
+        cum, acc = [], 0
+        for c in per_bucket[:-1]:
+            acc += c
+            cum.append(acc)
+        buckets = {"bounds": list(self.bounds), "counts": cum}
         if n == 0:
-            return {"count": 0}
+            return {"count": 0, "buckets": buckets}
         m = len(sample)
-        return {
+        out = {
             "count": n,
             "sum": s,
             "mean": s / n,
@@ -180,7 +209,11 @@ class Histogram:
             "p50": sample[m // 2],
             "p90": sample[min(m - 1, int(m * 0.9))],
             "p99": sample[min(m - 1, int(m * 0.99))],
+            "buckets": buckets,
         }
+        if include_sample:
+            out["sample"] = sample
+        return out
 
 
 def _get(name: str, cls, **kw):
@@ -205,8 +238,9 @@ def gauge(name: str) -> Gauge:
     return _get(name, Gauge)
 
 
-def histogram(name: str, capacity: int = 512) -> Histogram:
-    return _get(name, Histogram, capacity=capacity)
+def histogram(name: str, capacity: int = 512,
+              bounds: Optional[Sequence[float]] = None) -> Histogram:
+    return _get(name, Histogram, capacity=capacity, bounds=bounds)
 
 
 def peek(name: str, kind: str = "counter"):
@@ -348,6 +382,133 @@ def snapshot() -> dict:
             node[leaf]["_value"] = m.export()
         else:
             node[leaf] = m.export()
+    return out
+
+
+# -- federation primitives -----------------------------------------------
+def sample_quantile(sample: Sequence[float], q: float) -> Optional[float]:
+    """Quantile of a pre-sorted sample, using the same nearest-rank
+    convention as :meth:`Histogram.export` (``sample[int(m*q)]``,
+    clamped). Returns None for an empty sample."""
+    m = len(sample)
+    if m == 0:
+        return None
+    if q == 0.5:
+        return sample[m // 2]
+    return sample[min(m - 1, int(m * q))]
+
+
+def bucket_quantile(buckets: dict, count: int, q: float,
+                    hi: Optional[float] = None) -> Optional[float]:
+    """Quantile interpolated from a cumulative-bucket export
+    (``{"bounds": [...], "counts": [...]}``). Linear within the bucket
+    holding the target rank; ranks past the last finite bound clamp to
+    ``hi`` (observed max) or the last bound. Returns None when empty."""
+    if count <= 0 or not buckets:
+        return None
+    bounds = buckets.get("bounds") or []
+    counts = buckets.get("counts") or []
+    target = q * count
+    prev_bound, prev_cum = 0.0, 0
+    for bound, cum in zip(bounds, counts):
+        if cum >= target:
+            in_bucket = cum - prev_cum
+            if in_bucket <= 0:
+                return bound
+            frac = (target - prev_cum) / in_bucket
+            return prev_bound + (bound - prev_bound) * min(1.0, frac)
+        prev_bound, prev_cum = bound, cum
+    if hi is not None:
+        return float(hi)
+    return float(bounds[-1]) if bounds else None
+
+
+def _is_hist_export(d) -> bool:
+    return isinstance(d, dict) and "count" in d and "buckets" in d
+
+
+_MERGE_SAMPLE_CAP = 4096
+
+
+def _merge_hist(a: dict, b: dict) -> dict:
+    ba, bb = a.get("buckets") or {}, b.get("buckets") or {}
+    bounds_a = list(ba.get("bounds") or [])
+    bounds_b = list(bb.get("bounds") or [])
+    if bounds_a and bounds_b and bounds_a != bounds_b:
+        raise MXNetError(
+            "merge_snapshots: conflicting histogram bucket bounds "
+            "%r vs %r — federation requires one ladder per metric"
+            % (bounds_a, bounds_b))
+    n = int(a.get("count", 0)) + int(b.get("count", 0))
+    bounds = bounds_a or bounds_b
+    counts_a = list(ba.get("counts") or [0] * len(bounds))
+    counts_b = list(bb.get("counts") or [0] * len(bounds))
+    counts = [x + y for x, y in zip(counts_a, counts_b)]
+    out = {"count": n, "buckets": {"bounds": bounds, "counts": counts}}
+    if n == 0:
+        return out
+    out["sum"] = float(a.get("sum", 0.0)) + float(b.get("sum", 0.0))
+    out["mean"] = out["sum"] / n
+    mins = [v for v in (a.get("min"), b.get("min")) if v is not None]
+    maxs = [v for v in (a.get("max"), b.get("max")) if v is not None]
+    if mins:
+        out["min"] = min(mins)
+    if maxs:
+        out["max"] = max(maxs)
+    sample = sorted((a.get("sample") or []) + (b.get("sample") or []))
+    if len(sample) > _MERGE_SAMPLE_CAP:
+        # decimate evenly rather than truncate: keeps the distribution
+        step = len(sample) / float(_MERGE_SAMPLE_CAP)
+        sample = [sample[int(i * step)] for i in range(_MERGE_SAMPLE_CAP)]
+    if sample:
+        out["sample"] = sample
+        for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+            out[key] = sample_quantile(sample, q)
+    else:
+        for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+            v = bucket_quantile(out["buckets"], n, q, hi=out.get("max"))
+            if v is not None:
+                out[key] = v
+    return out
+
+
+def _merge_into(dst: dict, src: dict, path: str):
+    for k, v in src.items():
+        here = "%s.%s" % (path, k) if path else k
+        if k not in dst:
+            dst[k] = copy.deepcopy(v)
+            continue
+        cur = dst[k]
+        if _is_hist_export(cur) and _is_hist_export(v):
+            dst[k] = _merge_hist(cur, v)
+        elif _is_hist_export(cur) or _is_hist_export(v):
+            raise MXNetError("merge_snapshots: %r is a histogram in one "
+                             "snapshot and not in another" % here)
+        elif isinstance(cur, dict) and isinstance(v, dict):
+            _merge_into(cur, v, here)
+        elif isinstance(cur, (int, float)) and isinstance(v, (int, float)):
+            # counters (ints) and gauges (floats) both merge by sum; a
+            # federator wanting per-source gauge fan-out keeps the
+            # original snapshots alongside the merged view
+            dst[k] = cur + v
+        else:
+            raise MXNetError("merge_snapshots: %r has mismatched kinds "
+                             "(%s vs %s)" % (here, type(cur).__name__,
+                                             type(v).__name__))
+
+
+def merge_snapshots(snaps: Sequence[dict]) -> dict:
+    """Merge N :func:`snapshot`-shaped nested dicts into one fleet
+    rollup: counters and gauges sum, histogram exports merge bucket-wise
+    (counts/sums add, min/max combine, samples concatenate, percentiles
+    recomputed — exact from merged samples when every input carried one,
+    bucket-interpolated otherwise). Histograms with conflicting bucket
+    ladders raise :class:`MXNetError` rather than silently misbinning.
+    Inputs are never mutated."""
+    out: dict = {}
+    for s in snaps:
+        if s:
+            _merge_into(out, s, "")
     return out
 
 

@@ -232,12 +232,22 @@ class FleetHealthDetector:
     def check(self, rec: dict) -> Optional[dict]:
         down = rec.get("fleet_down", 0)
         tripped = rec.get("breaker_open", 0)
-        if down or tripped:
+        burn = rec.get("slo_burn_alert", 0)
+        if down or tripped or burn:
             ev = {"type": self.type}
             if down:
                 ev["replicas_down"] = int(down)
             if tripped:
                 ev["breakers_open"] = int(tripped)
+            if burn:
+                # stamped by obswatch's burn-rate monitor: both the
+                # fast and slow windows are burning error budget past
+                # the alert threshold
+                ev["slo_burn_alert"] = 1
+                for k in ("slo_burn_fast", "slo_burn_slow",
+                          "slo_budget_spent"):
+                    if rec.get(k) is not None:
+                        ev[k] = round(float(rec[k]), 4)
             if rec.get("fleet_size") is not None:
                 ev["fleet_size"] = int(rec["fleet_size"])
             return ev
@@ -737,13 +747,35 @@ def _prom_name(name: str) -> str:
     return "mxnet_tpu_" + "".join(out)
 
 
+def _prom_label_value(v) -> str:
+    """Escape a label value per the exposition format: backslash, double
+    quote and newline must be escaped or standard scrapers reject the
+    whole page."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_labels(**labels) -> str:
+    """``{k="v",...}`` with escaped values, keys in the given order."""
+    return "{%s}" % ",".join('%s="%s"' % (k, _prom_label_value(v))
+                             for k, v in labels.items())
+
+
+def _fmt_le(bound: float) -> str:
+    """Prometheus convention: integral bounds print without the
+    trailing ``.0`` (``le="10"``, not ``le="10.0"``)."""
+    return "%g" % bound
+
+
 def prometheus_text() -> str:
     """The full registry in the Prometheus text exposition format
-    (version 0.0.4). Counters/gauges map directly; histograms export as
-    summaries (quantiles from the bounded sample ring + exact
-    count/sum). Every sample carries the worker rank label."""
-    lbl = '{rank="%d"}' % worker_rank()
-    qlbl = '{rank="%d",quantile="%s"}'
+    (version 0.0.4). Counters/gauges map directly; histograms emit real
+    ``_bucket`` series with cumulative ``le`` labels (closing with
+    ``+Inf``) plus exact ``_sum``/``_count``, so a standard scraper or
+    the obswatch federator can bucket-merge across replicas. Every
+    sample carries the worker rank label; label values are escaped."""
+    rank = worker_rank()
+    lbl = _prom_labels(rank=rank)
     lines = []
     for name, m in _tel.metrics_items():
         pname = _prom_name(name)
@@ -755,14 +787,20 @@ def prometheus_text() -> str:
             lines.append("%s%s %s" % (pname, lbl, repr(m.value)))
         elif isinstance(m, _tel.Histogram):
             ex = m.export()
-            lines.append("# TYPE %s summary" % pname)
-            for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
-                v = ex.get(key)
-                if v is not None:
-                    lines.append("%s%s %s"
-                                 % (pname, qlbl % (worker_rank(), q), repr(v)))
+            count = ex.get("count", 0)
+            buckets = ex.get("buckets") or {}
+            lines.append("# TYPE %s histogram" % pname)
+            for bound, cum in zip(buckets.get("bounds", ()),
+                                  buckets.get("counts", ())):
+                lines.append("%s_bucket%s %d"
+                             % (pname,
+                                _prom_labels(rank=rank, le=_fmt_le(bound)),
+                                cum))
+            lines.append("%s_bucket%s %d"
+                         % (pname, _prom_labels(rank=rank, le="+Inf"),
+                            count))
             lines.append("%s_sum%s %s" % (pname, lbl, repr(ex.get("sum", 0))))
-            lines.append("%s_count%s %d" % (pname, lbl, ex.get("count", 0)))
+            lines.append("%s_count%s %d" % (pname, lbl, count))
     return "\n".join(lines) + "\n"
 
 

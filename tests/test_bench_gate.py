@@ -1,0 +1,201 @@
+"""The perf-regression gate: improvements pass, regressions fail with
+the metric/artifact/delta named, missing or stamped-incomplete
+artifacts report INCOMPLETE instead of failing an unattended window,
+tolerance is an exact boundary, the BENCH_r* trajectory gates on
+accelerator truth (never a cpu-fallback number), and --update-baselines
+accepts current perf."""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+import bench_gate  # noqa: E402
+
+CLOCK = lambda: 1234.5  # noqa: E731 (deterministic verdict stamps)
+
+
+def _write(root, name, rec):
+    with open(os.path.join(str(root), name), "w") as f:
+        json.dump(rec, f)
+
+
+def _serve(value=100.0, p99=5.0, occ=6.0):
+    return {"metric": "serve_goodput_rps", "value": value, "p99_ms": p99,
+            "mean_batch_occupancy": occ}
+
+
+def _baselines(root, value=100.0, p99=5.0, occ=6.0, tolerance=0.10):
+    path = os.path.join(str(root), "baselines.json")
+    base = {"SERVE_bench.json": {
+        "serve_goodput_rps": {"value": value, "direction": "higher",
+                              "tolerance": tolerance},
+        "serve_p99_ms": {"value": p99, "direction": "lower",
+                         "tolerance": tolerance},
+        "serve_mean_batch_occupancy": {"value": occ,
+                                       "direction": "higher",
+                                       "tolerance": tolerance}}}
+    with open(path, "w") as f:
+        json.dump(base, f)
+    return path
+
+
+def _by_metric(verdict):
+    return {c["metric"]: c for c in verdict["checks"]}
+
+
+def test_improvement_passes(tmp_path):
+    _write(tmp_path, "SERVE_bench.json", _serve(value=130.0, p99=4.0))
+    v = bench_gate.run_gate(str(tmp_path), _baselines(tmp_path),
+                            clock=CLOCK)
+    assert v["ts"] == 1234.5
+    assert v["verdict"] == "pass" and v["regressions"] == []
+    checks = _by_metric(v)
+    assert checks["serve_goodput_rps"]["status"] == "pass"
+    assert checks["serve_goodput_rps"]["delta"] == pytest.approx(0.30)
+    assert checks["serve_p99_ms"]["status"] == "pass"  # lower is better
+
+
+def test_regression_fails_with_named_metric(tmp_path):
+    _write(tmp_path, "SERVE_bench.json", _serve(value=80.0))
+    v = bench_gate.run_gate(str(tmp_path), _baselines(tmp_path),
+                            clock=CLOCK)
+    assert v["verdict"] == "fail"
+    assert "serve_goodput_rps (SERVE_bench.json)" in v["regressions"]
+    c = _by_metric(v)["serve_goodput_rps"]
+    assert c["status"] == "fail"
+    assert c["delta"] == pytest.approx(-0.20)
+    assert c["baseline"] == 100.0 and c["current"] == 80.0
+
+
+def test_lower_is_better_direction(tmp_path):
+    _write(tmp_path, "SERVE_bench.json", _serve(p99=6.0))  # +20% latency
+    v = bench_gate.run_gate(str(tmp_path), _baselines(tmp_path),
+                            clock=CLOCK)
+    assert "serve_p99_ms (SERVE_bench.json)" in v["regressions"]
+
+
+def test_missing_artifacts_incomplete_not_fail(tmp_path):
+    v = bench_gate.run_gate(str(tmp_path), _baselines(tmp_path),
+                            clock=CLOCK)
+    assert v["verdict"] == "incomplete" and v["regressions"] == []
+    assert any("SERVE_bench.json" in s for s in v["incomplete"])
+    # --strict upgrades INCOMPLETE to failure for interactive use
+    vs = bench_gate.run_gate(str(tmp_path), _baselines(tmp_path),
+                             strict=True, clock=CLOCK)
+    assert vs["verdict"] == "fail"
+
+
+def test_incomplete_stamp_propagates(tmp_path):
+    _write(tmp_path, "SERVE_bench.json",
+           {"value": 0, "incomplete": "stage timed out"})
+    v = bench_gate.run_gate(str(tmp_path), _baselines(tmp_path),
+                            clock=CLOCK)
+    c = _by_metric(v)["serve_goodput_rps"]
+    assert c["status"] == "incomplete" and "timed out" in c["detail"]
+    assert v["verdict"] == "incomplete"
+
+
+def test_no_baseline_is_not_a_regression(tmp_path):
+    _write(tmp_path, "SERVE_bench.json", _serve())
+    _write(tmp_path, "FLEET_bench.json",
+           {"metric": "fleet_goodput_rps", "value": 50.0})
+    v = bench_gate.run_gate(str(tmp_path), _baselines(tmp_path),
+                            clock=CLOCK)
+    c = _by_metric(v)["fleet_goodput_rps"]
+    assert c["status"] == "no-baseline" and c["current"] == 50.0
+    assert v["verdict"] == "pass"  # a brand-new headline never fails
+
+
+def test_tolerance_is_an_exact_boundary(tmp_path):
+    base = _baselines(tmp_path, value=100.0, tolerance=0.10)
+    # exactly -10%: NOT a regression (delta must move PAST tolerance)
+    _write(tmp_path, "SERVE_bench.json", _serve(value=90.0))
+    v = bench_gate.run_gate(str(tmp_path), base, clock=CLOCK)
+    assert _by_metric(v)["serve_goodput_rps"]["status"] == "pass"
+    # one tick past: regression
+    _write(tmp_path, "SERVE_bench.json", _serve(value=89.9))
+    v = bench_gate.run_gate(str(tmp_path), base, clock=CLOCK)
+    assert _by_metric(v)["serve_goodput_rps"]["status"] == "fail"
+
+
+def test_tolerance_override_applies_everywhere(tmp_path):
+    _write(tmp_path, "SERVE_bench.json", _serve(value=95.0))  # -5%
+    base = _baselines(tmp_path)
+    assert bench_gate.run_gate(str(tmp_path), base,
+                               clock=CLOCK)["verdict"] == "pass"
+    v = bench_gate.run_gate(str(tmp_path), base, tolerance=0.02,
+                            clock=CLOCK)
+    assert v["verdict"] == "fail"
+
+
+# -- BENCH_r* trajectory (accelerator truth) -----------------------------
+
+def _bench(value=None, platform="tpu", lar=None):
+    parsed = {"platform": platform}
+    if value is not None:
+        parsed["value"] = value
+    if lar is not None:
+        parsed["last_accelerator_result"] = {"value": lar}
+    return {"parsed": parsed}
+
+
+def test_trajectory_gates_on_accelerator_truth(tmp_path):
+    _write(tmp_path, "BENCH_r01.json", _bench(value=100.0))
+    _write(tmp_path, "BENCH_r02.json", _bench(value=150.0))
+    # a cpu-fallback record gates on the accelerator result it carries,
+    # never on the (much smaller) cpu number
+    _write(tmp_path, "BENCH_r03.json",
+           _bench(value=3.0, platform="cpu", lar=145.0))
+    v = bench_gate.run_gate(str(tmp_path), _baselines(tmp_path),
+                            clock=CLOCK)
+    c = _by_metric(v)["resnet50_train_imgs_per_sec"]
+    assert c["status"] == "pass"
+    assert c["current"] == 145.0 and c["baseline"] == 150.0
+    # a genuine accelerator regression fails the trajectory
+    _write(tmp_path, "BENCH_r04.json",
+           _bench(value=2.0, platform="cpu", lar=90.0))
+    v = bench_gate.run_gate(str(tmp_path), _baselines(tmp_path),
+                            clock=CLOCK)
+    c = _by_metric(v)["resnet50_train_imgs_per_sec"]
+    assert c["status"] == "fail" and c["baseline_artifact"] == \
+        "BENCH_r02.json"
+
+
+def test_trajectory_cpu_only_records_skipped(tmp_path):
+    # a cpu record with no carried accelerator result is ungateable
+    _write(tmp_path, "BENCH_r01.json", _bench(value=100.0))
+    _write(tmp_path, "BENCH_r02.json", _bench(value=3.0, platform="cpu"))
+    v = bench_gate.run_gate(str(tmp_path), _baselines(tmp_path),
+                            clock=CLOCK)
+    c = _by_metric(v)["resnet50_train_imgs_per_sec"]
+    assert c["status"] == "incomplete"  # only one gateable point
+
+
+def test_bench_headline_extraction():
+    assert bench_gate._bench_headline(_bench(value=100.0)) == 100.0
+    assert bench_gate._bench_headline(
+        _bench(value=3.0, platform="cpu", lar=140.0)) == 140.0
+    assert bench_gate._bench_headline(
+        _bench(value=3.0, platform="cpu")) is None
+    assert bench_gate._bench_headline({}) is None
+
+
+# -- baseline refresh ----------------------------------------------------
+
+def test_update_baselines_accepts_current(tmp_path):
+    _write(tmp_path, "SERVE_bench.json", _serve(value=123.0))
+    _write(tmp_path, "FLEET_bench.json",
+           {"value": 77.0, "smoke": True})
+    _write(tmp_path, "MULTICHIP_scaling.json",
+           {"value": 0, "incomplete": "no window"})  # kept out
+    path = os.path.join(str(tmp_path), "baselines.json")
+    out = bench_gate.update_baselines(str(tmp_path), path)
+    assert out["SERVE_bench.json"]["serve_goodput_rps"]["value"] == 123.0
+    assert out["FLEET_bench.json"]["fleet_goodput_rps"]["smoke"] is True
+    assert "MULTICHIP_scaling.json" not in out
+    # the refreshed file round-trips and now gates clean
+    v = bench_gate.run_gate(str(tmp_path), path, clock=CLOCK)
+    assert v["verdict"] == "pass" and v["regressions"] == []

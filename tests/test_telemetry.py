@@ -243,3 +243,86 @@ def test_kvstore_counters():
     assert snap["kvstore"]["pull"] >= 1
     assert snap["kvstore"]["push_bytes"] >= 4 * 4 * 4
     assert snap["kvstore"]["pull_bytes"] >= 4 * 4 * 4
+
+
+# -- bucketed export + fleet merge (obswatch federation core) ------------
+
+def test_histogram_bucket_export_cumulative():
+    h = telemetry.Histogram("t.ms", bounds=(1.0, 5.0, 10.0))
+    for v in (0.5, 0.7, 3.0, 20.0):
+        h.observe(v)
+    ex = h.export()
+    assert ex["count"] == 4
+    assert ex["buckets"]["bounds"] == [1.0, 5.0, 10.0]
+    # cumulative le counts; the +Inf bucket is implicit (== count)
+    assert ex["buckets"]["counts"] == [2, 3, 3]
+    empty = telemetry.Histogram("t.empty", bounds=(1.0,)).export()
+    assert empty == {"count": 0,
+                     "buckets": {"bounds": [1.0], "counts": [0]}}
+
+
+def test_bucket_quantile_interpolation():
+    buckets = {"bounds": [10.0, 20.0], "counts": [10, 20]}
+    # rank 10 of 20 sits at the top of the first bucket
+    assert telemetry.bucket_quantile(buckets, 20, 0.5) == 10.0
+    # rank 15 is halfway through the 10..20 bucket
+    assert telemetry.bucket_quantile(buckets, 20, 0.75) == \
+        pytest.approx(15.0)
+    # ranks past the last finite bound clamp to the observed max
+    assert telemetry.bucket_quantile(
+        {"bounds": [10.0], "counts": [0]}, 5, 0.5, hi=42.0) == 42.0
+    assert telemetry.bucket_quantile({}, 0, 0.5) is None
+
+
+def test_merge_snapshots_sums_and_recurses():
+    a = {"engine": {"push": 3, "dispatch": 1}, "io": {"wait_ms": 1.5}}
+    b = {"engine": {"push": 4}, "io": {"wait_ms": 0.5}, "extra": 1}
+    merged = telemetry.merge_snapshots([a, b])
+    assert merged["engine"] == {"push": 7, "dispatch": 1}
+    assert merged["io"]["wait_ms"] == pytest.approx(2.0)
+    assert merged["extra"] == 1
+    # inputs are never mutated
+    assert a["engine"]["push"] == 3 and b["engine"]["push"] == 4
+
+
+def test_merge_snapshots_histograms_bucket_wise():
+    ha = telemetry.Histogram("a.ms", bounds=(1.0, 10.0))
+    hb = telemetry.Histogram("b.ms", bounds=(1.0, 10.0))
+    for v in (0.5, 2.0):
+        ha.observe(v)
+    for v in (3.0, 50.0):
+        hb.observe(v)
+    merged = telemetry.merge_snapshots(
+        [{"lat": ha.export(include_sample=True)},
+         {"lat": hb.export(include_sample=True)}])["lat"]
+    assert merged["count"] == 4
+    assert merged["buckets"]["counts"] == [1, 3]
+    assert merged["min"] == 0.5 and merged["max"] == 50.0
+    assert merged["sum"] == pytest.approx(55.5)
+    # exact percentiles from the concatenated samples
+    assert merged["sample"] == [0.5, 2.0, 3.0, 50.0]
+    assert merged["p50"] == 3.0
+    # without samples, percentiles interpolate from the merged buckets
+    no_sample = telemetry.merge_snapshots(
+        [{"lat": ha.export()}, {"lat": hb.export()}])["lat"]
+    assert "sample" not in no_sample
+    assert 1.0 <= no_sample["p50"] <= 10.0
+
+
+def test_merge_snapshots_conflicting_bounds_raise():
+    ha = telemetry.Histogram("a.ms", bounds=(1.0, 10.0))
+    hb = telemetry.Histogram("b.ms", bounds=(1.0, 5.0))
+    ha.observe(2.0)
+    hb.observe(2.0)
+    with pytest.raises(MXNetError, match="conflicting"):
+        telemetry.merge_snapshots([{"lat": ha.export()},
+                                   {"lat": hb.export()}])
+
+
+def test_merge_snapshots_kind_mismatch_raises():
+    h = telemetry.Histogram("a.ms")
+    h.observe(1.0)
+    with pytest.raises(MXNetError):
+        telemetry.merge_snapshots([{"x": 1}, {"x": h.export()}])
+    with pytest.raises(MXNetError):
+        telemetry.merge_snapshots([{"x": 1}, {"x": "one"}])

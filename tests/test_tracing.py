@@ -307,13 +307,22 @@ def test_metrics_exposition_round_trip():
     assert samples["mxnet_tpu_engine_push"]['{rank="0"}'] == 7
     assert types["mxnet_tpu_io_pipeline_ring_occupancy"] == "gauge"
     assert samples["mxnet_tpu_io_pipeline_ring_occupancy"]['{rank="0"}'] == 3.0
-    assert types["mxnet_tpu_profiler_step_ms"] == "summary"
+    assert types["mxnet_tpu_profiler_step_ms"] == "histogram"
     assert samples["mxnet_tpu_profiler_step_ms_count"]['{rank="0"}'] == 4
     assert samples["mxnet_tpu_profiler_step_ms_sum"]['{rank="0"}'] == 10.0
-    # quantiles come from Histogram.export's sample ring (p50 of
-    # [1,2,3,4] is sample[2] by its upper-median convention)
-    assert samples["mxnet_tpu_profiler_step_ms"]['{rank="0",quantile="0.5"}'] \
-        == 3.0
+    # real histogram series: cumulative le buckets closing with +Inf.
+    # samples 1,2,3,4 against the default ladder: le="1" holds 1,
+    # le="2.5" holds 2, le="5" holds all 4
+    b = samples["mxnet_tpu_profiler_step_ms_bucket"]
+    assert b['{rank="0",le="1"}'] == 1
+    assert b['{rank="0",le="2.5"}'] == 2
+    assert b['{rank="0",le="5"}'] == 4
+    assert b['{rank="0",le="+Inf"}'] == 4
+    # cumulative counts are monotone in ladder order
+    ladder = [v for k, v in sorted(
+        b.items(), key=lambda kv: float("inf") if "+Inf" in kv[0]
+        else float(kv[0].split('le="')[1].rstrip('"}')))]
+    assert ladder == sorted(ladder)
 
 
 def test_metrics_rank_label_tags_dist_workers():

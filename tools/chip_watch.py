@@ -139,7 +139,8 @@ ARTIFACTS = ["BENCH_watch.json", ".bench_cache.json",
              "XPROF_DEVICE_TIME.json",
              "MULTICHIP_scaling.json", "SERVE_bench.json",
              "AUTOTUNE_search.json", ".autotune_cache.json",
-             "FLEET_bench.json", "FLEET_trace.json"]
+             "FLEET_bench.json", "FLEET_trace.json",
+             "OBS_fleet.json", "BENCH_GATE.json"]
 
 
 def tpu_consistency_verdict(out, stamp):
@@ -392,7 +393,31 @@ def fire():
                        "incomplete": "fleet trace phase did not run",
                        "chip_watch_stamp": stamp}, f)
             f.write("\n")
+    if not os.path.exists(os.path.join(REPO, "OBS_fleet.json")):
+        with open(os.path.join(REPO, "OBS_fleet.json"), "w") as f:
+            json.dump({"metric": "obswatch_fleet_goodput_rps",
+                       "value": 0,
+                       "incomplete": "fleet obswatch phase did not run",
+                       "chip_watch_stamp": stamp}, f)
+            f.write("\n")
     _commit("fleet fault tolerance", stamp)
+
+    # stage 10: the perf-regression gate over everything the window
+    # just produced. Same INCOMPLETE contract: bench_gate itself treats
+    # a missing/incomplete artifact as INCOMPLETE (exit 0), and if the
+    # gate process dies the stamped verdict says so — the window
+    # self-reports regressions either way, it never wedges on them.
+    out = _run([py, os.path.join(REPO, "tools", "bench_gate.py"),
+                "--json"], 600, keep_output=True)
+    if out is None or not os.path.exists(
+            os.path.join(REPO, "BENCH_GATE.json")):
+        with open(os.path.join(REPO, "BENCH_GATE.json"), "w") as f:
+            json.dump({"verdict": "incomplete",
+                       "incomplete": "chip_watch bench_gate stage "
+                                     "timed out or crashed",
+                       "chip_watch_stamp": stamp}, f)
+            f.write("\n")
+    _commit("bench regression gate", stamp)
 
 
 def main(argv=None):

@@ -558,6 +558,79 @@ def render_fleet(rec):
     return "\n".join(out) + "\n"
 
 
+def render_fleet_health(rec):
+    """Fleet-health view over an obswatch artifact (OBS_fleet.json):
+    the federated rollup table — one row per replica plus the fleet
+    row — the federation-agreement numbers, and the SLO burn-rate
+    verdict. INCOMPLETE-safe: a stamped-incomplete record renders its
+    marker instead of crashing the report."""
+    if rec.get("incomplete"):
+        return ("fleet-health: INCOMPLETE: %s\n" % rec["incomplete"])
+    fed = rec.get("federation") or {}
+    rollup = rec.get("final_rollup") or {}
+    fleet = rollup.get("fleet") or {}
+    burn = rec.get("burn") or {}
+    out = ["fleet-health: %s replicas up / %s, %.1f req/s federated "
+           "goodput" % (fleet.get("up", "?"),
+                        fleet.get("replicas", "?"),
+                        fed.get("fed_goodput_rps") or 0), ""]
+    rows = [("replica", "status", "state", "breaker", "served",
+             "breaches", "in_flight", "p50_ms", "p99_ms")]
+
+    def _ms(v):
+        return "-" if v is None else "%.2f" % v
+
+    for rid, r in sorted((rollup.get("replica_rows") or {}).items()):
+        rows.append((rid, str(r.get("status")), str(r.get("state")),
+                     str(r.get("breaker")), str(r.get("served")),
+                     str(r.get("slo_breaches")),
+                     "%g" % (r.get("in_flight") or 0),
+                     _ms(r.get("p50_ms")), _ms(r.get("p99_ms"))))
+    rows.append(("FLEET", "-", "-",
+                 "%s open" % fleet.get("breakers_open", 0),
+                 str(fleet.get("served")),
+                 str(fleet.get("slo_breaches")),
+                 "%g" % (fleet.get("in_flight") or 0),
+                 _ms(fleet.get("p50_ms")), _ms(fleet.get("p99_ms"))))
+    out.append("federated rollup (per-replica scheduler view; FLEET "
+               "row = router-view merge):")
+    out += _table(rows)
+    out.append("")
+    if fed:
+        out.append("federation agreement vs client-measured:")
+        out.append("  goodput %.1f vs %.1f req/s (%.2f%% off)   "
+                   "p99 %.2f vs %.2f ms (%.2f%% off)"
+                   % (fed.get("fed_goodput_rps") or 0,
+                      fed.get("client_goodput_rps") or 0,
+                      100 * (fed.get("goodput_rel_err") or 0),
+                      fed.get("fed_p99_ms") or 0,
+                      fed.get("client_p99_ms") or 0,
+                      100 * (fed.get("p99_rel_err") or 0)))
+        out.append("")
+    if burn:
+        if burn.get("alert_fired"):
+            out.append("SLO burn: ALERT at +%ss (fast %.2fx / slow "
+                       "%.2fx over budget rate), %.0f%% of error "
+                       "budget spent at alert"
+                       % (burn.get("alert_at_s"),
+                          burn.get("fast_burn") or 0,
+                          burn.get("slow_burn") or 0,
+                          100 * (burn.get("budget_spent_at_alert")
+                                 or 0)))
+        else:
+            out.append("SLO burn: no alert")
+        out.append("")
+    series = rec.get("series") or {}
+    pts = series.get("burn.budget_spent") or []
+    if pts:
+        out.append("budget burn-down (%d rollups in store):" % len(pts))
+        t0 = pts[0][0]
+        for ts, v in pts[-8:]:
+            out.append("  +%6.2fs  spent %5.1f%%"
+                       % (ts - t0, 100 * float(v or 0)))
+    return "\n".join(out) + "\n"
+
+
 # ---------------------------------------------------------------------------
 # distributed-trace views (dtrace span trees in a merged chrome trace)
 # ---------------------------------------------------------------------------
@@ -928,13 +1001,17 @@ def main(argv=None):
                    help="slowest steps to show (default 10)")
     p.add_argument("--view", default="steps",
                    choices=("steps", "compile", "ops", "memory", "bench",
-                            "serve", "fleet", "tune", "waterfall"),
+                            "serve", "fleet", "fleet-health", "tune",
+                            "waterfall"),
                    help="steps (default): slowest-step trace table; "
                         "compile/ops/memory/bench: xprof views over a "
                         "BENCH record file; serve: latency decomposition "
                         "+ load sweep over a SERVE_bench.json record; "
                         "fleet: recovery window + swap purity over a "
-                        "FLEET_bench.json record; tune: autotuner "
+                        "FLEET_bench.json record; fleet-health: "
+                        "federated rollup table + burn-rate verdict "
+                        "over an obswatch artifact (path optional, "
+                        "defaults to OBS_fleet.json); tune: autotuner "
                         "winners/losers per site from "
                         "MFU_EXPERIMENTS.jsonl; waterfall: one kept "
                         "distributed trace as an indented span tree "
@@ -978,6 +1055,22 @@ def main(argv=None):
             tid = max(trees, key=lambda t: max(
                 s["dur"] for s in trees[t]))
         sys.stdout.write(render_waterfall(tid, trees[tid]))
+        return 0
+    if a.view == "fleet-health":
+        # path optional: defaults to the repo-root obswatch artifact
+        path = a.path or os.path.join(_repo_root(), "OBS_fleet.json")
+        if not os.path.exists(path):
+            sys.stdout.write("no obswatch artifact at %s (run `python "
+                             "bench.py fleet --smoke`)\n" % path)
+            return 1
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except ValueError:
+            sys.stdout.write("fleet-health: INCOMPLETE: unreadable "
+                             "artifact %s\n" % path)
+            return 0
+        sys.stdout.write(render_fleet_health(rec))
         return 0
     if a.path is None:
         p.error("path is required unless --profile-report is given")
