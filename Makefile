@@ -82,6 +82,15 @@ autotune:
 fleet-bench:
 	python bench.py fleet
 
+# socket transport: the fleet bench's network tier — zero-copy frame
+# codec vs pickle, socket-vs-pipe p99 overhead, chaos over TCP
+# (net_drop/net_partition/net_reorder armed, zero client errors), and
+# the 2-process netfeed epoch -> the "socket" record in
+# FLEET_bench.json (read it with trace_report --view wire)
+net-bench:
+	python bench.py fleet --smoke
+	python tools/trace_report.py --view wire
+
 # distributed-tracing smoke: the fleet bench (smoke profile) with the
 # tracer armed must produce a loadable merged chrome trace holding at
 # least one kept span tree -> FLEET_trace.json (read it with
@@ -116,4 +125,4 @@ obs-gate: lint
 clean:
 	rm -rf mxnet_tpu/_native perl-package/blib
 
-.PHONY: all predict perl test lint profile-report multichip serve-bench fleet-bench trace-smoke ckpt-test bench-gate obs-gate clean
+.PHONY: all predict perl test lint profile-report multichip serve-bench fleet-bench net-bench trace-smoke ckpt-test bench-gate obs-gate clean

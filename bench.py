@@ -1196,6 +1196,164 @@ def _fleet_double_params(srv):
         arr._data = arr._data * 2.0
 
 
+def _round3(v):
+    return None if v is None else round(v, 3)
+
+
+def _fleet_socket_phase(smoke, rng, row):
+    """The socket-transport tier: (a) frame codec vs pickle
+    serialization cost per MB; (b) socket-vs-pipe per-request overhead
+    at equal open-loop load (the perf claim: p99 within 1.5x of the
+    pipe baseline); (c) the chaos acceptance over TCP — net_drop +
+    net_partition + net_reorder armed inside the framing layer, zero
+    client-visible errors, goodput >= 90% of the clean socket run;
+    (d) the disaggregated netfeed epoch — a spawned decode host
+    streams batches over loopback into a FeedScheduler and the
+    feed-stall p99 proves the chip never starved."""
+    import pickle
+
+    from mxnet_tpu import faults, fleet, netfeed, netwire, telemetry
+
+    # (a) serialization: zero-copy frames vs pickle, ms per MB
+    payload = [rng.randn(256, 1024).astype(np.float32)]   # 1 MiB
+    mb = sum(a.nbytes for a in payload) / (1 << 20)
+    reps = 20 if smoke else 50
+
+    def _time(fn):
+        fn()                                   # warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn()
+        return (time.perf_counter() - t0) * 1e3 / reps
+
+    wire_blob = b"".join(bytes(b) for b in
+                         netwire.encode_frame("infer", "m", payload))
+    pkl_blob = pickle.dumps(payload, protocol=-1)
+    ser = {
+        "payload_mb": round(mb, 3),
+        # encode builds the sendmsg buffer list — header bytes plus
+        # borrowed memoryviews, no payload copy ever happens
+        "wire_encode_ms_per_mb": round(_time(
+            lambda: netwire.encode_frame("infer", "m", payload)) / mb, 4),
+        "wire_decode_ms_per_mb": round(_time(
+            lambda: netwire.decode_frame(wire_blob)) / mb, 4),
+        "pickle_ms_per_mb": round(_time(
+            lambda: pickle.dumps(payload, protocol=-1)) / mb, 4),
+        "unpickle_ms_per_mb": round(_time(
+            lambda: pickle.loads(pkl_blob)) / mb, 4),
+    }
+
+    # (b) + (c): pipe baseline, clean socket, chaos socket — the same
+    # open-loop Poisson load through each transport. The rate sits
+    # well under either backend's capacity: the claim is per-request
+    # overhead at equal load, not a saturation race
+    rate = 60 if smoke else 80
+    duration = 2.0 if smoke else 5.0
+
+    def _router(backend, **kw):
+        kw.setdefault("deadline_ms", 20000.0)
+        kw.setdefault("attempt_timeout_ms", 2000.0)
+        kw.setdefault("retries", 40)
+        kw.setdefault("backoff_ms", 2.0)
+        kw.setdefault("health_interval_s", 60.0)
+        kw.setdefault("hedge", False)
+        return fleet.FleetRouter(backend, 1, **kw)
+
+    def _run(backend, **kw):
+        # paired arrival schedule: every transport replays the same
+        # Poisson draw, so phase ratios compare completion behaviour
+        # rather than arrival-count luck (sigma ~ sqrt(rate*duration))
+        prng = np.random.RandomState(20170401)
+        with _router(backend, **kw) as r:
+            for _ in range(8):                 # warm spawn + compile +
+                r.infer([row], timeout=120.0)  # connection dials
+            done, _ = _fleet_load(r, rate, duration, prng, row)
+            wire = None
+            for rid in r.replica_ids():
+                rep = r._entries[rid].replica
+                if hasattr(rep, "wire_stats"):
+                    wire = rep.wire_stats()
+            out = _fleet_phase_stats(done, duration)
+        if wire:
+            out["wire"] = wire
+        # load is open-loop and every request eventually completes, so
+        # total served just echoes the arrival draw; goodput is what
+        # finished INSIDE the measurement window — requests parked in
+        # fault-retry past the end are the signal chaos should pay for
+        out["in_window"] = sum(1 for t, ok, _ in done
+                               if ok and t <= duration)
+        return out
+
+    pipe = _run(fleet.in_subprocess("mxnet_tpu.fleet:demo_server_factory"))
+    clean = _run(fleet.in_socket("mxnet_tpu.fleet:demo_server_factory"))
+    faults.configure("net_drop:0.03,net_partition:0.01,net_reorder:0.08",
+                     seed=1)
+    try:
+        chaos = _run(fleet.in_socket("mxnet_tpu.fleet:demo_server_factory"),
+                     attempt_timeout_ms=500.0)
+        plan = faults._PLAN
+        chaos["injected"] = dict(plan.injected) if plan else {}
+    finally:
+        faults.configure(None)
+
+    overhead = None
+    if pipe["p99_ms"] and clean["p99_ms"]:
+        overhead = round(clean["p99_ms"] / pipe["p99_ms"], 3)
+    goodput_ratio = None
+    if clean["in_window"]:
+        goodput_ratio = round(chaos["in_window"] / clean["in_window"], 3)
+
+    # (d) netfeed: a real decode host, one epoch through FeedScheduler
+    from mxnet_tpu.io_pipeline import FeedScheduler
+
+    netfeed_rec = {"incomplete": "netfeed epoch did not run"}
+    proc, host, port = netfeed.serve_subprocess(
+        "mxnet_tpu.netfeed:demo_feed_factory")
+    it = netfeed.NetFeedIter(host, port)
+    try:
+        sched = FeedScheduler(it, depth=2)
+        first = sched.next()                   # warm device_put
+        telemetry.reset()                      # steady-state stalls only
+        telemetry.enable()
+        n, nbytes = 1, first.data[0].asnumpy().nbytes
+        t0 = time.perf_counter()
+        for batch in sched:
+            n += 1
+            nbytes += batch.data[0].asnumpy().nbytes
+            time.sleep(0.002)                  # the "training step"
+        wall = time.perf_counter() - t0
+        sched.close()
+        snap = telemetry.snapshot()
+        stall = snap.get("io", {}).get("feed_stall_ms") or {}
+        netfeed_rec = {
+            "batches": n,
+            "payload_mb": round(nbytes / (1 << 20), 2),
+            "epoch_s": round(wall, 3),
+            "goodput_mb_s": round(nbytes / (1 << 20) / wall, 1)
+            if wall else None,
+            "feed_stall_p50_ms": _round3(stall.get("p50")),
+            "feed_stall_p99_ms": _round3(stall.get("p99")),
+            "wait_p99_ms": _round3(
+                (snap.get("io", {}).get("netfeed_wait_ms")
+                 or {}).get("p99")),
+        }
+    finally:
+        it.close(stop_server=True)
+        proc.join(10)
+        if proc.is_alive():
+            proc.kill()
+            proc.join(5)
+
+    return {
+        "goodput_rps": clean["achieved_rps"],
+        "serialization": ser,
+        "pipe": pipe, "clean": clean, "chaos": chaos,
+        "overhead_p99_x": overhead,
+        "chaos_goodput_ratio": goodput_ratio,
+        "netfeed": netfeed_rec,
+    }
+
+
 def _bench_fleet():
     """The measured fleet tier (inner child, forced cpu): a FleetRouter
     over in-process ``demo_server_factory`` replicas.
@@ -1574,6 +1732,19 @@ def _bench_fleet():
     except OSError:
         pass
 
+    # phase 6: the socket transport — serialization vs pickle, the
+    # socket-vs-pipe overhead claim, chaos over TCP, and the netfeed
+    # epoch (the zero-copy wire's whole acceptance record)
+    try:
+        sock = _fleet_socket_phase(smoke, rng, row)
+    except Exception as e:   # noqa: BLE001 (recorded, never fatal)
+        sock = {"incomplete": "socket phase failed: %s" % e}
+    sock_ok = bool(
+        "incomplete" not in sock
+        and sock["chaos"]["errors"] == 0
+        and (sock["chaos_goodput_ratio"] or 0) >= 0.9
+        and (sock["overhead_p99_x"] or 99) <= 1.5)
+
     best = max(scaling, key=lambda t: t["achieved_rps"])
     result = {
         "metric": "fleet_goodput_rps",
@@ -1590,6 +1761,7 @@ def _bench_fleet():
                      and trace["pids"] >= 3 and trace["nested"]),
         "obs": obs, "burn": burn,
         "obs_ok": obs_ok, "burn_ok": burn_ok,
+        "socket": sock, "socket_ok": sock_ok,
         "smoke": smoke,
     }
     print(json.dumps(result))

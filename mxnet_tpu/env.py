@@ -482,16 +482,56 @@ declare("MXNET_TPU_FAULTS", str, "",
         "Arm the typed fault-injection registry (`mxnet_tpu/faults.py`) "
         "with a comma list of `name` or `name:rate` entries, rate in "
         "[0,1] (default 1). Names: `replica_crash`, `slow_replica`, "
-        "`drop_response`, `torn_swap`; anything else fails fast at "
-        "parse. Unset: injection code is a single None-check in the "
-        "hot path.", section=_F)
+        "`drop_response`, `torn_swap`, `net_drop`, `net_partition`, "
+        "`net_reorder`, `net_slow`; anything else fails fast at parse "
+        "with the full valid-name list in the error. Unset: injection "
+        "code is a single None-check in the hot path.", section=_F)
 declare("MXNET_TPU_FAULTS_SEED", int, 0,
         "Seed for the fault plan's RNG: every injection decision draws "
         "from one seeded stream, so a chaos run replays bit-identically.",
         section=_F)
 declare("MXNET_TPU_FAULT_SLOW_MS", float, 50.0,
         "Injected latency (ms) each time a `slow_replica` fault fires "
-        "in the batcher's dispatch path.", section=_F)
+        "in the batcher's dispatch path, or a `net_slow` fault fires "
+        "in the netwire send path.", section=_F)
+
+_W = "Netwire / socket transport"
+declare("MXNET_TPU_WIRE_POOL", int, 2,
+        "Persistent connections per peer in a `netwire.WireClient` "
+        "pool. Requests are multiplexed by message id and round-robin "
+        "over the pool, so N is also the per-peer request concurrency "
+        "a socket replica serves (each connection has one server-side "
+        "reader). 2-4 covers a loopback fleet; raise it for "
+        "high-fan-in cross-host peers.", section=_W)
+declare("MXNET_TPU_WIRE_MAX_FRAME_MB", int, 4096,
+        "Refuse any frame whose metadata or body length field exceeds "
+        "this many MiB (default 4096 = 4 GiB) BEFORE allocating: a "
+        "corrupt or hostile length prefix must not OOM the reader. "
+        "Raising it past 4096 also requires peers new enough to parse "
+        "64-bit body lengths (all WIRE_VERSION >= 1 peers do).",
+        section=_W)
+declare("MXNET_TPU_WIRE_CONNECT_TIMEOUT_MS", float, 2000.0,
+        "TCP connect timeout for each `WireClient` pool slot; a peer "
+        "that cannot be reached within it fails the attempt with "
+        "`WirePeerLost` (the router's retry budget decides what "
+        "happens next).", section=_W)
+declare("MXNET_TPU_WIRE_BACKPRESSURE_MS", float, 20.0,
+        "A frame send that blocks longer than this (socket buffer "
+        "full = TCP backpressure) counts `wire.backpressure_stalls` "
+        "and lands in the `wire.backpressure_stall_ms` histogram — "
+        "the queue-depth signal that inflates rtt and feeds the "
+        "router's hedge/breaker machinery.", section=_W)
+declare("MXNET_TPU_NETFEED_DEPTH", int, 2,
+        "Outstanding batch requests a `NetFeedIter` keeps in flight "
+        "to its decode host (credit-based pipelining). Depth D means "
+        "the decode host is always D batches ahead of the training "
+        "loop; 2-4 hides loopback/LAN rtt completely (io.feed_stall_ms "
+        "p99 ~ 0).", section=_W)
+declare("MXNET_TPU_NETFEED_TIMEOUT_S", float, 30.0,
+        "Per-batch reply deadline for `NetFeedIter.next()`: a decode "
+        "host that cannot produce a batch within it fails the epoch "
+        "with a named `WireTimeout` instead of wedging the training "
+        "loop.", section=_W)
 
 _D = "Distributed request tracing (dtrace)"
 declare("MXNET_TPU_DTRACE", bool, False,

@@ -27,6 +27,30 @@ Fault kinds (comma list, each ``name`` or ``name:rate`` with rate in
   Exercises the fleet's drain-then-swap rolling update, which must
   mask the window entirely.
 
+Network faults (injected inside :mod:`mxnet_tpu.netwire`'s framing
+layer, so every socket consumer — SocketReplica fleets, netfeed — is
+exercised by the same plane):
+
+* ``net_drop`` — an encoded frame is silently discarded instead of
+  written to the socket: the peer never sees the request (or the
+  reply), exactly like a datagram lost between hosts. Exercises
+  per-attempt deadlines and the router's retry path.
+* ``net_partition`` — the connection is hard-closed mid-conversation:
+  both ends see a reset, pending requests fail, and the pooled client
+  must reconnect. Exercises crash detection and reconnect accounting.
+* ``net_reorder`` — a frame is held back and written after the *next*
+  frame on the same connection, so replies arrive out of order.
+  Exercises mid-based multiplexing (fleet) and sequence-number
+  reassembly (netfeed).
+* ``net_slow`` — the sender sleeps ``MXNET_TPU_FAULT_SLOW_MS`` before
+  writing a frame: wire latency without loss. Exercises hedging and
+  the rtt/backpressure telemetry.
+
+Unknown fault names **fail fast at parse time**: ``FaultPlan`` (and
+therefore ``MXNET_TPU_FAULTS`` at import) raises :class:`MXNetError`
+naming the offending token and the full valid-name list, so a typo'd
+chaos spec can never silently inject nothing.
+
 Injection decisions come from one seeded ``random.Random``
 (``MXNET_TPU_FAULTS_SEED``) behind a lock, so a chaos run is
 reproducible; every fired fault counts ``faults.injected.<name>``.
@@ -54,7 +78,8 @@ __all__ = ["FAULTS", "FaultPlan", "configure", "reload", "active",
 _log = logging.getLogger(__name__)
 
 #: The typed registry: the only fault names MXNET_TPU_FAULTS accepts.
-FAULTS = ("replica_crash", "slow_replica", "drop_response", "torn_swap")
+FAULTS = ("replica_crash", "slow_replica", "drop_response", "torn_swap",
+          "net_drop", "net_partition", "net_reorder", "net_slow")
 
 
 class FaultPlan:

@@ -76,8 +76,8 @@ from .base import MXNetError
 __all__ = ["FleetError", "ReplicaCrash", "ReplicaError", "AttemptTimeout",
            "DeadlineExceeded", "NoReplicaAvailable", "CircuitBreaker",
            "backoff_delay_s", "Replica", "InProcReplica",
-           "SubprocessReplica", "FleetRouter", "in_process",
-           "in_subprocess"]
+           "SubprocessReplica", "SocketReplica", "FleetRouter",
+           "in_process", "in_subprocess", "in_socket"]
 
 _log = logging.getLogger(__name__)
 
@@ -528,28 +528,36 @@ class SubprocessReplica(Replica):
         self._reader.start()
 
     def _read_loop(self, conn):
-        while True:
-            try:
-                msg = conn.recv()
-            except (EOFError, OSError):
-                break
-            # replies are (kind, mid, payload) — traced ones append a
-            # span payload the tracer clock-aligns and merges BEFORE
-            # the waiter resolves (the root may finish right after)
-            kind, mid, payload = msg[0], msg[1], msg[2]
-            if len(msg) > 3 and msg[3]:
-                trc = _dtrace._TRACER
-                if trc is not None:
-                    trc.absorb(msg[3])
-            with self._lock:
-                w = self._pending.pop(mid, None)
-            if w is None:
-                continue
-            if kind == "ok":
-                w.resolve(payload)
-            else:
-                w.fail(ReplicaError("replica %s: %s"
-                                    % (self.rid, payload)))
+        try:
+            while True:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    break
+                # replies are (kind, mid, payload) — traced ones append
+                # a span payload the tracer clock-aligns and merges
+                # BEFORE the waiter resolves (the root may finish right
+                # after)
+                kind, mid, payload = msg[0], msg[1], msg[2]
+                if len(msg) > 3 and msg[3]:
+                    trc = _dtrace._TRACER
+                    if trc is not None:
+                        trc.absorb(msg[3])
+                with self._lock:
+                    w = self._pending.pop(mid, None)
+                if w is None:
+                    continue
+                if kind == "ok":
+                    w.resolve(payload)
+                else:
+                    w.fail(ReplicaError("replica %s: %s"
+                                        % (self.rid, payload)))
+        except Exception:   # noqa: BLE001 — an unexpected reader death
+            # (malformed reply, absorb bug) is NOT an EOF-equivalent:
+            # count it so it pages instead of masquerading as a crash
+            _tel.inc("fleet.reader_errors")
+            _log.exception("fleet reader for %s died unexpectedly",
+                           self.rid)
         self._mark_dead()
 
     def _mark_dead(self):
@@ -572,9 +580,18 @@ class SubprocessReplica(Replica):
                 self._pending[mid] = w
                 try:
                     self._conn.send((op, mid) + (payload or ()))
-                except (OSError, BrokenPipeError, ValueError):
+                except (OSError, BrokenPipeError):
+                    # narrowed from the historical (OSError,
+                    # BrokenPipeError, ValueError): a ValueError here is
+                    # an oversized/unpicklable payload — a caller bug,
+                    # not a dead pipe — and masking it as ReplicaCrash
+                    # sent the router respawning a healthy replica
                     self._pending.pop(mid, None)
                     broke = True
+                except ValueError:
+                    # surfaced to the caller as the bug it is
+                    self._pending.pop(mid, None)
+                    raise
         if broke:
             self._mark_dead()
             raise ReplicaCrash("replica %s is down" % self.rid)
@@ -659,6 +676,298 @@ def in_subprocess(factory_ref: str,
     """Replica-factory adapter for subprocess replicas;
     ``factory_ref`` is ``"module:attr"`` resolved inside the child."""
     return lambda rid: SubprocessReplica(rid, factory_ref, start_method)
+
+
+# ---------------------------------------------------------------------------
+# socket replicas (netwire transport)
+# ---------------------------------------------------------------------------
+
+def _socket_replica_main(port_conn, factory_ref: str):
+    """Child entry point for a socket replica: build the server from
+    the factory ref, serve the netwire frame protocol on an ephemeral
+    loopback port (reported back through ``port_conn``), run until a
+    ``stop`` frame or the parent kills us. The frame envelope mirrors
+    the pipe protocol — op/mid plus a metadata dict — so the reply
+    taxonomy ("ok"/"err", dtrace harvest appended when traced) is
+    identical; only the bytes underneath changed."""
+    from . import netwire as _netwire
+
+    srv = _resolve_factory(factory_ref)()
+    t_up = time.monotonic()
+    stop = threading.Event()
+
+    def handler(frame, respond):
+        op, meta = frame.op, frame.meta
+        if op == "infer":
+            if _faults.fires("replica_crash"):
+                os._exit(23)
+            tctx = frame.tctx
+            kw = {}
+            if tctx is not None:
+                _dtrace.ensure_enabled()
+                kw["trace_ctx"] = tctx
+            try:
+                out = srv.submit(
+                    frame.arrays, request_id=meta.get("req"),
+                    deadline_ms=meta.get("deadline_ms"),
+                    priority=meta.get("priority"), **kw).get(60.0)
+                rmeta = {}
+                if tctx is not None:
+                    rmeta["dtrace"] = _dtrace.harvest(tctx)
+                respond("ok", [np.asarray(o) for o in out], rmeta)
+            except BaseException as e:   # noqa: BLE001 (report,
+                rmeta = {"error": "%s: %s"   # don't die)
+                         % (type(e).__name__, e)}
+                if tctx is not None:
+                    rmeta["dtrace"] = _dtrace.harvest(tctx)
+                respond("err", (), rmeta)
+        elif op == "health":
+            try:
+                probe = srv.scheduler.slo_probe()
+                payload = {"status": "degraded" if probe else "ok",
+                           "pid": os.getpid(),
+                           "rank": _tracing.worker_rank(),
+                           "uptime_s":
+                               round(time.monotonic() - t_up, 3)}
+                payload.update(srv.health_info())
+                if probe:
+                    payload["probes"] = {"serve_slo": probe}
+                respond("ok", (), {"health": payload})
+            except BaseException as e:   # noqa: BLE001
+                respond("err", (), {"error": str(e)})
+        elif op == "refresh":
+            try:
+                srv.refresh_params()
+                respond("ok")
+            except BaseException as e:   # noqa: BLE001
+                respond("err", (), {"error": str(e)})
+        elif op == "stop":
+            respond("ok")
+            stop.set()
+        else:
+            respond("err", (), {"error": "unknown op %r" % (op,)})
+
+    wire = _netwire.WireServer(handler, "127.0.0.1", 0,
+                               name="replica-%d" % os.getpid())
+    try:
+        port_conn.send(wire.port)
+        port_conn.close()
+        while not stop.wait(0.5):
+            pass
+    finally:
+        wire.close()
+        srv.close()
+
+
+class _SocketWaiter:
+    """Adapts a netwire reply waiter to the router's waiter protocol,
+    mapping the wire taxonomy onto the retry taxonomy."""
+
+    def __init__(self, waiter, rid: str):
+        self._w = waiter
+        self.rid = rid
+
+    def wait(self, timeout_s: float):
+        from . import netwire as _netwire
+
+        try:
+            frame = self._w.wait(timeout_s)
+        except _netwire.WireTimeout as e:
+            # forget the mid: a fault-dropped frame's reply never comes
+            self._w.cancel()
+            raise AttemptTimeout(str(e))
+        except _netwire.WirePeerLost as e:
+            raise ReplicaCrash("replica %s died mid-request (%s)"
+                               % (self.rid, e))
+        except _netwire.WireError as e:
+            raise ReplicaError("replica %s wire error: %s"
+                               % (self.rid, e))
+        if frame.op != "ok":
+            raise ReplicaError("replica %s: %s"
+                               % (self.rid,
+                                  frame.meta.get("error", frame.op)))
+        return frame.arrays
+
+    def done(self) -> bool:
+        return self._w.done()
+
+    def cancel(self):
+        self._w.cancel()
+
+
+class SocketReplica(Replica):
+    """A replica across the network fabric: the same spawned child as
+    :class:`SubprocessReplica`, but serving netwire frames on a
+    loopback TCP port instead of a pickled pipe — the single-host
+    rehearsal of a cross-host fleet. The pooled :class:`WireClient`
+    gives the router ``MXNET_TPU_WIRE_POOL``-way concurrency per
+    replica; crash detection is the connection reset failing in-flight
+    waiters with :class:`ReplicaCrash`, and the monitor's respawn path
+    works unchanged (a restart spawns a fresh child on a fresh port).
+
+    ``host``/``port`` may also point at an already-running remote
+    ``_socket_replica_main``-style server (no child lifecycle then:
+    ``kill``/``restart`` raise, and ``close`` only drops connections).
+    """
+
+    def __init__(self, rid: str, factory_ref: Optional[str] = None,
+                 start_method: str = "spawn",
+                 host: str = "127.0.0.1", port: Optional[int] = None):
+        from . import netwire as _netwire
+
+        self.rid = rid
+        self._netwire = _netwire
+        self._factory_ref = None if factory_ref is None else str(factory_ref)
+        self._host = host
+        self._closed = False
+        self._proc = None
+        self._client: Optional[_netwire.WireClient] = None
+        if port is not None:
+            self._port = int(port)
+            self._client = _netwire.WireClient(host, self._port, peer=rid)
+            self._ctx = None
+            return
+        if self._factory_ref is None:
+            raise MXNetError("SocketReplica needs a factory_ref to "
+                             "spawn, or an explicit port to connect to")
+        _resolve_factory(self._factory_ref)   # fail fast in the parent
+        import multiprocessing
+
+        self._ctx = multiprocessing.get_context(start_method or "spawn")
+        self._spawn()
+
+    def _spawn(self):
+        port_conn, child_conn = self._ctx.Pipe()
+        self._proc = self._ctx.Process(
+            target=_socket_replica_main,
+            args=(child_conn, self._factory_ref),
+            name="mxtpu-fleet-%s" % self.rid, daemon=True)
+        self._proc.start()
+        child_conn.close()
+        # the child reports its ephemeral port once the listener is up;
+        # a child that dies first (bad factory) must not hang us
+        if not port_conn.poll(30.0):
+            port_conn.close()
+            self._proc.join(1.0)
+            raise MXNetError("socket replica %s never reported a port"
+                             % self.rid)
+        try:
+            self._port = int(port_conn.recv())
+        except (EOFError, OSError):
+            port_conn.close()
+            raise MXNetError("socket replica %s died before reporting "
+                             "a port" % self.rid)
+        port_conn.close()
+        self._client = self._netwire.WireClient(self._host, self._port,
+                                                peer=self.rid)
+
+    def alive(self) -> bool:
+        if self._closed or self._client is None:
+            return False
+        if self._proc is not None:
+            return self._proc.is_alive()
+        return self._client.alive()
+
+    def submit(self, arrays, request_id: Optional[str] = None,
+               deadline_ms: Optional[float] = None,
+               priority: Optional[str] = None,
+               trace_ctx: Optional[dict] = None):
+        if not self.alive():
+            raise ReplicaCrash("replica %s is down" % self.rid)
+        meta = {"req": request_id, "deadline_ms": deadline_ms,
+                "priority": priority}
+        try:
+            w = self._client.request(
+                "infer", [np.asarray(a) for a in arrays], meta,
+                trace_ctx=trace_ctx)
+        except self._netwire.WireError as e:
+            raise ReplicaCrash("replica %s is unreachable: %s"
+                               % (self.rid, e))
+        return _SocketWaiter(w, self.rid)
+
+    def health(self, timeout_s: float = 5.0) -> dict:
+        try:
+            frame = self._client.call("health", timeout_s=timeout_s)
+        except self._netwire.WireTimeout as e:
+            raise AttemptTimeout(str(e))
+        except self._netwire.WireError as e:
+            raise ReplicaCrash("replica %s is unreachable: %s"
+                               % (self.rid, e))
+        if frame.op != "ok":
+            raise ReplicaError("replica %s: %s"
+                               % (self.rid, frame.meta.get("error")))
+        return frame.meta.get("health") or {}
+
+    def in_flight(self) -> int:
+        return 0 if self._client is None else self._client.pending_count()
+
+    def wire_stats(self) -> dict:
+        """Per-peer transport rollup (frames/bytes/rtt/reconnects/
+        stalls) — the fleet bench embeds this for --view wire."""
+        return {} if self._client is None else self._client.stats()
+
+    def refresh_params(self, apply_fn=None, timeout_s: float = 60.0):
+        if apply_fn is not None:
+            raise MXNetError("apply_fn is not supported for socket "
+                             "replicas; ship params via checkpoint")
+        try:
+            frame = self._client.call("refresh", timeout_s=timeout_s)
+        except self._netwire.WireTimeout as e:
+            raise AttemptTimeout(str(e))
+        except self._netwire.WireError as e:
+            raise ReplicaCrash("replica %s is unreachable: %s"
+                               % (self.rid, e))
+        if frame.op != "ok":
+            raise ReplicaError("replica %s refresh failed: %s"
+                               % (self.rid, frame.meta.get("error")))
+
+    def kill(self):
+        """SIGKILL the child (chaos): in-flight requests fail with
+        ReplicaCrash as their connections reset."""
+        if self._proc is None:
+            raise MXNetError("cannot kill a remote socket replica %s"
+                             % self.rid)
+        self._proc.kill()
+        self._proc.join(5.0)
+
+    def restart(self):
+        if self._proc is None:
+            raise MXNetError("cannot restart a remote socket replica %s"
+                             % self.rid)
+        self._teardown(graceful=False)
+        self._spawn()
+        self._closed = False
+
+    def _teardown(self, graceful: bool = True):
+        if graceful and self._client is not None:
+            try:
+                self._client.call("stop", timeout_s=5.0)
+            except self._netwire.WireError:
+                pass
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+        if self._proc is not None:
+            self._proc.join(2.0)
+            if self._proc.is_alive():
+                self._proc.kill()
+                self._proc.join(5.0)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._teardown(graceful=True)
+
+
+def in_socket(factory_ref: str,
+              start_method: str = "spawn") -> Callable[[str], Replica]:
+    """Replica-factory adapter for socket replicas: each router slot
+    spawns a child serving netwire frames on its own loopback port.
+    Retries, hedges, breakers, respawn, and rolling swaps work
+    unchanged — the router only ever sees the :class:`Replica`
+    protocol."""
+    return lambda rid: SocketReplica(rid, factory_ref, start_method)
 
 
 def demo_server_factory():

@@ -827,3 +827,38 @@ def test_subprocess_end_to_end_traced_and_clock_aligned():
                  "serve.dispatch", "serve.d2h"))
     assert total == pytest.approx(spans["serve.request"]["dur"],
                                   rel=1e-6, abs=1e-9)
+
+
+def test_socket_end_to_end_traced_across_the_wire():
+    """The socket hop carries the trace context inside the frame
+    metadata ("tctx" out, harvested spans back in the reply): the
+    remote serve.* spans stitch under the local attempt span exactly
+    like the pipe path, so one request is one tree whichever transport
+    served it."""
+    dtrace.enable(sample=1)            # head-keep every trace
+    router = FleetRouter(
+        fleet.in_socket("mxnet_tpu.fleet:demo_server_factory"), 1,
+        deadline_ms=120000.0, attempt_timeout_ms=60000.0, retries=5,
+        backoff_ms=50.0, health_interval_s=60.0, hedge=False)
+    try:
+        x = _rows(1, seed=3)
+        (out,) = router.infer([x], request_id="wire-e2e", timeout=120.0)
+        assert out.shape[0] == 1
+    finally:
+        router.close()
+        kept = dtrace.kept_traces()
+        dtrace.disable()
+    ent = next(e for e in kept if e["request_id"] == "wire-e2e")
+    spans = {s["name"]: s for s in ent["spans"]}
+    root = spans["fleet.request"]
+    att = spans["fleet.attempt"]
+    request = spans["serve.request"]
+    assert root["pid"] == os.getpid()
+    assert request["pid"] != os.getpid()          # served over TCP
+    assert request["parent"] == att["span"]       # stitched across
+    assert att["parent"] == root["span"]          # the socket hop
+    # clock alignment holds across the wire exactly like the pipe
+    eps = 0.025
+    assert request["ts"] >= root["ts"] - eps
+    assert (request["ts"] + request["dur"]
+            <= root["ts"] + root["dur"] + eps)

@@ -459,6 +459,25 @@ def test_fault_registry_is_typed(no_faults):
     assert plan.rates == {"replica_crash": 0.25, "torn_swap": 1.0}
 
 
+def test_fault_registry_includes_network_faults(no_faults):
+    """The net_* faults are first-class registry members, and an
+    unknown name fails fast with the FULL valid-name list in the
+    error — a typo'd chaos spec can never silently inject nothing."""
+    plan = faults.FaultPlan(
+        "net_drop:0.1,net_partition:0.05,net_reorder,net_slow:0.2")
+    assert plan.rates == {"net_drop": 0.1, "net_partition": 0.05,
+                          "net_reorder": 1.0, "net_slow": 0.2}
+    with pytest.raises(MXNetError) as ei:
+        faults.FaultPlan("net_dorp")
+    msg = str(ei.value)
+    assert "net_dorp" in msg
+    for name in faults.FAULTS:          # every valid name is listed
+        assert name in msg
+    # same fail-fast contract through the env-driven configure path
+    with pytest.raises(MXNetError, match="net_everything"):
+        faults.configure("net_everything")
+
+
 def test_fault_plan_seeded_and_counted(no_faults):
     a = faults.FaultPlan("drop_response:0.5", seed=42)
     b = faults.FaultPlan("drop_response:0.5", seed=42)
@@ -719,6 +738,182 @@ def test_subprocess_replica_serves_and_survives_sigkill(tel):
         assert st["counters"]["respawns"] >= 1
     finally:
         router.close()
+
+
+# ---------------------------------------------------------------------------
+# socket replicas: the same fleet discipline over TCP frames
+# ---------------------------------------------------------------------------
+
+def test_socket_replica_serves_and_survives_sigkill(tel):
+    """The third Replica backend: same factory, same router policies,
+    but requests cross a real TCP socket as zero-copy frames. Parity
+    with the in-process answer is bit-exact, health crosses the wire,
+    and a SIGKILLed child respawns on a fresh port with zero
+    client-visible errors."""
+    srv = fleet.demo_server_factory()
+    x = _rows(1, seed=3)
+    expect = srv.submit([x]).get(30.0)[0]
+    srv.close()
+
+    router = FleetRouter(
+        fleet.in_socket("mxnet_tpu.fleet:demo_server_factory"), 1,
+        deadline_ms=120000.0, attempt_timeout_ms=60000.0, retries=20,
+        backoff_ms=50.0, health_interval_s=0.05)
+    try:
+        (out,) = router.infer([x], timeout=120.0)
+        assert np.array_equal(out, expect)        # bit-exact over TCP
+        rep = router._entries[router.replica_ids()[0]].replica
+        h = rep.health()
+        assert h["status"] == "ok"
+        assert h["pid"] != __import__("os").getpid()   # really remote
+        st = rep.wire_stats()
+        assert st["frames_tx"] >= 2 and st["frames_rx"] >= 2
+        assert st["rtt_ms"]["count"] >= 1
+        # SIGKILL mid-fleet: monitor respawns (new port, new client)
+        router.kill_replica(router.replica_ids()[0])
+        (out2,) = router.infer([x], timeout=120.0)
+        assert np.array_equal(out2, expect)
+        stats = router.stats()
+        assert stats["counters"]["replica_crashes"] >= 1
+        assert stats["counters"]["respawns"] >= 1
+    finally:
+        router.close()
+
+
+def test_socket_fleet_serves_through_net_chaos(tel, no_faults):
+    """net_drop + net_reorder armed inside the framing layer: the
+    router's per-attempt deadlines and retries absorb every injected
+    loss — zero client-visible errors, every answer bit-exact."""
+    router = FleetRouter(
+        fleet.in_socket("mxnet_tpu.fleet:demo_server_factory"), 1,
+        deadline_ms=120000.0, attempt_timeout_ms=2000.0, retries=40,
+        backoff_ms=10.0, health_interval_s=60.0, hedge=False)
+    try:
+        x = _rows(2, seed=9)
+        (expect,) = router.infer([x], timeout=120.0)   # pre-chaos truth
+        faults.configure("net_drop:0.15,net_reorder:0.2", seed=11)
+        outs = []
+        for i in range(12):
+            (out,) = router.infer([x], request_id="chaos-%d" % i,
+                                  timeout=120.0)
+            outs.append(out)
+        plan = faults._PLAN
+        faults.configure(None)
+        assert all(np.array_equal(o, expect) for o in outs)
+        assert sum(plan.injected.values()) >= 1    # chaos actually fired
+    finally:
+        faults.configure(None)
+        router.close()
+
+
+def test_socket_replica_refresh_remote_mode_and_in_flight():
+    rep = fleet.SocketReplica("s0",
+                              "mxnet_tpu.fleet:demo_server_factory")
+    try:
+        x = _rows(1, seed=3)
+        w = rep.submit([x], request_id="r1", deadline_ms=60000.0,
+                       priority="interactive")
+        (out,) = w.wait(60.0)
+        assert out.shape == (1, CLASSES)
+        assert rep.in_flight() == 0
+        rep.refresh_params()                       # round-trips "ok"
+        assert rep.alive()
+        # remote mode: an explicit port attaches to the SAME child with
+        # no lifecycle ownership — kill/restart refuse, close only
+        # drops connections
+        remote = fleet.SocketReplica("far", host="127.0.0.1",
+                                     port=rep._port)
+        try:
+            assert remote.health()["status"] == "ok"
+            with pytest.raises(MXNetError, match="remote"):
+                remote.kill()
+            with pytest.raises(MXNetError, match="remote"):
+                remote.restart()
+        finally:
+            remote.close()
+        assert rep.alive()                         # owner unaffected
+    finally:
+        rep.close()
+    assert not rep.alive()
+
+
+# ---------------------------------------------------------------------------
+# reader-death accounting: unexpected != EOF
+# ---------------------------------------------------------------------------
+
+def _bare_subprocess_replica():
+    """A SubprocessReplica shell with no child process — just enough
+    state (rid, lock, pending table) to drive _read_loop/_send
+    directly."""
+    r = fleet.SubprocessReplica.__new__(fleet.SubprocessReplica)
+    r.rid = "r-test"
+    r._lock = threading.Lock()
+    r._pending = {}
+    r._dead = False
+    r._closed = False
+    return r
+
+
+def test_unexpected_reader_death_is_counted_not_masked(tel):
+    """A reader thread killed by a malformed reply (not EOF) counts
+    ``fleet.reader_errors`` — it pages as a bug instead of
+    masquerading as an ordinary replica crash — and still fails the
+    pending waiters so no caller hangs."""
+    class _MalformedConn:
+        def recv(self):
+            return 7   # not a (kind, mid, payload) tuple
+
+    r = _bare_subprocess_replica()
+    w = fleet._PendingWaiter()
+    r._pending["m1"] = w
+    r._read_loop(_MalformedConn())
+    assert tel.peek("fleet.reader_errors") == 1
+    with pytest.raises(ReplicaCrash):
+        w.wait(0.1)
+    assert r._dead
+
+
+def test_clean_reader_eof_is_not_a_reader_error(tel):
+    class _EOFConn:
+        def recv(self):
+            raise EOFError
+
+    r = _bare_subprocess_replica()
+    r._read_loop(_EOFConn())
+    assert not tel.peek("fleet.reader_errors")
+    assert r._dead
+
+
+def test_send_valueerror_surfaces_as_bug_not_crash(tel):
+    """An unpicklable/oversized payload raising ValueError in send()
+    must reach the caller as ValueError — NOT be masked as a dead pipe
+    that sends the router respawning a healthy replica."""
+    class _BadSendConn:
+        def send(self, msg):
+            raise ValueError("payload too large to pickle")
+
+    class _AliveProc:
+        def is_alive(self):
+            return True
+
+    r = _bare_subprocess_replica()
+    r._proc = _AliveProc()
+    r._conn = _BadSendConn()
+    with pytest.raises(ValueError, match="too large"):
+        r._send("infer", (None,))
+    assert not r._dead                  # still healthy
+    assert r._pending == {}             # no leaked pending entry
+
+    class _DeadPipeConn:
+        def send(self, msg):
+            raise BrokenPipeError
+
+    r2 = _bare_subprocess_replica()
+    r2._proc = _AliveProc()
+    r2._conn = _DeadPipeConn()
+    with pytest.raises(ReplicaCrash):
+        r2._send("infer", (None,))
+    assert r2._dead
 
 
 # ---------------------------------------------------------------------------

@@ -121,6 +121,10 @@ SPECS: List[Spec] = [
     Spec("serve_mean_batch_occupancy", "SERVE_bench.json",
          "mean_batch_occupancy", "higher"),
     Spec("fleet_goodput_rps", "FLEET_bench.json", "value", "higher"),
+    Spec("fleet_socket_goodput_rps", "FLEET_bench.json",
+         "socket.goodput_rps", "higher"),
+    Spec("fleet_feed_stall_p99_ms", "FLEET_bench.json",
+         "socket.netfeed.feed_stall_p99_ms", "lower", tolerance=0.5),
     Spec("obswatch_fleet_goodput_rps", "OBS_fleet.json", "value",
          "higher"),
     Spec("multichip_imgs_per_sec", "MULTICHIP_scaling.json", "value",

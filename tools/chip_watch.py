@@ -402,6 +402,28 @@ def fire():
             f.write("\n")
     _commit("fleet fault tolerance", stamp)
 
+    # 9b. socket-fleet stage: the fleet bench's socket phase (zero-copy
+    # transport + netfeed epoch) rides inside FLEET_bench.json; a
+    # record that came back without one (older bench, child died before
+    # the phase) gets an INCOMPLETE socket stamp so --view wire and the
+    # gate report "didn't run" instead of crashing or silently passing.
+    fleet_path = os.path.join(REPO, "FLEET_bench.json")
+    try:
+        with open(fleet_path) as f:
+            fleet_rec = json.load(f)
+    except (OSError, ValueError):
+        fleet_rec = None
+    if isinstance(fleet_rec, dict) and "socket" not in fleet_rec:
+        fleet_rec["socket"] = {
+            "incomplete": "chip_watch: fleet bench produced no socket "
+                          "record"}
+        fleet_rec["socket_ok"] = False
+        fleet_rec["chip_watch_stamp"] = stamp
+        with open(fleet_path, "w") as f:
+            json.dump(fleet_rec, f, indent=2, sort_keys=True)
+            f.write("\n")
+        _commit("socket fleet stamp", stamp)
+
     # stage 10: the perf-regression gate over everything the window
     # just produced. Same INCOMPLETE contract: bench_gate itself treats
     # a missing/incomplete artifact as INCOMPLETE (exit 0), and if the

@@ -558,6 +558,90 @@ def render_fleet(rec):
     return "\n".join(out) + "\n"
 
 
+def render_wire(rec):
+    """Wire view over a FLEET_bench.json socket record: the
+    serialization-vs-pickle headline, the socket-vs-pipe overhead
+    claim, a per-peer transport table (frames, bytes, rtt, reconnects,
+    backpressure stalls), and the netfeed epoch. INCOMPLETE-safe: a
+    record whose socket phase never ran renders its marker instead of
+    crashing the report."""
+    if rec.get("incomplete"):
+        return "wire: INCOMPLETE: %s\n" % rec["incomplete"]
+    sock = rec.get("socket")
+    if not sock:
+        return ("wire: no socket record in this FLEET bench "
+                "(run `make net-bench`)\n")
+    if sock.get("incomplete"):
+        return "wire: INCOMPLETE: %s\n" % sock["incomplete"]
+    out = ["wire: %.1f req/s over TCP  p99 %.2fx of pipe  chaos "
+           "goodput %s%%  [%s]"
+           % (sock.get("goodput_rps") or 0,
+              sock.get("overhead_p99_x") or 0,
+              round(100 * (sock.get("chaos_goodput_ratio") or 0), 1),
+              "OK" if rec.get("socket_ok") else "FAILED"), ""]
+    ser = sock.get("serialization") or {}
+    if ser:
+        out.append("serialization (%.2f MB payload, ms/MB):"
+                   % (ser.get("payload_mb") or 0))
+        rows = [("codec", "encode", "decode"),
+                ("wire frames", "%.4f" % (ser.get("wire_encode_ms_per_mb")
+                                          or 0),
+                 "%.4f" % (ser.get("wire_decode_ms_per_mb") or 0)),
+                ("pickle", "%.4f" % (ser.get("pickle_ms_per_mb") or 0),
+                 "%.4f" % (ser.get("unpickle_ms_per_mb") or 0))]
+        out += _table(rows)
+        out.append("")
+    rows = [("peer", "pool", "frames", "MB", "rtt_mean", "rtt_p99",
+             "reconnects", "bp_stalls")]
+    for phase in ("clean", "chaos"):
+        w = (sock.get(phase) or {}).get("wire")
+        if not w:
+            continue
+        rtt = w.get("rtt_ms") or {}
+        rows.append(("%s/%s" % (phase, w.get("peer", "?")),
+                     str(w.get("pool")),
+                     "%d/%d" % (w.get("frames_tx", 0),
+                                w.get("frames_rx", 0)),
+                     "%.1f" % ((w.get("bytes_tx", 0)
+                                + w.get("bytes_rx", 0)) / 1048576.0),
+                     "-" if rtt.get("mean") is None
+                     else "%.2f" % rtt["mean"],
+                     "-" if rtt.get("p99") is None
+                     else "%.2f" % rtt["p99"],
+                     str(w.get("reconnects", 0)),
+                     str(w.get("backpressure_stalls", 0))))
+    if len(rows) > 1:
+        out.append("per-peer transport (frames tx/rx, rtt in ms):")
+        out += _table(rows)
+        out.append("")
+    for phase in ("pipe", "clean", "chaos"):
+        t = sock.get(phase) or {}
+        if t:
+            out.append("  %-5s %6.1f req/s  p50 %sms  p99 %sms  "
+                       "errors %s"
+                       % (phase, t.get("achieved_rps") or 0,
+                          t.get("p50_ms"), t.get("p99_ms"),
+                          t.get("errors")))
+    inj = (sock.get("chaos") or {}).get("injected") or {}
+    if inj:
+        out.append("  chaos injected: %s" % ", ".join(
+            "%s x%d" % (k, v) for k, v in sorted(inj.items())))
+    out.append("")
+    nf = sock.get("netfeed") or {}
+    if nf.get("incomplete"):
+        out.append("netfeed: INCOMPLETE: %s" % nf["incomplete"])
+    elif nf:
+        out.append("netfeed epoch (2-process, loopback):")
+        out.append("  %s batches, %.1f MB in %.2fs (%.1f MB/s); "
+                   "feed stall p50 %sms p99 %sms"
+                   % (nf.get("batches"), nf.get("payload_mb") or 0,
+                      nf.get("epoch_s") or 0,
+                      nf.get("goodput_mb_s") or 0,
+                      nf.get("feed_stall_p50_ms"),
+                      nf.get("feed_stall_p99_ms")))
+    return "\n".join(out) + "\n"
+
+
 def render_fleet_health(rec):
     """Fleet-health view over an obswatch artifact (OBS_fleet.json):
     the federated rollup table — one row per replica plus the fleet
@@ -1001,14 +1085,17 @@ def main(argv=None):
                    help="slowest steps to show (default 10)")
     p.add_argument("--view", default="steps",
                    choices=("steps", "compile", "ops", "memory", "bench",
-                            "serve", "fleet", "fleet-health", "tune",
-                            "waterfall"),
+                            "serve", "fleet", "fleet-health", "wire",
+                            "tune", "waterfall"),
                    help="steps (default): slowest-step trace table; "
                         "compile/ops/memory/bench: xprof views over a "
                         "BENCH record file; serve: latency decomposition "
                         "+ load sweep over a SERVE_bench.json record; "
                         "fleet: recovery window + swap purity over a "
-                        "FLEET_bench.json record; fleet-health: "
+                        "FLEET_bench.json record; wire: socket-"
+                        "transport per-peer table + netfeed epoch over "
+                        "a FLEET_bench.json record (path optional); "
+                        "fleet-health: "
                         "federated rollup table + burn-rate verdict "
                         "over an obswatch artifact (path optional, "
                         "defaults to OBS_fleet.json); tune: autotuner "
@@ -1055,6 +1142,19 @@ def main(argv=None):
             tid = max(trees, key=lambda t: max(
                 s["dur"] for s in trees[t]))
         sys.stdout.write(render_waterfall(tid, trees[tid]))
+        return 0
+    if a.view == "wire":
+        # path optional: defaults to the repo-root fleet bench record
+        path = a.path or os.path.join(_repo_root(), "FLEET_bench.json")
+        if not os.path.exists(path):
+            sys.stdout.write("no fleet bench record at %s (run `make "
+                             "net-bench`)\n" % path)
+            return 1
+        rec = latest_fleet_record(load_bench_records(path))
+        if rec is None:
+            sys.stdout.write("no fleet record in %s\n" % path)
+            return 1
+        sys.stdout.write(render_wire(rec))
         return 0
     if a.view == "fleet-health":
         # path optional: defaults to the repo-root obswatch artifact
