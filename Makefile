@@ -107,6 +107,12 @@ trace-smoke:
 ckpt-test:
 	python -m pytest tests/test_checkpoint.py tests/test_elastic_recovery.py -q
 
+# numerics observability suite: the in-graph stats pack (one dispatch,
+# one trace signature), NaN provenance, skip/rollback guards, detector
+# wiring, the disabled-path overhead pin, and the numerics report view
+numwatch-test:
+	python -m pytest tests/test_numwatch.py -q
+
 # perf-regression gate: current bench artifacts (SERVE / FLEET / OBS /
 # MULTICHIP, plus the BENCH_r* trajectory) vs tools/bench_baselines.json.
 # Exit 1 names the regressed metric, artifact, and measured delta;
@@ -125,4 +131,4 @@ obs-gate: lint
 clean:
 	rm -rf mxnet_tpu/_native perl-package/blib
 
-.PHONY: all predict perl test lint profile-report multichip serve-bench fleet-bench net-bench trace-smoke ckpt-test bench-gate obs-gate clean
+.PHONY: all predict perl test lint profile-report multichip serve-bench fleet-bench net-bench trace-smoke ckpt-test numwatch-test bench-gate obs-gate clean

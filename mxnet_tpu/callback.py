@@ -86,6 +86,11 @@ class Speedometer:
                 % (epoch, where, speed)
             for name, value in eval_metric.get_name_value():
                 msg += "\t%s=%f" % (name, value)
+            # model health without full tracing: numwatch's cadence
+            # fetch leaves the latest global grad norm in a gauge
+            gn = _tel.peek("numwatch.grad_norm", kind="gauge")
+            if gn is not None:
+                msg += "\tgrad_norm=%.4g" % gn
             logging.info(msg)
         else:
             logging.info("Iter[%d] %s\tSpeed: %.2f samples/sec",

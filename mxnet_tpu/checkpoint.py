@@ -562,6 +562,29 @@ class CheckpointManager:
     def save_now(self, reason: str = "manual") -> Optional[str]:
         return self._save(reason)
 
+    def rollback(self, reason: str = "guard") -> Optional[Dict[str, Any]]:
+        """Restore the newest valid snapshot onto the LIVE module
+        mid-run — the numwatch rollback guard's recovery action after a
+        numeric blowup. Unlike :meth:`maybe_restore` this never touches
+        the data cursor (the fit loop's iterator is live) and ignores
+        ``MXNET_TPU_CKPT_RESUME``. Re-placement goes through the
+        executor group's own ``_place`` with the shapes the executables
+        were traced for, so a rollback never retraces. Returns the
+        restored position or None when the store holds no valid
+        snapshot."""
+        found = self.store.load_latest()
+        if found is None:
+            return None
+        payload, entry = found
+        info = restore(payload, self._module, self._metric, None)
+        self.global_step = info["step"]
+        self._epoch, self._nbatch = info["epoch"], info["nbatch"]
+        _tel.inc("ckpt.rollbacks")
+        _log.warning("rolled back (reason=%s) to snapshot %s: step %d "
+                     "(epoch %d, batch %d)", reason, entry.get("file"),
+                     info["step"], info["epoch"], info["nbatch"])
+        return info
+
     def _save(self, reason: str,
               deadline: Optional[float] = None) -> Optional[str]:
         try:

@@ -658,6 +658,54 @@ declare("MXNET_TPU_OBSWATCH_BURN", float, 14.4,
         "`slo_burn_alert` into the step record (FleetHealthDetector "
         "anomaly) and flips a registered /healthz probe.", section=_OW)
 
+_NW = "Numerics observability (numwatch)"
+declare("MXNET_TPU_NUMWATCH", bool, False,
+        "Arm the in-graph numerics plane (`mxnet_tpu.numwatch`): "
+        "per-tensor gradient/param/update stats fold into a small f32 "
+        "stats pack INSIDE the donated fused jit (dispatches/step stays "
+        "exactly 1.0) and are host-fetched only on the "
+        "MXNET_TPU_NUMWATCH_EVERY_N cadence. Also armed implicitly when "
+        "a pack-expressible `Monitor` is installed.", section=_NW)
+declare("MXNET_TPU_NUMWATCH_EVERY_N", int, 50,
+        "Host-fetch cadence (steps) for the stats pack. Each fetch is "
+        "one small D2H copy inside an `intentional_transfer` window — "
+        "no extra dispatch — that updates `numwatch.*` telemetry, the "
+        "health ring, and the anomaly-detector inputs.", section=_NW)
+declare("MXNET_TPU_NUMWATCH_GUARD", str, "",
+        "Guarded-training auto-actions, comma-separated, off by "
+        "default. `skip`: an in-graph select drops any update whose "
+        "gradients contain NaN/Inf (params/opt-state/metric accs keep "
+        "their step k-1 values, still one dispatch). `rollback`: on a "
+        "fetch that sees nonfinite PARAMS, restore the last healthy "
+        "snapshot through CheckpointManager (requires "
+        "MXNET_TPU_CKPT_DIR or an explicitly bound manager). Both "
+        "actions are counted (`numwatch.skipped_steps`, "
+        "`numwatch.rollbacks`) and rate-limited.", section=_NW)
+declare("MXNET_TPU_NUMWATCH_SPIKE_K", float, 3.0,
+        "Loss-spike detector threshold: fire `loss_spike` when the "
+        "fetched in-graph loss exceeds this multiple of its rolling "
+        "median.", section=_NW)
+declare("MXNET_TPU_NUMWATCH_EXPLODE_K", float, 10.0,
+        "Grad-explosion detector threshold: fire `grad_explosion` when "
+        "the fetched global gradient norm exceeds this multiple of its "
+        "rolling median.", section=_NW)
+declare("MXNET_TPU_NUMWATCH_DEAD_UW", float, 1e-9,
+        "Dead-update detector threshold: fire `dead_update` when the "
+        "largest per-tensor update-to-weight ratio falls below this "
+        "while gradients are still nonzero (lr collapsed, optimizer "
+        "state saturated, or a frozen graph).", section=_NW)
+declare("MXNET_TPU_NUMWATCH_MAX_SKIPS", int, 100,
+        "Rate limit for the `skip` guard: once the in-graph skip "
+        "counter passes this many skipped steps, numwatch logs an "
+        "error, counts `numwatch.skip_cap_exceeded`, and (when the "
+        "rollback guard is armed) escalates to a rollback — endless "
+        "silent skipping is never a steady state.", section=_NW)
+declare("MXNET_TPU_NUMWATCH_ROLLBACK_COOLDOWN", int, 200,
+        "Rate limit for the `rollback` guard: at least this many steps "
+        "must pass between two rollbacks; a still-unhealthy model "
+        "inside the cooldown raises instead of thrashing the "
+        "snapshot store.", section=_NW)
+
 
 # ---------------------------------------------------------------------------
 # docs generation

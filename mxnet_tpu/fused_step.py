@@ -37,10 +37,12 @@ mean-psum all-reduce runs inside this one dispatch; gate with
 ``MXNET_TPU_DEVICE_SYNC_FUSED=0``). :func:`make_fused_step` returns
 None (-> classic three-phase loop) whenever a precondition fails:
 ``dist_*`` kvstores, ``update_on_kvstore``, custom-update optimizers
-without a fusable plan, grad_req "add", ``inputs_need_grad``, or an
-installed monitor (which needs every internal tensor). A
-requested-but-failed precondition counts
-``step.fused_fallback[.reason]`` and warns once naming the reason.
+without a fusable plan, grad_req "add", ``inputs_need_grad``, or a
+monitor with a custom ``stat_func`` (which needs every internal
+tensor; default-stat monitors ride the numwatch stats pack instead —
+see ``mxnet_tpu/numwatch.py``). A requested-but-failed precondition
+counts ``step.fused_fallback[.reason]`` and warns once naming the
+reason.
 
 Telemetry: ``step.dispatches`` counts XLA computation launches per
 batch on both paths (the fused-vs-unfused delta BENCH_r06 reports);
@@ -132,9 +134,19 @@ def make_fused_step(module, eval_metric):
                          "input gradients the fused step never builds")
     ex = module._exec_group.executor
     if ex._monitor_callback is not None:
-        return _fallback(module, "monitor",
-                         "an installed monitor needs every internal "
-                         "tensor; the fused step keeps them in-graph")
+        # a default-stat Monitor is expressible from the numwatch stats
+        # pack and rides the fused step (maybe_plane routes it); only a
+        # custom stat_func still needs every internal tensor host-side
+        from . import numwatch as _numwatch
+
+        mon = getattr(ex._monitor_callback, "__self__", None)
+        if not _numwatch.monitor_routable(mon):
+            return _fallback(module, "monitor_custom",
+                             "an installed monitor with a custom "
+                             "stat_func needs every internal tensor; "
+                             "the fused step keeps them in-graph "
+                             "(default-stat monitors ride the numwatch "
+                             "pack)")
     # grad_req "add" accumulates across batches in the grad arrays; the
     # fused step never materializes per-param grads, so it can't honor it
     if any(ex._grad_req[ex.arg_names[i]] != "write" for i in ex._grad_idx):
@@ -194,6 +206,12 @@ class FusedTrainStep:
                             for d in self._group.data_shapes
                             if d.name in arg_pos]
         self._fold_leaves = self._foldable_leaves(eval_metric)
+
+        # the numerics plane (env-armed, or implicitly by a routable
+        # Monitor): its stats pack rides this step's donated state
+        from . import numwatch as _numwatch
+
+        self._numwatch = _numwatch.maybe_plane(self)
 
         # optimizer states must exist before the first trace
         for upd_i, arg_i in zip(self._p_upd_idx, self._p_arg_idx):
@@ -330,10 +348,13 @@ class FusedTrainStep:
             else:
                 h, w, c = d0[1], d0[2], d0[3]
             feed = (nchw, h, w, c)
-        ck = (specs, clip is not None, donate, fold, feed)
+        nw = self._numwatch
+        ck = (specs, clip is not None, donate, fold, feed,
+              None if nw is None else nw.trace_key)
         fn = self._jit_cache.get(ck)
         if fn is None:
-            fn = self._build(specs, clip is not None, donate, fold, feed)
+            fn = self._build(specs, clip is not None, donate, fold, feed,
+                             watch=nw)
             self._jit_cache[ck] = fn
 
         with _san.intentional_transfer():
@@ -386,6 +407,13 @@ class FusedTrainStep:
                            _replicated_zero(like))
             accs.append(tuple(acc))
         accs = tuple(accs)
+        stats = None
+        if nw is not None:
+            # the numerics stats pack is donated like the accs: placed
+            # once (replicated on the params' mesh), swapped in-place by
+            # every dispatch's write-back
+            with _san.intentional_transfer():
+                stats = nw.device_pack(p_vals[0] if p_vals else None)
 
         # a fresh (shape, dtype, spec) signature means jax retraces and
         # XLA recompiles — in steady state that's the silent stall the
@@ -406,13 +434,20 @@ class FusedTrainStep:
 
         def _do():
             _tel.inc("step.dispatches")
-            if aug_vals is not None:
-                new_p, outs, aux_out, new_st, new_accs = fn(
-                    p_vals, o_vals, aux_vals, st_vals, sv_mats, accs,
-                    key, aug_vals)
+            if nw is not None:
+                args = (p_vals, o_vals, aux_vals, st_vals, sv_mats,
+                        accs, stats, key)
             else:
-                new_p, outs, aux_out, new_st, new_accs = fn(
-                    p_vals, o_vals, aux_vals, st_vals, sv_mats, accs, key)
+                args = (p_vals, o_vals, aux_vals, st_vals, sv_mats,
+                        accs, key)
+            if aug_vals is not None:
+                args = args + (aug_vals,)
+            res = fn(*args)
+            if nw is not None:
+                new_p, outs, aux_out, new_st, new_accs, new_stats = res
+                nw.write_back(new_stats)
+            else:
+                new_p, outs, aux_out, new_st, new_accs = res
             for nd, v in zip(p_nds, new_p):
                 nd._data = v
             for nd, v in zip(ex.aux_arrays, aux_out):
@@ -426,12 +461,14 @@ class FusedTrainStep:
             ex._set_outputs(outs)
             ex._train_pending = False
             if donate and _san.enabled("donation"):
-                # argnums (0, 2, 3, 5): params, aux, opt states, accs
+                # argnums (0, 2, 3, 5[, 6]): params, aux, opt states,
+                # accs, and the numwatch stats pack when armed
                 _san.DonationSanitizer.check(
                     "the fused step",
                     p_vals + aux_vals
                     + [s for g in st_vals for m in g for s in m]
-                    + [a for acc in accs for a in acc])
+                    + [a for acc in accs for a in acc]
+                    + ([stats] if stats is not None else []))
             return list(new_p)
 
         get_engine().push(_do, const_vars=[nd._var for nd in o_nds],
@@ -444,7 +481,7 @@ class FusedTrainStep:
             eval_metric.update(data_batch.label, ex.outputs)
 
     # ------------------------------------------------------------------
-    def _build(self, specs, clipped, donate, fold, feed=None):
+    def _build(self, specs, clipped, donate, fold, feed=None, watch=None):
         """Trace+compile the whole-batch step for one (structure,
         donation, fold, feed) configuration. With ``feed`` set the data
         slot of the non-donated pack holds raw uint8 stored frames and
@@ -496,7 +533,8 @@ class FusedTrainStep:
             y = (y.astype(jnp.float32) - mean) * scale
             return jnp.transpose(y, (0, 3, 1, 2)) if nchw else y
 
-        def step(p_vals, o_vals, aux, st, sv_mats, accs, key, aug=None):
+        def _core(p_vals, o_vals, aux, st, sv_mats, accs, stats, key,
+                  aug=None):
             full = [None] * n_args
             for pos, i in enumerate(o_idx):
                 full[i] = o_vals[pos]
@@ -531,8 +569,8 @@ class FusedTrainStep:
                     grp.append(ns)
                 new_st.append(tuple(grp))
             new_accs = accs
+            labels = [o_vals[p] for p in label_pos]
             if fold:
-                labels = [o_vals[p] for p in label_pos]
                 new_accs = []
                 for leaf, (s, c) in zip(leaves, accs):
                     for lab, pred in zip(labels, outs):
@@ -541,7 +579,24 @@ class FusedTrainStep:
                         c = c + dc
                     new_accs.append((s, c))
                 new_accs = tuple(new_accs)
-            return (tuple(new_p), outs, aux_out, tuple(new_st), new_accs)
+            new_p = tuple(new_p)
+            new_st = tuple(new_st)
+            if watch is None:
+                return (new_p, outs, aux_out, new_st, new_accs)
+            # numerics stats fold — same trace, same dispatch
+            new_stats, grads_ok = watch.fold(stats, p_vals, grads,
+                                             new_p, outs, labels)
+            if watch.skip_guard:
+                # nonfinite grads: select the step k-1 training state
+                # in-graph (params/opt-state/metric accs bit-identical
+                # to the pre-step buffers) — still one dispatch; the
+                # pack itself always advances so the host sees the skip
+                keep = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(grads_ok, new, old),
+                    (new_p, new_st, new_accs),
+                    (tuple(p_vals), tuple(st), tuple(accs)))
+                new_p, new_st, new_accs = keep
+            return (new_p, outs, aux_out, new_st, new_accs, new_stats)
 
         # route the compile through the device observability plane: a
         # plain jax.jit when xprof is off, else the AOT wrapper that
@@ -557,13 +612,32 @@ class FusedTrainStep:
         # consultation for observability — nothing runs per dispatch
         from . import autotune as _autotune
         _autotune.note_build("fused_step")
+        if watch is not None:
+            # the stats pack joins the donated set (argnum 6)
+            def step(p_vals, o_vals, aux, st, sv_mats, accs, stats, key,
+                     aug=None):
+                return _core(p_vals, o_vals, aux, st, sv_mats, accs,
+                             stats, key, aug)
+
+            arg_names = (tuple("params." + n for n in names),
+                         tuple("batch." + n for n in batch_names),
+                         "aux", "opt_state", "hyper", "metric_acc",
+                         "numwatch_pack", "rng_key", "aug")
+            donate_argnums = (0, 2, 3, 5, 6)
+        else:
+            def step(p_vals, o_vals, aux, st, sv_mats, accs, key,
+                     aug=None):
+                return _core(p_vals, o_vals, aux, st, sv_mats, accs,
+                             None, key, aug)
+
+            arg_names = (tuple("params." + n for n in names),
+                         tuple("batch." + n for n in batch_names),
+                         "aux", "opt_state", "hyper", "metric_acc",
+                         "rng_key", "aug")
+            donate_argnums = (0, 2, 3, 5)
         return _xprof.jit(
-            step, site="fused_step",
-            arg_names=(tuple("params." + n for n in names),
-                       tuple("batch." + n for n in batch_names),
-                       "aux", "opt_state", "hyper", "metric_acc",
-                       "rng_key", "aug"),
-            donate_argnums=(0, 2, 3, 5) if donate else ())
+            step, site="fused_step", arg_names=arg_names,
+            donate_argnums=donate_argnums if donate else ())
 
 
 # ---------------------------------------------------------------------------

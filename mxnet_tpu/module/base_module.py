@@ -298,12 +298,18 @@ class BaseModule:
         resume = ckpt.maybe_restore() if ckpt is not None else None
         if ckpt is not None:
             ckpt.arm()
+        # the numerics plane (MXNET_TPU_NUMWATCH / a routed Monitor)
+        # rides the fused step; its rollback guard restores through the
+        # same manager the preemption path uses
+        numwatch = getattr(fused, "_numwatch", None)
+        if numwatch is not None and ckpt is not None:
+            numwatch.bind_ckpt(ckpt)
         try:
             self._fit_epochs(train_data, eval_data, eval_metric,
                              validation_metric, epoch_end_callback,
                              batch_end_callback, eval_batch_end_callback,
                              monitor, fused, ckpt, resume,
-                             begin_epoch, num_epoch)
+                             begin_epoch, num_epoch, numwatch)
         finally:
             if ckpt is not None:
                 ckpt.disarm()
@@ -311,7 +317,9 @@ class BaseModule:
     def _fit_epochs(self, train_data, eval_data, eval_metric,
                     validation_metric, epoch_end_callback,
                     batch_end_callback, eval_batch_end_callback,
-                    monitor, fused, ckpt, resume, begin_epoch, num_epoch):
+                    monitor, fused, ckpt, resume, begin_epoch, num_epoch,
+                    numwatch=None):
+        from .. import numwatch as _numwatch
         for epoch in range(begin_epoch, num_epoch):
             if resume is not None and epoch < resume["epoch"]:
                 continue
@@ -361,14 +369,19 @@ class BaseModule:
                             # packs whole again: periodic cadence save,
                             # or the deferred preempt save + exit
                             ckpt.step_end(epoch, nbatch)
+                        # numerics plane: one None check when disabled;
+                        # on the EVERY_N cadence a single small D2H
+                        # fetch of the stats pack plus guard actions
+                        nw_extra = _numwatch.after_step(numwatch)
                         if monitor is not None:
                             monitor.toc_print()
                         if _tel.enabled():
                             now = time.perf_counter()
+                            extra = {"epoch": epoch, "nbatch": nbatch}
+                            if nw_extra:
+                                extra.update(nw_extra)
                             _tracing.record_step(
-                                (now - t_last) * 1e3,
-                                extra={"epoch": epoch,
-                                       "nbatch": nbatch})
+                                (now - t_last) * 1e3, extra=extra)
                             t_last = now
                         if batch_end_callback is not None:
                             params = BatchEndParam(

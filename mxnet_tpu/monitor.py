@@ -1,7 +1,19 @@
 """Monitor: per-op output/param statistics during training
 (reference ``python/mxnet/monitor.py:16-115`` — the only per-op
-observability in the reference; kept with the same callback design, backed
-by the executor's monitor hook)."""
+observability in the reference, an executor callback that materializes
+every internal tensor host-side).
+
+Rewritten as a facade over the numwatch stats pack: a monitor with the
+DEFAULT stat (``norm(x)/sqrt(x.size)``) is *pack-expressible* — the
+fused step computes exactly that statistic for every param and its
+gradient inside the one donated dispatch (``mxnet_tpu/numwatch.py``),
+and :meth:`toc` serves the classic ``(step, name, value)`` rows from a
+single small D2H fetch of the pack. Installing such a monitor no
+longer forces the fused step to fall back to the three-dispatch loop.
+
+A monitor constructed with a custom ``stat_func`` keeps the reference
+behavior end to end: the executor callback materializes internals, and
+the fused step refuses with fallback reason ``monitor_custom``."""
 from __future__ import annotations
 
 import logging
@@ -16,6 +28,10 @@ __all__ = ["Monitor"]
 class Monitor:
     def __init__(self, interval: int, stat_func: Optional[Callable] = None,
                  pattern: str = ".*", sort: bool = False):
+        # no stat_func -> the default norm/sqrt(size) stat, which the
+        # numwatch pack expresses exactly (l2 rows over params+grads):
+        # this monitor rides the fused step instead of breaking it
+        self.pack_expressible = stat_func is None
         if stat_func is None:
             def stat_func(x: NDArray):
                 from . import ndarray as nd
@@ -29,6 +45,12 @@ class Monitor:
         self.exes = []
         self.re_prog = re.compile(pattern)
         self.sort = sort
+        self._plane = None   # bound NumWatch when the fused step routes us
+
+    def attach_plane(self, plane):
+        """Bind the numwatch plane (called by the fused step's
+        ``maybe_plane`` routing): tic/toc serve from the stats pack."""
+        self._plane = plane
 
     def stat_helper(self, name: str, arr: NDArray):
         if not self.activated or not self.re_prog.match(name):
@@ -41,9 +63,10 @@ class Monitor:
 
     def tic(self):
         if self.step % self.interval == 0:
-            for exe in self.exes:
-                for arr in exe.arg_arrays:
-                    arr.wait_to_read()
+            if self._plane is None:
+                for exe in self.exes:
+                    for arr in exe.arg_arrays:
+                        arr.wait_to_read()
             self.queue = []
             self.activated = True
         self.step += 1
@@ -51,6 +74,15 @@ class Monitor:
     def toc(self) -> List[Tuple[int, str, str]]:
         if not self.activated:
             return []
+        self.activated = False
+        if self._plane is not None:
+            # fused route: one D2H of the stats pack, no executor sync,
+            # no per-tensor host math — rows carry the same default stat
+            res = self._plane.monitor_rows(self.re_prog, self.step)
+            if self.sort:
+                res.sort(key=lambda x: x[1])
+            self.queue = []
+            return res
         for exe in self.exes:
             for arr in exe.arg_arrays:
                 arr.wait_to_read()
@@ -61,7 +93,6 @@ class Monitor:
                 if arr is not None:
                     self.queue.append((self.step, name + "_grad",
                                        self.stat_func(arr)))
-        self.activated = False
         res = []
         if self.sort:
             self.queue.sort(key=lambda x: x[1])

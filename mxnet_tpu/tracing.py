@@ -40,6 +40,7 @@ from __future__ import annotations
 import http.server
 import json
 import logging
+import math
 import os
 import signal
 import sys
@@ -55,7 +56,9 @@ from . import env as _env
 
 __all__ = ["StepTrace", "SlowStepDetector", "RecompileDetector",
            "InputStallDetector", "SlowRequestDetector",
-           "FleetHealthDetector", "AnomalyProfiler",
+           "FleetHealthDetector", "LossSpikeDetector",
+           "GradExplosionDetector", "DeadUpdateDetector",
+           "NonfiniteDetector", "AnomalyProfiler",
            "FlightRecorder", "MetricsServer", "step_trace", "record_step",
            "maybe_init", "set_worker_rank", "worker_rank", "shutdown",
            "register_health_probe", "unregister_health_probe",
@@ -91,6 +94,9 @@ DELTA_SOURCES = (
     # they took (checkpoint.py)
     ("ckpt_saves", "ckpt.saves", "counter"),
     ("ckpt_save_ms", "ckpt.save_ms", "hist_sum"),
+    # numerics plane (numwatch.py): guard actions taken this step
+    ("numwatch_skipped", "numwatch.skipped_steps", "counter"),
+    ("numwatch_rolled_back", "numwatch.rollbacks", "counter"),
 )
 
 _STALL_FIELDS = ("io_stall_ms", "prefetch_stall_ms", "feed_stall_ms")
@@ -254,9 +260,120 @@ class FleetHealthDetector:
         return None
 
 
+class LossSpikeDetector:
+    """Numerics-plane guard: numwatch's cadence fetch stamps the
+    in-graph loss (``numwatch_loss``) into the step record; a loss more
+    than MXNET_TPU_NUMWATCH_SPIKE_K times its rolling median is a
+    spike — bad batch, lr too hot, or the first visible symptom of a
+    numeric blowup. Inert on records without the stamp (numwatch off,
+    or an off-cadence step)."""
+
+    type = "loss_spike"
+
+    def __init__(self, k: Optional[float] = None, window: int = 32):
+        self.k = float(k if k is not None
+                       else _env.get("MXNET_TPU_NUMWATCH_SPIKE_K"))
+        self._hist: deque = deque(maxlen=window)
+
+    def check(self, rec: dict) -> Optional[dict]:
+        loss = rec.get("numwatch_loss")
+        if loss is None or not math.isfinite(loss):
+            return None
+        prior = sorted(self._hist)
+        self._hist.append(float(loss))
+        if len(prior) < 3:
+            return None
+        median = prior[len(prior) // 2]
+        if median > 0 and loss > self.k * median:
+            return {"type": self.type, "loss": round(float(loss), 6),
+                    "median": round(median, 6),
+                    "ratio": round(float(loss) / median, 2)}
+        return None
+
+
+class GradExplosionDetector:
+    """Numerics-plane guard over the fetched global gradient norm
+    (``numwatch_grad_norm``): a norm more than
+    MXNET_TPU_NUMWATCH_EXPLODE_K times its rolling median means the
+    backward pass is exploding — the classic precursor of the NaN the
+    NonfiniteDetector would report a few steps later."""
+
+    type = "grad_explosion"
+
+    def __init__(self, k: Optional[float] = None, window: int = 32):
+        self.k = float(k if k is not None
+                       else _env.get("MXNET_TPU_NUMWATCH_EXPLODE_K"))
+        self._hist: deque = deque(maxlen=window)
+
+    def check(self, rec: dict) -> Optional[dict]:
+        norm = rec.get("numwatch_grad_norm")
+        if norm is None or not math.isfinite(norm):
+            return None
+        prior = sorted(self._hist)
+        self._hist.append(float(norm))
+        if len(prior) < 3:
+            return None
+        median = prior[len(prior) // 2]
+        if median > 0 and norm > self.k * median:
+            return {"type": self.type,
+                    "grad_norm": round(float(norm), 6),
+                    "median": round(median, 6),
+                    "ratio": round(float(norm) / median, 2)}
+        return None
+
+
+class DeadUpdateDetector:
+    """Numerics-plane guard over the largest per-tensor update-to-
+    weight ratio (``numwatch_uw_max``): gradients flowing but every
+    update below MXNET_TPU_NUMWATCH_DEAD_UW means training is inert —
+    an lr schedule that collapsed to zero, a saturated optimizer state,
+    or a frozen graph."""
+
+    type = "dead_update"
+
+    def __init__(self, threshold: Optional[float] = None):
+        self.threshold = float(
+            threshold if threshold is not None
+            else _env.get("MXNET_TPU_NUMWATCH_DEAD_UW"))
+
+    def check(self, rec: dict) -> Optional[dict]:
+        uw = rec.get("numwatch_uw_max")
+        if uw is None:
+            return None
+        norm = rec.get("numwatch_grad_norm") or 0.0
+        if uw < self.threshold and norm > 0 and math.isfinite(norm):
+            return {"type": self.type, "uw_max": float(uw),
+                    "grad_norm": round(float(norm), 6),
+                    "threshold": self.threshold}
+        return None
+
+
+class NonfiniteDetector:
+    """Numerics-plane alarm: any nonfinite param or grad element seen
+    by the fetch (``numwatch_nonfinite``) becomes an anomaly event
+    carrying the provenance verdict (``numwatch_bad_tensor`` — the
+    first tensor to go bad, in forward order) and the guard counters,
+    so a crash dump names the layer, not just the symptom."""
+
+    type = "nonfinite"
+
+    def check(self, rec: dict) -> Optional[dict]:
+        n = rec.get("numwatch_nonfinite")
+        if not n:
+            return None
+        ev = {"type": self.type, "nonfinite": int(n)}
+        for k in ("numwatch_bad_tensor", "numwatch_skips",
+                  "numwatch_rollbacks"):
+            if rec.get(k) is not None:
+                ev[k.replace("numwatch_", "")] = rec[k]
+        return ev
+
+
 def default_detectors() -> list:
     return [SlowStepDetector(), RecompileDetector(), InputStallDetector(),
-            SlowRequestDetector(), FleetHealthDetector()]
+            SlowRequestDetector(), FleetHealthDetector(),
+            LossSpikeDetector(), GradExplosionDetector(),
+            DeadUpdateDetector(), NonfiniteDetector()]
 
 
 # ---------------------------------------------------------------------------
@@ -668,6 +785,19 @@ class FlightRecorder:
                 json.dump(_tel.snapshot(), f, indent=1)
             if tr is not None:
                 tr.dump_jsonl(os.path.join(d, "steps.jsonl"))
+            # last-K model-health rows from the numerics plane, so a
+            # post-mortem shows the numeric trajectory into the failure
+            try:
+                from . import numwatch as _numwatch
+
+                rows = _numwatch.health_rows()
+                if rows:
+                    with open(os.path.join(d, "numwatch.jsonl"),
+                              "w") as f:
+                        for row in rows:
+                            f.write(json.dumps(row) + "\n")
+            except Exception:
+                pass
             _log.error("flight recorder dump (%s) written to %s", reason, d)
             return d
         except Exception as e:

@@ -1,0 +1,436 @@
+"""Numerics observability plane (MXNET_TPU_NUMWATCH=1): the in-graph
+stats pack keeps the fused step's one-dispatch/one-trace contract, NaN
+provenance names the first bad tensor, the skip guard holds params
+bit-identical through a poisoned batch, the rollback guard restores a
+bit-identical healthy snapshot without retracing, the disabled path is
+free, default monitors route through the pack, and the anomaly
+detectors + report views read the fetched health."""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import numwatch, telemetry, tracing
+from mxnet_tpu.analysis import sanitizers
+from mxnet_tpu.checkpoint import CheckpointManager
+from mxnet_tpu.fused_step import make_fused_step
+from mxnet_tpu.module import Module
+from mxnet_tpu.monitor import Monitor
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+BATCH = 8
+DIM = 6
+CLASSES = 3
+
+
+def _mlp_sym():
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=CLASSES, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _synthetic(n, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, DIM).astype(np.float32)
+    w = rng.randn(DIM, CLASSES)
+    y = X.dot(w).argmax(axis=1).astype(np.float32)
+    return X, y
+
+
+def _seed_params(net, seed=3):
+    arg_shapes, _, _ = net.infer_shape(data=(BATCH, DIM),
+                                       softmax_label=(BATCH,))
+    rng = np.random.RandomState(seed)
+    return {name: mx.nd.array((rng.randn(*shape) * 0.1).astype(np.float32))
+            for name, shape in zip(net.list_arguments(), arg_shapes)
+            if name not in ("data", "softmax_label")}
+
+
+def _manual(monkeypatch, guard=None, every_n=1, nbatches=2):
+    """A bound+fused module driven by hand (the fit loop's fused path
+    without the loop): returns (mod, fused, plane, metric, batches)."""
+    monkeypatch.setenv("MXNET_TPU_FUSED_STEP", "1")
+    monkeypatch.setenv("MXNET_TPU_NUMWATCH", "1")
+    monkeypatch.setenv("MXNET_TPU_NUMWATCH_EVERY_N", str(every_n))
+    if guard is not None:
+        monkeypatch.setenv("MXNET_TPU_NUMWATCH_GUARD", guard)
+    net = _mlp_sym()
+    X, y = _synthetic(BATCH * nbatches)
+    data = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    mod = Module(net, context=mx.cpu())
+    mod.bind(data.provide_data, data.provide_label)
+    mod.init_params(arg_params=_seed_params(net), initializer=None)
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+    metric = mx.metric.create("acc")
+    fused = make_fused_step(mod, metric)
+    assert fused is not None and fused._numwatch is not None
+    return mod, fused, fused._numwatch, metric, list(data)
+
+
+def _nan_batch():
+    X = np.full((BATCH, DIM), np.nan, np.float32)
+    y = np.zeros((BATCH,), np.float32)
+    return next(iter(mx.io.NDArrayIter(X, y, batch_size=BATCH)))
+
+
+def _params(mod):
+    args, _ = mod.get_params()
+    return {k: v.asnumpy().copy() for k, v in args.items()}
+
+
+def _poison_param(fused, name):
+    """NaN-fill one param in place (no retrace: same shape/dtype)."""
+    import jax.numpy as jnp
+
+    nd = fused._executor.arg_dict[name]
+    with sanitizers.intentional_transfer():
+        nd._data = jnp.full(nd.shape, jnp.nan, jnp.float32)
+
+
+@pytest.fixture
+def tel():
+    telemetry.reset()
+    telemetry.enable()
+    yield telemetry
+    telemetry.reset()
+    telemetry.disable()
+
+
+# -- the one-dispatch / one-trace contract ----------------------------------
+
+def test_armed_fit_one_dispatch_one_trace(tel, monkeypatch):
+    """THE acceptance criterion: with the stats pack riding the donated
+    state, a fit is still exactly one XLA dispatch per batch and one
+    fresh trace signature for the whole run."""
+    monkeypatch.setenv("MXNET_TPU_FUSED_STEP", "1")
+    monkeypatch.setenv("MXNET_TPU_NUMWATCH", "1")
+    monkeypatch.setenv("MXNET_TPU_NUMWATCH_EVERY_N", "2")
+    nbatches = 6
+    net = _mlp_sym()
+    X, y = _synthetic(BATCH * nbatches)
+    data = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    mod = Module(net, context=mx.cpu())
+    d0 = telemetry.peek("step.dispatches") or 0
+    r0 = telemetry.peek("step.fused_recompiles") or 0
+    mod.fit(data, num_epoch=1, optimizer="sgd",
+            arg_params=_seed_params(net), initializer=None,
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
+    assert mod._fused_step_active
+    assert (telemetry.peek("step.dispatches") or 0) - d0 == nbatches
+    assert (telemetry.peek("step.fused_recompiles") or 0) - r0 == 1
+    # the EVERY_N cadence fetched, and left the health gauges behind
+    assert (telemetry.peek("numwatch.fetches") or 0) == nbatches // 2
+    assert telemetry.peek("numwatch.grad_norm", kind="gauge") > 0
+
+
+def test_numwatch_off_is_off(monkeypatch):
+    """No env, no monitor: the fused step carries no pack and the
+    per-batch hook is a single None check (pinned < 2 us)."""
+    monkeypatch.setenv("MXNET_TPU_FUSED_STEP", "1")
+    monkeypatch.delenv("MXNET_TPU_NUMWATCH", raising=False)
+    net = _mlp_sym()
+    X, y = _synthetic(BATCH)
+    data = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    mod = Module(net, context=mx.cpu())
+    mod.bind(data.provide_data, data.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    fused = make_fused_step(mod, mx.metric.create("acc"))
+    assert fused is not None and fused._numwatch is None
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        numwatch.after_step(None)
+    per_call = (time.perf_counter() - t0) / n
+    assert per_call < 2e-6, "disabled numwatch hook costs %.2fus" \
+        % (per_call * 1e6)
+
+
+def test_guard_env_validation(monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_NUMWATCH_GUARD", "explode")
+    with pytest.raises(ValueError, match="explode"):
+        numwatch.NumWatch(["w"], [4])
+
+
+# -- NaN provenance ----------------------------------------------------------
+
+def test_provenance_names_first_bad_tensor(tel, monkeypatch):
+    """A param seeded NaN mid-run must be named by the next fetch —
+    kind 'param', even though the same backward pass fanned the NaN out
+    to every gradient (param beats grad at equal step)."""
+    mod, fused, plane, metric, batches = _manual(monkeypatch)
+    fused.step(batches[0], metric)
+    extras = plane.after_step()
+    assert extras["numwatch_nonfinite"] == 0
+    assert plane.provenance() is None
+    _poison_param(fused, "fc2_weight")
+    fused.step(batches[1], metric)
+    extras = plane.after_step()
+    assert extras["numwatch_nonfinite"] > 0
+    name, kind, step = plane.provenance()
+    assert name == "fc2_weight"
+    assert kind == "param"
+    assert step == 2
+    assert extras["numwatch_bad_tensor"] == "fc2_weight"
+
+
+def test_provenance_bad_data_stamps_grads(tel, monkeypatch):
+    """A poisoned BATCH (params healthy) stamps gradients only; the
+    verdict is the first grad-bearing tensor in forward order."""
+    mod, fused, plane, metric, batches = _manual(monkeypatch)
+    fused.step(batches[0], metric)
+    plane.after_step()
+    fused.step(_nan_batch(), metric)
+    extras = plane.after_step()
+    assert extras["numwatch_nonfinite"] > 0
+    name, kind, step = plane.provenance()
+    assert kind == "grad"
+    assert name == "fc1_weight"
+    assert step == 2
+
+
+# -- guarded training ---------------------------------------------------------
+
+def test_skip_guard_holds_params_bit_identical(tel, monkeypatch):
+    """skip: a nonfinite-grad step selects the k-1 state in-graph —
+    params after the poisoned batch are bit-identical to before it,
+    with no second dispatch and no retrace; training then resumes."""
+    mod, fused, plane, metric, batches = _manual(monkeypatch,
+                                                 guard="skip")
+    fused.step(batches[0], metric)
+    plane.after_step()
+    before = _params(mod)
+    r0 = telemetry.peek("step.fused_recompiles") or 0
+    fused.step(_nan_batch(), metric)
+    extras = plane.after_step()
+    after = _params(mod)
+    for name in before:
+        assert np.array_equal(before[name], after[name]), name
+    assert extras["numwatch_skips"] == 1
+    assert (telemetry.peek("numwatch.skipped_steps") or 0) == 1
+    assert (telemetry.peek("step.fused_recompiles") or 0) == r0
+    # a clean batch afterwards learns again, and stays finite
+    fused.step(batches[1], metric)
+    plane.after_step()
+    resumed = _params(mod)
+    assert any(not np.array_equal(after[n], resumed[n]) for n in after)
+    assert all(np.isfinite(v).all() for v in resumed.values())
+
+
+def test_rollback_restores_healthy_snapshot(tel, monkeypatch, tmp_path):
+    """rollback: nonfinite PARAMS at a fetch restore the last healthy
+    snapshot bit-identically, through the preemption CheckpointManager,
+    without a retrace."""
+    mod, fused, plane, metric, batches = _manual(monkeypatch,
+                                                 guard="rollback")
+    ckpt = CheckpointManager(mod, metric, None, directory=str(tmp_path))
+    plane.bind_ckpt(ckpt)
+    fused.step(batches[0], metric)
+    plane.after_step()  # clean fetch -> saves the healthy snapshot
+    healthy = _params(mod)
+    r0 = telemetry.peek("step.fused_recompiles") or 0
+    _poison_param(fused, "fc1_weight")
+    fused.step(batches[1], metric)
+    plane.after_step()  # sees nonfinite params -> rolls back
+    assert (telemetry.peek("numwatch.rollbacks") or 0) == 1
+    restored = _params(mod)
+    for name in healthy:
+        assert np.array_equal(healthy[name], restored[name]), name
+    assert (telemetry.peek("step.fused_recompiles") or 0) == r0
+    # the pack was reset: training continues finite from the snapshot
+    fused.step(batches[0], metric)
+    extras = plane.after_step()
+    assert extras["numwatch_nonfinite"] == 0
+    assert (telemetry.peek("step.fused_recompiles") or 0) == r0
+
+
+def test_rollback_cooldown_refuses_thrash(tel, monkeypatch, tmp_path):
+    mod, fused, plane, metric, batches = _manual(monkeypatch,
+                                                 guard="rollback")
+    ckpt = CheckpointManager(mod, metric, None, directory=str(tmp_path))
+    plane.bind_ckpt(ckpt)
+    fused.step(batches[0], metric)
+    plane.after_step()
+    _poison_param(fused, "fc1_weight")
+    fused.step(batches[1], metric)
+    plane.after_step()  # first rollback
+    _poison_param(fused, "fc1_weight")
+    fused.step(batches[0], metric)
+    with pytest.raises(numwatch.NumericsError, match="cooldown"):
+        plane.after_step()
+
+
+# -- monitor facade -----------------------------------------------------------
+
+def test_default_monitor_rides_the_pack(tel, monkeypatch):
+    """Installing a default-stat Monitor no longer kills the fused
+    step: the facade serves the classic rows from the pack."""
+    monkeypatch.setenv("MXNET_TPU_FUSED_STEP", "1")
+    monkeypatch.delenv("MXNET_TPU_NUMWATCH", raising=False)
+    net = _mlp_sym()
+    X, y = _synthetic(BATCH)
+    data = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    mod = Module(net, context=mx.cpu())
+    mod.bind(data.provide_data, data.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    mon = Monitor(interval=1)
+    mod.install_monitor(mon)
+    fused = make_fused_step(mod, mx.metric.create("acc"))
+    assert fused is not None  # no monitor fallback
+    assert (telemetry.peek("step.fused_fallback.monitor_custom")
+            or 0) == 0
+    plane = fused._numwatch
+    assert plane is not None and plane._monitor is mon
+    batch = next(iter(data))
+    mon.tic()
+    fused.step(batch, mx.metric.create("acc"))
+    rows = mon.toc()
+    names = {name for _, name, _ in rows}
+    assert "fc1_weight" in names and "fc1_weight_grad" in names
+    for _, _, stat in rows:
+        assert np.isfinite(float(stat))
+
+
+def test_custom_stat_func_still_falls_back(tel, monkeypatch):
+    monkeypatch.setenv("MXNET_TPU_FUSED_STEP", "1")
+    net = _mlp_sym()
+    X, y = _synthetic(BATCH)
+    data = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    mod = Module(net, context=mx.cpu())
+    mod.bind(data.provide_data, data.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    mon = Monitor(interval=1, stat_func=lambda x: x)
+    mod.install_monitor(mon)
+    assert not numwatch.monitor_routable(mon)
+    assert make_fused_step(mod, mx.metric.create("acc")) is None
+    assert (telemetry.peek("step.fused_fallback.monitor_custom")
+            or 0) == 1
+
+
+# -- anomaly detectors ---------------------------------------------------------
+
+def test_loss_spike_detector():
+    det = tracing.LossSpikeDetector(k=3.0)
+    for loss in (1.0, 1.1, 0.9, 1.0):
+        assert det.check({"numwatch_loss": loss}) is None
+    ev = det.check({"numwatch_loss": 10.0})
+    assert ev and ev["type"] == "loss_spike" and ev["ratio"] >= 3.0
+    # nonfinite loss is the NonfiniteDetector's job, not a spike
+    assert det.check({"numwatch_loss": float("nan")}) is None
+    assert det.check({}) is None
+
+
+def test_grad_explosion_detector():
+    det = tracing.GradExplosionDetector(k=10.0)
+    for norm in (2.0, 2.2, 1.9, 2.1):
+        assert det.check({"numwatch_grad_norm": norm}) is None
+    ev = det.check({"numwatch_grad_norm": 50.0})
+    assert ev and ev["type"] == "grad_explosion"
+
+
+def test_dead_update_detector():
+    det = tracing.DeadUpdateDetector(threshold=1e-9)
+    ok = {"numwatch_uw_max": 1e-3, "numwatch_grad_norm": 1.0}
+    assert det.check(ok) is None
+    dead = {"numwatch_uw_max": 1e-12, "numwatch_grad_norm": 1.0}
+    ev = det.check(dead)
+    assert ev and ev["type"] == "dead_update"
+    # no gradient signal (start of run) is not "dead"
+    assert det.check({"numwatch_uw_max": 0.0,
+                      "numwatch_grad_norm": 0.0}) is None
+
+
+def test_nonfinite_detector_carries_provenance():
+    det = tracing.NonfiniteDetector()
+    assert det.check({"numwatch_nonfinite": 0}) is None
+    ev = det.check({"numwatch_nonfinite": 7,
+                    "numwatch_bad_tensor": "fc1_weight",
+                    "numwatch_skips": 2, "numwatch_rollbacks": 1})
+    assert ev["nonfinite"] == 7
+    assert ev["bad_tensor"] == "fc1_weight"
+    assert ev["skips"] == 2 and ev["rollbacks"] == 1
+
+
+def test_detectors_registered_by_default():
+    types = {type(d).__name__ for d in tracing.default_detectors()}
+    assert {"LossSpikeDetector", "GradExplosionDetector",
+            "DeadUpdateDetector", "NonfiniteDetector"} <= types
+
+
+# -- report views ---------------------------------------------------------------
+
+def test_render_numerics_view(tmp_path):
+    from trace_report import render_numerics
+
+    rec = {"overhead_pct": 1.5, "baseline_step_ms": 30.0,
+           "armed_step_ms": 30.45, "dispatches_per_step": 1.0,
+           "fused_recompiles": 1, "overhead_ok": True,
+           "tensors": [{"name": "fc1_weight", "grad_l2": 3.2,
+                        "grad_maxabs": 0.5, "nonfinite": 0,
+                        "zero_frac": 0.01, "uw_ratio": 1e-4}],
+           "guard": {"skipped": 2, "rollbacks": 1},
+           "provenance": {"name": "fc1_weight", "kind": "grad",
+                          "step": 9},
+           "health_rows": [{"step": 9, "loss": 1.2, "grad_norm": 3.3,
+                            "uw_max": 1e-4, "nonfinite": 4,
+                            "bad_tensor": "fc1_weight", "skips": 2,
+                            "rollbacks": 1}]}
+    out = render_numerics(rec)
+    assert "overhead 1.50%" in out and "PASS" in out
+    assert "fc1_weight" in out and "2 skipped steps, 1 rollbacks" in out
+    assert "first bad tensor fc1_weight (grad, step 9)" in out
+    assert "model-health rows" in out
+
+
+def test_render_numerics_incomplete_safe():
+    from trace_report import render_health_rows, render_numerics
+
+    out = render_numerics({"incomplete": "child timed out"})
+    assert out.startswith("numerics: INCOMPLETE")
+    assert render_health_rows([]) == ""
+    # None-valued fields (a fetch before any loss head) must not crash
+    assert "-" in render_health_rows([{"step": 1, "loss": None}])
+
+
+def test_numerics_view_cli(tmp_path, capsys):
+    from trace_report import main as report_main
+
+    path = tmp_path / "NUMWATCH_health.json"
+    path.write_text(json.dumps({
+        "overhead_pct": 0.5, "baseline_step_ms": 10.0,
+        "armed_step_ms": 10.05, "dispatches_per_step": 1.0,
+        "fused_recompiles": 1, "overhead_ok": True, "tensors": [],
+        "guard": {"skipped": 0, "rollbacks": 0}}))
+    assert report_main(["--view", "numerics", str(path)]) == 0
+    assert "overhead 0.50%" in capsys.readouterr().out
+    assert report_main(["--view", "numerics",
+                        str(tmp_path / "missing.json")]) == 1
+
+
+def test_flight_recorder_dumps_health_ring(tel, monkeypatch, tmp_path):
+    """A crash dump must carry the model's numeric trajectory
+    (numwatch.jsonl) next to steps.jsonl."""
+    mod, fused, plane, metric, batches = _manual(monkeypatch)
+    fused.step(batches[0], metric)
+    plane.after_step()
+    fr = tracing.FlightRecorder(crash_dir=str(tmp_path))
+    d = fr.dump("test")
+    assert d is not None
+    rows = [json.loads(line) for line in
+            open(os.path.join(d, "numwatch.jsonl"))]
+    assert rows and rows[-1]["grad_norm"] > 0

@@ -140,7 +140,8 @@ ARTIFACTS = ["BENCH_watch.json", ".bench_cache.json",
              "MULTICHIP_scaling.json", "SERVE_bench.json",
              "AUTOTUNE_search.json", ".autotune_cache.json",
              "FLEET_bench.json", "FLEET_trace.json",
-             "OBS_fleet.json", "BENCH_GATE.json"]
+             "OBS_fleet.json", "NUMWATCH_health.json",
+             "BENCH_GATE.json"]
 
 
 def tpu_consistency_verdict(out, stamp):
@@ -423,6 +424,23 @@ def fire():
             json.dump(fleet_rec, f, indent=2, sort_keys=True)
             f.write("\n")
         _commit("socket fleet stamp", stamp)
+
+    # 9c. numerics observability tier: the fused step timed with the
+    # numwatch stats pack off vs armed (paired windows), the one-
+    # dispatch/one-trace proof, and the per-tensor health table ->
+    # NUMWATCH_health.json, which the gate checks against the 3%
+    # overhead contract. Same INCOMPLETE contract: bench.py stamps its
+    # own record when the child dies; a wedged orchestrator gets one
+    # written here.
+    out = _run([py, os.path.join(REPO, "bench.py"), "numwatch"], 1200)
+    if out is None:
+        with open(os.path.join(REPO, "NUMWATCH_health.json"), "w") as f:
+            json.dump({"metric": "numwatch_overhead_pct", "value": 0,
+                       "incomplete": "chip_watch numerics stage timed "
+                                     "out or crashed",
+                       "chip_watch_stamp": stamp}, f)
+            f.write("\n")
+    _commit("numerics observability", stamp)
 
     # stage 10: the perf-regression gate over everything the window
     # just produced. Same INCOMPLETE contract: bench_gate itself treats

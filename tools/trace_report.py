@@ -715,6 +715,83 @@ def render_fleet_health(rec):
     return "\n".join(out) + "\n"
 
 
+def render_health_rows(rows, top=10):
+    """The last-K numwatch health rows (a crash dump's numwatch.jsonl):
+    the model's numeric trajectory into the failure."""
+    if not rows:
+        return ""
+    out = ["last-%d model-health rows (numwatch fetches):" % min(
+        len(rows), top)]
+    t = [("step", "loss", "grad_norm", "uw_max", "nonfinite",
+          "bad_tensor", "skips", "rollbacks")]
+
+    def _f(v, fmt="%.4g"):
+        if v is None:
+            return "-"
+        try:
+            return fmt % v
+        except TypeError:
+            return str(v)
+
+    for r in rows[-top:]:
+        t.append((str(r.get("step", "?")), _f(r.get("loss")),
+                  _f(r.get("grad_norm")), _f(r.get("uw_max")),
+                  str(r.get("nonfinite", 0)),
+                  str(r.get("bad_tensor") or "-"),
+                  str(r.get("skips", 0)), str(r.get("rollbacks", 0))))
+    out += _table(t)
+    return "\n".join(out) + "\n"
+
+
+def render_numerics(rec):
+    """Numerics view over a NUMWATCH_health.json artifact: the per-
+    tensor health table (norm / max-abs / nonfinite / zero-frac /
+    update-to-weight ratio), the measured stats-on overhead and the
+    one-dispatch proof, the guard counters, and the provenance verdict
+    when something went nonfinite. INCOMPLETE-safe: a stamped-
+    incomplete record renders its marker instead of crashing."""
+    if rec.get("incomplete"):
+        return "numerics: INCOMPLETE: %s\n" % rec["incomplete"]
+    out = ["numerics: stats-on overhead %.2f%% (baseline %.3f ms -> "
+           "armed %.3f ms per fused step)"
+           % (rec.get("overhead_pct") or 0,
+              rec.get("baseline_step_ms") or 0,
+              rec.get("armed_step_ms") or 0)]
+    out.append("  dispatches/step %.3f   fused_recompiles %s   "
+               "overhead gate (<=3%%): %s"
+               % (rec.get("dispatches_per_step") or 0,
+                  rec.get("fused_recompiles", "?"),
+                  "PASS" if rec.get("overhead_ok") else "FAIL"))
+    out.append("")
+    tensors = rec.get("tensors") or []
+    if tensors:
+        rows = [("tensor", "grad_l2", "grad_maxabs", "nonfinite",
+                 "zero_frac", "uw_ratio")]
+        for t in tensors:
+            rows.append((str(t.get("name")),
+                         "%.4g" % (t.get("grad_l2") or 0),
+                         "%.4g" % (t.get("grad_maxabs") or 0),
+                         str(t.get("nonfinite", 0)),
+                         "%.3f" % (t.get("zero_frac") or 0),
+                         "%.3g" % (t.get("uw_ratio") or 0)))
+        out.append("per-tensor health (forward order):")
+        out += _table(rows)
+        out.append("")
+    guard = rec.get("guard") or {}
+    out.append("guard: %s skipped steps, %s rollbacks"
+               % (guard.get("skipped", 0), guard.get("rollbacks", 0)))
+    prov = rec.get("provenance")
+    if prov:
+        out.append("provenance: first bad tensor %s (%s, step %s)"
+                   % (prov.get("name"), prov.get("kind"),
+                      prov.get("step")))
+    health = rec.get("health_rows") or []
+    if health:
+        out.append("")
+        out.append(render_health_rows(health).rstrip())
+    return "\n".join(out) + "\n"
+
+
 # ---------------------------------------------------------------------------
 # distributed-trace views (dtrace span trees in a merged chrome trace)
 # ---------------------------------------------------------------------------
@@ -1070,6 +1147,11 @@ def report_crash_dump(dump_dir, top=10):
         ckpt = render_ckpt(tel)
         if ckpt:
             out.append(ckpt)
+    nw_path = os.path.join(dump_dir, "numwatch.jsonl")
+    if os.path.exists(nw_path):
+        health = render_health_rows(load_records(nw_path), top=top)
+        if health:
+            out.append(health)
     out.append(render_events(events))
     return "\n".join(out)
 
@@ -1086,7 +1168,7 @@ def main(argv=None):
     p.add_argument("--view", default="steps",
                    choices=("steps", "compile", "ops", "memory", "bench",
                             "serve", "fleet", "fleet-health", "wire",
-                            "tune", "waterfall"),
+                            "tune", "waterfall", "numerics"),
                    help="steps (default): slowest-step trace table; "
                         "compile/ops/memory/bench: xprof views over a "
                         "BENCH record file; serve: latency decomposition "
@@ -1104,7 +1186,9 @@ def main(argv=None):
                         "distributed trace as an indented span tree "
                         "(path = trace id, resolved against "
                         "FLEET_trace.json in the repo root, or a "
-                        "chrome-trace file)")
+                        "chrome-trace file); numerics: per-tensor "
+                        "model-health table + overhead verdict over a "
+                        "NUMWATCH_health.json artifact (path optional)")
     p.add_argument("--profile-report", action="store_true",
                    help="auto-discover the newest BENCH / chip_watch "
                         "artifacts in the repo root and render the "
@@ -1171,6 +1255,22 @@ def main(argv=None):
                              "artifact %s\n" % path)
             return 0
         sys.stdout.write(render_fleet_health(rec))
+        return 0
+    if a.view == "numerics":
+        # path optional: defaults to the repo-root numwatch artifact
+        path = a.path or os.path.join(_repo_root(), "NUMWATCH_health.json")
+        if not os.path.exists(path):
+            sys.stdout.write("no numwatch artifact at %s (run `python "
+                             "bench.py numwatch`)\n" % path)
+            return 1
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except ValueError:
+            sys.stdout.write("numerics: INCOMPLETE: unreadable "
+                             "artifact %s\n" % path)
+            return 0
+        sys.stdout.write(render_numerics(rec))
         return 0
     if a.path is None:
         p.error("path is required unless --profile-report is given")
