@@ -62,6 +62,13 @@ profile-report:
 multichip:
 	python bench.py multichip
 
+# FSDP tier on the same 8 simulated devices, mesh factored
+# dp=2 x fsdp=4: per-device params+opt-state byte ratio, one-dispatch
+# proof, exact-parity witness -> merged under the "fsdp" key of
+# MULTICHIP_scaling.json
+fsdp-bench:
+	python bench.py multichip --fsdp
+
 # continuous-batching serving tier: open-loop Poisson load swept until
 # the tail-latency SLO breaks -> SERVE_bench.json (goodput, p50/p99,
 # batch occupancy, zero-retrace proof)
@@ -131,4 +138,4 @@ obs-gate: lint
 clean:
 	rm -rf mxnet_tpu/_native perl-package/blib
 
-.PHONY: all predict perl test lint profile-report multichip serve-bench fleet-bench net-bench trace-smoke ckpt-test numwatch-test bench-gate obs-gate clean
+.PHONY: all predict perl test lint profile-report multichip fsdp-bench serve-bench fleet-bench net-bench trace-smoke ckpt-test numwatch-test bench-gate obs-gate clean

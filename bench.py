@@ -208,9 +208,14 @@ def main():
     # the multichip dp-scaling tier: measured imgs/sec + scaling
     # efficiency on 8 simulated devices; child routing below via env
     # graft: env-ok
+    if os.environ.get("MXNET_TPU_BENCH_FSDP"):
+        return _bench_fsdp()
+    # graft: env-ok
     if os.environ.get("MXNET_TPU_BENCH_MULTICHIP"):
         return _bench_multichip()
     if "multichip" in sys.argv[1:]:
+        if "--fsdp" in sys.argv[1:]:
+            return _fsdp_main()
         return _multichip_main()
     # the serving tier: continuous-batching inference under open-loop
     # Poisson load on the 8-device mesh ("serve" before the generic
@@ -715,9 +720,282 @@ def _multichip_main():
                   "incomplete": "multichip bench child failed/timed out"}
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "MULTICHIP_scaling.json")
+    # a prior `--fsdp` run's record rides along: the two tiers share
+    # the artifact, and a plain dp-scaling rerun must not drop it
+    try:
+        with open(out) as f:
+            prev = json.load(f)
+        if isinstance(prev, dict) and "fsdp" in prev:
+            result.setdefault("fsdp", prev["fsdp"])
+    except (OSError, ValueError):
+        pass
     try:
         with open(out, "w") as f:
             json.dump(result, f, indent=2, sort_keys=True)
+            f.write("\n")
+    except OSError:
+        pass
+    print(json.dumps(result))
+    return result
+
+
+def _pack_bytes_per_device(mod):
+    """Bytes of params + optimizer state RESIDENT ON DEVICE 0 (summed
+    over its shards): the quantity FSDP divides by the fsdp axis size.
+    A replicated array contributes its full size (one copy per device);
+    an fsdp-sharded one contributes 1/fsdp of it."""
+    import jax
+
+    dev0 = jax.devices()[0]
+
+    def on_dev(arr):
+        shards = getattr(arr, "addressable_shards", None)
+        if shards:
+            return sum(int(s.data.nbytes) for s in shards
+                       if s.device == dev0)
+        return int(getattr(arr, "nbytes", 0))
+
+    ex = mod._exec_group.executor
+    total = 0
+    for n in mod._param_names:
+        if n in ex.arg_dict:
+            total += on_dev(ex.arg_dict[n]._data)
+    updater = getattr(mod, "_updater", None)
+    states = updater.states if updater is not None else {}
+    for leaf in jax.tree_util.tree_leaves(states):
+        data = getattr(leaf, "_data", None)
+        if data is not None:
+            total += on_dev(data)
+    return total
+
+
+def _fsdp_tier(fsdp, per_device_batch=32, dim=128, hidden=256,
+               nbatches=16, epochs=2):
+    """One measured mesh factoring of the SAME model/batch as the
+    multichip tier, with momentum SGD so real optimizer state exists to
+    shard: ``fsdp<=1`` is the replicated dp-only baseline, ``fsdp>1``
+    reshapes the grid into ``(dp, fsdp)`` and the params + momentum
+    shard along ``fsdp``. Returns throughput, per-device pack bytes,
+    the fused site's per-partition memory_analysis, dispatch count and
+    the collective breakdown (with per-opcode sub-buckets)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry, xprof
+
+    import jax
+
+    n_dev = len(jax.devices())
+    # graft: env-ok (child process; the registry re-reads os.environ)
+    if fsdp > 1:
+        os.environ["MXNET_TPU_MESH_FSDP"] = str(fsdp)
+    else:
+        os.environ.pop("MXNET_TPU_MESH_FSDP", None)
+    try:
+        gb = n_dev * per_device_batch
+        rng = np.random.RandomState(11)
+        X = rng.rand(gb * nbatches, dim).astype(np.float32)
+        y = rng.randint(0, 4, (gb * nbatches,)).astype(np.float32)
+        it = mx.io.NDArrayIter(X, y, batch_size=gb)
+        net = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(net, num_hidden=hidden, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=hidden, name="fc2")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=4, name="fc3")
+        net = mx.sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.mod.Module(net,
+                            context=[mx.cpu(i) for i in range(n_dev)])
+        telemetry.enable()
+        before = telemetry.peek("step.dispatches") or 0
+        xprof.enable()
+        xprof.reset()
+        t0 = time.perf_counter()
+        mod.fit(it, num_epoch=epochs, kvstore="device_sync",
+                optimizer="sgd",
+                optimizer_params={"learning_rate": 0.05,
+                                  "momentum": 0.9})
+        elapsed = time.perf_counter() - t0
+        steps = epochs * nbatches
+        xp = xprof.summary()
+        compile_s = xp["totals"]["compile_time_s"]
+        measured = max(elapsed - compile_s, 1e-9)
+        dispatches = ((telemetry.peek("step.dispatches") or 0)
+                      - before) / float(steps)
+        tier = {"fsdp": fsdp if fsdp > 1 else 1,
+                "dp": n_dev // fsdp if fsdp > 1 else n_dev,
+                "global_batch": gb, "steps": steps,
+                "imgs_per_sec": round(steps * gb / measured, 1),
+                "step_ms": round(measured / steps * 1e3, 3),
+                "compile_time_s": round(compile_s, 3),
+                "dispatches_per_step": round(dispatches, 2),
+                "param_opt_bytes_per_device":
+                    _pack_bytes_per_device(mod)}
+        site = ((xp["sites"].get("fused_step") or {}).get("last")
+                or {})
+        mem = {k: site.get(k) for k in
+               ("argument_bytes", "temp_bytes", "peak_bytes")
+               if site.get(k) is not None}
+        if mem:
+            # memory_analysis is per-partition under SPMD: these are
+            # the bytes ONE device holds for the fused executable
+            tier["memory_analysis_per_device"] = mem
+        bd = site.get("op_breakdown") or {}
+        c = bd.get("collective")
+        if c:
+            total_by = sum(v.get("bytes", 0) for v in bd.values())
+            tier["collective"] = {
+                "ops": c.get("count", 0),
+                "byte_fraction": round(c.get("bytes", 0) / total_by, 4)
+                if total_by else 0.0,
+                "by_op": {op: dict(v) for op, v in
+                          (c.get("by_op") or {}).items()}}
+        return tier
+    finally:
+        os.environ.pop("MXNET_TPU_MESH_FSDP", None)
+
+
+def _fsdp_parity_probe(fsdp, nbatches=4):
+    """Exact-arithmetic witness that the ZeRO exchange is the same
+    mean: a linear head on integer data with quarter-integer seed
+    weights keeps every product/psum/update a dyadic rational, so the
+    dp-only and (dp, fsdp) loss streams and final params must match
+    BIT FOR BIT — any rescale or reduce-order bug shows as inequality,
+    not as noise."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import symbol as sym
+    from mxnet_tpu.module import Module
+
+    import jax
+
+    n_dev = len(jax.devices())
+    batch, dim, hid = n_dev, 4, 8   # 1 row per shard; hid % fsdp == 0
+
+    def run(use_fsdp):
+        # graft: env-ok (child process; registry re-reads os.environ)
+        if use_fsdp:
+            os.environ["MXNET_TPU_MESH_FSDP"] = str(fsdp)
+        else:
+            os.environ.pop("MXNET_TPU_MESH_FSDP", None)
+        try:
+            rng = np.random.RandomState(5)
+            X = rng.randint(0, 2, (batch * nbatches, dim)) \
+                .astype(np.float32)
+            # binary labels: with an 8-wide head the mantissa grows
+            # ~6 bits/step, so 0..3 labels overflow float32 by step 4
+            y = rng.randint(0, 2, (batch * nbatches, hid)) \
+                .astype(np.float32)
+            net = sym.Variable("data")
+            net = sym.FullyConnected(net, num_hidden=hid, name="fc1")
+            net = mx.sym.LinearRegressionOutput(net, name="lro")
+            arg_shapes, _, _ = net.infer_shape(
+                data=(batch, dim), lro_label=(batch, hid))
+            prng = np.random.RandomState(9)
+            seed = {name: mx.nd.array(
+                (prng.randint(-2, 3, shape) * 0.5).astype(np.float32))
+                for name, shape in zip(net.list_arguments(),
+                                       arg_shapes)
+                if name not in ("data", "lro_label")}
+            it = mx.io.NDArrayIter(X, y, batch_size=batch,
+                                   label_name="lro_label")
+            mod = Module(net,
+                         context=[mx.cpu(i) for i in range(n_dev)],
+                         label_names=("lro_label",))
+            stream = []
+
+            def cb(param):
+                stream.append(round(dict(
+                    param.eval_metric.get_name_value())["mse"], 10))
+
+            mod.fit(it, num_epoch=1, kvstore="device_sync",
+                    eval_metric="mse", optimizer="sgd",
+                    arg_params=seed, initializer=None,
+                    optimizer_params={"learning_rate": 0.5},
+                    batch_end_callback=cb)
+            args, _ = mod.get_params()
+            return stream, {n: a.asnumpy() for n, a in args.items()}
+        finally:
+            os.environ.pop("MXNET_TPU_MESH_FSDP", None)
+
+    ref_stream, ref_params = run(False)
+    sh_stream, sh_params = run(True)
+    params_equal = (set(ref_params) == set(sh_params) and all(
+        np.array_equal(ref_params[n], sh_params[n])
+        for n in ref_params))
+    return {"loss_stream_dp": ref_stream,
+            "loss_stream_fsdp": sh_stream,
+            "loss_stream_equal": ref_stream == sh_stream,
+            "params_bit_identical": bool(params_equal)}
+
+
+def _bench_fsdp():
+    """Measured FSDP tier (``bench.py multichip --fsdp``): the same
+    8-device mesh factored ``dp=8`` (replicated baseline) vs
+    ``dp=2 x fsdp=4`` (params + momentum sharded). The headline metric
+    is the per-device params+opt-state byte ratio — ~1/fsdp when every
+    array's dim 0 divides — plus the one-dispatch proof, the collective
+    op evidence (all-gather/reduce-scatter emitted by GSPMD inside the
+    donated jit) and the exact-arithmetic parity witness."""
+    import jax
+
+    from mxnet_tpu import telemetry
+
+    os.environ["MXNET_TPU_XPROF_OPS"] = "1"
+    n_dev = len(jax.devices())
+    fsdp = 4 if n_dev % 4 == 0 else (2 if n_dev % 2 == 0 else 1)
+    # throwaway warmup (same reason as the multichip tier)
+    _fsdp_tier(1, nbatches=4, epochs=1)
+    rep = _fsdp_tier(1)
+    sh = _fsdp_tier(fsdp)
+    ratio = (sh["param_opt_bytes_per_device"]
+             / float(rep["param_opt_bytes_per_device"] or 1))
+    parity = _fsdp_parity_probe(fsdp)
+    result = {"metric": "fsdp_param_bytes_ratio",
+              "value": round(ratio, 4), "unit": "ratio",
+              "platform": jax.devices()[0].platform,
+              "n_devices": n_dev, "fsdp": fsdp,
+              "kvstore": "device_sync",
+              "param_bytes_ratio": round(ratio, 4),
+              "dispatches_per_step": sh["dispatches_per_step"],
+              "replicated": rep, "sharded": sh,
+              "parity": parity,
+              "telemetry":
+                  {"step": telemetry.snapshot().get("step", {})}}
+    if sh.get("collective"):
+        result["collective"] = sh["collective"]
+    print(json.dumps(result))
+    return result
+
+
+def _fsdp_main():
+    """Orchestrator for ``bench.py multichip --fsdp``: run the FSDP
+    tier in a child forced onto 8 simulated cpu devices and MERGE the
+    record under the ``fsdp`` key of MULTICHIP_scaling.json (the plain
+    multichip record stays whatever the last plain run wrote). Never
+    imports jax itself."""
+    # graft: env-ok
+    timeout_s = int(os.environ.get("MXNET_TPU_BENCH_TIMEOUT", 1800))
+    # graft: env-ok
+    xla = os.environ.get("XLA_FLAGS", "")
+    result = _run_child({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS":
+            (xla + " --xla_force_host_platform_device_count=8").strip(),
+        "MXNET_TPU_BENCH_FSDP": "1",
+    }, timeout_s)
+    if result is None:
+        result = {"metric": "fsdp_param_bytes_ratio", "value": 0,
+                  "incomplete": "fsdp bench child failed/timed out"}
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "MULTICHIP_scaling.json")
+    record = {}
+    try:
+        with open(out) as f:
+            record = json.load(f)
+    except (OSError, ValueError):
+        record = {}
+    record["fsdp"] = result
+    try:
+        with open(out, "w") as f:
+            json.dump(record, f, indent=2, sort_keys=True)
             f.write("\n")
     except OSError:
         pass

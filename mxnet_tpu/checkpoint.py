@@ -180,20 +180,24 @@ def _metric_leaves(eval_metric):
     return [eval_metric]
 
 
-def _place_states(group, obj):
+def _place_states(group, obj, name=None):
     """Numpy optimizer-state tree -> NDArrays placed like fresh-created
-    states (replicated on the group's mesh / pinned to its device):
+    states (param-sharded on an fsdp mesh, replicated otherwise):
     identical avals+shardings to ``_zeros_like_state``, so the fused
     step's next dispatch reuses its compiled executable — restore must
-    never grow the trace cache."""
+    never grow the trace cache. ``name`` is the owning param: leaves
+    with the param's shape inherit its sharding (the opt-state
+    contract), odd-shaped leaves replicate."""
     if isinstance(obj, np.ndarray):
+        if name is not None and hasattr(group, "place_like_param"):
+            return group.place_like_param(name, obj)
         return group._place(obj, None)
     if isinstance(obj, tuple):
-        return tuple(_place_states(group, o) for o in obj)
+        return tuple(_place_states(group, o, name) for o in obj)
     if isinstance(obj, list):
-        return [_place_states(group, o) for o in obj]
+        return [_place_states(group, o, name) for o in obj]
     if isinstance(obj, dict):
-        return {k: _place_states(group, v) for k, v in obj.items()}
+        return {k: _place_states(group, v, name) for k, v in obj.items()}
     return obj
 
 
@@ -215,6 +219,14 @@ def snapshot(module, eval_metric=None, train_data=None, *, step: int = 0,
         "nbatch": int(nbatch), "dp": len(group.contexts),
         "time": round(time.time(), 3),
     }
+    # named mesh axes ("dp" alone, or "dp"+"fsdp") so a resume can log
+    # exactly which factoring the state re-shards from; "dp" above stays
+    # the total device count for snapshots/readers that predate the
+    # multi-axis mesh
+    if getattr(group, "_mesh", None) is not None:
+        from .parallel.sharding import mesh_axis_sizes
+
+        payload["mesh"] = mesh_axis_sizes(group._mesh)
     with _san.intentional_transfer():
         payload["params"] = {
             n: _fetch(ex.arg_dict[n]._data)
@@ -261,12 +273,14 @@ def restore(payload: Dict[str, Any], module, eval_metric=None,
             train_data=None) -> Dict[str, Any]:
     """Rebuild a :func:`snapshot` payload onto the module's CURRENT
     mesh. Every array re-enters the device through the executor group's
-    own ``_place`` with the placement fresh init uses (params/opt-state/
-    metric accs replicated, batch-independent) — so a snapshot saved at
-    a different dp count re-shards without retracing, and a same-dp
-    resume reuses every compiled executable. Assignments go into the
-    executor's existing NDArrays in place, so the fused step's
-    pre-derived packs see the restored values."""
+    own placement helpers with the placement fresh init uses (params and
+    opt-state fsdp-sharded on a ``(dp, fsdp)`` mesh, replicated
+    otherwise; metric accs replicated, batch-independent) — so a
+    snapshot saved on a different mesh factoring (dp-only, or another
+    fsdp size) re-shards without retracing, and a same-mesh resume
+    reuses every compiled executable. Assignments go into the executor's
+    existing NDArrays in place, so the fused step's pre-derived packs
+    see the restored values."""
     import jax.numpy as jnp
 
     group = module._exec_group
@@ -278,10 +292,20 @@ def restore(payload: Dict[str, Any], module, eval_metric=None,
                               % (payload.get("format"),))
     saved_dp = int(payload.get("dp") or 0)
     cur_dp = len(group.contexts)
-    if saved_dp and saved_dp != cur_dp:
-        _log.info("elastic rejoin: snapshot saved at dp=%d restoring "
-                  "onto dp=%d (replicated state re-shards; no retrace)",
-                  saved_dp, cur_dp)
+    saved_mesh = payload.get("mesh") or ({"dp": saved_dp} if saved_dp
+                                         else {})
+    cur_mesh = {}
+    if getattr(group, "_mesh", None) is not None:
+        from .parallel.sharding import mesh_axis_sizes
+
+        cur_mesh = mesh_axis_sizes(group._mesh)
+    if saved_dp and (saved_dp != cur_dp or saved_mesh != cur_mesh):
+        _log.info("elastic rejoin: snapshot saved on mesh %s restoring "
+                  "onto %s (params/opt-state re-shard through host "
+                  "numpy; no retrace)",
+                  "x".join("%s=%d" % kv for kv in saved_mesh.items()),
+                  "x".join("%s=%d" % kv for kv in cur_mesh.items())
+                  or "dp=%d" % cur_dp)
     aux_by_name = dict(zip(group.aux_names, ex.aux_arrays))
     with _san.intentional_transfer():
         for name, val in payload["params"].items():
@@ -295,7 +319,10 @@ def restore(payload: Dict[str, Any], module, eval_metric=None,
                     "snapshot param '%s' shape %s does not match bound "
                     "shape %s" % (name, tuple(val.shape),
                                   tuple(arr.shape)))
-            arr._data = group._place(val, None)._data
+            if hasattr(group, "place_param"):
+                arr._data = group.place_param(name, val)._data
+            else:
+                arr._data = group._place(val, None)._data
         for name, val in payload.get("aux", {}).items():
             arr = aux_by_name.get(name)
             if arr is None:
@@ -306,8 +333,20 @@ def restore(payload: Dict[str, Any], module, eval_metric=None,
         updater = getattr(module, "_updater", None)
         if payload.get("updater_states") is not None \
                 and updater is not None:
-            updater.states = _place_states(group,
-                                           payload["updater_states"])
+            # states are keyed by param index: place each subtree with
+            # its OWNING param's sharding so momentum/variance land
+            # fsdp-sharded next to their weight shard
+            names = list(getattr(module, "_param_names", ()) or ())
+            states = payload["updater_states"]
+            if isinstance(states, dict):
+                updater.states = {
+                    k: _place_states(
+                        group, v,
+                        names[k] if isinstance(k, int)
+                        and 0 <= k < len(names) else None)
+                    for k, v in states.items()}
+            else:
+                updater.states = _place_states(group, states)
         optimizer = getattr(module, "_optimizer", None)
         if payload.get("optimizer") is not None and optimizer is not None:
             optimizer.set_checkpoint_state(payload["optimizer"])
@@ -418,14 +457,17 @@ class SnapshotStore:
                                          self._seq)
         atomic_write_bytes(os.path.join(self.dir, fname), blob)
         manifest = self._read_manifest()
-        manifest["snapshots"].append({
+        entry = {
             "file": fname, "step": int(payload.get("step", 0)),
             "epoch": int(payload.get("epoch", 0)),
             "nbatch": int(payload.get("nbatch", -1)),
             "dp": int(payload.get("dp", 0)),
             "sha256": digest, "bytes": len(blob),
             "time": round(time.time(), 3), "reason": reason,
-        })
+        }
+        if payload.get("mesh"):
+            entry["mesh"] = payload["mesh"]
+        manifest["snapshots"].append(entry)
         drop = manifest["snapshots"][:-self.keep]
         manifest["snapshots"] = manifest["snapshots"][-self.keep:]
         # manifest LAST, and only ever pointing at fully-written files

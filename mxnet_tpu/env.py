@@ -146,6 +146,27 @@ declare("MXNET_TPU_FUSED_UPDATE", bool, True,
         "kernel (one XLA call per param group); also disables the fused "
         "train step, which builds on it.",
         section="Fused train step")
+declare("MXNET_TPU_MESH_FSDP", int, 0,
+        "Size of the `fsdp` mesh axis. 0/1 keeps the single-axis `dp` "
+        "mesh (every device a data-parallel replica, params and "
+        "optimizer state fully replicated). N>1 reshapes the device "
+        "grid into a named `(dp, fsdp)` mesh (device count must divide "
+        "by N): the batch shards over `dp x fsdp` as before, while "
+        "params and optimizer-state packs NamedSharding-shard along "
+        "`fsdp` (ZeRO-3 style) — GSPMD emits the all-gather before the "
+        "forward and the reduce-scatter of the gradients INSIDE the one "
+        "donated fused dispatch, so per-device params+opt-state bytes "
+        "drop ~1/N and `dispatches_per_step` stays 1.0. See \"Sharding "
+        "the model\" in `performance.md`.",
+        section="Multi-axis mesh / FSDP")
+declare("MXNET_TPU_FSDP_PARAMS", bool, True,
+        "Escape hatch for the FSDP recipe: set to 0 to keep params and "
+        "optimizer state fully replicated even on a `(dp, fsdp)` mesh "
+        "(the batch still shards over both axes — behaviourally plain "
+        "data parallelism, for bisecting a sharding suspicion without "
+        "changing the mesh shape). Params whose leading dimension does "
+        "not divide by the `fsdp` axis size replicate regardless.",
+        section="Multi-axis mesh / FSDP")
 declare("MXNET_TPU_ENGINE_SYNC", bool, False,
         "Re-enable the engine's `block_until_ready` on fused-step "
         "results. The fused step normally skips that block (its outputs "

@@ -124,10 +124,14 @@ def make_fused_step(module, eval_metric):
                          "needs an inline engine (MXNET_ENGINE_TYPE="
                          "XLAEngine or NaiveEngine)")
     if kv is not None and not getattr(kv, "fused_step_compatible", False):
-        return _fallback(module, "dist_kvstore",
-                         "kvstore %r moves gradient bytes between "
-                         "dispatches; use a local/device/device_sync "
-                         "store to fuse" % kv.type)
+        # a kvstore that knows WHY it can't fuse names the surviving
+        # host path (dist_host_exchange / dist_async_host) so the
+        # telemetry points at the actual byte movement, not just "dist"
+        reason, detail = getattr(kv, "fused_fallback", None) or (
+            "dist_kvstore",
+            "kvstore %r moves gradient bytes between dispatches; use a "
+            "local/device/device_sync store to fuse" % kv.type)
+        return _fallback(module, reason, detail)
     if module.inputs_need_grad:
         return _fallback(module, "inputs_need_grad",
                          "inputs_need_grad=True requires materialized "
@@ -497,20 +501,35 @@ class FusedTrainStep:
         ex = self._executor
         run_graph = ex._run_graph
         n_args = len(ex.arg_names)
-        # in-jit gradient exchange: with the batch sharded over the dp
-        # mesh axis and params replicated, pinning each vjp gradient to
-        # a replicated NamedSharding makes GSPMD lower the exchange to a
-        # mean-psum all-reduce INSIDE this dispatch (rescale_grad is
-        # 1/global_batch, so the sum over shards is the mean). Without
-        # the constraint the partitioner may defer the reduce into the
-        # update — correct but unpinned; with it the collective is a
-        # guaranteed, xprof-visible op between backward and update.
-        grad_sharding = None
+        # in-jit gradient exchange: with the batch sharded over the
+        # mesh's data axes, pinning each vjp gradient to its PARAM's
+        # sharding makes GSPMD lower the exchange INSIDE this dispatch
+        # (rescale_grad is 1/global_batch, so the sum over shards is the
+        # mean). A replicated param gets a mean-psum all-reduce; an
+        # fsdp-sharded param gets the ZeRO reduce-scatter (each device
+        # keeps only its shard of the reduced grad, then updates only
+        # its shard of the param/opt-state). Without the constraint the
+        # partitioner may defer the reduce into the update — correct but
+        # unpinned; with it the collective is a guaranteed,
+        # xprof-visible op between backward and update. The kvstore's
+        # reduce spec (DeviceSyncKVStore.grad_reduce_sharding) owns the
+        # mapping so future recipes can widen it without touching this.
+        grad_shardings = None
         mesh = getattr(self._group, "_mesh", None)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            grad_sharding = NamedSharding(mesh, PartitionSpec())
+            rep = NamedSharding(mesh, PartitionSpec())
+            kv = getattr(self._module, "_kvstore", None)
+            reduce_spec = getattr(kv, "grad_reduce_sharding", None)
+            grad_shardings = []
+            param_shardings = []
+            for i in self._p_arg_idx:
+                ps = self._group.param_sharding(ex.arg_names[i]) or rep
+                param_shardings.append(ps)
+                if reduce_spec is not None:
+                    ps = reduce_spec(mesh, ps) or ps
+                grad_shardings.append(ps)
         p_idx = list(self._p_arg_idx)
         o_idx = list(self._o_arg_idx)
         label_pos = list(self._label_o_pos)
@@ -554,9 +573,9 @@ class FusedTrainStep:
                      else zero_cotangent(o) for o in outs]
             cts = (heads, jax.tree_util.tree_map(zero_cotangent, aux_out))
             grads, = vjp(cts)
-            if grad_sharding is not None:
-                grads = [jax.lax.with_sharding_constraint(g, grad_sharding)
-                         for g in grads]
+            if grad_shardings is not None:
+                grads = [jax.lax.with_sharding_constraint(g, s)
+                         for g, s in zip(grads, grad_shardings)]
             new_p = list(p_vals)
             new_st = []
             for gi, (kind, n_states, positions) in enumerate(specs):
@@ -568,6 +587,12 @@ class FusedTrainStep:
                     new_p[pos] = nw
                     grp.append(ns)
                 new_st.append(tuple(grp))
+            if grad_shardings is not None:
+                # keep the updated params on their (fsdp) shardings so
+                # GSPMD never gathers them just to re-scatter on entry
+                # to the next step
+                new_p = [jax.lax.with_sharding_constraint(p, s)
+                         for p, s in zip(new_p, param_shardings)]
             new_accs = accs
             labels = [o_vals[p] for p in label_pos]
             if fold:

@@ -321,6 +321,43 @@ class KVStoreDist(KVStore):
         reduced = self._dist.all_reduce_np(local.asnumpy())
         return nd_array(reduced, ctx=local.context)
 
+    @property
+    def fused_step_compatible(self) -> bool:
+        """A single-process ``dist_sync`` world has no cross-process
+        hop: its reduce IS the local device reduce, which the fused
+        step's in-jit GSPMD exchange subsumes exactly like
+        ``device_sync``. With real workers the host ``all_reduce_np``
+        round (process_allgather + numpy sum) survives between
+        dispatches, and the classic loop must keep it."""
+        return self.num_workers <= 1
+
+    @property
+    def in_jit_gradient_exchange(self) -> bool:
+        """Single-process ``dist_sync`` rides the device_sync in-jit
+        exchange path by default (same contract: batch sharded over the
+        mesh's data axes, gradients pinned to the kvstore reduce spec
+        inside the one donated dispatch)."""
+        return self.num_workers <= 1
+
+    @property
+    def fused_fallback(self):
+        """(reason, detail) naming the surviving host path when the
+        fused step cannot subsume this store — telemetry then counts
+        ``step.fused_fallback.dist_host_exchange`` instead of a generic
+        dist bucket."""
+        if self.num_workers <= 1:
+            return None
+        return ("dist_host_exchange",
+                "dist_sync with %d workers exchanges gradients "
+                "host-side (all_reduce_np: process_allgather + numpy "
+                "sum) between dispatches; the in-jit GSPMD exchange "
+                "only spans the local mesh" % self.num_workers)
+
+    def grad_reduce_sharding(self, mesh, param_sharding):
+        """Reduce spec for the in-jit exchange (single-process world):
+        identical to :meth:`DeviceSyncKVStore.grad_reduce_sharding`."""
+        return param_sharding
+
     def barrier(self):
         self._dist.barrier()
 
@@ -426,6 +463,21 @@ class KVStoreDistAsync(KVStore):
             for o in olist:
                 src.copyto(o)
 
+    @property
+    def fused_step_compatible(self) -> bool:
+        return False
+
+    @property
+    def fused_fallback(self):
+        """Async push/pull is host-side TCP by construction (Hogwild
+        staleness has no collective analogue) — name the path precisely
+        in the fallback telemetry."""
+        return ("dist_async_host",
+                "dist_async pushes/pulls through the host TCP "
+                "parameter server (parallel/ps.py); asynchronous "
+                "staleness semantics have no in-jit collective "
+                "analogue")
+
     def barrier(self):
         self._client.call("barrier")
 
@@ -474,13 +526,15 @@ class DeviceSyncKVStore(TPUSyncKVStore):
     the gradient exchange INSIDE the donated fused jit. The store keeps
     the push/pull API (jitted tree-sum reduce) for explicit use, but its
     training-path contract is different: the module shards the batch
-    over the executor group's ``dp`` mesh axis, replicates params and
-    optimizer state, and the fused step pins the vjp gradients to a
-    replicated ``NamedSharding`` — GSPMD lowers that to a mean-``psum``
-    all-reduce between backward and update, one collective per step,
-    zero extra dispatches. This is the TPU-native answer to the
-    reference's ps-lite push/pull round: bytes move on ICI inside the
-    step instead of host-side between dispatches."""
+    over the executor group's mesh data axes (``dp``, and ``fsdp`` on a
+    multi-axis mesh), and the fused step pins each vjp gradient to the
+    sharding :meth:`grad_reduce_sharding` returns — GSPMD lowers that
+    to the matching collective between backward and update (mean-psum
+    all-reduce for a replicated param, ZeRO reduce-scatter for an
+    fsdp-sharded one), one exchange per step, zero extra dispatches.
+    This is the TPU-native answer to the reference's ps-lite push/pull
+    round: bytes move on ICI inside the step instead of host-side
+    between dispatches."""
 
     def __init__(self, kv_type: str = "device_sync"):
         super().__init__(kv_type)
@@ -493,8 +547,18 @@ class DeviceSyncKVStore(TPUSyncKVStore):
     def in_jit_gradient_exchange(self) -> bool:
         """Marker consulted by ``make_fused_step``: this store asks for
         the fused path by default (no MXNET_TPU_FUSED_STEP opt-in) and
-        for the in-jit replicated-gradient constraint."""
+        for the in-jit gradient constraint."""
         return True
+
+    def grad_reduce_sharding(self, mesh, param_sharding):
+        """The fsdp-aware reduce spec: the exchanged gradient lands on
+        its PARAM's sharding. For a replicated param GSPMD emits the
+        mean-psum all-reduce over every data axis; for an fsdp-sharded
+        param it emits a reduce-scatter (sum over all devices, each
+        keeping only the shard its param/opt-state slice needs) — the
+        ZeRO exchange, chosen per-param with no new dispatch. Future
+        axes (tp/pp/ep) widen this mapping here, not in fused_step."""
+        return param_sharding
 
 
 def create(name: str = "local") -> KVStore:

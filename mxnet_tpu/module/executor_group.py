@@ -4,11 +4,16 @@ TPU-native re-design of the reference's ``DataParallelExecutorGroup``
 (``python/mxnet/module/executor_group.py:68-530``): where the reference
 slices the batch across per-device executors and reduces grads via
 KVStore/Comm, here there is ONE executor whose arrays carry
-``jax.sharding`` placements over a device mesh — data batch-sharded along
-the ``dp`` axis, parameters replicated. XLA GSPMD partitions the jitted
-step and inserts the gradient all-reduce over ICI automatically
-(the ``kvstore='tpu_sync'`` north star: grad reduction fused INTO the
-training step instead of a separate push/pull phase).
+``jax.sharding`` placements over a named multi-axis device mesh — data
+batch-sharded along the data axes (``dp``, and ``fsdp`` when
+``MXNET_TPU_MESH_FSDP`` factors the grid), parameters replicated on a
+``dp`` mesh or ZeRO-style sharded along ``fsdp`` under the FSDP recipe
+(:meth:`param_sharding`). XLA GSPMD partitions the jitted step and
+inserts the collectives over ICI automatically — gradient all-reduce
+for replicated params, all-gather before the forward plus
+reduce-scatter of the grads for sharded ones (the ``kvstore='tpu_sync'``
+north star: the exchange fused INTO the training step instead of a
+separate push/pull phase).
 """
 from __future__ import annotations
 
@@ -51,12 +56,16 @@ class DataParallelExecutorGroup:
             DataDesc.get_batch_axis(self.data_shapes[0].layout)]
 
         self._mesh = None
+        self._param_shardings: Dict[str, object] = {}
+        self._arg_shape: Dict[str, tuple] = {}
         if len(self.contexts) > 1:
             if self.batch_size % len(self.contexts):
                 raise MXNetError(
                     "batch size %d not divisible by %d devices"
                     % (self.batch_size, len(self.contexts)))
             self._mesh = self._make_mesh()
+        from .. import env as _env
+        self._fsdp_params = bool(_env.get("MXNET_TPU_FSDP_PARAMS"))
 
         # grad requests (reference: data grads only if inputs_need_grad)
         reqs: Dict[str, str] = {}
@@ -77,24 +86,97 @@ class DataParallelExecutorGroup:
         # one shared mesh constructor (parallel/sharding.py) so the
         # module path and the explicit-sharding API agree on axis names
         # and device-count validation — the fused step's in-jit gradient
-        # exchange keys off this mesh's "dp" axis
+        # exchange keys off this mesh's data axes. MXNET_TPU_MESH_FSDP=N
+        # factors the device grid into the named (dp, fsdp) mesh; the
+        # axis list stays open for tp/pp/ep recipes later.
+        from .. import env as _env
         from ..parallel.sharding import make_mesh
 
         devices = [c.jax_device() for c in self.contexts]
-        return make_mesh({"dp": len(devices)}, devices=devices)
+        n = len(devices)
+        fsdp = int(_env.get("MXNET_TPU_MESH_FSDP") or 0)
+        if fsdp > 1:
+            if n % fsdp:
+                raise MXNetError(
+                    "MXNET_TPU_MESH_FSDP=%d does not divide the %d-device"
+                    " grid: the (dp, fsdp) mesh needs dp = devices/fsdp "
+                    "to be a whole number" % (fsdp, n))
+            return make_mesh({"dp": n // fsdp, "fsdp": fsdp},
+                             devices=devices)
+        return make_mesh({"dp": n}, devices=devices)
 
     def _sharding(self, batch_axis: Optional[int]):
         """NamedSharding for a batch-sharded (or replicated, axis None)
-        array on the group's mesh."""
+        array on the group's mesh. The batch shards over EVERY data
+        axis (``dp``, and ``fsdp`` when the mesh carries it), so the
+        global batch always splits across all devices."""
         from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.sharding import batch_spec
 
         if self._mesh is None:
             return None
         if batch_axis is None:
             return NamedSharding(self._mesh, P())
-        spec = [None] * (batch_axis + 1)
-        spec[batch_axis] = "dp"
-        return NamedSharding(self._mesh, P(*spec))
+        return NamedSharding(self._mesh, batch_spec(self._mesh,
+                                                    batch_axis))
+
+    # ------------------------------------------------------------------
+    # per-parameter sharding (the FSDP recipe)
+    # ------------------------------------------------------------------
+    def param_sharding(self, name: str):
+        """NamedSharding of param ``name`` (and of its gradient and
+        optimizer state): sharded along the mesh's ``fsdp`` axis when
+        the recipe is armed and the shape divides, replicated
+        otherwise. None on a single-device group. The fused step pins
+        the vjp gradients to exactly these shardings, which is what
+        makes GSPMD lower the gradient exchange to a reduce-scatter
+        (sharded) or all-reduce (replicated) inside the one dispatch."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self._mesh is None:
+            return None
+        cached = self._param_shardings.get(name)
+        if cached is not None:
+            return cached
+        spec = P()
+        shape = self._arg_shape.get(name)
+        if shape is not None and name in self.param_names \
+                and self._fsdp_params:
+            from ..parallel.sharding import fsdp_param_spec
+
+            spec = fsdp_param_spec(shape, self._mesh) or P()
+        sharding = NamedSharding(self._mesh, spec)
+        self._param_shardings[name] = sharding
+        return sharding
+
+    def place_param(self, name: str, np_or_nd, dtype=None) -> NDArray:
+        """``device_put`` a param (or same-shaped optimizer-state leaf)
+        with its :meth:`param_sharding` — the placement fresh init uses,
+        so checkpoint restore re-enters the device bit-identically to a
+        cold bind (same avals + shardings -> no retrace)."""
+        import jax
+
+        sharding = self.param_sharding(name)
+        if sharding is None:
+            return self._place(np_or_nd, None, dtype=dtype)
+        data = np_or_nd._data if isinstance(np_or_nd, NDArray) \
+            else np.asarray(np_or_nd, dtype=dtype)
+        return NDArray(jax.device_put(data, sharding),
+                       ctx=self.contexts[0])
+
+    def place_like_param(self, name: Optional[str], np_or_nd,
+                         dtype=None) -> NDArray:
+        """Place an array with ``name``'s param sharding when the shape
+        matches the param's (the optimizer-state contract:
+        ``_zeros_like_state`` inherits the weight's sharding), else
+        replicated — scalar/odd-shaped state leaves replicate."""
+        shape = self._arg_shape.get(name) if name else None
+        arr = np_or_nd._data if isinstance(np_or_nd, NDArray) \
+            else np.asarray(np_or_nd, dtype=dtype)
+        if shape is not None and tuple(arr.shape) == tuple(shape):
+            return self.place_param(name, np_or_nd, dtype=dtype)
+        return self._place(np_or_nd, None, dtype=dtype)
 
     def _place(self, np_or_nd, batch_axis: Optional[int], dtype=None) -> NDArray:
         import jax
@@ -113,6 +195,8 @@ class DataParallelExecutorGroup:
         shapes = {d.name: d.shape for d in self.data_shapes}
         shapes.update({d.name: d.shape for d in self.label_shapes})
         arg_shapes, _, aux_shapes = self.symbol.infer_shape(**shapes)
+        self._arg_shape = {n: tuple(s) for n, s in zip(self.arg_names,
+                                                       arg_shapes)}
 
         shared_args = {}
         if shared_group is not None:
@@ -137,7 +221,12 @@ class DataParallelExecutorGroup:
                         "(%s vs %s); bucketing shares weights, so every "
                         "bucket's symbol must give params the same shape"
                         % (name, shared_args[name].shape, shape))
-                arr = self._place(np.zeros(shape, dtype=np.float32), baxis)
+                zeros = np.zeros(shape, dtype=np.float32)
+                # params (and below, their grads) take their per-param
+                # sharding — replicated on a dp mesh, fsdp-sharded under
+                # the FSDP recipe; data/labels take the batch sharding
+                arr = (self._place(zeros, baxis) if is_data
+                       else self.place_param(name, zeros))
             args.append(arr)
             if self.grad_req.get(name, "null") != "null":
                 if shared_group is not None and name in shared_group.executor.grad_dict:
@@ -145,7 +234,9 @@ class DataParallelExecutorGroup:
                     if g.shape == shape:
                         grads[name] = g
                         continue
-                grads[name] = self._place(np.zeros(shape, dtype=np.float32), baxis)
+                zeros = np.zeros(shape, dtype=np.float32)
+                grads[name] = (self._place(zeros, baxis) if is_data
+                               else self.place_param(name, zeros))
 
         aux = []
         shared_aux = {}
@@ -174,7 +265,7 @@ class DataParallelExecutorGroup:
     # ------------------------------------------------------------------
     def set_params(self, arg_params: Dict[str, NDArray],
                    aux_params: Dict[str, NDArray]):
-        def _placed_copy(arr):
+        def _placed_copy(arr, name=None):
             # _place is a no-copy when the source already lives on the
             # target device (device_put returns a fresh HANDLE to the SAME
             # buffer); the executor's buffers get DONATED (optimizer
@@ -184,7 +275,8 @@ class DataParallelExecutorGroup:
 
             from ..ndarray import _shares_buffer
 
-            placed = self._place(arr, None)._data
+            placed = (self.place_param(name, arr) if name is not None
+                      else self._place(arr, None))._data
             if isinstance(arr, NDArray) \
                     and _shares_buffer(placed, arr._data) is not False:
                 # None (unverifiable aliasing) copies too — see
@@ -194,7 +286,8 @@ class DataParallelExecutorGroup:
 
         for name, arr in arg_params.items():
             if name in self.executor.arg_dict:
-                self.executor.arg_dict[name]._data = _placed_copy(arr)
+                self.executor.arg_dict[name]._data = _placed_copy(arr,
+                                                                  name)
         for name, arr in (aux_params or {}).items():
             if name in self.executor.aux_dict:
                 self.executor.aux_dict[name]._data = _placed_copy(arr)

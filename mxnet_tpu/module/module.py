@@ -226,6 +226,13 @@ class Module(BaseModule):
         self._updater = opt.get_updater(optimizer)
         # update_on_kvstore: push grad / pull weight with server-side update
         self._update_on_kvstore = bool(kvstore) and "dist" in (kvstore.type if kvstore else "")
+        if self._update_on_kvstore and getattr(
+                kvstore, "fused_step_compatible", False):
+            # a dist store whose exchange the fused step can subsume
+            # (single-process dist_sync) keeps the update worker-side so
+            # the in-jit path stays eligible — the server-side update
+            # would force the kvstore_update fallback for no byte saved
+            self._update_on_kvstore = False
         if kvstore:
             for i, name in enumerate(self._param_names):
                 kvstore.init(i, self._arg_params[name])

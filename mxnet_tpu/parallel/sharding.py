@@ -3,8 +3,14 @@
 Scaling recipe (the "pick a mesh, annotate shardings, let XLA insert
 collectives" loop): build a Mesh over the device grid (ICI topology),
 declare per-parameter PartitionSpecs via regex rules, place the batch
-sharded along ``dp``, and jit the train step — GSPMD partitions the
-computation and emits the all-reduces.
+sharded along the data axes, and jit the train step — GSPMD partitions
+the computation and emits the collectives.
+
+The mesh is multi-axis by name: ``{"dp": N}`` is plain data
+parallelism, ``{"dp": N, "fsdp": M}`` adds the FSDP recipe
+(:func:`fsdp_param_spec`: params/opt-state sharded along ``fsdp``,
+batch over ``dp x fsdp`` via :func:`batch_spec`), and the axis list
+stays open for tp/pp/ep recipes on the same abstraction.
 
 Replaces (TPU-natively) the reference's explicit two-tier comm:
 intra-node ``Comm`` reduce (``src/kvstore/comm.h``) and ps-lite push/pull
@@ -21,9 +27,17 @@ import numpy as np
 from ..base import MXNetError
 
 __all__ = ["make_mesh", "make_param_shardings", "shard_args",
-           "build_sgd_train_step", "ShardingRule"]
+           "build_sgd_train_step", "ShardingRule", "mesh_axis_sizes",
+           "batch_spec", "fsdp_param_spec", "DATA_AXES"]
 
 ShardingRule = namedtuple("ShardingRule", ["pattern", "spec"])
+
+#: Mesh axes the BATCH shards over, in mesh-major order. ``dp`` is pure
+#: data parallelism (params replicated across it); ``fsdp`` also shards
+#: the batch — its distinguishing role is sharding params/opt-state.
+#: Future recipe axes (tp/pp/ep) are NOT batch axes and join the mesh
+#: without extending this tuple.
+DATA_AXES = ("dp", "fsdp")
 
 
 def make_mesh(axis_sizes: Dict[str, int], devices: Optional[Sequence] = None):
@@ -39,6 +53,43 @@ def make_mesh(axis_sizes: Dict[str, int], devices: Optional[Sequence] = None):
         raise MXNetError("mesh needs %d devices, have %d" % (n, len(devices)))
     grid = np.array(devices[:n]).reshape(sizes)
     return Mesh(grid, tuple(axis_sizes.keys()))
+
+
+def mesh_axis_sizes(mesh) -> Dict[str, int]:
+    """``{axis_name: size}`` of a Mesh, in axis order — the snapshot
+    form checkpoint.py records so a resume can log exactly which mesh
+    shape the state is re-sharding from/onto."""
+    return {name: int(mesh.shape[name]) for name in mesh.axis_names}
+
+
+def batch_spec(mesh, batch_axis: int):
+    """PartitionSpec sharding ``batch_axis`` over every data axis the
+    mesh carries (``dp``, and ``fsdp`` when present): the global batch
+    splits across ALL devices regardless of how the grid is factored
+    between replication and param sharding."""
+    from jax.sharding import PartitionSpec as P
+
+    axes = tuple(a for a in DATA_AXES if a in mesh.axis_names)
+    spec = [None] * (batch_axis + 1)
+    spec[batch_axis] = axes if len(axes) > 1 else axes[0]
+    return P(*spec)
+
+
+def fsdp_param_spec(shape, mesh, axis: str = "fsdp"):
+    """PartitionSpec for a param/opt-state array under the FSDP recipe:
+    dim 0 sharded along ``axis`` when it divides evenly (the ZeRO-style
+    1-D shard), fully replicated otherwise (odd-shaped leaves — e.g. a
+    bias whose length does not divide — cost little replicated, and a
+    ragged shard would force padding collectives). Returns None when
+    the mesh has no ``axis``."""
+    from jax.sharding import PartitionSpec as P
+
+    if axis not in getattr(mesh, "axis_names", ()):
+        return None
+    size = int(mesh.shape[axis])
+    if size <= 1 or not shape or shape[0] % size != 0:
+        return P()
+    return P(*((axis,) + (None,) * (len(shape) - 1)))
 
 
 def _spec_fits(shape, spec, mesh) -> bool:

@@ -387,7 +387,12 @@ class _InstrumentedJit:
         rec = record_compile(self._site, compiled,
                              time.perf_counter() - t0, signature=sig)
         if _env.get("MXNET_TPU_XPROF_PREFLIGHT") and rec.peak_bytes:
-            preflight_check(rec.peak_bytes, what=self._site)
+            try:
+                devs = compiled.runtime_executable().local_devices()
+            except Exception:
+                devs = None
+            preflight_check(rec.peak_bytes, devices=devs,
+                            what=self._site)
         self._cache[sig] = compiled
         return compiled
 
@@ -617,6 +622,7 @@ def hlo_op_breakdown(hlo_text: str) -> Dict[str, dict]:
         return totals
 
     agg = {c: {"flops": 0, "bytes": 0, "count": 0} for c in CATEGORIES}
+    coll_ops: Dict[str, Dict[str, int]] = {}
     for parsed in comps[entry]:
         cl = classify(parsed)
         if cl is None:
@@ -624,6 +630,14 @@ def hlo_op_breakdown(hlo_text: str) -> Dict[str, dict]:
         cat, fl, by = cl
         agg[cat]["bytes"] += by
         agg[cat]["count"] += 1
+        if cat == "collective":
+            # per-opcode sub-buckets: an fsdp step's all-gather
+            # (param gather before forward) and reduce-scatter (grad
+            # shard-reduce) are distinguishable from the dp all-reduce
+            op = parsed[1]
+            sub = coll_ops.setdefault(op, {"bytes": 0, "count": 0})
+            sub["bytes"] += by
+            sub["count"] += 1
         if cat == "fusion":
             m = re.search(r"calls=%?([\w.\-]+)", parsed[3])
             sub = body_flops(m.group(1), (entry,)) if m else {}
@@ -632,7 +646,10 @@ def hlo_op_breakdown(hlo_text: str) -> Dict[str, dict]:
                 agg[c]["flops"] += f
         else:
             agg[cat]["flops"] += fl
-    return {c: v for c, v in agg.items() if v["count"] or v["flops"]}
+    if coll_ops:
+        agg["collective"]["by_op"] = coll_ops
+    return {c: v for c, v in agg.items()
+            if v.get("count") or v.get("flops")}
 
 
 # ---------------------------------------------------------------------------
@@ -705,9 +722,14 @@ def analyze(flops, bytes_accessed, step_time_s=None,
 # ---------------------------------------------------------------------------
 
 def hbm_stats(device=None) -> dict:
-    """Live-buffer accounting: ``device.memory_stats()`` where the
-    backend provides it (TPU), else the sum of ``jax.live_arrays()``
-    sizes (CPU — no allocator limit, so ``limit_bytes`` is None)."""
+    """Live-buffer accounting FOR ONE DEVICE: ``device.memory_stats()``
+    where the backend provides it (TPU), else ``jax.live_arrays()``
+    (CPU — no allocator limit, so ``limit_bytes`` is None). The
+    live_arrays walk is per-device exact: a sharded array contributes
+    only the bytes of its shards resident on ``device`` (an
+    fsdp-sharded pack bills 1/fsdp per chip), never its GLOBAL
+    ``nbytes`` — billing the whole pack to device 0 is precisely the
+    accounting bug a sharded mesh exposes."""
     import jax
 
     try:
@@ -730,7 +752,13 @@ def hbm_stats(device=None) -> dict:
     live = 0
     for arr in jax.live_arrays():
         try:
-            live += int(arr.nbytes)
+            shards = getattr(arr, "addressable_shards", None)
+            if shards:
+                for s in shards:
+                    if s.device == dev:
+                        live += int(s.data.nbytes)
+            else:
+                live += int(arr.nbytes)
         except Exception:
             pass
     return {"live_bytes": live, "limit_bytes": None,
@@ -789,11 +817,22 @@ def _fmt_bytes(n) -> str:
 
 
 def preflight_check(peak_bytes, limit_bytes: Optional[int] = None,
-                    device=None, what: str = "computation"):
+                    device=None, devices=None, what: str = "computation"):
     """Refuse a config before it runs: raise :class:`MXNetError` when
     the executable's ``memory_analysis`` peak exceeds the device HBM
     limit. Returns the headroom in bytes, or None when no limit is
-    known (CPU) — the check is advisory there by design."""
+    known (CPU) — the check is advisory there by design.
+
+    ``memory_analysis`` reports PER-PARTITION bytes for an SPMD
+    executable (each device holds only its shard of arguments, temps
+    and outputs), so the comparison is per-device by construction:
+    pass ``devices`` (the executable's local devices) and the peak is
+    checked against the SMALLEST per-device limit among them — NOT
+    against device 0's limit with the whole pack billed to it."""
+    if limit_bytes is None and devices:
+        limits = [device_memory_limit(d) for d in devices]
+        limits = [l for l in limits if l]
+        limit_bytes = min(limits) if limits else None
     if limit_bytes is None:
         limit_bytes = device_memory_limit(device)
     if not limit_bytes or not peak_bytes:

@@ -36,7 +36,7 @@ import pytest  # noqa: E402
 # docs/static_analysis.md)
 _TRANSFER_SANITIZED = {"test_fused_step", "test_fused_feed",
                        "test_sharded_fused", "test_checkpoint",
-                       "test_numwatch"}
+                       "test_numwatch", "test_fsdp"}
 
 
 def pytest_configure(config):

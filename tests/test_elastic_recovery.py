@@ -170,3 +170,62 @@ def test_elastic_shrink_dp8_snapshot_resumes_at_dp1(tmp_path, tel,
     s = []
     _fit(dp=1, stream=s)                              # rejoin at dp=1
     assert s == [r for r in ref1 if (r[0], r[1]) > (0, 2)]
+
+
+@pytest.mark.multichip
+def test_elastic_reshard_dp8_to_fsdp4_and_back(tmp_path, tel,
+                                               monkeypatch):
+    """Elastic re-shard matrix across mesh FACTORINGS of the same 8
+    devices: a dp=8 (replicated) snapshot resumes onto the
+    dp=2 x fsdp=4 mesh — params and momentum re-enter sharded — and an
+    fsdp=4 snapshot resumes back onto dp=8. Both directions continue
+    the uninterrupted stream bit for bit (the exact-arithmetic regime
+    of test_fsdp makes all three trajectories identical), and each
+    resume costs exactly ONE fused compile: restore re-places state
+    with the shardings fresh init uses, so the step never retraces."""
+    from test_fsdp import _fit_mesh
+
+    ref = []
+    _fit_mesh(monkeypatch, stream=ref)            # uninterrupted dp=8
+    assert len(ref) == 8
+    tail = [r for r in ref if (r[0], r[1]) > (0, 2)]
+
+    d = str(tmp_path / "snaps")
+    monkeypatch.setenv("MXNET_TPU_CKPT_DIR", d)
+    monkeypatch.setenv("MXNET_TPU_CKPT_EVERY_N_STEPS", "3")
+    monkeypatch.setenv("MXNET_TPU_CKPT_RESUME", "0")
+    _fit_mesh(monkeypatch)                        # saved at dp=8
+    _keep_only_step(d, 3)
+    with open(os.path.join(d, "MANIFEST.json")) as f:
+        entry = json.load(f)["snapshots"][0]
+    assert entry["dp"] == 8
+    assert entry["mesh"] == {"dp": 8}
+
+    # dp=8 snapshot -> dp=2 x fsdp=4 resume
+    monkeypatch.setenv("MXNET_TPU_CKPT_RESUME", "1")
+    monkeypatch.setenv("MXNET_TPU_CKPT_EVERY_N_STEPS", "0")
+    before = tel.peek("step.fused_recompiles") or 0
+    s = []
+    mod = _fit_mesh(monkeypatch, fsdp=4, stream=s)
+    assert s == tail, "dp->fsdp resume stream diverged"
+    assert (tel.peek("step.fused_recompiles") or 0) - before == 1
+    w = mod._exec_group.executor.arg_dict["fc1_weight"]._data
+    assert tuple(w.sharding.spec)[0] == "fsdp"    # restored SHARDED
+
+    # fsdp=4 snapshot -> dp=8 resume (the back direction)
+    d2 = str(tmp_path / "snaps2")
+    monkeypatch.setenv("MXNET_TPU_CKPT_DIR", d2)
+    monkeypatch.setenv("MXNET_TPU_CKPT_EVERY_N_STEPS", "3")
+    monkeypatch.setenv("MXNET_TPU_CKPT_RESUME", "0")
+    _fit_mesh(monkeypatch, fsdp=4)                # saved sharded
+    _keep_only_step(d2, 3)
+    with open(os.path.join(d2, "MANIFEST.json")) as f:
+        assert json.load(f)["snapshots"][0]["mesh"] == \
+            {"dp": 2, "fsdp": 4}
+    monkeypatch.setenv("MXNET_TPU_CKPT_RESUME", "1")
+    monkeypatch.setenv("MXNET_TPU_CKPT_EVERY_N_STEPS", "0")
+    before = tel.peek("step.fused_recompiles") or 0
+    s2 = []
+    _fit_mesh(monkeypatch, stream=s2)             # rejoin replicated
+    assert s2 == tail, "fsdp->dp resume stream diverged"
+    assert (tel.peek("step.fused_recompiles") or 0) - before == 1

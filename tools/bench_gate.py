@@ -131,6 +131,13 @@ SPECS: List[Spec] = [
          "higher"),
     Spec("multichip_dispatches_per_step", "MULTICHIP_scaling.json",
          "dispatches_per_step", "lower"),
+    # FSDP recipe (bench.py multichip --fsdp): per-device params +
+    # opt-state bytes vs replicated — 0.25 at fsdp=4 when every dim 0
+    # divides; a ratio drift upward means the recipe stopped sharding
+    Spec("fsdp_param_bytes_ratio", "MULTICHIP_scaling.json",
+         "fsdp.param_bytes_ratio", "lower"),
+    Spec("fsdp_dispatches_per_step", "MULTICHIP_scaling.json",
+         "fsdp.dispatches_per_step", "lower"),
     # the checked-in baseline is the CONTRACT (3% overhead), not a
     # measurement; tolerance 1.0 sizes the trip point (>2x the bar) to
     # the one-core host's program-placement noise floor — the exact

@@ -341,6 +341,29 @@ def fire():
                        "chip_watch_stamp": stamp}, f)
             f.write("\n")
     _commit("multichip dp scaling", stamp)
+    # 6b. FSDP tier (same simulated 8-device mesh, factored
+    # dp=2 x fsdp=4): per-device params+opt-state byte ratio, the
+    # one-dispatch proof and the exact-parity witness, MERGED under the
+    # "fsdp" key of MULTICHIP_scaling.json. On a wedged orchestrator
+    # the incomplete record is merged the same way — never clobbering
+    # the plain multichip record stage 6 just wrote
+    out = _run([py, os.path.join(REPO, "bench.py"), "multichip",
+                "--fsdp"], 2000)
+    if out is None:
+        mc_path = os.path.join(REPO, "MULTICHIP_scaling.json")
+        try:
+            with open(mc_path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            rec = {}
+        rec["fsdp"] = {"metric": "fsdp_param_bytes_ratio", "value": 0,
+                       "incomplete": "chip_watch fsdp stage timed out "
+                                     "or crashed",
+                       "chip_watch_stamp": stamp}
+        with open(mc_path, "w") as f:
+            json.dump(rec, f, indent=2, sort_keys=True)
+            f.write("\n")
+    _commit("fsdp sharding tier", stamp)
     # 7. serving tier: continuous-batching goodput sweep against the
     # tail-latency SLO, with the adaptive deadline-aware scheduler and
     # the mixed interactive/batch lane workload -> SERVE_bench.json

@@ -366,7 +366,57 @@ def render_bench_summary(rec):
                 "%.1f%% of bytes moved\n"
                 % (100.0 * coll["flop_fraction"],
                    100.0 * coll["byte_fraction"]))
+        out += _render_collective_axes(coll)
     return out
+
+
+# which mesh axis each collective opcode serves: the param
+# gather/scatter legs are the fsdp (ZeRO) exchange, the mean-psum
+# all-reduce is the dp exchange. -start/-done variants fold onto their
+# base opcode.
+_AXIS_OPS = (("fsdp", ("all-gather", "reduce-scatter")),
+             ("dp", ("all-reduce",)))
+
+
+def _render_collective_axes(coll):
+    """Per-axis breakdown of the collective bytes (``by_op`` sub-
+    buckets from the HLO breakdown): 'fsdp: ... via all-gather+
+    reduce-scatter / dp: ... via all-reduce'. Empty string when the
+    record predates by_op."""
+    by_op = coll.get("by_op") or {}
+    if not by_op:
+        return ""
+    total = sum(v.get("bytes", 0) for v in by_op.values()) or 1
+
+    def base(op):
+        return op[:-6] if op.endswith("-start") else (
+            op[:-5] if op.endswith("-done") else op)
+
+    lines = []
+    seen = set()
+    for axis, ops in _AXIS_OPS:
+        byts = ops_n = 0
+        used = []
+        for op, v in by_op.items():
+            if base(op) in ops:
+                seen.add(op)
+                byts += v.get("bytes", 0)
+                ops_n += v.get("count", 0)
+                used.append(base(op))
+        if ops_n:
+            lines.append("  %s axis: %.1f%% of collective bytes "
+                         "(%d op%s: %s)"
+                         % (axis, 100.0 * byts / total, ops_n,
+                            "s" if ops_n != 1 else "",
+                            "+".join(sorted(set(used)))))
+    other = {op: v for op, v in by_op.items() if op not in seen}
+    if other:
+        byts = sum(v.get("bytes", 0) for v in other.values())
+        ops_n = sum(v.get("count", 0) for v in other.values())
+        lines.append("  other: %.1f%% of collective bytes (%d ops: %s)"
+                     % (100.0 * byts / total, ops_n,
+                        "+".join(sorted(other))))
+    return "\n".join(lines) + "\n" if lines else ""
 
 
 def collective_fraction(rec):
@@ -383,7 +433,8 @@ def collective_fraction(rec):
         if isinstance(c, dict) and "byte_fraction" in c:
             return {"flop_fraction": c.get("flop_fraction", 0.0),
                     "byte_fraction": c.get("byte_fraction", 0.0),
-                    "ops": c.get("ops", 0)}
+                    "ops": c.get("ops", 0),
+                    "by_op": c.get("by_op") or {}}
         return None
     total_fl = sum(v.get("flops", 0) for v in bd.values())
     total_by = sum(v.get("bytes", 0) for v in bd.values())
@@ -392,7 +443,8 @@ def collective_fraction(rec):
                               if total_fl else 0.0),
             "byte_fraction": (c.get("bytes", 0) / total_by
                               if total_by else 0.0),
-            "ops": c.get("count", 0)}
+            "ops": c.get("count", 0),
+            "by_op": c.get("by_op") or {}}
 
 
 def latest_serve_record(recs):
