@@ -75,6 +75,14 @@ fsdp-bench:
 serve-bench:
 	python bench.py serve
 
+# tensor-parallel serving tier on the same 8 simulated devices, group
+# factored dp=4 x tp=2: per-device param byte ratio, the preflight
+# bigger-than-one-chip proof, in-graph collectives inside the one
+# dispatch, and the delta-aware weight stream -> merged under the
+# "tp" key of SERVE_bench.json
+tp-serve-bench:
+	python bench.py serve --tp
+
 # closed-loop kernel/config search: candidates compiled through the
 # xprof registry, pruned or timed, fenced rows into
 # MFU_EXPERIMENTS.jsonl, winners into .autotune_cache.json
@@ -138,4 +146,4 @@ obs-gate: lint
 clean:
 	rm -rf mxnet_tpu/_native perl-package/blib
 
-.PHONY: all predict perl test lint profile-report multichip fsdp-bench serve-bench fleet-bench net-bench trace-smoke ckpt-test numwatch-test bench-gate obs-gate clean
+.PHONY: all predict perl test lint profile-report multichip fsdp-bench serve-bench tp-serve-bench fleet-bench net-bench trace-smoke ckpt-test numwatch-test bench-gate obs-gate clean

@@ -78,8 +78,8 @@ from .analysis import sanitizers as _san
 from .base import MXNetError
 
 __all__ = ["CheckpointError", "atomic_writer", "atomic_write_bytes",
-           "atomic_ndarray_save", "snapshot", "restore", "SnapshotStore",
-           "CheckpointManager", "maybe_manager"]
+           "atomic_ndarray_save", "param_digest", "snapshot", "restore",
+           "SnapshotStore", "CheckpointManager", "maybe_manager"]
 
 _log = logging.getLogger(__name__)
 
@@ -172,6 +172,18 @@ def _fetch(x) -> np.ndarray:
     return np.asarray(jax.device_get(x))
 
 
+def param_digest(arr) -> str:
+    """Content hash of one host param array — THE digest identity the
+    delta-aware serving refresh diffs against
+    (:meth:`mxnet_tpu.fused_step.FusedInfer.refresh_params`):
+    sha256 over the raw C-contiguous bytes, the same hashing
+    :meth:`SnapshotStore.save` applies per file. Snapshot writers and
+    refresh readers must hash identically or every rollout degrades to
+    a full re-pack."""
+    return hashlib.sha256(
+        np.ascontiguousarray(np.asarray(arr)).tobytes()).hexdigest()
+
+
 def _metric_leaves(eval_metric):
     from . import metric as _metric
 
@@ -231,6 +243,11 @@ def snapshot(module, eval_metric=None, train_data=None, *, step: int = 0,
         payload["params"] = {
             n: _fetch(ex.arg_dict[n]._data)
             for n in module._param_names if n in ex.arg_dict}
+        # per-param sha256 so a serving-side delta refresh
+        # (FusedInfer.refresh_params(host_params=..., digests=...))
+        # diffs against its resident pack without re-hashing the blobs
+        payload["param_digests"] = {
+            n: param_digest(v) for n, v in payload["params"].items()}
         payload["aux"] = {
             n: _fetch(a._data)
             for n, a in zip(group.aux_names, ex.aux_arrays)}
@@ -467,6 +484,11 @@ class SnapshotStore:
         }
         if payload.get("mesh"):
             entry["mesh"] = payload["mesh"]
+        if payload.get("param_digests"):
+            # the streaming-refresh index: a serving replica diffs
+            # these against its resident pack and fetches/unpickles
+            # the blob only when something actually changed
+            entry["param_digests"] = payload["param_digests"]
         manifest["snapshots"].append(entry)
         drop = manifest["snapshots"][:-self.keep]
         manifest["snapshots"] = manifest["snapshots"][-self.keep:]

@@ -452,6 +452,22 @@ declare("MXNET_TPU_SERVE_BATCH_DEADLINE_MS", float, 0.0,
         "along in whatever bucket capacity the interactive lane "
         "leaves free and are the first shed under overload.",
         section=_S)
+declare("MXNET_TPU_SERVE_TP", int, 0,
+        "Tensor-parallel degree for an `InferenceServer`: the device "
+        "group is refactored into a `(dp, tp)` mesh and each param is "
+        "sharded along its largest `tp`-divisible dimension "
+        "(replicated when none divides), so one model can span chips "
+        "whose individual HBM it exceeds. Activations reshard "
+        "in-graph — every batch is still exactly one XLA dispatch. "
+        "Must divide the device-group size. `0`/`1`: no tensor "
+        "sharding (the `dp`-replicated default).", section=_S)
+declare("MXNET_TPU_REFRESH_DELTA", bool, True,
+        "Delta-aware weight streaming for `refresh_params`: incoming "
+        "host params are diffed per-param (sha256, the PR-11 snapshot "
+        "manifest digests) against the resident pack and only changed "
+        "shards cross the PCIe/ICI boundary. `infer.refresh_bytes` / "
+        "`infer.refresh_skipped` report the savings. Set to 0 to "
+        "force every refresh to move the full pack.", section=_S)
 
 _F = "Fleet / fault injection"
 declare("MXNET_TPU_FLEET_REPLICAS", int, 2,

@@ -238,7 +238,13 @@ class Replica:
     def in_flight(self) -> int:
         return 0
 
-    def refresh_params(self, apply_fn=None):
+    def refresh_params(self, apply_fn=None, snapshot_dir=None):
+        """Swap in new weights. ``apply_fn`` mutates the live server
+        in-process (in-proc replicas only); ``snapshot_dir`` names a
+        :class:`~mxnet_tpu.checkpoint.SnapshotStore` directory whose
+        newest snapshot is streamed in delta-aware (only shards whose
+        manifest digest changed move) — the only weight path that
+        crosses a process boundary."""
         raise NotImplementedError
 
     def restart(self):
@@ -370,13 +376,16 @@ class InProcReplica(Replica):
             return 0
         return srv.scheduler.in_flight()
 
-    def refresh_params(self, apply_fn=None):
+    def refresh_params(self, apply_fn=None, snapshot_dir=None):
         srv = self._srv
         if not self.alive() or srv is None:
             raise ReplicaCrash("replica %s is down" % self.rid)
         if apply_fn is not None:
             apply_fn(srv)
-        srv.refresh_params()
+        if snapshot_dir is not None:
+            _refresh_from_store(srv, snapshot_dir)
+        else:
+            srv.refresh_params()
 
     def kill(self):
         self._dead = True
@@ -394,6 +403,21 @@ class InProcReplica(Replica):
         srv, self._srv = self._srv, None
         if srv is not None:
             srv.close()
+
+
+def _refresh_from_store(srv, snapshot_dir: str):
+    """Stream the newest snapshot in ``snapshot_dir`` into a live
+    server. The snapshot payload carries per-param sha256 digests, so
+    the server's delta-aware refresh moves only the shards that
+    actually changed since the last swap."""
+    from .checkpoint import SnapshotStore
+
+    found = SnapshotStore(snapshot_dir).load_latest()
+    if found is None:
+        raise MXNetError("snapshot dir %r holds no valid snapshot to "
+                         "refresh from" % snapshot_dir)
+    payload, _ = found
+    srv.refresh_from_snapshot(payload)
 
 
 def _resolve_factory(factory_ref: str) -> Callable[[], object]:
@@ -475,7 +499,11 @@ def _subprocess_replica_main(conn, factory_ref: str):
                     conn.send(("err", mid, str(e)))
             elif op == "refresh":
                 try:
-                    srv.refresh_params()
+                    sdir = msg[2] if len(msg) > 2 else None
+                    if sdir:
+                        _refresh_from_store(srv, sdir)
+                    else:
+                        srv.refresh_params()
                     conn.send(("ok", mid, None))
                 except BaseException as e:   # noqa: BLE001
                     conn.send(("err", mid, str(e)))
@@ -620,7 +648,8 @@ class SubprocessReplica(Replica):
         with self._lock:
             return len(self._pending)
 
-    def refresh_params(self, apply_fn=None, timeout_s: float = 60.0):
+    def refresh_params(self, apply_fn=None, snapshot_dir=None,
+                       timeout_s: float = 60.0):
         # apply_fn cannot cross the process boundary; the child's own
         # factory/checkpoint path owns its params and ``refresh``
         # repacks them (serve-while-training delivers new weights via
@@ -628,7 +657,8 @@ class SubprocessReplica(Replica):
         if apply_fn is not None:
             raise MXNetError("apply_fn is not supported for subprocess "
                              "replicas; ship params via checkpoint")
-        self._send("refresh").wait(timeout_s)
+        payload = (snapshot_dir,) if snapshot_dir else None
+        self._send("refresh", payload).wait(timeout_s)
 
     def kill(self):
         """SIGKILL the child (chaos): pending requests fail with
@@ -737,7 +767,11 @@ def _socket_replica_main(port_conn, factory_ref: str):
                 respond("err", (), {"error": str(e)})
         elif op == "refresh":
             try:
-                srv.refresh_params()
+                sdir = meta.get("snapshot_dir") if meta else None
+                if sdir:
+                    _refresh_from_store(srv, sdir)
+                else:
+                    srv.refresh_params()
                 respond("ok")
             except BaseException as e:   # noqa: BLE001
                 respond("err", (), {"error": str(e)})
@@ -906,12 +940,15 @@ class SocketReplica(Replica):
         stalls) — the fleet bench embeds this for --view wire."""
         return {} if self._client is None else self._client.stats()
 
-    def refresh_params(self, apply_fn=None, timeout_s: float = 60.0):
+    def refresh_params(self, apply_fn=None, snapshot_dir=None,
+                       timeout_s: float = 60.0):
         if apply_fn is not None:
             raise MXNetError("apply_fn is not supported for socket "
                              "replicas; ship params via checkpoint")
+        meta = {"snapshot_dir": snapshot_dir} if snapshot_dir else None
         try:
-            frame = self._client.call("refresh", timeout_s=timeout_s)
+            frame = self._client.call("refresh", meta=meta,
+                                      timeout_s=timeout_s)
         except self._netwire.WireTimeout as e:
             raise AttemptTimeout(str(e))
         except self._netwire.WireError as e:
@@ -1601,12 +1638,16 @@ class FleetRouter:
                 self.remove_replica(rid)
 
     # -- rolling param swap -------------------------------------------------
-    def refresh_params(self, apply_fn=None, drain_timeout_s: float = 30.0):
+    def refresh_params(self, apply_fn=None, snapshot_dir=None,
+                       drain_timeout_s: float = 30.0):
         """Glitch-free rolling swap: for each replica — drain (unroute,
         wait for in-flight zero), apply + repack params, rejoin. Load
         keeps flowing to the other replicas, and because the swapping
         replica is idle, even an injected ``torn_swap`` window is
-        unobservable: every response is pure-old or pure-new."""
+        unobservable: every response is pure-old or pure-new.
+        ``snapshot_dir`` streams weights from a checkpoint store
+        instead of the in-process module — the delta-aware path, and
+        the only one subprocess/socket replicas accept."""
         for rid in self.replica_ids():
             with self._rlock:
                 e = self._entries.get(rid)
@@ -1617,7 +1658,8 @@ class FleetRouter:
             self._event("swap_drain", rid)
             try:
                 self._await_drain(e, drain_timeout_s)
-                e.replica.refresh_params(apply_fn)
+                e.replica.refresh_params(apply_fn,
+                                         snapshot_dir=snapshot_dir)
             finally:
                 with self._rlock:
                     if e.state == "draining":

@@ -680,8 +680,13 @@ def make_fused_infer(executor, data_names, top_k=0, mesh=None):
     is part of the params pack. ``top_k=0`` skips post-processing,
     ``top_k=1`` appends an argmax over the last axis of the first
     output, ``top_k>1`` appends ``jax.lax.top_k`` values+indices.
-    ``mesh`` (a ``dp`` device mesh) replicates the params pack and
-    shards the batch axis of incoming data across it."""
+    ``mesh`` shards the batch axis of incoming data across its data
+    axes (``dp``); on a ``(dp, tp)`` mesh the params pack additionally
+    NamedSharding-shards along ``tp`` (per-param dim via
+    :func:`~mxnet_tpu.parallel.sharding.tp_param_spec`) so a model
+    bigger than one chip's HBM serves from the shards, with the
+    activation resharding collectives emitted by GSPMD INSIDE the one
+    dispatch. Off a tp mesh the pack replicates as before."""
     return FusedInfer(executor, data_names, top_k=top_k, mesh=mesh)
 
 
@@ -723,10 +728,23 @@ class FusedInfer:
                        if i not in d_set]
         self._top_k = int(top_k)
         self._mesh = mesh
+        self._tp = 1
+        if mesh is not None and "tp" in mesh.axis_names:
+            self._tp = int(mesh.shape["tp"])
         self._fn = self._build()
         self._seen_sigs = set()
         self._param_vals = None
         self._aux_vals = None
+        # per-param content digests (sha256 over host bytes, the same
+        # hashing checkpoint.snapshot records in its manifest): the
+        # resident-pack side of the delta-aware refresh. None = unknown
+        # provenance, so the next streamed refresh transfers everything
+        # and re-seeds.
+        self._digests = None
+        self.last_refresh_bytes = 0
+        self.last_refresh_ms = 0.0
+        self.last_refresh_changed = 0
+        self.last_refresh_skipped = 0
         with _san.intentional_transfer():
             # one fixed key for every dispatch: is_train=False, so the
             # graph's rng is inert — a per-call fold_in would be one
@@ -740,6 +758,35 @@ class FusedInfer:
         """Distinct data-shape signatures seen (== jit retraces)."""
         return len(self._seen_sigs)
 
+    @property
+    def mesh_key(self):
+        """Mesh-factoring fingerprint this executable was built for
+        (``(("dp", 4), ("tp", 2))``-style tuple, None off-mesh) — the
+        cache key a re-bind across meshes must miss on."""
+        if self._mesh is None:
+            return None
+        from .parallel.sharding import mesh_axis_sizes
+
+        return tuple(mesh_axis_sizes(self._mesh).items())
+
+    @staticmethod
+    def factoring_key(mesh):
+        """The :attr:`mesh_key` a FusedInfer built over ``mesh`` would
+        carry — for callers checking a cached instance without one."""
+        if mesh is None:
+            return None
+        from .parallel.sharding import mesh_axis_sizes
+
+        return tuple(mesh_axis_sizes(mesh).items())
+
+    def stale_for(self, executor, mesh=None) -> bool:
+        """True when this cached executable no longer matches the
+        caller's executor or mesh factoring: dispatching it would reuse
+        an AOT executable compiled for the OLD placement. Rebuild
+        instead (predictor.py and InferenceServer both key off this)."""
+        return (executor is not self._ex
+                or self.factoring_key(mesh) != self.mesh_key)
+
     def _replicated(self):
         if self._mesh is None:
             return None
@@ -747,17 +794,60 @@ class FusedInfer:
 
         return NamedSharding(self._mesh, PartitionSpec())
 
-    def _batch_sharding(self, ndim):
+    def _param_sharding(self, arg_i):
+        """NamedSharding for one params-pack member: tp-sharded on the
+        per-param dim :func:`tp_param_spec` picks when the mesh carries
+        a ``tp`` axis (replicated when no dim divides), replicated on a
+        data-only mesh, None off-mesh."""
         if self._mesh is None:
             return None
         from jax.sharding import NamedSharding, PartitionSpec
 
-        return NamedSharding(
-            self._mesh, PartitionSpec(*(("dp",) + (None,) * (ndim - 1))))
+        if self._tp > 1:
+            from .parallel.sharding import tp_param_spec
 
-    def refresh_params(self, torn_ms: float = 0.0):
-        """(Re)pack the non-data args + aux states, replicated across
-        the mesh when sharded serving is on. Call after set_params.
+            shape = tuple(self._ex.arg_arrays[arg_i]._data.shape)
+            spec = tp_param_spec(shape, self._mesh) or PartitionSpec()
+            return NamedSharding(self._mesh, spec)
+        return NamedSharding(self._mesh, PartitionSpec())
+
+    def _batch_sharding(self, ndim):
+        """Request batches shard over the mesh's DATA axes only —
+        ``dp`` (and ``fsdp`` when a training mesh is reused), never
+        ``tp``: the model axis splits params, not rows."""
+        if self._mesh is None:
+            return None
+        from jax.sharding import NamedSharding
+
+        from .parallel.sharding import batch_spec
+
+        return NamedSharding(self._mesh, batch_spec(self._mesh, 0))
+
+    def refresh_params(self, host_params=None, digests=None,
+                       torn_ms: float = 0.0):
+        """(Re)pack the non-data args + aux states, placed per
+        :meth:`_param_sharding` (tp-sharded on a ``(dp, tp)`` mesh,
+        replicated otherwise).
+
+        Two entry modes:
+
+        * **full re-pack** (no arguments) — after ``module.set_params``
+          the whole pack re-places from the executor's arrays, exactly
+          the pre-delta behaviour. Resident digests reset to unknown.
+        * **delta stream** (``host_params``: name -> host ndarray) —
+          the checkpoint-streamed path. Each incoming param's sha256
+          (``digests[name]`` when the caller already has it from the
+          snapshot manifest, hashed here otherwise) is diffed against
+          the resident pack's digest and ONLY changed params transfer
+          and re-place inside the ``intentional_transfer`` window; the
+          executor's arrays are written through so a later full re-pack
+          agrees. ``MXNET_TPU_REFRESH_DELTA=0`` transfers everything
+          regardless (the diff bypass hatch).
+
+        Telemetry either way: ``infer.refresh_bytes`` (host bytes
+        moved), ``infer.refresh_ms``, ``infer.refresh_changed`` /
+        ``infer.refresh_skipped`` param counts — mirrored on
+        ``last_refresh_*`` attributes for the bench.
 
         ``torn_ms > 0`` (the ``torn_swap`` injected fault) makes the
         swap deliberately non-atomic: half the new pack lands, then a
@@ -769,14 +859,62 @@ class FusedInfer:
         import jax
 
         ex = self._ex
-        rep = self._replicated()
-        with _san.intentional_transfer():
-            def place(v):
-                return jax.device_put(v, rep) if rep is not None else v
+        t0 = _time.perf_counter()
+        moved = 0
+        changed = skipped = 0
+        if host_params is not None:
+            from .checkpoint import param_digest
 
-            new_params = [place(ex.arg_arrays[i]._data)
-                          for i in self._p_idx]
-            new_aux = [place(a._data) for a in ex.aux_arrays]
+            delta_on = (_env.get("MXNET_TPU_REFRESH_DELTA")
+                        and self._digests is not None)
+            new_params = list(self._param_vals)
+            new_aux = self._aux_vals
+            new_digests = dict(self._digests or {})
+            pos_of = {ex.arg_names[i]: pos
+                      for pos, i in enumerate(self._p_idx)}
+            with _san.intentional_transfer():
+                for name, host in host_params.items():
+                    pos = pos_of.get(name)
+                    if pos is None:
+                        continue   # a data arg, not part of the pack
+                    dg = ((digests or {}).get(name)
+                          or param_digest(host))
+                    if delta_on and new_digests.get(name) == dg:
+                        skipped += 1
+                        continue
+                    arg_i = self._p_idx[pos]
+                    sh = self._param_sharding(arg_i)
+                    val = (jax.device_put(host, sh) if sh is not None
+                           else jax.device_put(host))
+                    new_params[pos] = val
+                    # write-through so a later full re-pack (or a
+                    # host-side get_params) sees the streamed values
+                    ex.arg_arrays[arg_i]._data = val
+                    new_digests[name] = dg
+                    changed += 1
+                    moved += int(getattr(host, "nbytes", 0))
+        else:
+            with _san.intentional_transfer():
+                new_params = []
+                for i in self._p_idx:
+                    sh = self._param_sharding(i)
+                    v = ex.arg_arrays[i]._data
+                    new_params.append(jax.device_put(v, sh)
+                                      if sh is not None else v)
+                rep = self._replicated()
+                new_aux = [jax.device_put(a._data, rep)
+                           if rep is not None else a._data
+                           for a in ex.aux_arrays]
+            changed = len(new_params)
+            moved = sum(int(v.nbytes) for v in new_params)
+            new_digests = None   # unknown provenance: next delta
+            #                      refresh transfers all and re-seeds
+        self.last_refresh_bytes = moved
+        self.last_refresh_changed = changed
+        self.last_refresh_skipped = skipped
+        _tel.inc("infer.refresh_bytes", moved)
+        _tel.inc("infer.refresh_changed", changed)
+        _tel.inc("infer.refresh_skipped", skipped)
         if torn_ms > 0 and self._param_vals is not None and new_params:
             half = max(1, len(new_params) // 2)
             self._param_vals = (new_params[:half]
@@ -784,9 +922,15 @@ class FusedInfer:
             _time.sleep(torn_ms / 1e3)
             self._param_vals = new_params
             self._aux_vals = new_aux
+            self._digests = new_digests
+            self.last_refresh_ms = (_time.perf_counter() - t0) * 1e3
+            _tel.observe("infer.refresh_ms", self.last_refresh_ms)
             return
         self._param_vals = new_params
         self._aux_vals = new_aux
+        self._digests = new_digests
+        self.last_refresh_ms = (_time.perf_counter() - t0) * 1e3
+        _tel.observe("infer.refresh_ms", self.last_refresh_ms)
 
     def place_batch(self, arrays):
         """Device-place one request batch (numpy or jax arrays), batch
@@ -835,6 +979,22 @@ class FusedInfer:
         p_idx = list(self._p_idx)
         d_idx = list(self._d_idx)
         top_k = self._top_k
+        # tensor-sharded serving: pin every forward output back to the
+        # batch (data-axes) sharding. With params split along ``tp``
+        # the activations come out of the matmuls partially-summed or
+        # model-sharded; the constraint makes GSPMD emit the
+        # all-reduce/all-gather INSIDE this one dispatch (the xprof
+        # collective bucket is the proof) instead of deferring a
+        # gather to the host fetch. Off a tp mesh the outputs are
+        # already batch-sharded and no constraint is needed.
+        batch_out = None
+        if self._mesh is not None and self._tp > 1:
+            from jax.sharding import NamedSharding
+
+            from .parallel.sharding import batch_spec
+
+            batch_out = NamedSharding(self._mesh,
+                                      batch_spec(self._mesh, 0))
 
         _tel.inc("executor.jit_build")
 
@@ -845,6 +1005,10 @@ class FusedInfer:
             for pos, i in enumerate(d_idx):
                 full[i] = d_vals[pos]
             outs, _ = run_graph(full, aux, key, False)
+            if batch_out is not None:
+                outs = [jax.lax.with_sharding_constraint(o, batch_out)
+                        if getattr(o, "ndim", 0) >= 1 else o
+                        for o in outs]
             post = ()
             if top_k and outs:
                 head = outs[0]

@@ -817,7 +817,8 @@ class RequestStager:
     (zero rows, sliced off again after the dispatch), then device-
     placed through the caller's mesh-aware ``place`` function (the
     ``FusedInfer.place_batch`` NamedSharding path: batch sharded along
-    ``dp``, params already replicated). Padding to a ladder rung is
+    the mesh's data axes — ``dp``, never ``tp`` — params already
+    resident, replicated or tensor-sharded). Padding to a ladder rung is
     what keeps every dispatch one of at most ``len(buckets)`` stable
     shapes — mixed request rates never retrace.
 
@@ -843,6 +844,14 @@ class RequestStager:
         # a fresh zero block on every under-full dispatch — under a
         # fleet every replica batcher pays this on the hot path
         self._pad_cache: dict = {}
+
+    def rebind_place(self, place) -> None:
+        """Re-point staging at a new mesh-aware placement fn (a server
+        re-bound across mesh factorings rebuilds its FusedInfer; the
+        stager must place onto the NEW mesh's batch sharding, not keep
+        shipping rows to the old device set). The pad cache survives —
+        pad blocks are host arrays, placement-independent."""
+        self._place = place
 
     def _pad_rows(self, pad: int, shape: tuple, dtype) -> np.ndarray:
         key = (pad, shape, np.dtype(dtype).str)

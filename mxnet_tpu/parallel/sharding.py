@@ -9,8 +9,11 @@ the computation and emits the collectives.
 The mesh is multi-axis by name: ``{"dp": N}`` is plain data
 parallelism, ``{"dp": N, "fsdp": M}`` adds the FSDP recipe
 (:func:`fsdp_param_spec`: params/opt-state sharded along ``fsdp``,
-batch over ``dp x fsdp`` via :func:`batch_spec`), and the axis list
-stays open for tp/pp/ep recipes on the same abstraction.
+batch over ``dp x fsdp`` via :func:`batch_spec`), and ``{"dp": N,
+"tp": K}`` the tensor-parallel serving recipe (:func:`tp_param_spec`:
+each param sharded along ``tp`` on a per-param dim, batch over ``dp``
+only — ``tp`` is a MODEL axis, not a data axis). The axis list stays
+open for pp/ep recipes on the same abstraction.
 
 Replaces (TPU-natively) the reference's explicit two-tier comm:
 intra-node ``Comm`` reduce (``src/kvstore/comm.h``) and ps-lite push/pull
@@ -28,7 +31,8 @@ from ..base import MXNetError
 
 __all__ = ["make_mesh", "make_param_shardings", "shard_args",
            "build_sgd_train_step", "ShardingRule", "mesh_axis_sizes",
-           "batch_spec", "fsdp_param_spec", "DATA_AXES"]
+           "batch_spec", "fsdp_param_spec", "tp_param_spec",
+           "batch_shard_extent", "DATA_AXES"]
 
 ShardingRule = namedtuple("ShardingRule", ["pattern", "spec"])
 
@@ -90,6 +94,47 @@ def fsdp_param_spec(shape, mesh, axis: str = "fsdp"):
     if size <= 1 or not shape or shape[0] % size != 0:
         return P()
     return P(*((axis,) + (None,) * (len(shape) - 1)))
+
+
+def batch_shard_extent(mesh) -> int:
+    """How many ways the batch axis shards on this mesh: the product of
+    the DATA axes present (``dp``, ``dp x fsdp``) — NOT ``mesh.size``.
+    On a ``(dp, tp)`` mesh the batch shards ``dp`` ways while ``tp``
+    splits the model, so rounding batch rungs to ``mesh.size`` would
+    over-pad every bucket. 1 for no mesh."""
+    if mesh is None:
+        return 1
+    extent = 1
+    for a in DATA_AXES:
+        if a in mesh.axis_names:
+            extent *= int(mesh.shape[a])
+    return extent
+
+
+def tp_param_spec(shape, mesh, axis: str = "tp"):
+    """PartitionSpec for a param under the tensor-parallel serving
+    recipe: the LARGEST dim that divides evenly by the ``axis`` size is
+    sharded along it (ties go to the earliest dim — for an FC weight
+    ``(out, in)`` that is the Megatron-style column split), fully
+    replicated when no dim divides (odd-shaped leaves cost little
+    replicated, and a ragged shard would force padding collectives).
+    Returns None when the mesh has no ``axis``."""
+    from jax.sharding import PartitionSpec as P
+
+    if axis not in getattr(mesh, "axis_names", ()):
+        return None
+    size = int(mesh.shape[axis])
+    if size <= 1 or not shape:
+        return P()
+    best = None
+    for d, dim in enumerate(shape):
+        if dim % size == 0 and (best is None or dim > shape[best]):
+            best = d
+    if best is None:
+        return P()
+    spec = [None] * len(shape)
+    spec[best] = axis
+    return P(*spec)
 
 
 def _spec_fits(shape, spec, mesh) -> bool:

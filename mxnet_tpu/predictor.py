@@ -94,12 +94,21 @@ class Predictor:
     def _fused_infer(self):
         """The cached single-dispatch executable: built once per bound
         shape set (the `predict.recompiles` count), reused for every
-        subsequent forward."""
+        subsequent forward. The cache is keyed on the executor AND the
+        mesh factoring it was built over (``FusedInfer.stale_for``) —
+        a predictor re-bound onto a different executor/mesh must
+        rebuild rather than dispatch an executable compiled for the
+        old placement."""
+        if self._fused is not None and self._fused.stale_for(
+                self._executor, getattr(self, "_mesh", None)):
+            self._fused = None
         if self._fused is None:
             from .fused_step import make_fused_infer
 
             self._fused = make_fused_infer(self._executor,
-                                           self._input_names)
+                                           self._input_names,
+                                           mesh=getattr(self, "_mesh",
+                                                        None))
             _tel.inc("predict.recompiles")
         return self._fused
 

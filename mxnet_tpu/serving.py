@@ -109,14 +109,23 @@ class RequestShed(MXNetError):
 
 
 def bucket_ladder(max_batch: int, dp: int = 1,
-                  spec: Optional[str] = None) -> Tuple[int, ...]:
+                  spec: Optional[str] = None,
+                  mesh=None) -> Tuple[int, ...]:
     """The padded batch-size ladder: every dispatched batch rounds up
     to the next rung, so the serving path compiles at most
     ``len(ladder)`` executables total. Default rungs are powers of two
     from ``dp`` up to ``max_batch``; an explicit ``spec`` (or
-    ``MXNET_TPU_SERVE_BUCKETS``) is a comma list. Under a ``dp`` mesh
-    every rung is rounded up to a multiple of ``dp`` so the batch axis
-    always shards evenly."""
+    ``MXNET_TPU_SERVE_BUCKETS``) is a comma list. Every rung is rounded
+    up to a multiple of the mesh's BATCH-SHARDING EXTENT so the batch
+    axis always shards evenly: pass ``mesh`` and the extent is the
+    product of its data axes (``dp``, ``dp x fsdp`` — and on a
+    ``(dp, tp)`` serving mesh just ``dp``: rounding to ``mesh.size``
+    there would over-pad every bucket by the tp factor), or pass the
+    extent directly as ``dp``."""
+    if mesh is not None:
+        from .parallel.sharding import batch_shard_extent
+
+        dp = batch_shard_extent(mesh)
     dp = max(1, int(dp))
     if spec is None:
         spec = _env.get("MXNET_TPU_SERVE_BUCKETS")
@@ -455,6 +464,17 @@ class BatchScheduler:
                                         name="mxtpu-serve-batcher",
                                         daemon=True)
         self._worker.start()
+
+    def rebind_infer(self, infer_fn, place=None):
+        """Atomically re-point dispatching at a new infer callable (and
+        the stager at its placement fn): the server rebuilt its
+        FusedInfer after a re-bind across mesh factorings. Taken under
+        the scheduler lock so a concurrently-running ``_dispatch``
+        finishes whole on whichever executable it already read."""
+        with self._lock:
+            self._infer = infer_fn
+            if place is not None:
+                self._stager.rebind_place(place)
 
     # -- intake ------------------------------------------------------------
     def submit(self, arrays: Sequence[np.ndarray],
@@ -1125,8 +1145,10 @@ class InferenceServer:
                  port: Optional[object] = None,
                  adaptive: Optional[bool] = None,
                  default_deadline_ms: Optional[float] = None,
-                 batch_deadline_ms: Optional[float] = None):
+                 batch_deadline_ms: Optional[float] = None,
+                 tp: Optional[int] = None):
         from .fused_step import make_fused_infer
+        from .parallel.sharding import batch_shard_extent
 
         if not module.binded or not module.params_initialized:
             raise MXNetError("InferenceServer needs a bound, "
@@ -1134,10 +1156,20 @@ class InferenceServer:
         group = module._exec_group
         ex = group.executor
         mesh = getattr(group, "_mesh", None)
-        dp = int(mesh.size) if mesh is not None else 1
+        if tp is None:
+            tp = int(_env.get("MXNET_TPU_SERVE_TP") or 0)
+        self.tp = tp = max(1, int(tp))
+        if tp > 1:
+            mesh = self._tp_mesh(group, mesh, tp)
+        self._module = module
+        self._mesh = mesh
+        # rungs round to the BATCH-sharding extent, not the device
+        # count: on a (dp, tp) mesh only dp splits rows
+        dp = batch_shard_extent(mesh) if mesh is not None else 1
         self.dp = dp
         self._fused = make_fused_infer(ex, module._data_names,
                                        top_k=top_k, mesh=mesh)
+        self._top_k = top_k
         self._data_shapes = [d.shape for d in group.data_shapes]
         self.scheduler = BatchScheduler(
             self._fused, self._data_shapes, max_batch=max_batch,
@@ -1165,12 +1197,34 @@ class InferenceServer:
         self._closed = False
         self._close_lock = threading.Lock()
         _log.info("serving: buckets=%s max_wait_ms=%s adaptive=%s dp=%d "
-                  "slo_ms=%s%s",
+                  "tp=%d slo_ms=%s%s",
                   self.scheduler.buckets, self.scheduler.max_wait_ms,
-                  self.scheduler.adaptive, dp,
+                  self.scheduler.adaptive, dp, tp,
                   self.scheduler.slo_ms or "off",
                   " metrics on :%d" % self._metrics.port
                   if self._metrics else "")
+
+    @staticmethod
+    def _tp_mesh(group, mesh, tp: int):
+        """Factor the module's devices into the ``(dp, tp)`` serving
+        mesh: the same devices the group bound, reshaped so ``tp`` of
+        them split the model and the rest replicate/shard the batch.
+        Refuses (naming the knob) when ``tp`` does not divide the
+        device count — silently dropping devices would serve a
+        different capacity than the operator asked for."""
+        import jax
+
+        from .parallel.sharding import make_mesh
+
+        devices = (list(mesh.devices.flat) if mesh is not None
+                   else jax.devices()[:1])
+        n = len(devices)
+        if n % tp != 0:
+            raise MXNetError(
+                "MXNET_TPU_SERVE_TP=%d does not divide the %d-device "
+                "group; pick a tp that factors the device count"
+                % (tp, n))
+        return make_mesh({"dp": n // tp, "tp": tp}, devices=devices)
 
     # -- serving API -------------------------------------------------------
     @property
@@ -1211,19 +1265,71 @@ class InferenceServer:
                                     deadline_ms=deadline_ms,
                                     priority=priority)
 
-    def refresh_params(self):
-        """Repack after a weight update (e.g. module.set_params).
+    def refresh_params(self, host_params=None, digests=None):
+        """Repack after a weight update — full re-pack after
+        ``module.set_params`` (no arguments), or the delta-aware
+        checkpoint-streamed path when ``host_params`` (name -> host
+        ndarray) and optionally ``digests`` (the snapshot manifest's
+        per-param sha256) are given: only params whose digest differs
+        from the resident pack transfer
+        (:meth:`~mxnet_tpu.fused_step.FusedInfer.refresh_params`).
+
+        Either way the serving executable is first re-validated
+        against the module's CURRENT executor and mesh factoring — a
+        re-bind across meshes rebuilds the FusedInfer (and re-points
+        the scheduler + stager at it) instead of serving a stale
+        executable compiled for the old placement.
 
         Under an injected ``torn_swap`` fault the repack becomes
         non-atomic (half the pack, a sleep, the rest), so a dispatch
         inside the window would mix param versions — the fleet's
         drain-then-swap rolling update must mask that window, and the
         chaos tests prove it does."""
+        self._ensure_executable()
+        kw = {}
+        if host_params is not None:
+            kw = {"host_params": host_params, "digests": digests}
         if _faults.fires("torn_swap"):
             self._fused.refresh_params(
-                torn_ms=max(_faults.slow_ms(), 1.0))
+                torn_ms=max(_faults.slow_ms(), 1.0), **kw)
         else:
-            self._fused.refresh_params()
+            self._fused.refresh_params(**kw)
+
+    def refresh_from_snapshot(self, payload: dict):
+        """Delta-refresh from a :func:`mxnet_tpu.checkpoint.snapshot`
+        payload (the serve-while-training rollout path: training saves,
+        the fleet ships the directory, each drained replica streams the
+        changed params only)."""
+        self.refresh_params(host_params=payload.get("params") or {},
+                            digests=payload.get("param_digests"))
+
+    def _ensure_executable(self):
+        """Rebuild the FusedInfer when the module was re-bound onto a
+        different executor or mesh factoring since construction. The
+        scheduler's infer fn and the stager's place fn are re-pointed
+        atomically under the scheduler lock — in-flight dispatches
+        finish on the old executable, every later batch rides the new
+        one."""
+        group = self._module._exec_group
+        mesh = self._mesh
+        if self.tp <= 1:
+            mesh = getattr(group, "_mesh", None)
+        elif self._fused.stale_for(group.executor, self._mesh):
+            # re-bound under tp: refactor the new device set
+            mesh = self._tp_mesh(group, getattr(group, "_mesh", None),
+                                 self.tp)
+        if not self._fused.stale_for(group.executor, mesh):
+            return
+        from .fused_step import make_fused_infer
+
+        self._mesh = mesh
+        self._fused = make_fused_infer(group.executor,
+                                       self._module._data_names,
+                                       top_k=self._top_k, mesh=mesh)
+        self._data_shapes = [d.shape for d in group.data_shapes]
+        self.scheduler.rebind_infer(self._fused,
+                                    self._fused.place_batch)
+        _tel.inc("serve.executable_rebuilds")
 
     def health_info(self) -> dict:
         """Identity payload merged into /healthz by the tracing tier —
@@ -1247,6 +1353,7 @@ class InferenceServer:
         out["compiles"] = self.compiles
         out["buckets"] = list(self.buckets)
         out["dp"] = self.dp
+        out["tp"] = self.tp
         out["in_flight"] = self.scheduler.in_flight()
         return out
 

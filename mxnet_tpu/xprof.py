@@ -103,8 +103,9 @@ class CompileRecord:
 
     def to_dict(self) -> dict:
         d = {k: getattr(self, k) for k in self.__slots__}
-        d["signature"] = [[n, list(s), dt] for n, (s, dt, _w)
-                          in (self.signature or ())]
+        d["signature"] = [[n, list(a[0]), a[1]]
+                          + ([a[3]] if len(a) > 3 and a[3] else [])
+                          for n, a in (self.signature or ())]
         return d
 
 
@@ -139,15 +140,40 @@ def last_retrace_cause() -> Optional[str]:
 
 # -- argument signatures ----------------------------------------------------
 
+def _sharding_fp(x) -> Optional[str]:
+    """Stable placement fingerprint for a device array, or None for
+    host arrays. Part of the AOT-cache key: two calls with identical
+    shapes but different shardings (a server re-bound across mesh
+    factorings) must NOT share an executable — dispatching one
+    compiled for the old placement silently computes on wrong layouts.
+    """
+    sh = getattr(x, "sharding", None)
+    if sh is None:
+        return None
+    spec = getattr(sh, "spec", None)
+    mesh = getattr(sh, "mesh", None)
+    if spec is not None and mesh is not None:
+        axes = ",".join("%s=%d" % (a, int(mesh.shape[a]))
+                        for a in mesh.axis_names)
+        return "mesh(%s)%s" % (axes, spec)
+    dev = getattr(sh, "_device", None)
+    if dev is not None:
+        return "dev(%s)" % (dev,)
+    return type(sh).__name__
+
+
 def _aval(x) -> tuple:
     shape = tuple(int(d) for d in getattr(x, "shape", ()) or ())
     dtype = str(getattr(x, "dtype", type(x).__name__))
-    return (shape, dtype, bool(getattr(x, "weak_type", False)))
+    return (shape, dtype, bool(getattr(x, "weak_type", False)),
+            _sharding_fp(x))
 
 
 def _fmt_aval(a) -> str:
-    shape, dtype, _weak = a
-    return "(%s)%s" % (",".join(str(d) for d in shape), dtype)
+    shape, dtype = a[0], a[1]
+    placed = a[3] if len(a) > 3 and a[3] else ""
+    return "(%s)%s%s" % (",".join(str(d) for d in shape), dtype,
+                         "@" + placed if placed else "")
 
 
 def leaf_signature(args, arg_names=None) -> tuple:

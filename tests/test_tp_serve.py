@@ -1,0 +1,516 @@
+"""Tensor-sharded inference on the ``(dp, tp)`` serving mesh: params
+NamedSharding-split along each param's largest divisible dim,
+activations resharded in-graph by GSPMD inside the ONE non-donated
+dispatch. Covers the spec helpers and the mesh-extent bucket ladder,
+tp=2 vs tp=1 bit-identical parity in the exact-arithmetic regime, the
+one-dispatch/zero-retrace pin with tp armed, the per-device byte
+ratio, the placement-aware executable caches (xprof leaf signature +
+``FusedInfer.stale_for``), the delta-aware weight stream (equivalence
+to a full re-pack, digest skip accounting, the env bypass hatch, the
+snapshot round-trip) and rolling-swap purity under ``torn_swap`` with
+checkpoint-streamed weights."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, fleet, serving, telemetry, xprof
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.checkpoint import SnapshotStore, param_digest
+from mxnet_tpu.fleet import FleetRouter
+from mxnet_tpu.module import Module
+from mxnet_tpu.parallel.sharding import (batch_shard_extent, make_mesh,
+                                         tp_param_spec)
+from mxnet_tpu.serving import InferenceServer, bucket_ladder
+
+# exact-arithmetic regime (see test_serving.py): integer data x
+# half-integer weights, power-of-two sizes — every logit is a dyadic
+# rational, so dp-replicated vs tp-sharded parity is ``==``, not
+# ``allclose``. HID=16 so fc1 (weight (16, 8), bias (16,)) actually
+# SHARDS at tp=2.
+DIM = 8
+CLASSES = 4
+HID = 16
+BATCH = 8
+
+
+@pytest.fixture
+def tel():
+    telemetry.reset()
+    telemetry.enable()
+    yield telemetry
+    telemetry.reset()
+    telemetry.disable()
+
+
+@pytest.fixture
+def no_faults():
+    yield
+    faults.configure(None)
+
+
+def _mlp():
+    net = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(net, num_hidden=HID, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=CLASSES, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _seed_params(net, batch, seed=3):
+    arg_shapes, _, _ = net.infer_shape(data=(batch, DIM),
+                                       softmax_label=(batch,))
+    rng = np.random.RandomState(seed)
+    return {name: mx.nd.array(
+        (rng.randint(-2, 3, shape) * 0.5).astype(np.float32))
+        for name, shape in zip(net.list_arguments(), arg_shapes)
+        if name not in ("data", "softmax_label")}
+
+
+def _rows(n, seed=11):
+    rng = np.random.RandomState(seed)
+    return rng.randint(-3, 4, (n, DIM)).astype(np.float32)
+
+
+def _bound_module(n_dev=8, batch=BATCH):
+    net = _mlp()
+    ctx = [mx.cpu(i) for i in range(n_dev)] if n_dev > 1 else mx.cpu()
+    mod = Module(net, context=ctx)
+    mod.bind(data_shapes=[("data", (batch, DIM))],
+             label_shapes=[("softmax_label", (batch,))],
+             for_training=False)
+    mod.init_params(initializer=None,
+                    arg_params=_seed_params(net, batch), aux_params={})
+    return mod
+
+
+def _server(tp=0, n_dev=8, batch=BATCH, **kw):
+    kw.setdefault("max_wait_ms", 1.0)
+    kw.setdefault("slo_ms", 0.0)
+    return InferenceServer(_bound_module(n_dev, batch),
+                           max_batch=batch, tp=tp, **kw)
+
+
+def _dev0_bytes(fused):
+    dev0 = total = 0
+    for v in fused._param_vals:
+        total += int(v.nbytes)
+        for s in v.addressable_shards:
+            if s.device.id == 0:
+                dev0 += int(np.prod(s.data.shape)
+                            * s.data.dtype.itemsize)
+    return dev0, total
+
+
+# ---------------------------------------------------------------------------
+# spec helpers
+# ---------------------------------------------------------------------------
+
+@pytest.mark.multichip
+def test_tp_param_spec_picks_largest_divisible_dim():
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh({"dp": 4, "tp": 2}, devices=jax.devices()[:8])
+    assert tp_param_spec((16, 8), mesh) == P("tp", None)
+    assert tp_param_spec((8, 16), mesh) == P(None, "tp")
+    # a tie keeps the first largest dim
+    assert tp_param_spec((8, 8), mesh) == P("tp", None)
+    assert tp_param_spec((16,), mesh) == P("tp")
+    # nothing divides -> replicate rather than fail the bind
+    assert tp_param_spec((7, 3), mesh) == P()
+    assert tp_param_spec((), mesh) == P()
+    # no tp axis on the mesh -> not a tp placement at all
+    dp_mesh = make_mesh({"dp": 8}, devices=jax.devices()[:8])
+    assert tp_param_spec((16, 8), dp_mesh) is None
+
+
+@pytest.mark.multichip
+def test_batch_shard_extent_counts_data_axes_only():
+    import jax
+
+    devs = jax.devices()[:8]
+    assert batch_shard_extent(None) == 1
+    assert batch_shard_extent(make_mesh({"dp": 8}, devices=devs)) == 8
+    # fsdp is a DATA axis: batch shards over dp x fsdp
+    assert batch_shard_extent(
+        make_mesh({"dp": 2, "fsdp": 4}, devices=devs)) == 8
+    # tp is a MODEL axis: it splits params, never rows
+    assert batch_shard_extent(
+        make_mesh({"dp": 4, "tp": 2}, devices=devs)) == 4
+
+
+@pytest.mark.multichip
+def test_bucket_ladder_rounds_to_mesh_extent():
+    import jax
+
+    devs = jax.devices()[:8]
+    tp_mesh = make_mesh({"dp": 4, "tp": 2}, devices=devs)
+    # rungs round to dp=4 (the batch-sharding extent), NOT the
+    # 8-device group size — a tp mesh must not inflate the min rung
+    assert bucket_ladder(16, mesh=tp_mesh) == bucket_ladder(16, dp=4)
+    for r in bucket_ladder(16, mesh=tp_mesh):
+        assert r % 4 == 0
+    fsdp_mesh = make_mesh({"dp": 2, "fsdp": 4}, devices=devs)
+    assert bucket_ladder(16, mesh=fsdp_mesh) == bucket_ladder(16, dp=8)
+
+
+# ---------------------------------------------------------------------------
+# the (dp, tp) server
+# ---------------------------------------------------------------------------
+
+@pytest.mark.multichip
+def test_tp_server_refuses_indivisible_group():
+    mod = _bound_module()
+    with pytest.raises(MXNetError, match="does not divide"):
+        InferenceServer(mod, tp=3, max_wait_ms=1.0, slo_ms=0.0)
+
+
+@pytest.mark.multichip
+def test_tp_halves_per_device_param_bytes():
+    srv = _server(tp=2)
+    try:
+        # every param's largest dim divides by 2 (HID=16, CLASSES=4,
+        # BATCH=8), so device 0 holds exactly half the pack
+        dev0, total = _dev0_bytes(srv._fused)
+        assert total > 0
+        assert dev0 * 2 == total
+    finally:
+        srv.close()
+    rep = _server(tp=1)
+    try:
+        dev0, total = _dev0_bytes(rep._fused)
+        assert dev0 == total   # replicated baseline: the whole pack
+    finally:
+        rep.close()
+
+
+@pytest.mark.multichip
+def test_tp_parity_bit_identical():
+    """tp=2 must serve the same bits as the dp-replicated server: in
+    the exact-arithmetic regime the in-graph all-reduce adds exactly
+    representable partial sums, so ``==`` holds, not ``allclose``."""
+    X = _rows(6)
+    srv1 = _server(tp=1)
+    try:
+        ref = [np.asarray(srv1.infer([X[i:i + 1]])[0]) for i in
+               range(len(X))]
+    finally:
+        srv1.close()
+    srv2 = _server(tp=2)
+    try:
+        assert srv2.tp == 2 and srv2.dp == 4
+        for i in range(len(X)):
+            (out,) = srv2.infer([X[i:i + 1]])
+            assert np.array_equal(np.asarray(out), ref[i]), \
+                "tp=2 diverged from tp=1 on row %d" % i
+    finally:
+        srv2.close()
+
+
+@pytest.mark.multichip
+def test_tp_env_knob_one_dispatch_zero_retrace(tel, monkeypatch):
+    """With MXNET_TPU_SERVE_TP=2 armed via the env knob: every rung
+    warmed once, then steady state never recompiles and every served
+    batch is exactly one XLA dispatch (the collectives ride inside)."""
+    monkeypatch.setenv("MXNET_TPU_SERVE_TP", "2")
+    srv = _server(tp=None)
+    try:
+        assert srv.tp == 2
+        for b in srv.buckets:
+            srv._fused([np.zeros((b, DIM), np.float32)])
+        compiles = srv.compiles
+        assert compiles <= len(srv.buckets)
+        rc0 = tel.peek("infer.recompiles") or 0
+        di0 = tel.peek("infer.dispatches") or 0
+        ba0 = tel.peek("serve.batches") or 0
+        X = _rows(10)
+        for i in range(len(X)):
+            srv.infer([X[i:i + 1]])
+        rc1 = tel.peek("infer.recompiles") or 0
+        di1 = tel.peek("infer.dispatches") or 0
+        ba1 = tel.peek("serve.batches") or 0
+        assert rc1 == rc0, "steady state retraced under tp"
+        assert srv.compiles == compiles
+        batches = ba1 - ba0
+        assert batches > 0
+        assert di1 - di0 == batches   # exactly 1.0 dispatches/batch
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# placement-aware executable caches
+# ---------------------------------------------------------------------------
+
+@pytest.mark.multichip
+def test_leaf_signature_keys_on_sharding():
+    """Two arrays with identical shape/dtype but different placements
+    must produce different xprof leaf signatures — the AOT cache would
+    otherwise serve an executable compiled for the wrong layout."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()[:8]
+    mesh = make_mesh({"dp": 4, "tp": 2}, devices=devs)
+    host = np.zeros((16, 8), np.float32)
+    a = jax.device_put(host, NamedSharding(mesh, P("tp", None)))
+    b = jax.device_put(host, NamedSharding(mesh, P()))
+    sig_a = xprof.leaf_signature([a])
+    sig_b = xprof.leaf_signature([b])
+    assert sig_a != sig_b
+    # same placement -> same signature (no retrace churn)
+    a2 = jax.device_put(host, NamedSharding(mesh, P("tp", None)))
+    assert xprof.leaf_signature([a2]) == sig_a
+
+
+@pytest.mark.multichip
+def test_fused_infer_stale_for_mesh_factoring():
+    import jax
+
+    from mxnet_tpu.fused_step import make_fused_infer
+
+    devs = jax.devices()[:8]
+    mod = _bound_module()
+    ex = mod._exec_group.executor
+    dp_mesh = make_mesh({"dp": 8}, devices=devs)
+    tp_mesh = make_mesh({"dp": 4, "tp": 2}, devices=devs)
+    fused = make_fused_infer(ex, ["data"], mesh=dp_mesh)
+    assert not fused.stale_for(ex, dp_mesh)
+    # a re-bind across mesh factorings must MISS the cache
+    assert fused.stale_for(ex, tp_mesh)
+    assert fused.stale_for(ex, None)
+    # a different executor always misses, same mesh or not
+    mod2 = _bound_module()
+    assert fused.stale_for(mod2._exec_group.executor, dp_mesh)
+
+
+@pytest.mark.multichip
+def test_server_rebuilds_executable_on_rebind(tel):
+    """A module re-bound after the server was built serves through a
+    REBUILT executable, not the stale one compiled for the old
+    executor (serve.executable_rebuilds counts it)."""
+    srv = _server(tp=2)
+    try:
+        old_fused = srv._fused
+        # re-bind the module: new executor, same shapes
+        srv._module.bind(data_shapes=[("data", (BATCH, DIM))],
+                         label_shapes=[("softmax_label", (BATCH,))],
+                         for_training=False, force_rebind=True)
+        srv._module.init_params(
+            initializer=None,
+            arg_params=_seed_params(_mlp(), BATCH), aux_params={},
+            force_init=True)
+        rb0 = tel.peek("serve.executable_rebuilds") or 0
+        srv.refresh_params()
+        assert srv._fused is not old_fused
+        assert (tel.peek("serve.executable_rebuilds") or 0) == rb0 + 1
+        # the scheduler was re-pointed: serving still works
+        (out,) = srv.infer([_rows(1)])
+        assert np.asarray(out).shape == (1, CLASSES)
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# delta-aware weight streaming
+# ---------------------------------------------------------------------------
+
+def _host_pack(mod, scale_name=None, scale=2.0):
+    args, _ = mod.get_params()
+    host = {n: np.asarray(a.asnumpy()) for n, a in args.items()}
+    if scale_name is not None:
+        host[scale_name] = (host[scale_name] * scale).astype(np.float32)
+    return host
+
+
+@pytest.mark.multichip
+def test_delta_refresh_equivalent_to_full_repack(tel):
+    """Streaming one changed param through the delta path must serve
+    the same bits as a full set_params + re-pack — and move only that
+    param's bytes."""
+    X = _rows(4)
+    # full-repack arm: new weights via set_params + refresh_params()
+    srv_full = _server(tp=2)
+    try:
+        host2 = _host_pack(srv_full._module, scale_name="fc1_weight")
+        srv_full._module.set_params(
+            {n: mx.nd.array(v) for n, v in host2.items()}, {},
+            force_init=True)
+        srv_full.refresh_params()
+        ref = [np.asarray(srv_full.infer([X[i:i + 1]])[0])
+               for i in range(len(X))]
+    finally:
+        srv_full.close()
+    # delta arm: seed the resident digests, then stream the one change
+    srv = _server(tp=2)
+    try:
+        fused = srv._fused
+        host = _host_pack(srv._module)
+        digests = {n: param_digest(v) for n, v in host.items()}
+        srv.refresh_params(host_params=host, digests=digests)
+        assert fused.last_refresh_changed == len(host)   # seeding pass
+        host2 = dict(host)
+        host2["fc1_weight"] = (host["fc1_weight"] * 2.0).astype(
+            np.float32)
+        digests2 = dict(digests)
+        digests2["fc1_weight"] = param_digest(host2["fc1_weight"])
+        by0 = tel.peek("infer.refresh_bytes") or 0
+        srv.refresh_params(host_params=host2, digests=digests2)
+        assert fused.last_refresh_changed == 1
+        assert fused.last_refresh_skipped == len(host) - 1
+        assert fused.last_refresh_bytes == host2["fc1_weight"].nbytes
+        assert (tel.peek("infer.refresh_bytes") or 0) - by0 \
+            == host2["fc1_weight"].nbytes
+        for i in range(len(X)):
+            (out,) = srv.infer([X[i:i + 1]])
+            assert np.array_equal(np.asarray(out), ref[i]), \
+                "delta-refreshed server diverged on row %d" % i
+        # an identical re-send moves nothing at all
+        srv.refresh_params(host_params=host2, digests=digests2)
+        assert fused.last_refresh_changed == 0
+        assert fused.last_refresh_bytes == 0
+    finally:
+        srv.close()
+
+
+@pytest.mark.multichip
+def test_delta_refresh_env_bypass(tel, monkeypatch):
+    """MXNET_TPU_REFRESH_DELTA=0: the diff is bypassed and every
+    refresh moves the full pack (the escape hatch when digests are
+    suspect)."""
+    monkeypatch.setenv("MXNET_TPU_REFRESH_DELTA", "0")
+    srv = _server(tp=2)
+    try:
+        fused = srv._fused
+        host = _host_pack(srv._module)
+        digests = {n: param_digest(v) for n, v in host.items()}
+        srv.refresh_params(host_params=host, digests=digests)
+        srv.refresh_params(host_params=host, digests=digests)
+        assert fused.last_refresh_skipped == 0
+        assert fused.last_refresh_changed == len(host)
+    finally:
+        srv.close()
+
+
+@pytest.mark.multichip
+def test_refresh_from_snapshot_roundtrip(tmp_path):
+    """The serve-while-training rollout path end to end: a snapshot
+    payload (with the manifest's param_digests) saved to a
+    SnapshotStore streams into a live tp server via the fleet helper,
+    and the served bits match the snapshot's weights."""
+    srv = _server(tp=2)
+    try:
+        host2 = _host_pack(srv._module, scale_name="fc2_weight")
+        payload = {"format": 1, "params": host2,
+                   "param_digests": {n: param_digest(v)
+                                     for n, v in host2.items()},
+                   "step": 7}
+        store = SnapshotStore(str(tmp_path))
+        store.save(payload, reason="test")
+        entry = store._read_manifest()["snapshots"][-1]
+        assert entry["param_digests"] == payload["param_digests"]
+
+        X = _rows(3)
+        before = [np.asarray(srv.infer([X[i:i + 1]])[0])
+                  for i in range(len(X))]
+        fleet._refresh_from_store(srv, str(tmp_path))
+        assert srv._fused.last_refresh_changed > 0
+        after = [np.asarray(srv.infer([X[i:i + 1]])[0])
+                 for i in range(len(X))]
+        assert not all(np.array_equal(a, b)
+                       for a, b in zip(before, after))
+        # equivalence: a server built directly on the new weights
+        ref_srv = _server(tp=2)
+        try:
+            ref_srv._module.set_params(
+                {n: mx.nd.array(v) for n, v in host2.items()}, {},
+                force_init=True)
+            ref_srv.refresh_params()
+            for i in range(len(X)):
+                (out,) = ref_srv.infer([X[i:i + 1]])
+                assert np.array_equal(np.asarray(out), after[i])
+        finally:
+            ref_srv.close()
+    finally:
+        srv.close()
+
+
+def _tp_server_factory():
+    return _server(tp=0, n_dev=1)
+
+
+@pytest.mark.multichip
+def test_rolling_snapshot_swap_pure_under_torn_swap(tel, no_faults,
+                                                    tmp_path):
+    """The delta-streamed rolling swap keeps the fleet's purity
+    contract with torn_swap ARMED: weights ship via the snapshot
+    store (the only path subprocess/socket replicas accept), each
+    replica drains, delta-refreshes and rejoins — every response is
+    pure-old or pure-new, zero failed."""
+    faults.configure("torn_swap", slow_ms=30.0)
+    router = FleetRouter(fleet.in_process(_tp_server_factory), 2,
+                         deadline_ms=30000.0, attempt_timeout_ms=5000.0,
+                         retries=10, backoff_ms=2.0,
+                         health_interval_s=60.0)
+    try:
+        x = _rows(1, seed=55)
+        (old,) = router.infer([x])
+
+        # the shipped snapshot: doubled fc1 weights + manifest digests
+        ref = fleet.InProcReplica("ref", _tp_server_factory)
+        try:
+            host2 = _host_pack(ref._srv._module, scale_name="fc1_weight")
+            payload = {"format": 1, "params": host2,
+                       "param_digests": {n: param_digest(v)
+                                         for n, v in host2.items()}}
+            SnapshotStore(str(tmp_path)).save(payload, reason="test")
+            ref._srv.refresh_from_snapshot(payload)
+            (new,) = ref.submit([x]).wait(30)
+        finally:
+            ref.close()
+        assert not np.array_equal(old, new)
+
+        stop = threading.Event()
+        outs, errs = [], []
+
+        def load():
+            i = 0
+            while not stop.is_set():
+                try:
+                    (out,) = router.infer(
+                        [x], request_id="tpswap-%d" % i)
+                    outs.append(out)
+                except Exception as e:   # noqa: BLE001 (collected)
+                    errs.append(e)
+                i += 1
+
+        threads = [threading.Thread(target=load, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        router.refresh_params(snapshot_dir=str(tmp_path),
+                              drain_timeout_s=30.0)
+        time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join(30)
+
+        assert not errs, errs[:3]               # zero failed responses
+        n_old = sum(np.array_equal(o, old) for o in outs)
+        n_new = sum(np.array_equal(o, new) for o in outs)
+        assert n_old + n_new == len(outs), \
+            "mixed-version responses served: %d of %d" \
+            % (len(outs) - n_old - n_new, len(outs))
+        assert n_old > 0 and n_new > 0          # load straddled the swap
+        plan = faults._PLAN
+        assert plan is not None
+        assert plan.injected.get("torn_swap", 0) >= 2   # window existed
+        st = router.stats()
+        assert st["counters"]["param_swaps"] == 2
+    finally:
+        router.close()
+        faults.configure(None)

@@ -120,6 +120,15 @@ SPECS: List[Spec] = [
     Spec("serve_p99_ms", "SERVE_bench.json", "p99_ms", "lower"),
     Spec("serve_mean_batch_occupancy", "SERVE_bench.json",
          "mean_batch_occupancy", "higher"),
+    # tensor-parallel serving (bench.py serve --tp), merged under the
+    # ``tp`` key: goodput at tp>=2 with in-graph resharding, and the
+    # delta-aware weight stream — moved bytes over full-pack bytes
+    # when one param changed; a drift toward 1.0 means the diff
+    # stopped skipping resident shards
+    Spec("serve_tp_goodput_rps", "SERVE_bench.json",
+         "tp.goodput_rps", "higher"),
+    Spec("refresh_delta_bytes_ratio", "SERVE_bench.json",
+         "tp.refresh.delta_bytes_ratio", "lower"),
     Spec("fleet_goodput_rps", "FLEET_bench.json", "value", "higher"),
     Spec("fleet_socket_goodput_rps", "FLEET_bench.json",
          "socket.goodput_rps", "higher"),

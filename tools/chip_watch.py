@@ -381,6 +381,30 @@ def fire():
                        "chip_watch_stamp": stamp}, f)
             f.write("\n")
     _commit("serving goodput sweep", stamp)
+    # 7b. tensor-parallel serving tier (same 8-device group factored
+    # dp=4 x tp=2): per-device param byte ratio, the preflight
+    # bigger-than-one-chip proof, the in-graph collective bucket, and
+    # the delta-aware weight-stream record, MERGED under the "tp" key
+    # of SERVE_bench.json. On a wedged orchestrator the incomplete
+    # record is merged the same way — never clobbering the plain
+    # serving record stage 7 just wrote.
+    out = _run([py, os.path.join(REPO, "bench.py"), "serve",
+                "--tp"], 2000)
+    if out is None:
+        sv_path = os.path.join(REPO, "SERVE_bench.json")
+        try:
+            with open(sv_path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            rec = {}
+        rec["tp"] = {"metric": "serve_tp_goodput_rps", "value": 0,
+                     "incomplete": "chip_watch tp-serving stage timed "
+                                   "out or crashed",
+                     "chip_watch_stamp": stamp}
+        with open(sv_path, "w") as f:
+            json.dump(rec, f, indent=2, sort_keys=True)
+            f.write("\n")
+    _commit("tensor-parallel serving tier", stamp)
     # 8. autotune tier: the closed-loop kernel/config search on the
     # real chip -> AUTOTUNE_search.json + fenced rows appended to
     # MFU_EXPERIMENTS.jsonl + winners into .autotune_cache.json, so the

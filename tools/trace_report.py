@@ -547,6 +547,52 @@ def render_serve(rec):
         out.append("adaptive-wait trajectory (sampled):")
         out += _table(rows)
         out.append("")
+    tp = rec.get("tp") or {}
+    if tp:
+        if tp.get("incomplete"):
+            out.append("tensor-parallel serving: INCOMPLETE: %s"
+                       % tp["incomplete"])
+            out.append("")
+        else:
+            out.append(
+                "tensor-parallel serving (tp=%s dp=%s): %.1f req/s  "
+                "p50 %.2fms  p99 %.2fms  param bytes/device %.2fx  "
+                "dispatches/batch %s"
+                % (tp.get("tp"), tp.get("dp"),
+                   tp.get("goodput_rps") or 0, tp.get("p50_ms") or 0,
+                   tp.get("p99_ms") or 0,
+                   tp.get("param_bytes_ratio") or 0,
+                   tp.get("dispatches_per_request_batch")))
+            coll = tp.get("collective") or {}
+            by_op = coll.get("by_op") or {}
+            out.append(
+                "in-graph collectives: %s ops, %s bytes (%.1f%% of "
+                "HLO bytes)%s"
+                % (coll.get("count", 0), coll.get("bytes", 0),
+                   100.0 * (tp.get("collective_bytes_fraction") or 0),
+                   "  [%s]" % ", ".join(
+                       "%s x%d" % (op, v.get("count", 0))
+                       for op, v in sorted(by_op.items()))
+                   if by_op else ""))
+            pf = tp.get("preflight") or {}
+            if pf:
+                out.append(
+                    "preflight vs simulated %s-byte chip: replicated "
+                    "pack %s, tp pack fits (headroom %s bytes)"
+                    % (pf.get("simulated_limit_bytes"),
+                       "REFUSED" if pf.get("replicated_refused")
+                       else "fit (?)", pf.get("tp_headroom_bytes")))
+            rf = tp.get("refresh") or {}
+            if rf:
+                out.append(
+                    "delta weight stream: full re-pack %s bytes -> "
+                    "delta %s bytes (%.1f%% moved; %s changed / %s "
+                    "skipped params)"
+                    % (rf.get("full_bytes"), rf.get("delta_bytes"),
+                       100.0 * (rf.get("delta_bytes_ratio") or 0),
+                       rf.get("changed_params"),
+                       rf.get("skipped_params")))
+            out.append("")
     if rec.get("incomplete"):
         out.append("INCOMPLETE: %s" % rec["incomplete"])
     return "\n".join(out) + "\n"
